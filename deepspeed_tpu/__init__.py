@@ -1,0 +1,97 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with DeepSpeed's capabilities.
+
+This package re-implements the capability surface of DeepSpeed (reference:
+``deepspeed/__init__.py``) idiomatically for TPU: JAX/XLA for the compute path,
+GSPMD sharding (``jax.sharding``) for ZeRO/TP/EP/SP/PP, Pallas for hot kernels,
+and plain host Python/C++ for the runtime around it.
+
+The top-level API mirrors ``deepspeed.initialize()`` (reference
+``deepspeed/__init__.py:69``): the user keeps their model and training loop and
+receives an engine that subsumes optimizer, mixed precision, distributed
+communication, and checkpointing.
+"""
+
+__version__ = "0.1.0"
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu import comm as dist
+
+
+def __getattr__(name):
+    # engine import is deferred so `import deepspeed_tpu` stays cheap
+    if name == "DeepSpeedEngine":
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+        return DeepSpeedEngine
+    raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port=29500,
+               mesh=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               rng=None):
+    """Initialize the DeepSpeed-TPU engine.
+
+    Mirrors ``deepspeed.initialize`` (reference ``deepspeed/__init__.py:69``):
+    returns a tuple of ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+
+    Arguments:
+        args: an object whose ``deepspeed_config`` attribute (if present) names a
+            JSON config file, as in the reference CLI glue.
+        model: the model to wrap. Either a ``flax.linen.Module`` whose
+            ``__call__(params-batch)`` returns a scalar loss, or a pure callable
+            ``fn(params, batch, rng) -> loss``. See
+            ``deepspeed_tpu.runtime.engine.DeepSpeedEngine`` for the contract.
+        optimizer: optional user optimizer *name or callable factory* overriding
+            the config's ``optimizer`` section (reference allows a torch optimizer
+            instance; here the functional equivalent is a factory).
+        model_parameters: the initial parameter pytree (fp32). If ``None`` the
+            model must be a flax module and ``training_data`` must be provided so
+            the engine can initialize parameters from the first batch shape.
+        training_data: optional dataset (anything indexable / iterable of numpy
+            batches) wrapped into a ``DeepSpeedDataLoader``.
+        lr_scheduler: optional schedule name/callable overriding config.
+        mesh: optional ``jax.sharding.Mesh``; by default one is built from the
+            config's parallel sizes over all visible devices.
+        config: dict or path to a DeepSpeed-style JSON config.
+        config_params: legacy alias for ``config`` (reference
+            ``deepspeed/__init__.py:125``).
+        rng: optional ``jax.random.PRNGKey`` seed or key for dropout etc.
+
+    Returns:
+        tuple of (engine, optimizer_shim, training_dataloader, lr_scheduler_shim)
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config"):
+        config = args.deepspeed_config
+
+    engine = DeepSpeedEngine(config=config,
+                             model=model,
+                             optimizer=optimizer,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler,
+                             mesh=mesh,
+                             collate_fn=collate_fn,
+                             rng=rng)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Build an inference engine (mirrors ``deepspeed.init_inference``,
+    reference ``deepspeed/__init__.py:273``)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    cfg = DeepSpeedInferenceConfig.from_dict(config or {}, **kwargs)
+    return InferenceEngine(model, cfg)

@@ -1,0 +1,104 @@
+"""Accelerator abstraction.
+
+Mirrors the reference's ``DeepSpeedAccelerator`` abstract interface
+(``accelerator/abstract_accelerator.py:10``: device management, RNG, memory
+stats, dtype capabilities, communication backend name, op-builder factory) with
+TPU-appropriate semantics: devices are ``jax.Device`` objects, "streams" do not
+exist (XLA dispatch is async; synchronization is ``block_until_ready``), and
+memory stats come from PJRT ``memory_stats()``.
+"""
+
+import abc
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # --- device management (reference abstract_accelerator.py:34-58) ---
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    def set_device(self, device_index):
+        # XLA places data explicitly per-array; a mutable "current device" is
+        # advisory only.
+        self._current_device = device_index
+
+    def is_available(self):
+        return self.device_count() > 0
+
+    # --- RNG (reference :63-87) ---
+    @abc.abstractmethod
+    def manual_seed(self, seed):
+        ...
+
+    def initial_seed(self):
+        return getattr(self, "_seed", 0)
+
+    # --- synchronization (streams/events in the reference, :93-110) ---
+    def synchronize(self, device_index=None):
+        """Block until all dispatched work is done (CUDA stream-sync analog)."""
+        import jax
+        try:
+            (jax.device_put(0) + 0).block_until_ready()
+        except Exception:
+            pass
+
+    # --- memory (reference :115-163) ---
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None):
+        ...
+
+    def memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    # --- dtype capabilities (reference :168-181) ---
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    # --- comm backend (reference :201) ---
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    # --- op builder hooks (reference :270-284) ---
+    @abc.abstractmethod
+    def create_op_builder(self, op_name):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, op_name):
+        ...

@@ -1,0 +1,121 @@
+"""TPU accelerator — the north-star seam from the reference's design.
+
+The reference routes *all* device access through ``get_accelerator()``
+(``accelerator/cuda_accelerator.py`` for CUDA); this is the TPU implementation
+slot the reference left open (SURVEY §2.5). Devices come from ``jax.devices()``;
+memory stats from PJRT; the communication backend name is "xla" (collectives are
+compiled into programs over the mesh rather than issued by a comm library).
+"""
+
+import os
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+        self._seed = 0
+        self._current_device = 0
+
+    def _devices(self):
+        import jax
+        return jax.local_devices()
+
+    # --- device management ---
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        devs = self._devices()
+        return devs[device_index if device_index is not None else self._current_device]
+
+    def device_count(self):
+        return len(self._devices())
+
+    def global_device_count(self):
+        import jax
+        return jax.device_count()
+
+    def current_device(self):
+        return self._current_device
+
+    def current_device_name(self):
+        return self.device_name(self._current_device)
+
+    # --- RNG ---
+    def manual_seed(self, seed):
+        self._seed = seed
+
+    def manual_seed_all(self, seed):
+        self._seed = seed
+
+    def prng_key(self):
+        import jax
+        return jax.random.PRNGKey(self._seed)
+
+    # --- memory ---
+    def memory_stats(self, device_index=None):
+        try:
+            dev = self.device(device_index)
+            stats = dev.memory_stats()
+            return stats or {}
+        except Exception:
+            return {}
+
+    def empty_cache(self):
+        # XLA manages HBM arena itself; garbage-collect python-side references.
+        import gc
+        gc.collect()
+
+    def reset_peak_memory_stats(self, device_index=None):
+        pass  # PJRT exposes no reset; peak is monotonic per-process
+
+    # --- dtype caps ---
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        # TPUs compute natively in bf16; fp16 works but has no hardware
+        # loss-scale advantage. We still support the fp16 engine path.
+        return True
+
+    def is_triton_supported(self):
+        return False
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.int32]
+
+    def is_fp8_supported(self):
+        import jax.numpy as jnp
+        return hasattr(jnp, "float8_e4m3fn")
+
+    # --- platform info ---
+    def on_tpu(self):
+        import jax
+        try:
+            return jax.devices()[0].platform in ("tpu", "axon")
+        except Exception:
+            return False
+
+    def device_kind(self):
+        import jax
+        try:
+            return jax.devices()[0].device_kind
+        except Exception:
+            return "unknown"
+
+    # --- op builders (reference op_builder factory hooks) ---
+    def create_op_builder(self, op_name):
+        builder = self.get_op_builder(op_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, op_name):
+        from deepspeed_tpu.ops.registry import get_op_builder
+        return get_op_builder(op_name)

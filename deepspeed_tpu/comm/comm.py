@@ -1,0 +1,271 @@
+"""Communication shim — the analog of ``deepspeed/comm/comm.py``.
+
+The reference exposes module-level collectives over a global backend object
+(``comm/comm.py:222-520``) wrapping torch.distributed/NCCL. On TPU there are two
+communication contexts, and this module serves both under the same verb names:
+
+1. **In-trace** (inside ``jit``/``shard_map``): collectives are ``jax.lax`` ops
+   over a named mesh axis and are compiled into the program; these are the hot
+   paths and map 1:1 — all_reduce→psum, reduce_scatter→psum_scatter,
+   all_gather→all_gather, all_to_all(_single)→all_to_all, send/recv→ppermute.
+   Pass ``axis_name`` (str or tuple) instead of the reference's ``group``.
+
+2. **Host-level** (outside jit): process bring-up and occasional scalar syncs.
+   ``init_distributed`` mirrors ``comm/comm.py:604`` (env discovery →
+   ``jax.distributed.initialize``); ``get_rank``/``get_world_size`` are process
+   rank/count; ``barrier`` synchronizes processes.
+
+Every verb is wrapped by ``timed_op`` feeding the comms logger, mirroring
+``comm/comm.py:101``.
+"""
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ReduceOp:
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+    PRODUCT = "prod"
+
+
+_comms_logger = None
+_initialized = False
+
+
+def configure(comms_config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None):
+    """Configure comms logging (reference ``comm/comm.py`` configure)."""
+    global _comms_logger
+    from deepspeed_tpu.utils.comms_logging import CommsLogger
+    if _comms_logger is None:
+        _comms_logger = CommsLogger()
+    _comms_logger.configure(comms_config=comms_config, enabled=enabled,
+                            prof_all=prof_all, prof_ops=prof_ops, verbose=verbose)
+
+
+def get_comms_logger():
+    global _comms_logger
+    if _comms_logger is None:
+        from deepspeed_tpu.utils.comms_logging import CommsLogger
+        _comms_logger = CommsLogger()
+    return _comms_logger
+
+
+def _in_trace(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def timed_op(fn):
+    """Profiling wrapper (reference ``comm/comm.py:101``). In-trace calls are
+    never timed (they compile into the program); host-level calls are timed when
+    the comms logger is enabled."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        log = _comms_logger
+        tensor = args[0] if args else kwargs.get("tensor")
+        if log is None or not log.enabled or _in_trace(tensor):
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        result = fn(*args, **kwargs)
+        try:
+            jax.block_until_ready(result)
+        except Exception:
+            pass
+        elapsed = time.perf_counter() - t0
+        nbytes = 0
+        try:
+            nbytes = tensor.size * tensor.dtype.itemsize
+        except Exception:
+            pass
+        log.append(fn.__name__, kwargs.get("log_name", fn.__name__), elapsed, nbytes)
+        return result
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# In-trace collectives (jax.lax over mesh axes)
+# ---------------------------------------------------------------------------
+
+@timed_op
+def all_reduce(tensor, op=ReduceOp.SUM, axis_name="dp", **kwargs):
+    """reference ``comm/comm.py:483`` all_reduce."""
+    if op == ReduceOp.SUM:
+        return lax.psum(tensor, axis_name)
+    if op == ReduceOp.AVG:
+        return lax.pmean(tensor, axis_name)
+    if op == ReduceOp.MAX:
+        return lax.pmax(tensor, axis_name)
+    if op == ReduceOp.MIN:
+        return lax.pmin(tensor, axis_name)
+    if op == ReduceOp.PRODUCT:
+        return jnp.exp(lax.psum(jnp.log(tensor), axis_name))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+inference_all_reduce = all_reduce  # reference comm.py:500
+
+
+@timed_op
+def all_gather(tensor, axis_name="dp", axis=0, tiled=True, **kwargs):
+    """reference ``comm/comm.py:228`` all_gather / :297 all_gather_into_tensor.
+
+    ``tiled=True`` concatenates along ``axis`` (the into_tensor form);
+    ``tiled=False`` stacks a new leading axis."""
+    return lax.all_gather(tensor, axis_name, axis=axis, tiled=tiled)
+
+
+all_gather_into_tensor = all_gather
+
+
+@timed_op
+def reduce_scatter(tensor, op=ReduceOp.SUM, axis_name="dp", scatter_dim=0, **kwargs):
+    """reference ``comm/comm.py:446`` reduce_scatter / :246 reduce_scatter_fn.
+
+    psum_scatter splits along ``scatter_dim`` across the axis; with
+    ``op=AVG`` divides by the axis size."""
+    if scatter_dim != 0:
+        tensor = jnp.moveaxis(tensor, scatter_dim, 0)
+    out = lax.psum_scatter(tensor, axis_name, scatter_dimension=0, tiled=True)
+    if scatter_dim != 0:
+        out = jnp.moveaxis(out, 0, scatter_dim)
+    if op == ReduceOp.AVG:
+        out = out / lax.axis_size(axis_name)
+    return out
+
+
+reduce_scatter_tensor = reduce_scatter
+
+
+@timed_op
+def all_to_all_single(tensor, axis_name="sp", split_axis=0, concat_axis=0, tiled=True, **kwargs):
+    """reference ``comm/comm.py:331`` all_to_all_single."""
+    return lax.all_to_all(tensor, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+@timed_op
+def all_to_all(tensors, axis_name="sp", **kwargs):
+    """reference ``comm/comm.py:350`` all_to_all (list form)."""
+    stacked = jnp.stack(tensors, axis=0)
+    out = lax.all_to_all(stacked, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    n = lax.axis_size(axis_name)
+    return [out[i] for i in range(n)]
+
+
+@timed_op
+def broadcast(tensor, src=0, axis_name="dp", **kwargs):
+    """reference ``comm/comm.py:222`` broadcast — keep src's value on all ranks."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return lax.psum(masked, axis_name)
+
+
+@timed_op
+def reduce(tensor, dst=0, op=ReduceOp.SUM, axis_name="dp", **kwargs):
+    """reference ``comm/comm.py:433`` reduce — SPMD has no single-destination
+    reduce; result is materialized everywhere (dst kept for API parity)."""
+    return all_reduce(tensor, op=op, axis_name=axis_name)
+
+
+def send_recv(tensor, perm, axis_name="pp"):
+    """Point-to-point via collective permute (reference ``runtime/pipe/p2p.py:46,67``
+    send/recv pairs). ``perm`` is a list of (src, dst) pairs along ``axis_name``."""
+    return lax.ppermute(tensor, axis_name, perm)
+
+
+def send_next(tensor, axis_name="pp"):
+    n = lax.axis_size(axis_name)
+    return lax.ppermute(tensor, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def send_prev(tensor, axis_name="pp"):
+    n = lax.axis_size(axis_name)
+    return lax.ppermute(tensor, axis_name, [(i, (i - 1) % n) for i in range(n)])
+
+
+def axis_rank(axis_name):
+    return lax.axis_index(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Host-level process management
+# ---------------------------------------------------------------------------
+
+def init_distributed(dist_backend=None,
+                     auto_mpi_discovery=True,
+                     distributed_port=29500,
+                     verbose=True,
+                     timeout=None,
+                     init_method=None,
+                     dist_init_required=None,
+                     config=None,
+                     rank=-1,
+                     world_size=-1):
+    """Bring up multi-host JAX (reference ``comm/comm.py:604`` init_distributed).
+
+    The reference discovers ranks from MPI/AzureML/SLURM env (:650-771) and
+    calls torch.distributed.init_process_group; here the equivalent is
+    ``jax.distributed.initialize`` which reads the coordinator address. On a
+    single host this is a no-op.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator = os.environ.get("DST_COORDINATOR_ADDRESS") or os.environ.get("MASTER_ADDR")
+    num_proc = int(os.environ.get("DST_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1")))
+    proc_id = int(os.environ.get("DST_PROCESS_ID", os.environ.get("RANK", "0")))
+    # SLURM discovery (reference comm.py:673 mpi_discovery analog)
+    if coordinator is None and "SLURM_JOB_NODELIST" in os.environ:
+        num_proc = int(os.environ.get("SLURM_NTASKS", "1"))
+        proc_id = int(os.environ.get("SLURM_PROCID", "0"))
+        coordinator = os.environ["SLURM_JOB_NODELIST"].split(",")[0]
+    if coordinator is not None and num_proc > 1:
+        if verbose:
+            logger.info(f"init_distributed: coordinator={coordinator}:{distributed_port} "
+                        f"process {proc_id}/{num_proc}")
+        jax.distributed.initialize(coordinator_address=f"{coordinator}:{distributed_port}",
+                                   num_processes=num_proc,
+                                   process_id=proc_id)
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return jax.process_count()
+
+
+def get_local_rank():
+    return int(os.environ.get("DST_LOCAL_RANK", os.environ.get("LOCAL_RANK", "0")))
+
+
+def barrier(group=None, **kwargs):
+    """Host-level process barrier (reference ``comm/comm.py:406``)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("deepspeed_tpu_barrier")
+
+
+monitored_barrier = barrier
+
+
+def log_summary(show_straggler=False):
+    """Print the comms-log summary (reference ``comm/comm.py`` log_summary)."""
+    get_comms_logger().log_all()

@@ -1,0 +1,81 @@
+"""Op registry — the analog of ``op_builder/`` (reference ``op_builder/builder.py:108``).
+
+The reference JIT-compiles CUDA extensions per accelerator with compatibility
+probing (``is_compatible``, ``builder.py:250``) and a ``load()`` entry point.
+Here every op has a pure-jnp reference implementation and optionally a Pallas
+TPU kernel; ``load()`` returns the best available implementation, and
+``is_compatible`` reports whether the fast path can run on the current backend.
+"""
+
+from deepspeed_tpu.utils.logging import logger
+
+_REGISTRY = {}
+
+
+class OpBuilder:
+    """Base op builder: name + jnp fallback + optional pallas impl."""
+
+    NAME = None
+
+    def __init__(self):
+        self._loaded = None
+
+    def is_compatible(self, verbose=False):
+        try:
+            import jax
+            plat = jax.devices()[0].platform
+        except Exception:
+            return False
+        ok = self.pallas_available() and plat in ("tpu", "axon")
+        if verbose and not ok:
+            logger.info(f"op {self.NAME}: falling back to pure-XLA implementation")
+        return ok
+
+    def pallas_available(self):
+        return self.pallas_impl() is not None
+
+    def pallas_impl(self):
+        return None
+
+    def reference_impl(self):
+        raise NotImplementedError
+
+    def load(self, verbose=False):
+        """Return the best implementation (reference ``builder.py:463`` load)."""
+        if self._loaded is None:
+            if self.is_compatible(verbose=verbose):
+                self._loaded = self.pallas_impl()
+            else:
+                self._loaded = self.reference_impl()
+        return self._loaded
+
+
+def register_op_builder(cls):
+    assert cls.NAME is not None
+    _REGISTRY[cls.NAME] = cls
+    return cls
+
+
+def get_op_builder(name):
+    if not _REGISTRY:
+        _populate()
+    return _REGISTRY.get(name)
+
+
+def available_ops():
+    if not _REGISTRY:
+        _populate()
+    return sorted(_REGISTRY)
+
+
+def _populate():
+    # import modules for registration side effects
+    import deepspeed_tpu.ops.adam  # noqa: F401
+    try:
+        import deepspeed_tpu.ops.flash_attention  # noqa: F401
+    except Exception:
+        pass
+    try:
+        import deepspeed_tpu.ops.quantizer  # noqa: F401
+    except Exception:
+        pass

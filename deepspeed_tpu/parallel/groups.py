@@ -1,0 +1,78 @@
+"""Global topology registry — the analog of ``deepspeed/utils/groups.py``.
+
+The reference materializes torch process groups per parallelism axis
+(``groups.initialize(ep_size, mpu)``, ``utils/groups.py:52``; getters at
+:397-487). On TPU a "group" is a named mesh axis; this module keeps the
+process-wide ``MeshTopology`` and exposes the same getter surface.
+"""
+
+from deepspeed_tpu.parallel.topology import MeshTopology, build_topology
+
+_TOPOLOGY = None
+
+
+def initialize(ep_size=1, mesh_topology=None, config=None, devices=None):
+    """Install the global topology (reference ``utils/groups.py:52`` initialize)."""
+    global _TOPOLOGY
+    if mesh_topology is not None:
+        _TOPOLOGY = mesh_topology
+    else:
+        _TOPOLOGY = build_topology(config=config, devices=devices)
+        if ep_size > 1 and _TOPOLOGY.ep_size == 1:
+            _TOPOLOGY = MeshTopology(pp=_TOPOLOGY.pp_size,
+                                     dp=-1,
+                                     ep=ep_size,
+                                     sp=_TOPOLOGY.sp_size,
+                                     tp=_TOPOLOGY.tp_size,
+                                     devices=devices)
+    return _TOPOLOGY
+
+
+def get_topology():
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        _TOPOLOGY = build_topology()
+    return _TOPOLOGY
+
+
+def reset():
+    global _TOPOLOGY
+    _TOPOLOGY = None
+
+
+def get_mesh():
+    return get_topology().mesh
+
+
+# --- getter surface mirroring utils/groups.py:397-487 ---
+def get_data_parallel_world_size():
+    return get_topology().data_parallel_size
+
+
+def get_model_parallel_world_size():
+    return get_topology().tp_size
+
+
+def get_tensor_model_parallel_world_size():
+    return get_topology().tp_size
+
+
+def get_expert_parallel_world_size(group_name=None):
+    return get_topology().ep_size
+
+
+def get_expert_data_parallel_world_size(group_name=None):
+    t = get_topology()
+    return t.dp_size * t.sp_size
+
+
+def get_sequence_parallel_world_size():
+    return get_topology().sp_size
+
+
+def get_pipe_parallel_world_size():
+    return get_topology().pp_size
+
+
+def get_world_size():
+    return get_topology().world_size()

@@ -1,0 +1,119 @@
+"""Device-mesh topology.
+
+Mirrors the reference's ``ProcessTopology`` / ``PipeModelDataParallelTopology``
+(``runtime/pipe/topology.py:12,244``) but TPU-native: instead of building
+torch.distributed process groups per axis, we build ONE ``jax.sharding.Mesh``
+whose named axes carry every parallelism form, and XLA's GSPMD partitioner
+inserts collectives along those axes.
+
+Canonical axis order (outermost → innermost):
+
+    ("pp", "dp", "ep", "sp", "tp")
+
+- ``pp``  pipeline stages — outermost so stages map to DCN/slice boundaries
+- ``dp``  pure data parallel (ZeRO shard axis together with ep+sp)
+- ``ep``  expert parallel — carved out of the data-parallel world, exactly as the
+  reference forms expert groups inside DP (``utils/groups.py:114,254``)
+- ``sp``  Ulysses sequence parallel (``deepspeed/sequence/layer.py``)
+- ``tp``  tensor parallel — innermost so its collectives ride the fastest ICI links
+
+Data-like axes: the global batch is sharded over ``(dp, ep)`` and the sequence
+over ``sp``; gradients of shared (non-expert) parameters must therefore be
+reduced over all of ``(dp, ep, sp)`` — those are also the ZeRO partition axes.
+"""
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+
+class MeshTopology:
+
+    def __init__(self, pp=1, dp=-1, ep=1, sp=1, tp=1, devices=None):
+        import jax
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        fixed = pp * ep * sp * tp
+        if dp == -1:
+            assert n % fixed == 0, (
+                f"device count {n} not divisible by pp*ep*sp*tp={fixed}")
+            dp = n // fixed
+        assert pp * dp * ep * sp * tp == n, (
+            f"mesh {pp}x{dp}x{ep}x{sp}x{tp} != device count {n}")
+        self.pp_size, self.dp_size, self.ep_size, self.sp_size, self.tp_size = pp, dp, ep, sp, tp
+        self._sizes = dict(pp=pp, dp=dp, ep=ep, sp=sp, tp=tp)
+        dev_array = np.asarray(devices).reshape(pp, dp, ep, sp, tp)
+        self.mesh = jax.sharding.Mesh(dev_array, AXIS_ORDER)
+
+    @property
+    def axis_names(self):
+        return AXIS_ORDER
+
+    def get_dim(self, axis):
+        return self._sizes[axis]
+
+    @property
+    def zero_axes(self):
+        """Axes over which ZeRO partitions params/grads/optimizer state; the
+        reference's DP world (``groups._get_data_parallel_group``) is the
+        product of these."""
+        return ("dp", "ep", "sp")
+
+    @property
+    def data_parallel_size(self):
+        return self.dp_size * self.ep_size * self.sp_size
+
+    @property
+    def batch_spec(self):
+        """PartitionSpec for a [batch, seq, ...] input."""
+        from jax.sharding import PartitionSpec as P
+        return P(("dp", "ep"), "sp")
+
+    def batch_sharding(self):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, self.batch_spec)
+
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def sharding(self, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(*spec))
+
+    # --- coordinate math, mirroring ProcessTopology (topology.py:12) ---
+    def world_size(self):
+        return int(np.prod([self._sizes[a] for a in AXIS_ORDER]))
+
+    def get_rank(self, **coords):
+        """Flat rank from axis coordinates (reference ``ProcessTopology.get_rank``)."""
+        full = [coords.get(a, 0) for a in AXIS_ORDER]
+        dims = [self._sizes[a] for a in AXIS_ORDER]
+        rank = 0
+        for c, d in zip(full, dims):
+            rank = rank * d + c
+        return rank
+
+    def get_coord(self, rank):
+        dims = [self._sizes[a] for a in AXIS_ORDER]
+        coords = {}
+        for a, d in zip(reversed(AXIS_ORDER), reversed(dims)):
+            coords[a] = rank % d
+            rank //= d
+        return {a: coords[a] for a in AXIS_ORDER}
+
+    def __repr__(self):
+        return ("MeshTopology(" +
+                ", ".join(f"{a}={self._sizes[a]}" for a in AXIS_ORDER) + ")")
+
+
+def build_topology(config=None, devices=None):
+    """Build a MeshTopology from a DeepSpeedConfig-like object (or defaults)."""
+    pp = ep = sp = tp = 1
+    if config is not None:
+        pp = getattr(config, "pipeline_stages", 1) or 1
+        ep = getattr(config, "expert_parallel_size", 1) or 1
+        sp = getattr(config, "sequence_parallel_size", 1) or 1
+        tp = getattr(config, "tensor_parallel_size", 1) or 1
+    return MeshTopology(pp=pp, dp=-1, ep=ep, sp=sp, tp=tp, devices=devices)

@@ -1,0 +1,256 @@
+"""DeepSpeed-style JSON config system.
+
+Mirrors reference ``deepspeed/runtime/config.py``: a single JSON/dict is parsed
+into ~20 typed sub-configs (``DeepSpeedConfig._initialize_params``,
+``config.py:798``) with the train-batch triple auto-derivation
+(train_batch = micro_batch × grad_accum × data_parallel_size, ``config.py:789``).
+"""
+
+import json
+import os
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel, get_scalar_param
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """reference fp16 dict (``runtime/config.py`` get_fp16_enabled etc.)."""
+    enabled = False
+    auto_cast = False
+    loss_scale = 0.0  # 0 => dynamic
+    initial_scale_power = 16
+    loss_scale_window = 1000
+    hysteresis = 2
+    consecutive_hysteresis = False
+    min_loss_scale = 1.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled = False
+    immediate_grad_update = False
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype = None  # None => fp32
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type = "AdamW"
+    params = {}
+    legacy_fusion = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type = None
+    params = {}
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """reference ``runtime/activation_checkpointing/config.py``; on TPU this
+    selects the ``jax.checkpoint`` (remat) policy applied to scanned blocks."""
+    partition_activations = False
+    cpu_checkpointing = False
+    contiguous_memory_optimization = False
+    number_checkpoints = None
+    synchronize_checkpoint_boundary = False
+    profile = False
+    # TPU-specific: named jax.checkpoint policy ("nothing" | "dots" | "everything")
+    policy = "everything"
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages = 1
+    partition_method = "parameters"
+    seed_layers = False
+    activation_checkpoint_interval = 0
+
+
+class TensorParallelConfig(DeepSpeedConfigModel):
+    tp_size = 1
+    mpu = None
+
+
+class MonitorWriterConfig(DeepSpeedConfigModel):
+    enabled = False
+    output_path = ""
+    job_name = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled = False
+    group = None
+    team = None
+    project = "deepspeed_tpu"
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled = False
+    verbose = False
+    prof_all = True
+    prof_ops = []
+    debug = False
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled = False
+    recompute_fwd_factor = 0.0
+    profile_step = 1
+    module_depth = -1
+    top_modules = 1
+    detailed = True
+    output_file = None
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation = "Warn"
+    load_universal = False
+    use_node_local_storage = False
+    parallel_write = {}
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled = False
+    max_train_batch_size = 2000
+    micro_batch_sizes = [2, 4, 6]
+    min_gpus = 1
+    max_gpus = 10000
+    min_time = 0
+    version = 0.2
+    ignore_non_elastic_batch_info = False
+    prefer_larger_batch = True
+
+
+class CompileConfig(DeepSpeedConfigModel):
+    """reference ``runtime/compiler.py`` — on TPU everything is jitted; these
+    knobs control donation and jit options."""
+    enabled = True
+    backend = "xla"
+    kwargs = {}
+    donate_state = True
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled = False
+    start_profile_step = 3
+    end_profile_step = 5
+    metric = "throughput"
+    fast = True
+    max_train_batch_size = None
+    mp_size = 1
+    num_tuning_micro_batch_sizes = 3
+    tuner_type = "gridsearch"
+    tuner_early_stopping = 5
+    tuner_num_trials = 50
+
+
+class MoEConfig(DeepSpeedConfigModel):
+    enabled = False
+    ep_size = 1
+    moe_param_group = False
+    use_residual = False
+
+
+class DeepSpeedConfig:
+
+    def __init__(self, config, mpu=None, mesh_topology=None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise FileNotFoundError(f"DeepSpeed config file not found: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(f)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        elif config is None:
+            self._param_dict = {}
+        else:
+            raise ValueError(f"Expected dict or path for config, got {type(config)}")
+        self.mesh_topology = mesh_topology
+        self._initialize_params(self._param_dict)
+        self._do_sanity_check()
+
+    # mirrors reference config.py:798 _initialize_params
+    def _initialize_params(self, pd):
+        self.train_batch_size = get_scalar_param(pd, C.TRAIN_BATCH_SIZE, None)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, None)
+        self.gradient_accumulation_steps = get_scalar_param(pd, C.GRADIENT_ACCUMULATION_STEPS, None)
+        self.steps_per_print = get_scalar_param(pd, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN, False)
+        self.dump_state = get_scalar_param(pd, C.DUMP_STATE, False)
+        self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING, 0.0)
+        self.prescale_gradients = get_scalar_param(pd, C.PRESCALE_GRADIENTS, False)
+        self.gradient_predivide_factor = get_scalar_param(pd, C.GRADIENT_PREDIVIDE_FACTOR, 1.0)
+        self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS, False)
+
+        self.optimizer = OptimizerConfig(pd.get(C.OPTIMIZER, {}))
+        self.scheduler = SchedulerConfig(pd.get(C.SCHEDULER, {}))
+        self.fp16 = FP16Config(pd.get(C.FP16, {}))
+        self.bf16 = BF16Config(pd.get(C.BF16, {}))
+        self.data_types = DataTypesConfig(pd.get(C.DATA_TYPES, {}))
+        self.zero_config = DeepSpeedZeroConfig(pd.get(C.ZERO_OPTIMIZATION, {}))
+        self.activation_checkpointing = ActivationCheckpointingConfig(pd.get(C.ACTIVATION_CHECKPOINTING, {}))
+        self.pipeline = PipelineConfig(pd.get(C.PIPELINE, {}))
+        self.tensor_parallel = TensorParallelConfig(pd.get(C.TENSOR_PARALLEL, {}))
+        self.sequence_parallel_size = get_scalar_param(pd, C.SEQUENCE_PARALLEL_SIZE, 1)
+        self.moe = MoEConfig(pd.get("moe", {}))
+        self.expert_parallel_size = get_scalar_param(pd, C.EXPERT_PARALLEL_SIZE, self.moe.ep_size)
+        self.comms_config = CommsLoggerConfig(pd.get(C.COMMS_LOGGER, {}))
+        self.monitor_config_tb = MonitorWriterConfig(pd.get(C.MONITOR_TENSORBOARD, {}))
+        self.monitor_config_csv = MonitorWriterConfig(pd.get(C.MONITOR_CSV, {}))
+        self.monitor_config_wandb = WandbConfig(pd.get(C.MONITOR_WANDB, {}))
+        self.flops_profiler_config = FlopsProfilerConfig(pd.get(C.FLOPS_PROFILER, {}))
+        self.checkpoint_config = CheckpointConfig(pd.get(C.CHECKPOINT, {}))
+        self.elasticity_config = ElasticityConfig(pd.get(C.ELASTICITY, {}))
+        self.compile_config = CompileConfig(pd.get(C.COMPILE, {}))
+        self.autotuning_config = AutotuningConfig(pd.get(C.AUTOTUNING, {}))
+        self.seed = get_scalar_param(pd, "seed", 42)
+
+        # convenience views used by topology building
+        self.pipeline_stages = self.pipeline.stages
+        self.tensor_parallel_size = self.tensor_parallel.tp_size
+
+        self.zero_enabled = self.zero_config.stage > 0
+        self.zero_optimization_stage = self.zero_config.stage
+
+    def resolve_batch_params(self, dp_world_size):
+        """Auto-derive the train-batch triple (reference ``config.py:789-791``)."""
+        tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                       self.gradient_accumulation_steps)
+        if tb is not None and mb is not None and gas is not None:
+            pass
+        elif tb is not None and mb is not None:
+            gas = tb // (mb * dp_world_size)
+        elif tb is not None and gas is not None:
+            mb = tb // (gas * dp_world_size)
+        elif mb is not None and gas is not None:
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            gas = 1
+            mb = tb // dp_world_size
+        elif mb is not None:
+            gas = 1
+            tb = mb * dp_world_size
+        else:
+            raise ValueError(
+                "At least one of train_batch_size / train_micro_batch_size_per_gpu "
+                "must be set in the config")
+        if tb != mb * gas * dp_world_size:
+            raise ValueError(
+                f"Check batch related parameters. train_batch_size is not equal to "
+                f"micro_batch_per_gpu * gradient_acc_step * world_size "
+                f"{tb} != {mb} * {gas} * {dp_world_size}")
+        if mb < 1 or gas < 1:
+            raise ValueError(f"Derived invalid batch params: micro={mb} gas={gas}")
+        self.train_batch_size, self.train_micro_batch_size_per_gpu, \
+            self.gradient_accumulation_steps = tb, mb, gas
+        return tb, mb, gas
+
+    def _do_sanity_check(self):
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.zero_config.stage not in (0, 1, 2, 3):
+            raise ValueError(f"invalid ZeRO stage {self.zero_config.stage}")
+
+    def print_config(self):
+        logger.info(f"DeepSpeedConfig: {json.dumps(self._param_dict, indent=2, default=str)}")

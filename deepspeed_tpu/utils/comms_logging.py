@@ -1,0 +1,93 @@
+"""Comms logger with algorithmic/bus bandwidth calculation.
+
+Mirrors reference ``deepspeed/utils/comms_logging.py``: per-op size/latency
+records (:67) and ``calc_bw_log`` (:34) computing algbw and busbw with the
+standard ring-collective correction factors.
+"""
+
+from collections import defaultdict
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_caller_func(frame=3):
+    import sys
+    return sys._getframe(frame).f_code.co_name
+
+
+def calc_bw_log(comm_op, size_bytes, duration_s, n=None):
+    """Algorithmic and bus bandwidth in GB/s (reference ``comms_logging.py:34``)."""
+    if duration_s <= 0:
+        return 0.0, 0.0
+    if n is None:
+        try:
+            import jax
+            n = max(jax.device_count(), 1)
+        except Exception:
+            n = 1
+    tput = size_bytes / duration_s
+    if comm_op in ("all_to_all", "all_to_all_single"):
+        busbw = tput * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor"):
+        busbw = tput * ((n - 1) / n)
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        busbw = tput * (2 * (n - 1) / n)
+    else:  # pt2pt, broadcast, reduce
+        busbw = tput
+    return tput / 1e9, busbw / 1e9
+
+
+class CommsLogger:
+    """reference ``comms_logging.py:67`` CommsLogger."""
+
+    def __init__(self):
+        self.enabled = False
+        self.prof_all = False
+        self.prof_ops = []
+        self.verbose = False
+        self.debug = False
+        self.comms_dict = defaultdict(lambda: defaultdict(lambda: [0, 0.0, 0.0, 0.0]))
+
+    def configure(self, comms_config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None):
+        if comms_config is not None:
+            self.enabled = getattr(comms_config, "enabled", self.enabled)
+            self.prof_all = getattr(comms_config, "prof_all", self.prof_all)
+            self.prof_ops = getattr(comms_config, "prof_ops", self.prof_ops)
+            self.verbose = getattr(comms_config, "verbose", self.verbose)
+        if enabled is not None:
+            self.enabled = enabled
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+        if verbose is not None:
+            self.verbose = verbose
+
+    def append(self, raw_name, record_name, latency_s, msg_size):
+        if self.prof_ops and raw_name not in self.prof_ops and not self.prof_all:
+            return
+        algbw, busbw = calc_bw_log(raw_name, msg_size, latency_s)
+        rec = self.comms_dict[record_name][msg_size]
+        rec[0] += 1
+        rec[1] += latency_s * 1000.0
+        rec[2] += algbw
+        rec[3] += busbw
+        if self.verbose:
+            logger.info(f"comm op: {record_name} | time(ms): {latency_s*1000:.2f} | "
+                        f"msg size: {msg_size} | algbw (Gbps): {algbw*8:.2f} | "
+                        f"busbw (Gbps): {busbw*8:.2f}")
+
+    def log_all(self, print_log=True, show_straggler=False):
+        lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
+                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}"
+                 f"{'tput_avg (GB/s)':<20}{'busbw_avg (GB/s)':<20}"]
+        for record_name, sizes in self.comms_dict.items():
+            for size, (count, total_ms, algbw, busbw) in sorted(sizes.items()):
+                lines.append(f"{record_name:<20}{size:<20}{count:<10}"
+                             f"{total_ms:<20.2f}{total_ms/max(count,1):<20.2f}"
+                             f"{algbw/max(count,1):<20.2f}{busbw/max(count,1):<20.2f}")
+        out = "\n".join(lines)
+        if print_log:
+            logger.info("\n" + out)
+        return self.comms_dict

@@ -1,0 +1,177 @@
+"""Wall-clock + throughput timers.
+
+Mirrors reference ``deepspeed/utils/timer.py``: ``SynchronizedWallClockTimer``
+(:43) keyed by name with start/stop/elapsed/mean, and ``ThroughputTimer`` (:198)
+reporting samples/sec and TFLOPS. TPU twist: there are no CUDA events; JAX
+dispatch is async, so "synchronized" timing calls ``block_until_ready`` on a
+token array when one is supplied, else falls back to host perf_counter.
+"""
+
+import time
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _sync(token=None):
+    if token is not None:
+        try:
+            import jax
+            jax.block_until_ready(token)
+            return
+        except Exception:
+            pass
+
+
+class _Timer:
+
+    def __init__(self, name):
+        self.name_ = name
+        self.started_ = False
+        self.elapsed_ = 0.0
+        self.records = []
+        self.start_time = 0.0
+
+    def start(self):
+        assert not self.started_, f"{self.name_} timer has already been started"
+        self.start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self, record=True, token=None):
+        assert self.started_, f"{self.name_} timer is not started"
+        _sync(token)
+        dt = time.perf_counter() - self.start_time
+        self.elapsed_ += dt
+        if record:
+            self.records.append(dt)
+        self.started_ = False
+
+    def reset(self):
+        self.started_ = False
+        self.elapsed_ = 0.0
+
+    def elapsed(self, reset=True):
+        started = self.started_
+        if started:
+            self.stop(record=False)
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+    def mean(self):
+        return (sum(self.records) / len(self.records)) if self.records else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Named timer group (reference ``utils/timer.py:43``)."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name):
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage():
+        try:
+            from deepspeed_tpu.accelerator import get_accelerator
+            stats = get_accelerator().memory_stats()
+            gb = 1024**3
+            return (f"MemAllocated={stats.get('bytes_in_use', 0) / gb:.2f} GB "
+                    f"MaxMemAllocated={stats.get('peak_bytes_in_use', 0) / gb:.2f} GB")
+        except Exception:
+            return "MemAllocated=? MaxMemAllocated=?"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        from deepspeed_tpu.utils.logging import log_dist
+        log_dist(f"time (ms) | {' | '.join(parts)}", ranks=ranks or [0])
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                means[name] = self.timers[name].mean() * 1000.0 / normalizer
+                if reset:
+                    self.timers[name].records = []
+        return means
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS reporting (reference ``utils/timer.py:198``)."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: None)
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step=False, report_speed=True, token=None):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _sync(token)
+            self.end_time = time.perf_counter()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, RunningAvgSamplesPerSec="
+                    f"{self.avg_samples_per_sec():.2f}, CurrSamplesPerSec="
+                    f"{self.batch_size / self.step_elapsed_time:.2f}")
+                self.step_elapsed_time = 0
+            elif global_step:
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return float("-inf")

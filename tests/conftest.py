@@ -1,0 +1,36 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the TPU analog of the reference's
+in-process multi-rank harness, ``tests/unit/common.py:373`` DistributedTest with
+world_size 1/2/4): ``xla_force_host_platform_device_count=8`` gives eight XLA
+CPU devices so every sharding/collective path executes real multi-device code.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_groups():
+    """Each test gets a fresh global topology registry."""
+    from deepspeed_tpu.parallel import groups
+    groups.reset()
+    yield
+    groups.reset()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

@@ -1,0 +1,92 @@
+"""Config system tests (mirrors reference ``tests/unit/runtime/test_ds_config_dict.py``)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def test_batch_triple_derivation():
+    cfg = DeepSpeedConfig({"train_batch_size": 32})
+    tb, mb, gas = cfg.resolve_batch_params(dp_world_size=4)
+    assert (tb, mb, gas) == (32, 8, 1)
+
+
+def test_batch_triple_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    tb, mb, gas = cfg.resolve_batch_params(dp_world_size=4)
+    assert (tb, mb, gas) == (32, 2, 4)
+
+
+def test_batch_triple_from_micro():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3})
+    tb, mb, gas = cfg.resolve_batch_params(dp_world_size=2)
+    assert (tb, mb, gas) == (12, 2, 3)
+
+
+def test_batch_triple_inconsistent_raises():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 30,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2
+    })
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_params(dp_world_size=4)
+
+
+def test_missing_batch_raises():
+    cfg = DeepSpeedConfig({})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_params(dp_world_size=1)
+
+
+def test_zero_config_keys():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "sub_group_size": 1000,
+            "offload_optimizer": {"device": "cpu", "ratio": 0.5},
+            "stage3_param_persistence_threshold": 1234,
+        }
+    })
+    z = cfg.zero_config
+    assert z.stage == 3
+    assert cfg.zero_enabled
+    assert z.sub_group_size == 1000
+    assert z.offload_optimizer.device == "cpu"
+    assert z.offload_optimizer.ratio == 0.5
+    assert z.stage3_param_persistence_threshold == 1234
+
+
+def test_deprecated_key_remap():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {"stage3_gather_fp16_weights_on_model_save": True}
+    })
+    assert cfg.zero_config.stage3_gather_16bit_weights_on_model_save is True
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16, "fp16": {"enabled": True, "initial_scale_power": 8}}))
+    cfg = DeepSpeedConfig(str(p))
+    assert cfg.train_batch_size == 16
+    assert cfg.fp16.enabled and cfg.fp16.initial_scale_power == 8
+
+
+def test_optimizer_scheduler_sections():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "betas": [0.9, 0.99]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    })
+    assert cfg.optimizer.type == "Adam"
+    assert cfg.optimizer.params["lr"] == 1e-3
+    assert cfg.scheduler.type == "WarmupLR"

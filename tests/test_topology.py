@@ -1,0 +1,47 @@
+"""Mesh topology tests (mirrors reference ``tests/unit/runtime/pipe/test_topology.py``)."""
+
+import pytest
+
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import MeshTopology
+
+
+def test_default_all_dp(eight_devices):
+    t = MeshTopology()
+    assert t.dp_size == 8
+    assert t.world_size() == 8
+    assert t.mesh.shape == {"pp": 1, "dp": 8, "ep": 1, "sp": 1, "tp": 1}
+
+
+def test_mixed_axes(eight_devices):
+    t = MeshTopology(pp=2, tp=2)
+    assert t.dp_size == 2 * 1  # 8/(2*2)=2
+    assert t.pp_size == 2 and t.tp_size == 2
+    assert t.data_parallel_size == 2
+
+
+def test_indivisible_raises(eight_devices):
+    with pytest.raises(AssertionError):
+        MeshTopology(pp=3)
+
+
+def test_rank_coord_roundtrip(eight_devices):
+    t = MeshTopology(pp=2, dp=2, tp=2)
+    for r in range(8):
+        c = t.get_coord(r)
+        assert t.get_rank(**c) == r
+
+
+def test_groups_registry(eight_devices):
+    groups.initialize(ep_size=2)
+    assert groups.get_expert_parallel_world_size() == 2
+    assert groups.get_data_parallel_world_size() == 8  # dp*ep*sp
+    assert groups.get_expert_data_parallel_world_size() == 4
+    assert groups.get_world_size() == 8
+
+
+def test_batch_spec(eight_devices):
+    t = MeshTopology(dp=4, sp=2)
+    spec = t.batch_spec
+    assert spec == __import__("jax").sharding.PartitionSpec(("dp", "ep"), "sp")
+    assert t.data_parallel_size == 8
