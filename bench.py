@@ -1,0 +1,105 @@
+"""Headline benchmark: GPT-2-small (124M) bf16 causal-LM training throughput on
+the available TPU chip(s), reported as tokens/sec/chip and MFU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is MFU / 0.45 — the north-star MFU target from BASELINE.json
+(≥45% MFU for ZeRO-3 pretraining); >1.0 beats the target.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip peak bf16 FLOP/s (public specs)
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "cpu": 1e12,  # nominal, for smoke runs
+}
+
+
+def peak_flops(device_kind):
+    for k, v in PEAK_BF16_FLOPS.items():
+        if device_kind.lower().startswith(k.lower()):
+            return v
+    return 197e12
+
+
+def main():
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, gpt2_flops_per_token
+
+    n_chips = len(jax.devices())
+    kind = jax.devices()[0].device_kind
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    print(f"bench: {n_chips}x {kind}", file=sys.stderr)
+
+    batch, seq = (16, 1024) if on_tpu else (2, 128)
+    cfg = GPT2Config.small() if on_tpu else GPT2Config.tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "n_positions": max(cfg.n_positions, seq),
+                       "scan_layers": True, "remat": True})
+    model = GPT2LMHeadModel(cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(batch * max(n_chips, 1), seq)).astype(np.int32)
+    batch_data = {"input_ids": ids, "labels": ids}
+
+    params = model.init(jax.random.PRNGKey(0), batch_data)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": batch,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "gradient_clipping": 1.0,
+        })
+
+    def step():
+        loss = engine(batch_data)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    # warmup (compile)
+    t0 = time.perf_counter()
+    loss = step()
+    jax.block_until_ready(loss)
+    print(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={float(loss):.3f}",
+          file=sys.stderr)
+
+    n_steps = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step()
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * max(n_chips, 1) * seq * n_steps
+    tok_per_sec_chip = tokens / dt / max(n_chips, 1)
+    fpt = gpt2_flops_per_token(cfg, seq)
+    mfu = tok_per_sec_chip * fpt / peak_flops(kind)
+
+    print(json.dumps({
+        "metric": "gpt2_small_bf16_zero1_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {"mfu": round(mfu, 4), "chips": n_chips, "device": kind,
+                  "batch_per_chip": batch, "seq": seq, "steps": n_steps,
+                  "loss": float(jax.device_get(loss))},
+    }))
+
+
+if __name__ == "__main__":
+    main()

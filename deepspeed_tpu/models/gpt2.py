@@ -1,0 +1,199 @@
+"""GPT-2 model family (TPU-native flax implementation).
+
+The reference frames models as user-supplied torch modules plus fused-kernel
+shells (``deepspeed/ops/transformer/transformer.py``,
+``model_implementations/``); this package ships first-class JAX models so the
+engine, ZeRO, TP and the benchmarks have a standard flagship. Design notes:
+
+- optional ``scan_layers``: parameters stacked [L, ...] and the layer stack run
+  under ``lax.scan`` — this is what makes ZeRO-3 gather per-block (the
+  ``stage3_max_live_parameters`` analog) and keeps compile time O(1) in depth
+- optional ``remat``: ``jax.checkpoint`` per block (activation checkpointing,
+  reference ``runtime/activation_checkpointing/checkpointing.py``)
+- ``param_specs``: tensor-parallel PartitionSpecs (Megatron-style column/row
+  split of QKV/MLP, vocab-split embedding) consumed by the engine's partitioner
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    scan_layers: bool = True
+    remat: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def tiny(**kw):
+        return GPT2Config(vocab_size=512, n_positions=128, n_embd=64, n_layer=2,
+                          n_head=4, **kw)
+
+    @staticmethod
+    def small(**kw):  # 124M
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def medium(**kw):  # 350M
+        return GPT2Config(n_embd=1024, n_layer=24, n_head=16, **kw)
+
+    @staticmethod
+    def large(**kw):  # 774M
+        return GPT2Config(n_embd=1280, n_layer=36, n_head=20, **kw)
+
+
+def causal_attention(q, k, v, dtype, dropout_rng=None, dropout=0.0, deterministic=True):
+    """Plain causal MHA core — the XLA-fusion path. The Pallas flash-attention
+    kernel (ops/flash_attention.py) slots in behind the same signature."""
+    from deepspeed_tpu.ops.flash_attention import mha
+    return mha(q, k, v, causal=True)
+
+
+class SelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        D, H = cfg.n_embd, cfg.n_head
+        qkv = nn.Dense(3 * D, dtype=cfg.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, T = x.shape[0], x.shape[1]
+        q = q.reshape(B, T, H, D // H)
+        k = k.reshape(B, T, H, D // H)
+        v = v.reshape(B, T, H, D // H)
+        out = causal_attention(q, k, v, cfg.dtype)
+        out = out.reshape(B, T, D)
+        out = nn.Dense(D, dtype=cfg.dtype, name="c_proj")(out)
+        out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+        return out
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="c_fc")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        x = x + SelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_1")(x),
+            deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_2")(x),
+            deterministic)
+        return x
+
+
+class ScanBlock(nn.Module):
+    """Block adapted for nn.scan carry signature."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, deterministic = carry
+        x = Block(self.config, name="block")(x, deterministic)
+        return (x, deterministic), None
+
+
+class GPT2LMHeadModel(nn.Module):
+    """Returns the LM cross-entropy loss when batch has ``labels`` (DeepSpeed
+    convention: the wrapped module's forward returns the loss), else logits."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, batch, deterministic=True):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+
+        B, T = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.n_embd),
+                         jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd),
+                         jnp.float32)
+        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :T]
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        if cfg.scan_layers:
+            block = ScanBlock
+            if cfg.remat:
+                block = nn.remat(ScanBlock, prevent_cse=False,
+                                 static_argnums=())
+            ScannedBlocks = nn.scan(block,
+                                    variable_axes={"params": 0},
+                                    split_rngs={"params": True, "dropout": True},
+                                    length=cfg.n_layer,
+                                    metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            (x, _), _ = ScannedBlocks(cfg, name="h")((x, deterministic), None)
+        else:
+            block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+            for i in range(cfg.n_layer):
+                x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
+        logits = x @ wte.astype(cfg.dtype).T  # tied embeddings
+
+        if labels is None:
+            return logits
+        from deepspeed_tpu.models.losses import next_token_loss
+        return next_token_loss(logits, labels)
+
+    def param_specs(self, params):
+        """Tensor-parallel PartitionSpecs (Megatron column/row pattern)."""
+        cfg = self.config
+
+        def spec_for(path, leaf):
+            names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+            joined = "/".join(str(n) for n in names)
+            scan_prefix = (None,) if (cfg.scan_layers and "h" in names) else ()
+            if leaf.ndim == 1 + len(scan_prefix):  # biases / layernorm scales
+                if "c_attn" in joined or "c_fc" in joined:
+                    return P(*scan_prefix, "tp")
+                return P(*scan_prefix) if scan_prefix else None
+            if "wte" in joined or "wpe" in joined:
+                return P("tp", None) if "wte" in joined else None
+            if "c_attn" in joined or "c_fc" in joined:   # column parallel
+                return P(*scan_prefix, None, "tp")
+            if "c_proj" in joined:                        # row parallel
+                return P(*scan_prefix, "tp", None)
+            return None
+
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        specs = [spec_for(path, leaf) for path, leaf in flat]
+        treedef = jax.tree_util.tree_structure(params)
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def gpt2_flops_per_token(cfg: GPT2Config, seq_len: int) -> float:
+    """Approximate training FLOPs/token (6N + attention term) for MFU calc."""
+    n_params = (cfg.vocab_size * cfg.n_embd + cfg.n_positions * cfg.n_embd +
+                cfg.n_layer * (12 * cfg.n_embd ** 2) + cfg.n_embd * 2)
+    return 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * seq_len

@@ -1,0 +1,226 @@
+"""Llama model family (flagship) — TPU-native flax implementation.
+
+Covers the reference's Llama support surface (inference containers
+``module_inject/containers/llama.py``, v2 model implementation
+``inference/v2/model_implementations/llama_v2``) as a first-class training +
+inference model: RMSNorm, rotary embeddings, SwiGLU MLP, grouped-query
+attention. Same TPU design as gpt2.py: scan-over-layers + remat + TP
+PartitionSpecs (Megatron column/row pattern).
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    scan_layers: bool = True
+    remat: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def tiny(**kw):
+        return LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, max_position_embeddings=128, **kw)
+
+    @staticmethod
+    def llama2_7b(**kw):
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama2_13b(**kw):
+        return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                           num_hidden_layers=40, num_attention_heads=40,
+                           num_key_value_heads=40, **kw)
+
+    @staticmethod
+    def llama2_70b(**kw):
+        return LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                           num_hidden_layers=80, num_attention_heads=64,
+                           num_key_value_heads=8, **kw)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    def num_parameters(self):
+        c = self
+        per_layer = (c.hidden_size * c.hidden_size  # q
+                     + 2 * c.hidden_size * c.num_key_value_heads * c.head_dim  # k,v
+                     + c.hidden_size * c.hidden_size  # o
+                     + 3 * c.hidden_size * c.intermediate_size  # gate,up,down
+                     + 2 * c.hidden_size)  # norms
+        return (c.vocab_size * c.hidden_size * 2  # embed + lm_head
+                + c.num_hidden_layers * per_layer + c.hidden_size)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+def rotary_embed(x, positions, theta=10000.0):
+    """Apply rotary position embeddings. x: [B, T, H, Dh]."""
+    dh = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, dh, 2, dtype=jnp.float32) / dh))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,dh/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    out = jnp.stack([rx1, rx2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic=True):
+        cfg = self.config
+        B, T, D = x.shape
+        H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(feats, use_bias=False, dtype=cfg.dtype, name=name)
+        q = dense(H * Dh, "q_proj")(x).reshape(B, T, H, Dh)
+        k = dense(KV * Dh, "k_proj")(x).reshape(B, T, KV, Dh)
+        v = dense(KV * Dh, "v_proj")(x).reshape(B, T, KV, Dh)
+        q = rotary_embed(q, positions, cfg.rope_theta)
+        k = rotary_embed(k, positions, cfg.rope_theta)
+        if KV != H:  # GQA: repeat kv heads
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        from deepspeed_tpu.ops.flash_attention import mha
+        out = mha(q, k, v, causal=True)
+        out = out.reshape(B, T, H * Dh)
+        return dense(D, "o_proj")(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(feats, use_bias=False, dtype=cfg.dtype, name=name)
+        gate = nn.silu(dense(cfg.intermediate_size, "gate_proj")(x))
+        up = dense(cfg.intermediate_size, "up_proj")(x)
+        return dense(cfg.hidden_size, "down_proj")(gate * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic=True):
+        cfg = self.config
+        x = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x),
+            positions, deterministic)
+        x = x + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(x))
+        return x
+
+
+class ScanLlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions = carry
+        x = LlamaBlock(self.config, name="block")(x, positions)
+        return (x, positions), None
+
+
+class LlamaForCausalLM(nn.Module):
+    """Returns LM loss when batch carries ``labels`` (DeepSpeed convention)."""
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic=True):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        embed = self.param("embed_tokens", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        x = embed.astype(cfg.dtype)[input_ids]
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+
+        if cfg.scan_layers:
+            block = ScanLlamaBlock
+            if cfg.remat:
+                block = nn.remat(ScanLlamaBlock, prevent_cse=False)
+            Scanned = nn.scan(block,
+                              variable_axes={"params": 0},
+                              split_rngs={"params": True, "dropout": True},
+                              length=cfg.num_hidden_layers,
+                              metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            (x, _), _ = Scanned(cfg, name="layers")((x, positions), None)
+        else:
+            block_cls = nn.remat(LlamaBlock, prevent_cse=False) if cfg.remat else LlamaBlock
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"layers_{i}")(x, positions, deterministic)
+
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+        lm_head = self.param("lm_head", nn.initializers.normal(0.02),
+                             (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        logits = x @ lm_head.astype(cfg.dtype).T
+
+        if labels is None:
+            return logits
+        from deepspeed_tpu.models.losses import next_token_loss
+        return next_token_loss(logits, labels)
+
+    def param_specs(self, params):
+        """Megatron-style TP specs: q/k/v/gate/up column-split, o/down row-split,
+        embeddings vocab-split."""
+        cfg = self.config
+
+        def spec_for(path, leaf):
+            names = "/".join(str(getattr(p, "key", getattr(p, "name", ""))) for p in path)
+            scan_prefix = (None,) if (cfg.scan_layers and "layers/" in names) else ()
+            if leaf.ndim == 1 + len(scan_prefix):
+                return None
+            if "embed_tokens" in names or "lm_head" in names:
+                return P("tp", None)
+            if any(k in names for k in ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")):
+                return P(*scan_prefix, None, "tp")
+            if any(k in names for k in ("o_proj", "down_proj")):
+                return P(*scan_prefix, "tp", None)
+            return None
+
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        specs = [spec_for(path, leaf) for path, leaf in flat]
+        return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(params), specs)
+
+
+def llama_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token ≈ 6N + attention quadratic term."""
+    return 6 * cfg.num_parameters() + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len

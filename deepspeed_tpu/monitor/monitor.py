@@ -1,0 +1,108 @@
+"""Monitoring fan-out (mirrors reference ``deepspeed/monitor/monitor.py:13,29``).
+
+``MonitorMaster`` fans events out to TensorBoard / W&B / CSV writers; engine
+writes (name, value, global_sample) event tuples, same schema as the reference
+(``engine.py:2273``).
+"""
+
+import csv
+import os
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor:
+
+    def __init__(self, config):
+        self.config = config
+        self.enabled = getattr(config, "enabled", False)
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class CsvMonitor(Monitor):
+    """reference ``monitor/csv_monitor.py``: one csv per event name."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = config.output_path or "csv_monitor_output"
+        self.job_name = config.job_name
+        self._files = {}
+
+    def _path(self, name):
+        d = os.path.join(self.output_path, self.job_name)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, name.replace("/", "_") + ".csv")
+
+    def write_events(self, event_list):
+        for name, value, step in event_list:
+            p = self._path(name)
+            new = not os.path.exists(p)
+            with open(p, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                path = os.path.join(config.output_path or "tensorboard_output", config.job_name)
+                self.writer = SummaryWriter(log_dir=path)
+            except Exception as e:
+                logger.warning(f"tensorboard unavailable ({e}); disabling TB monitor")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if self.writer is None:
+            return
+        for name, value, step in event_list:
+            self.writer.add_scalar(name, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.run = None
+        if self.enabled:
+            try:
+                import wandb
+                self.run = wandb.init(project=config.project, group=config.group)
+            except Exception as e:
+                logger.warning(f"wandb unavailable ({e}); disabling wandb monitor")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if self.run is None:
+            return
+        import wandb
+        for name, value, step in event_list:
+            wandb.log({name: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """reference ``monitor/monitor.py:29``."""
+
+    def __init__(self, ds_config):
+        self.writers = []
+        if ds_config.monitor_config_tb.enabled:
+            self.writers.append(TensorBoardMonitor(ds_config.monitor_config_tb))
+        if ds_config.monitor_config_csv.enabled:
+            self.writers.append(CsvMonitor(ds_config.monitor_config_csv))
+        if ds_config.monitor_config_wandb.enabled:
+            self.writers.append(WandbMonitor(ds_config.monitor_config_wandb))
+        self.enabled = any(w.enabled for w in self.writers)
+
+    def write_events(self, event_list):
+        for w in self.writers:
+            if w.enabled:
+                w.write_events(event_list)

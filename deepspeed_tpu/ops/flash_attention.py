@@ -1,0 +1,53 @@
+"""Attention kernels.
+
+``mha`` is the framework-wide attention entry point (the analog of the
+reference's fused attention kernels, ``csrc/transformer/inference/csrc/softmax.cu``
+and the blocked_flash kernel family): callers always go through here, and the
+best implementation for the backend is selected — a Pallas TPU flash-attention
+kernel when on TPU, else the XLA einsum path (which XLA fuses well on its own).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder
+
+NEG_INF = -1e9  # large finite; -inf breaks softmax rows that are fully masked
+
+
+def mha_reference(q, k, v, bias=None, causal=True, softmax_scale=None):
+    """Plain XLA attention. Shapes: q,k,v [B, T, H, Dh] -> [B, T, H, Dh]."""
+    *_, T, H, Dh = q.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (Dh ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        Tq, Tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), Tk - Tq)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def mha(q, k, v, bias=None, causal=True, softmax_scale=None):
+    impl = FlashAttnBuilder().load()
+    return impl(q, k, v, bias=bias, causal=causal, softmax_scale=softmax_scale)
+
+
+@register_op_builder
+class FlashAttnBuilder(OpBuilder):
+    """Pallas flash attention slot (reference evoformer/blocked_flash analog)."""
+    NAME = "flash_attn"
+
+    def reference_impl(self):
+        return mha_reference
+
+    def pallas_impl(self):
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_mha
+            return flash_mha
+        except Exception:
+            return None

@@ -1,0 +1,107 @@
+"""Checkpoint engines.
+
+Mirrors the reference's pluggable ``CheckpointEngine`` interface
+(``runtime/checkpoint_engine/checkpoint_engine.py:9``: create/save/load/commit).
+``NativeCheckpointEngine`` is the torch-engine analog: it persists an arbitrary
+pytree (including engine TrainState) to a directory of .npz shards + a JSON
+manifest, gathering sharded arrays to host. Multi-host / async engines slot in
+behind the same interface (the Nebula-engine analog).
+"""
+
+import json
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+class CheckpointEngine:
+    """reference checkpoint_engine.py:9 interface."""
+
+    def create(self, tag):
+        pass
+
+    def save(self, state_dict, path):
+        raise NotImplementedError
+
+    def load(self, path, template=None, map_location=None):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        return True
+
+
+def _flatten(tree):
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    return flat, treedef
+
+
+class NativeCheckpointEngine(CheckpointEngine):
+    """Two buckets: ``state`` (array pytree, loaded against a structure
+    template) and ``meta`` (free-form counters/client state, loaded verbatim)."""
+
+    ARRAYS = "arrays.npz"
+    META = "meta.json"
+    AUX = "aux.pkl"
+    FREE = "meta_state.pkl"
+
+    def save(self, state_dict, path, meta=None):
+        os.makedirs(path, exist_ok=True)
+        if meta is not None:
+            with open(os.path.join(path, self.FREE), "wb") as f:
+                pickle.dump(meta, f)
+        flat, treedef = _flatten(state_dict)
+        arrays, aux, kinds, dtypes = {}, [], [], []
+        for i, leaf in enumerate(flat):
+            if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+                arr = np.asarray(jax.device_get(leaf))
+                dtypes.append(arr.dtype.name)
+                if arr.dtype.kind == "V" or arr.dtype.name in ("bfloat16",) or \
+                        arr.dtype.name.startswith("float8"):
+                    # numpy can't round-trip ml_dtypes through savez; store raw bytes
+                    arr = arr.view(np.uint8 if arr.dtype.itemsize == 1 else np.uint16)
+                arrays[f"a{i}"] = arr
+                kinds.append("array")
+                aux.append(None)
+            else:
+                kinds.append("aux")
+                dtypes.append(None)
+                aux.append(leaf)
+        np.savez(os.path.join(path, self.ARRAYS), **arrays)
+        with open(os.path.join(path, self.AUX), "wb") as f:
+            pickle.dump(aux, f)
+        with open(os.path.join(path, self.META), "w") as f:
+            json.dump({"num_leaves": len(flat), "kinds": kinds, "dtypes": dtypes,
+                       "format_version": 1}, f)
+
+    def load_meta(self, path):
+        p = os.path.join(path, self.FREE)
+        if not os.path.exists(p):
+            return {}
+        with open(p, "rb") as f:
+            return pickle.load(f)
+
+    def load(self, path, template=None, map_location=None):
+        with open(os.path.join(path, self.META)) as f:
+            meta = json.load(f)
+        data = np.load(os.path.join(path, self.ARRAYS), allow_pickle=False)
+        with open(os.path.join(path, self.AUX), "rb") as f:
+            aux = pickle.load(f)
+        import ml_dtypes
+        flat = []
+        for i, kind in enumerate(meta["kinds"]):
+            if kind != "array":
+                flat.append(aux[i])
+                continue
+            arr = data[f"a{i}"]
+            want = meta.get("dtypes", [None] * len(meta["kinds"]))[i]
+            if want is not None and arr.dtype.name != want:
+                arr = arr.view(np.dtype(getattr(ml_dtypes, want, want)))
+            flat.append(arr)
+        assert template is not None, "NativeCheckpointEngine.load needs a structure template"
+        _, treedef = _flatten(template)
+        assert treedef.num_leaves == len(flat), (
+            f"checkpoint has {len(flat)} leaves but template has {treedef.num_leaves} — "
+            f"model/optimizer structure changed since save")
+        return jax.tree_util.tree_unflatten(treedef, flat)

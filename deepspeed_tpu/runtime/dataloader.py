@@ -1,0 +1,84 @@
+"""Dataloader (mirrors reference ``deepspeed/runtime/dataloader.py``).
+
+``DeepSpeedDataLoader`` wraps any indexable dataset (dict-of-arrays, list of
+samples, or an iterable of ready batches) and yields numpy batches of the
+*global* batch size; the engine shards them over the (dp, ep) × sp mesh axes at
+device_put time, which is the TPU analog of the reference's DistributedSampler
+(each rank reading its slice). ``RepeatingLoader`` is a faithful port of the
+reference's infinite wrapper.
+"""
+
+import numpy as np
+
+import jax
+
+
+class DeepSpeedDataLoader:
+
+    def __init__(self, dataset, batch_size, collate_fn=None, topology=None,
+                 shuffle=True, seed=0, drop_last=True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.topology = topology
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+        self._epoch = 0
+        if hasattr(dataset, "__len__") and not isinstance(dataset, dict):
+            self.num_samples = len(dataset)
+        elif isinstance(dataset, dict):
+            self.num_samples = len(next(iter(dataset.values())))
+        else:
+            self.num_samples = None  # pure iterable
+
+    def __len__(self):
+        if self.num_samples is None:
+            raise TypeError("iterable dataset has no length")
+        n = self.num_samples // self.batch_size
+        if not self.drop_last and self.num_samples % self.batch_size:
+            n += 1
+        return n
+
+    def _index_batches(self):
+        idx = np.arange(self.num_samples)
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        end = (self.num_samples // self.batch_size) * self.batch_size if self.drop_last \
+            else self.num_samples
+        for start in range(0, end, self.batch_size):
+            yield idx[start:start + self.batch_size]
+
+    def __iter__(self):
+        self._epoch += 1
+        if self.num_samples is None:
+            yield from self.dataset
+            return
+        for batch_idx in self._index_batches():
+            if isinstance(self.dataset, dict):
+                batch = {k: np.asarray(v)[batch_idx] for k, v in self.dataset.items()}
+            else:
+                samples = [self.dataset[int(i)] for i in batch_idx]
+                if self.collate_fn is not None:
+                    batch = self.collate_fn(samples)
+                else:
+                    batch = jax.tree.map(lambda *xs: np.stack(xs), *samples)
+            yield batch
+
+
+class RepeatingLoader:
+    """reference ``runtime/dataloader.py`` RepeatingLoader."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

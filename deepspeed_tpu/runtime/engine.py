@@ -1,0 +1,636 @@
+"""DeepSpeedEngine — the TPU-native training engine.
+
+Mirrors the capability surface of the reference ``DeepSpeedEngine``
+(``deepspeed/runtime/engine.py:180``): ``forward`` (:1794) / ``backward``
+(:1933) / ``step`` (:2132), gradient accumulation with boundary semantics,
+mixed precision (fp16 dynamic loss scaling / bf16), ZeRO 0-3, gradient
+clipping, LR scheduling, checkpoint save/load (:3056/:2712), monitoring and
+wall-clock timers.
+
+Architecture (deliberately NOT a transliteration): the reference drives eager
+PyTorch with backward hooks, bucketed NCCL reduce-scatter and stream juggling.
+Here the whole micro-step (forward+backward+grad-accumulate) and the whole
+apply-step (unscale, clip, optimizer, loss-scale update, recast) are each ONE
+jitted XLA program over a sharded state pytree; ZeRO partitioning is a set of
+GSPMD sharding constraints (see ``runtime/zero/partition.py``) and XLA emits
+the reduce-scatters/all-gathers the reference issues by hand. The
+forward/backward/step imperative API is preserved on top: ``forward`` runs the
+fused micro-step and stages the result, ``backward`` commits it, ``step``
+applies the optimizer at the gradient-accumulation boundary.
+"""
+
+import os
+import time
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepspeed_tpu.ops.adam import build_optimizer, set_lr
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScaleState, init_loss_scale_state,
+                                                    update_loss_scale)
+from deepspeed_tpu.runtime.lr_schedules import LRSchedulerShim, get_lr_schedule
+from deepspeed_tpu.runtime.utils import (clip_grads_by_global_norm, constrain_tree,
+                                         count_parameters, global_norm, has_overflow,
+                                         tree_cast, tree_where, tree_zeros_like)
+from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
+                                       STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
+                                       ThroughputTimer)
+
+
+class TrainState(NamedTuple):
+    """The engine's entire training state as one sharded pytree."""
+    params: Any            # working precision (bf16/fp16/fp32)
+    master: Any            # fp32 master copy (None in pure-fp32 training)
+    opt_state: Any
+    grad_acc: Any          # gradient accumulation buffer (grad_accum_dtype)
+    scale: LossScaleState
+    global_step: jnp.ndarray
+    skipped: jnp.ndarray   # overflow-skipped step count (device-side: no per-step host sync)
+    rng: jnp.ndarray
+
+
+class StepStats(NamedTuple):
+    grad_norm: jnp.ndarray
+    overflow: jnp.ndarray
+    lr: jnp.ndarray
+    loss_scale: jnp.ndarray
+
+
+class OptimizerShim:
+    """Minimal object with the torch-optimizer surface the reference returns
+    from initialize() — param_groups for LR introspection/HF compat."""
+
+    def __init__(self, engine, base_lr):
+        self._engine = engine
+        self.param_groups = [{"lr": base_lr}]
+
+    def state_dict(self):
+        return {}
+
+    def zero_grad(self, set_to_none=True):
+        pass  # grads live in the engine's accumulation buffer
+
+    def step(self):
+        raise RuntimeError("Call engine.step() — the engine owns the optimizer step")
+
+
+class DeepSpeedEngine:
+
+    def __init__(self,
+                 config=None,
+                 model=None,
+                 optimizer=None,
+                 model_parameters=None,
+                 training_data=None,
+                 lr_scheduler=None,
+                 mesh=None,
+                 collate_fn=None,
+                 rng=None,
+                 param_specs=None,
+                 dont_change_device=False):
+        self.config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
+        self.module = model
+        self._user_param_specs = param_specs
+
+        # --- topology (reference engine.py:1094 _configure_distributed_model) ---
+        if mesh is not None:
+            if isinstance(mesh, MeshTopology):
+                self.topology = mesh
+            else:
+                raise ValueError("pass a deepspeed_tpu.parallel.topology.MeshTopology")
+        else:
+            self.topology = groups.initialize(ep_size=self.config.expert_parallel_size,
+                                              config=self.config)
+        self.mesh = self.topology.mesh
+
+        # --- batch arithmetic (reference config.py:789) ---
+        tb, mb, gas = self.config.resolve_batch_params(self.topology.data_parallel_size)
+        self.train_batch_size_value = tb
+        self.micro_batch_size = mb
+        self.gradient_accumulation_steps_value = gas
+
+        # --- precision ---
+        self.fp16_enabled = self.config.fp16.enabled
+        self.bf16_enabled = self.config.bf16.enabled
+        if self.fp16_enabled:
+            self.working_dtype = jnp.float16
+        elif self.bf16_enabled:
+            self.working_dtype = jnp.bfloat16
+        else:
+            self.working_dtype = jnp.float32
+        self.mixed_precision = self.working_dtype != jnp.float32
+        self.dynamic_loss_scale = self.fp16_enabled and not (self.config.fp16.loss_scale > 0)
+        gad = self.config.data_types.grad_accum_dtype
+        self.grad_accum_dtype = {None: jnp.float32, "fp32": jnp.float32,
+                                 "fp16": jnp.float16, "bf16": jnp.bfloat16}[gad]
+
+        # --- model fn normalization ---
+        self._model_fn = self._normalize_model_fn(model)
+
+        # --- optimizer (reference engine.py:1228 _configure_optimizer) ---
+        # Accepts: a name string, an optax.GradientTransformation (the functional
+        # analog of the reference's client torch optimizer), a zero-arg/params
+        # factory returning one, or None (use the config section).
+        opt_cfg = self.config.optimizer
+        self._tx = None
+        if optimizer is not None and not isinstance(optimizer, str):
+            tx = optimizer
+            if callable(tx) and not isinstance(tx, optax.GradientTransformation):
+                try:
+                    tx = tx(model_parameters)
+                except TypeError:
+                    tx = tx()
+            if not isinstance(tx, optax.GradientTransformation):
+                raise ValueError(
+                    "client optimizer must be an optax.GradientTransformation or a "
+                    f"factory returning one, got {type(optimizer)}")
+            self._tx, self._base_lr = tx, opt_cfg.params.get("lr", 1e-3)
+        else:
+            opt_name = optimizer if isinstance(optimizer, str) else opt_cfg.type
+            self._tx, self._base_lr = build_optimizer(opt_name, opt_cfg.params)
+        self.optimizer = OptimizerShim(self, self._base_lr)
+
+        # --- LR schedule (reference engine.py:914) ---
+        # Accepts: a name string, a callable step->lr (client schedule), or None.
+        if lr_scheduler is not None and not isinstance(lr_scheduler, str):
+            if not callable(lr_scheduler):
+                raise ValueError("client lr_scheduler must be callable: step -> lr")
+            self._schedule_fn = lr_scheduler
+        else:
+            sched_name = lr_scheduler if isinstance(lr_scheduler, str) else self.config.scheduler.type
+            self._schedule_fn = get_lr_schedule(sched_name, self.config.scheduler.params,
+                                                base_lr=opt_cfg.params.get("lr", self._base_lr))
+        self.lr_scheduler = LRSchedulerShim(self._schedule_fn, engine=self)
+
+        # --- dataloader (reference engine.py:1699 deepspeed_io) ---
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = DeepSpeedDataLoader(
+                training_data, batch_size=self.micro_batch_size * self.topology.data_parallel_size,
+                collate_fn=collate_fn, topology=self.topology)
+
+        # --- monitoring / timers (reference engine.py:252, 2238) ---
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+        self.monitor = MonitorMaster(self.config)
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=tb, steps_per_output=self.config.steps_per_print,
+            logging_fn=lambda m: log_dist(m, ranks=[0]))
+        self.wall_clock_breakdown = self.config.wall_clock_breakdown
+
+        # comms logging
+        import deepspeed_tpu.comm as dist
+        dist.configure(comms_config=self.config.comms_config)
+
+        # --- counters (reference engine bookkeeping) ---
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self._step_applied = False
+        self._last_stats: Optional[StepStats] = None
+        self._staged_loss = None
+        self._data_iterator = None  # persistent iterator for train_batch()
+
+        # --- state init ---
+        self._rng_seed = rng if rng is not None else self.config.seed
+        self.partitioner = None
+        self.state: Optional[TrainState] = None
+        self._micro_step_fn = None
+        self._apply_step_fn = None
+        self._eval_step_fn = None
+        if model_parameters is not None:
+            self._init_state(model_parameters)
+
+        log_dist(
+            f"DeepSpeedEngine: zero_stage={self.zero_optimization_stage()} "
+            f"dtype={self.working_dtype.__name__} batch=({tb},{mb},{gas}) "
+            f"topology={self.topology}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # setup
+    # ------------------------------------------------------------------
+    def _normalize_model_fn(self, model):
+        if model is None:
+            raise ValueError("deepspeed_tpu.initialize requires a model")
+        if hasattr(model, "apply") and hasattr(model, "init"):  # flax module
+            def model_fn(params, batch, rng, training=True):
+                rngs = {"dropout": rng} if (rng is not None and training) else None
+                kwargs = {}
+                try:
+                    out = model.apply({"params": params}, batch, rngs=rngs,
+                                      deterministic=not training, **kwargs)
+                except TypeError:
+                    out = model.apply({"params": params}, batch, rngs=rngs, **kwargs)
+                return out
+            return model_fn
+        if callable(model):
+            def model_fn(params, batch, rng, training=True):
+                try:
+                    return model(params, batch, rng)
+                except TypeError:
+                    return model(params, batch)
+            return model_fn
+        raise ValueError(f"unsupported model type {type(model)}")
+
+    def _resolve_param_specs(self, params):
+        if self._user_param_specs is not None:
+            return self._user_param_specs
+        if self.module is not None and hasattr(self.module, "param_specs"):
+            try:
+                return self.module.param_specs(params)
+            except Exception:
+                return None
+        return None
+
+    def _init_state(self, model_parameters):
+        # Force a copy: the engine's state buffers are donated to compiled steps,
+        # so they must never alias the caller's arrays (astype/device_put return
+        # the input unchanged when dtype+sharding already match).
+        model_parameters = jax.tree.map(lambda x: jnp.array(x, copy=True), model_parameters)
+        params_f32 = tree_cast(model_parameters, jnp.float32)
+        self.partitioner = ZeroPartitioner(self.topology, self.config.zero_config,
+                                           param_specs=self._resolve_param_specs(params_f32))
+        self.partitioner.describe(params_f32)
+
+        working = tree_cast(params_f32, self.working_dtype)
+        param_sh = self.partitioner.param_sharding(working)
+        master_sh = self.partitioner.master_sharding(params_f32)
+        grad_sh = self.partitioner.grad_sharding(params_f32)
+
+        working = jax.tree.map(jax.device_put, working, param_sh)
+        if self.mixed_precision:
+            master = jax.tree.map(jax.device_put, params_f32, master_sh)
+        else:
+            master = None
+            working = jax.tree.map(jax.device_put, params_f32, master_sh) \
+                if self.zero_optimization_stage() >= 3 else working
+
+        opt_target = master if master is not None else working
+        opt_state = self._tx.init(opt_target)
+        opt_sh = self.partitioner.opt_state_sharding(opt_state, params_f32)
+        opt_state = jax.tree.map(jax.device_put, opt_state, opt_sh)
+
+        grad_acc = tree_zeros_like(params_f32, self.grad_accum_dtype)
+        grad_acc = jax.tree.map(jax.device_put, grad_acc, grad_sh)
+
+        self._shardings = dict(params=param_sh, master=master_sh, grad=grad_sh, opt=opt_sh)
+        rep = self.topology.replicated()
+        scale = init_loss_scale_state(self.config.fp16) if self.fp16_enabled \
+            else LossScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(0))
+        rng_key = jax.random.PRNGKey(self._rng_seed) if isinstance(self._rng_seed, int) \
+            else self._rng_seed
+        self.state = TrainState(
+            params=working,
+            master=master,
+            opt_state=opt_state,
+            grad_acc=grad_acc,
+            scale=jax.tree.map(lambda x: jax.device_put(x, rep), scale),
+            global_step=jax.device_put(jnp.int32(0), rep),
+            skipped=jax.device_put(jnp.int32(0), rep),
+            rng=jax.device_put(rng_key, rep),
+        )
+        n = count_parameters(params_f32)
+        log_dist(f"model parameters: {n/1e6:.2f}M", ranks=[0])
+
+    def _ensure_initialized(self, batch):
+        if self.state is not None:
+            return
+        if not (hasattr(self.module, "init")):
+            raise ValueError("model_parameters required for non-flax models")
+        key = jax.random.PRNGKey(self._rng_seed if isinstance(self._rng_seed, int) else 0)
+        variables = self.module.init(key, batch)
+        self._init_state(variables["params"])
+
+    # ------------------------------------------------------------------
+    # compiled step functions
+    # ------------------------------------------------------------------
+    def _build_micro_step(self):
+        gas = self.gradient_accumulation_steps_value
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+        grad_sh = self._shardings["grad"]
+        accum_dtype = self.grad_accum_dtype
+        fp16 = self.fp16_enabled
+        model_fn = self._model_fn
+
+        def micro_step(state: TrainState, batch):
+            rng, sub = jax.random.split(state.rng)
+
+            def loss_fn(p):
+                loss = model_fn(p, batch, sub, True)
+                if isinstance(loss, tuple):
+                    loss = loss[0]
+                scaled = loss.astype(jnp.float32)
+                if fp16:
+                    scaled = scaled * state.scale.loss_scale
+                if prescale and predivide != 1.0:
+                    scaled = scaled / predivide
+                return scaled, loss
+
+            (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+            grads = tree_cast(grads, accum_dtype)
+            acc = jax.tree.map(lambda a, g: a + g, state.grad_acc, grads)
+            acc = constrain_tree(acc, grad_sh)
+            return state._replace(grad_acc=acc, rng=rng), loss
+
+        return jax.jit(micro_step, donate_argnums=(0,))
+
+    def _build_apply_step(self):
+        gas = self.gradient_accumulation_steps_value
+        fp16 = self.fp16_enabled
+        clip = self.config.gradient_clipping
+        tx = self._tx
+        param_sh = self._shardings["params"]
+        master_sh = self._shardings["master"]
+        working_dtype = self.working_dtype
+        mixed = self.mixed_precision
+        fp16_cfg = self.config.fp16
+        dynamic = self.dynamic_loss_scale
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+
+        def apply_step(state: TrainState, lr):
+            denom = jnp.float32(gas)
+            if fp16:
+                denom = denom * state.scale.loss_scale
+            if prescale and predivide != 1.0:
+                denom = denom / jnp.float32(predivide)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, state.grad_acc)
+
+            overflow = has_overflow(grads) if fp16 else jnp.asarray(False)
+            safe_grads = jax.tree.map(lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
+            norm = global_norm(safe_grads)
+            if clip and clip > 0:
+                safe_grads, norm = clip_grads_by_global_norm(safe_grads, clip, norm=norm)
+
+            target = state.master if mixed else state.params
+            opt_state = set_lr(state.opt_state, lr)
+            updates, new_opt = tx.update(safe_grads, opt_state, target)
+            new_target = optax.apply_updates(target, updates)
+            # fp16 overflow => skip (keep old state) without host sync
+            new_target = tree_where(overflow, target, new_target)
+            new_opt = tree_where(overflow, opt_state, new_opt)
+            new_target = constrain_tree(new_target, master_sh)
+
+            if mixed:
+                new_params = constrain_tree(tree_cast(new_target, working_dtype), param_sh)
+                new_master = new_target
+            else:
+                new_params = new_target
+                new_master = None
+
+            new_scale = update_loss_scale(state.scale, overflow, fp16_cfg, dynamic)
+            new_acc = jax.tree.map(jnp.zeros_like, state.grad_acc)
+            new_state = TrainState(params=new_params, master=new_master, opt_state=new_opt,
+                                   grad_acc=new_acc, scale=new_scale,
+                                   global_step=state.global_step + 1,
+                                   skipped=state.skipped + overflow.astype(jnp.int32),
+                                   rng=state.rng)
+            stats = StepStats(grad_norm=norm, overflow=overflow, lr=jnp.asarray(lr, jnp.float32),
+                              loss_scale=state.scale.loss_scale)
+            return new_state, stats
+
+        return jax.jit(apply_step, donate_argnums=(0,))
+
+    def _build_eval_step(self):
+        model_fn = self._model_fn
+
+        def eval_step(state: TrainState, batch):
+            out = model_fn(state.params, batch, None, False)
+            return out
+
+        return jax.jit(eval_step)
+
+    def _compiled(self):
+        if self._micro_step_fn is None:
+            self._micro_step_fn = self._build_micro_step()
+            self._apply_step_fn = self._build_apply_step()
+            self._eval_step_fn = self._build_eval_step()
+
+    # ------------------------------------------------------------------
+    # public API (reference engine.py:1794/1933/2132)
+    # ------------------------------------------------------------------
+    def _shard_batch(self, batch):
+        sharding = self.topology.batch_sharding()
+
+        def put(x):
+            x = jnp.asarray(x)
+            try:
+                return jax.device_put(x, sharding)
+            except Exception:
+                return jax.device_put(x, self.topology.replicated())
+
+        return jax.tree.map(put, batch)
+
+    def forward(self, batch):
+        """Run the fused forward+backward+accumulate micro-step and commit it.
+        Returns the (unscaled) loss.
+
+        Note on semantics vs the reference: eager PyTorch separates forward
+        (activations) from backward (grads); one fused XLA program is both
+        faster and simpler, so grads are accumulated here and ``backward`` is
+        bookkeeping. The state is committed immediately — the old state buffers
+        are donated to the compiled step, so holding the previous ``state``
+        reference is invalid either way."""
+        self._ensure_initialized(batch)
+        self._compiled()
+        if self.wall_clock_breakdown:
+            self.timers(FORWARD_GLOBAL_TIMER).start()
+        self.tput_timer.start()
+        batch = self._shard_batch(batch)
+        self.state, loss = self._micro_step_fn(self.state, batch)
+        self._staged_loss = loss
+        if self.wall_clock_breakdown:
+            self.timers(FORWARD_GLOBAL_TIMER).stop(token=loss)
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, retain_graph=False):
+        """API-parity shim: gradient computation/reduction already ran fused
+        inside ``forward`` (see note there)."""
+        assert self._staged_loss is not None, "backward() called before forward()"
+        staged_loss = self._staged_loss
+        self._staged_loss = None
+        return staged_loss
+
+    def is_gradient_accumulation_boundary(self):
+        """reference engine.py:2153 semantics."""
+        return (self.micro_steps + 1) % self.gradient_accumulation_steps_value == 0
+
+    def step(self):
+        """Optimizer step at the gradient-accumulation boundary (engine.py:2132)."""
+        self._step_applied = False
+        if self.wall_clock_breakdown:
+            self.timers(STEP_GLOBAL_TIMER).start()
+        if self.is_gradient_accumulation_boundary():
+            lr = self._schedule_fn(self.global_steps)
+            self.state, stats = self._apply_step_fn(self.state, lr)
+            self._last_stats = stats
+            self._step_applied = True
+            self.global_steps += 1
+            # NOTE: no per-step host sync on overflow — the skipped counter
+            # lives in device state and is read lazily (skipped_steps property)
+            self.lr_scheduler.step()
+            if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
+                self.monitor.write_events([
+                    ("Train/Samples/lr", float(stats.lr), self.global_samples),
+                    ("Train/Samples/loss_scale", float(stats.loss_scale), self.global_samples),
+                ])
+        self.micro_steps += 1
+        self.global_samples += self.micro_batch_size * self.topology.data_parallel_size
+        if self.wall_clock_breakdown:
+            self.timers(STEP_GLOBAL_TIMER).stop()
+        self.tput_timer.stop(global_step=self._step_applied)
+        if self._step_applied and self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps}, skipped={self.skipped_steps}, "
+                     f"lr={self.get_lr()}, loss_scale={self.cur_scale}", ranks=[0])
+
+    def train_batch(self, data_iter=None):
+        """Full GAS cycle — PipelineEngine-parity API (pipe/engine.py:327)."""
+        if data_iter is None:
+            assert self.training_dataloader is not None
+            if self._data_iterator is None:
+                from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+                self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._data_iterator
+        losses = []
+        for _ in range(self.gradient_accumulation_steps_value):
+            batch = next(data_iter)
+            loss = self.forward(batch)
+            self.backward(loss)
+            self.step()
+            losses.append(loss)
+        return sum(jax.device_get(l) for l in losses) / len(losses)
+
+    def eval_batch(self, batch):
+        self._ensure_initialized(batch)
+        self._compiled()
+        return self._eval_step_fn(self.state, self._shard_batch(batch))
+
+    # ------------------------------------------------------------------
+    # introspection (reference engine getter surface)
+    # ------------------------------------------------------------------
+    def zero_optimization_stage(self):
+        return self.config.zero_config.stage
+
+    def zero_optimization(self):
+        return self.zero_optimization_stage() > 0
+
+    def get_lr(self):
+        return [float(self._last_stats.lr)] if self._last_stats is not None \
+            else [float(self._schedule_fn(self.global_steps))]
+
+    def get_global_grad_norm(self):
+        return float(self._last_stats.grad_norm) if self._last_stats is not None else 0.0
+
+    @property
+    def skipped_steps(self):
+        """Overflow-skipped optimizer steps (device counter, synced on read)."""
+        return int(jax.device_get(self.state.skipped)) if self.state is not None else 0
+
+    @property
+    def cur_scale(self):
+        return float(self.state.scale.loss_scale) if self.state is not None else 1.0
+
+    def loss_scale(self):
+        return self.cur_scale
+
+    def was_step_applied(self):
+        return self._step_applied
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.micro_batch_size
+
+    def train_batch_size(self):
+        return self.train_batch_size_value
+
+    def gradient_accumulation_steps(self):
+        return self.gradient_accumulation_steps_value
+
+    def get_model_parameters(self, dtype=jnp.float32):
+        """Gathered full-precision parameters (analog of
+        ``zero_gather_16bit_weights_on_model_save`` / zero_to_fp32)."""
+        src = self.state.master if self.state.master is not None else self.state.params
+        rep = self.topology.replicated()
+        return jax.tree.map(lambda x: np.asarray(jax.device_put(x, rep), dtype=dtype), src)
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference engine.py:3056 save / :2712 load)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        from deepspeed_tpu.runtime.checkpoint_engine.native_engine import NativeCheckpointEngine
+        tag = tag or f"global_step{self.global_steps}"
+        engine = NativeCheckpointEngine()
+        path = os.path.join(save_dir, str(tag))
+        meta = {
+            "counters": {
+                "global_steps": self.global_steps,
+                "global_samples": self.global_samples,
+                "micro_steps": self.micro_steps,
+                "skipped_steps": self.skipped_steps,
+            },
+            "lr_scheduler": self.lr_scheduler.state_dict(),
+            "client_state": client_state or {},
+            "ds_config": self.config._param_dict,
+        }
+        engine.save(self.state, path, meta=meta)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+        log_dist(f"saved checkpoint {path}", ranks=[0])
+        return path
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True, load_module_only=False):
+        from deepspeed_tpu.runtime.checkpoint_engine.native_engine import NativeCheckpointEngine
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest):
+                logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = os.path.join(load_dir, str(tag))
+        engine = NativeCheckpointEngine()
+        assert self.state is not None, "engine state must be initialized before load"
+        new_state = engine.load(path, template=self.state)
+        meta = engine.load_meta(path)
+        if load_module_only or not load_optimizer_states:
+            new_state = self.state._replace(params=new_state.params, master=new_state.master)
+        # restore device placement/shardings
+        shard_template = self.state
+        new_state = jax.tree.map(
+            lambda new, old: jax.device_put(jnp.asarray(new), old.sharding)
+            if hasattr(old, "sharding") else new,
+            new_state, shard_template)
+        self.state = new_state
+        c = meta.get("counters", {"global_steps": 0, "global_samples": 0,
+                                  "micro_steps": 0, "skipped_steps": 0})
+        self.global_steps = int(c["global_steps"])
+        self.global_samples = int(c["global_samples"])
+        self.micro_steps = int(c["micro_steps"])
+        # skipped count travels inside the device state (TrainState.skipped)
+        if load_lr_scheduler_states and "lr_scheduler" in meta:
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        log_dist(f"loaded checkpoint {path} (step {self.global_steps})", ranks=[0])
+        return path, meta.get("client_state", {})
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
+        """reference engine ``save_16bit_model`` — gathered half-precision dump."""
+        os.makedirs(save_dir, exist_ok=True)
+        params = self.get_model_parameters(dtype=np.float16 if self.fp16_enabled else np.float32)
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            flat[jax.tree_util.keystr(path)] = leaf
+        np.savez(os.path.join(save_dir, save_filename), **flat)
+        return os.path.join(save_dir, save_filename)

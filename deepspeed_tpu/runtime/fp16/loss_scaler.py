@@ -1,0 +1,53 @@
+"""Loss scaling (mirrors reference ``deepspeed/runtime/fp16/loss_scaler.py:42,67``).
+
+``LossScaler`` is static; ``DynamicLossScaler`` doubles after
+``scale_window`` consecutive overflow-free steps and halves (with hysteresis)
+on overflow. Here the scaler state is a small pytree updated *inside* the jitted
+apply step with ``lax.cond``-free arithmetic, so overflow skipping costs no
+host sync.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jnp.ndarray      # f32 scalar
+    good_steps: jnp.ndarray      # i32 consecutive overflow-free steps
+    hysteresis: jnp.ndarray      # i32 remaining tolerated overflows before halving
+
+
+def init_loss_scale_state(fp16_config, static_scale=None):
+    if static_scale is None:
+        static_scale = fp16_config.loss_scale
+    if static_scale and static_scale > 0:
+        init = float(static_scale)
+    else:
+        init = float(2.0 ** fp16_config.initial_scale_power)
+    return LossScaleState(loss_scale=jnp.float32(init),
+                          good_steps=jnp.int32(0),
+                          hysteresis=jnp.int32(fp16_config.hysteresis))
+
+
+def update_loss_scale(state, found_inf, fp16_config, dynamic):
+    """One ``DynamicLossScaler.update_scale`` step (reference loss_scaler.py:67)
+    as branch-free arithmetic. Returns the new state."""
+    if not dynamic:
+        return state
+    window = fp16_config.loss_scale_window
+    min_scale = fp16_config.min_loss_scale
+    found_inf = found_inf.astype(jnp.bool_)
+
+    # on overflow: consume hysteresis; halve scale only when hysteresis exhausted
+    hys_left = jnp.where(found_inf, jnp.maximum(state.hysteresis - 1, 0), state.hysteresis)
+    do_halve = found_inf & (state.hysteresis <= 1)
+    scale = jnp.where(do_halve, jnp.maximum(state.loss_scale / 2.0, min_scale), state.loss_scale)
+
+    good = jnp.where(found_inf, 0, state.good_steps + 1)
+    do_grow = (~found_inf) & (good % window == 0) & (good > 0)
+    scale = jnp.where(do_grow, scale * 2.0, scale)
+    # reset hysteresis on successful growth interval (consecutive_hysteresis=False default)
+    hys = jnp.where(do_grow | (~found_inf & ~fp16_config.consecutive_hysteresis),
+                    jnp.int32(fp16_config.hysteresis), hys_left)
+    return LossScaleState(loss_scale=scale, good_steps=good, hysteresis=hys)

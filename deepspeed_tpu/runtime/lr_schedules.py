@@ -1,0 +1,162 @@
+"""LR schedules (mirrors reference ``deepspeed/runtime/lr_schedules.py:18-22,267``).
+
+The reference implements LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR and
+WarmupCosineLR as torch scheduler objects. Here each schedule is a pure
+``lr(step) -> float`` function built from the same config params — usable both
+inside jit (jnp ops only) and on the host — wrapped in a scheduler shim with
+the reference's ``step()/get_lr()/state_dict()`` surface.
+"""
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, WARMUP_COSINE_LR]
+
+
+def _warmup(step, warmup_num_steps, warmup_min_lr, warmup_max_lr, warmup_type="log"):
+    warmup_num_steps = max(2, warmup_num_steps)
+    if warmup_type == "log":
+        # reference _get_gamma: min + (max-min) * log(step+1)/log(warmup_steps)
+        # (log(1)=0 at step 0 => exactly warmup_min_lr)
+        frac = jnp.log(step + 1.0) / jnp.log(float(warmup_num_steps))
+    else:  # linear
+        frac = step / float(warmup_num_steps)
+    frac = jnp.clip(frac, 0.0, 1.0)
+    return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+
+def warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.001, warmup_num_steps=1000,
+              warmup_type="log", **_):
+    """reference WarmupLR: warmup then hold at max."""
+
+    def lr(step):
+        step = jnp.asarray(step, jnp.float32)
+        return jnp.where(step < warmup_num_steps,
+                         _warmup(step, warmup_num_steps, warmup_min_lr, warmup_max_lr, warmup_type),
+                         warmup_max_lr)
+
+    return lr
+
+
+def warmup_decay_lr(total_num_steps, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                    warmup_num_steps=1000, warmup_type="log", **_):
+    """reference WarmupDecayLR: warmup then linear decay to 0 at total_num_steps."""
+
+    def lr(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay_frac = jnp.clip(
+            (total_num_steps - step) / jnp.maximum(float(total_num_steps - warmup_num_steps), 1.0),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps,
+                         _warmup(step, warmup_num_steps, warmup_min_lr, warmup_max_lr, warmup_type),
+                         warmup_max_lr * decay_frac)
+
+    return lr
+
+
+def warmup_cosine_lr(total_num_steps, warmup_min_ratio=0.0, warmup_num_steps=1000,
+                     cos_min_ratio=0.0001, warmup_type="log", warmup_max_lr=1.0, **_):
+    """reference WarmupCosineLR: ratio warmup then cosine decay; returns a
+    multiplier of the optimizer lr (we fold warmup_max_lr in for an absolute lr)."""
+
+    def lr(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = _warmup(step, warmup_num_steps, warmup_min_ratio * warmup_max_lr,
+                       warmup_max_lr, warmup_type)
+        progress = jnp.clip((step - warmup_num_steps) /
+                            jnp.maximum(float(total_num_steps - warmup_num_steps), 1.0), 0.0, 1.0)
+        cosine = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr * cosine)
+
+    return lr
+
+
+def lr_range_test(lr_range_test_min_lr=1e-3, lr_range_test_step_size=2000,
+                  lr_range_test_step_rate=1.0, lr_range_test_staircase=False, **_):
+    """reference LRRangeTest (:18): linearly/staircase increasing lr probe."""
+
+    def lr(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = (jnp.floor(step / lr_range_test_step_size)
+                    if lr_range_test_staircase else step / lr_range_test_step_size)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return lr
+
+
+def one_cycle(cycle_min_lr=0.0, cycle_max_lr=0.001, decay_lr_rate=0.0,
+              cycle_first_step_size=2000, cycle_second_step_size=None,
+              cycle_first_stair_count=0, cycle_second_stair_count=None,
+              decay_step_size=0, **_):
+    """reference OneCycle (:19): triangular cycle then decay."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def lr(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (step / cycle_first_step_size)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * ((step - cycle_first_step_size) / second)
+        in_cycle = jnp.where(step < cycle_first_step_size, up, down)
+        post = step - total_cycle
+        decayed = cycle_min_lr if decay_step_size == 0 else (
+            cycle_min_lr / (1.0 + jnp.floor(post / decay_step_size) * decay_lr_rate))
+        return jnp.where(step < total_cycle, in_cycle, decayed)
+
+    return lr
+
+
+_FACTORIES = {
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+    LR_RANGE_TEST: lr_range_test,
+    ONE_CYCLE: one_cycle,
+}
+
+
+def get_lr_schedule(name, params, base_lr=None):
+    """Build an ``lr(step)`` function from a scheduler config section."""
+    if name is None:
+        base = base_lr if base_lr is not None else 1e-3
+        return lambda step: jnp.asarray(base, jnp.float32)
+    if name not in _FACTORIES:
+        raise ValueError(f"unknown lr schedule {name}; valid: {VALID_LR_SCHEDULES}")
+    params = dict(params or {})
+    if base_lr is not None:
+        params.setdefault("warmup_max_lr", base_lr)
+    return _FACTORIES[name](**params)
+
+
+class LRSchedulerShim:
+    """Object with the reference scheduler surface (step/get_lr/state_dict)."""
+
+    def __init__(self, schedule_fn, engine=None):
+        self.schedule_fn = schedule_fn
+        self._engine = engine
+        self.last_batch_iteration = -1
+
+    def step(self, last_batch_iteration=None):
+        if last_batch_iteration is not None:
+            self.last_batch_iteration = last_batch_iteration
+        else:
+            self.last_batch_iteration += 1
+
+    def get_lr(self):
+        step = self.last_batch_iteration
+        if self._engine is not None:
+            step = self._engine.global_steps
+        return [float(self.schedule_fn(max(step, 0)))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]

@@ -1,0 +1,79 @@
+"""Runtime math/memory utilities (mirrors reference ``deepspeed/runtime/utils.py``).
+
+- ``get_global_norm_of_tensors`` (:836) / ``clip_grad_norm_`` (:316) → pytree
+  global-norm + clip, GSPMD-safe (partial sums over sharded leaves are combined
+  by XLA automatically).
+- ``CheckOverflow`` (:182) → ``has_overflow`` on a pytree.
+- ``see_memory_usage`` (:762) → PJRT memory stats.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def global_norm(tree, use_rms=False):
+    """L2 norm over every leaf of a pytree (reference utils.py:836)."""
+    leaves = [jnp.sum(jnp.square(l.astype(jnp.float32))) for l in jax.tree.leaves(tree)]
+    total = jnp.asarray(0.0, jnp.float32) if not leaves else sum(leaves)
+    if use_rms:
+        n = sum(l.size for l in jax.tree.leaves(tree))
+        return jnp.sqrt(total / max(n, 1))
+    return jnp.sqrt(total)
+
+
+def clip_grads_by_global_norm(grads, max_norm, norm=None, eps=1e-6):
+    """Scale grads so their global norm ≤ max_norm (reference clip_grad_norm_:316).
+    Returns (clipped_grads, pre_clip_norm)."""
+    if norm is None:
+        norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + eps))
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads), norm
+
+
+def has_overflow(tree):
+    """True if any leaf contains inf/nan (reference CheckOverflow, utils.py:182)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [~jnp.all(jnp.isfinite(l.astype(jnp.float32))) for l in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = out | f
+    return out
+
+
+def tree_where(pred, a, b):
+    """Elementwise select whole pytrees on a scalar predicate (used for fp16
+    overflow step-skipping without host sync)."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def tree_cast(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def tree_zeros_like(tree, dtype=None):
+    return jax.tree.map(lambda x: jnp.zeros(x.shape, dtype or x.dtype), tree)
+
+
+def count_parameters(tree):
+    return sum(l.size for l in jax.tree.leaves(tree))
+
+
+def see_memory_usage(message, force=False):
+    """reference utils.py:762 — PJRT per-device memory stats."""
+    from deepspeed_tpu.accelerator import get_accelerator
+    acc = get_accelerator()
+    stats = acc.memory_stats()
+    gb = 1024**3
+    logger.info(f"{message} | MA {stats.get('bytes_in_use', 0)/gb:.2f} GB | "
+                f"Max_MA {stats.get('peak_bytes_in_use', 0)/gb:.2f} GB | "
+                f"limit {stats.get('bytes_limit', 0)/gb:.2f} GB")
+
+
+def constrain_tree(tree, sharding_tree):
+    """Apply with_sharding_constraint leaf-wise (no-op outside jit tracing)."""
+    return jax.tree.map(jax.lax.with_sharding_constraint, tree, sharding_tree)

@@ -1,0 +1,46 @@
+"""Test model fixtures (mirrors reference ``tests/unit/simple_model.py``)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class SimpleModel(nn.Module):
+    """2-layer MLP regression; returns MSE loss given batch dict (the reference
+    SimpleModel equivalent)."""
+    hidden_dim: int = 16
+
+    @nn.compact
+    def __call__(self, batch, deterministic=True):
+        x, y = batch["x"], batch["y"]
+        h = nn.Dense(self.hidden_dim)(x)
+        h = nn.relu(h)
+        out = nn.Dense(y.shape[-1])(h)
+        return jnp.mean((out - y) ** 2)
+
+
+def random_dataset(n=64, dim=8, out_dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(dim, out_dim)).astype(np.float32)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = (x @ w + 0.01 * rng.normal(size=(n, out_dim))).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def random_batches(n_batches, batch_size, dim=8, out_dim=4, seed=0):
+    data = random_dataset(n_batches * batch_size, dim, out_dim, seed)
+    return [{k: v[i * batch_size:(i + 1) * batch_size] for k, v in data.items()}
+            for i in range(n_batches)]
+
+
+def tiny_gpt2_batches(n_batches, batch_size, seq_len=16, vocab=128, seed=0):
+    """Learnable sequences: consecutive tokens mod vocab, so next-token
+    prediction has near-zero irreducible loss."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        start = rng.integers(0, vocab, size=(batch_size, 1))
+        ids = ((start + np.arange(seq_len)[None, :]) % vocab).astype(np.int32)
+        out.append({"input_ids": ids, "labels": ids})
+    return out

@@ -1,0 +1,94 @@
+"""LR schedule tests (mirrors reference ``tests/unit/runtime/test_lr_schedulers.py``)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (get_lr_schedule, warmup_lr, warmup_decay_lr,
+                                                warmup_cosine_lr, one_cycle, lr_range_test)
+
+
+def test_warmup_lr_endpoints():
+    lr = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.01, warmup_num_steps=10)
+    assert float(lr(0)) < 0.01
+    assert float(lr(10)) == pytest.approx(0.01, rel=1e-5)
+    assert float(lr(100)) == pytest.approx(0.01, rel=1e-5)
+
+
+def test_warmup_lr_linear():
+    lr = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.01, warmup_num_steps=10,
+                   warmup_type="linear")
+    assert float(lr(5)) == pytest.approx(0.005, rel=1e-5)
+
+
+def test_warmup_decay_hits_zero():
+    lr = warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.01, warmup_num_steps=10)
+    assert float(lr(100)) == pytest.approx(0.0, abs=1e-8)
+    assert float(lr(55)) == pytest.approx(0.01 * 0.5, rel=1e-5)
+
+
+def test_warmup_cosine_monotone_decay():
+    lr = warmup_cosine_lr(total_num_steps=100, warmup_num_steps=10, warmup_max_lr=0.01)
+    vals = [float(lr(s)) for s in range(10, 101, 10)]
+    assert all(a >= b for a, b in zip(vals, vals[1:]))
+    assert vals[-1] == pytest.approx(0.01 * 0.0001, rel=1e-2)
+
+
+def test_one_cycle_shape():
+    lr = one_cycle(cycle_min_lr=0.001, cycle_max_lr=0.01, cycle_first_step_size=10)
+    assert float(lr(0)) == pytest.approx(0.001, rel=1e-4)
+    assert float(lr(10)) == pytest.approx(0.01, rel=1e-4)
+    assert float(lr(20)) == pytest.approx(0.001, rel=1e-4)
+
+
+def test_lr_range_test_growth():
+    lr = lr_range_test(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                       lr_range_test_step_rate=1.0)
+    assert float(lr(0)) == pytest.approx(0.001)
+    assert float(lr(10)) == pytest.approx(0.002)
+
+
+def test_get_lr_schedule_unknown_raises():
+    with pytest.raises(ValueError):
+        get_lr_schedule("NoSuchSchedule", {})
+
+
+def test_constant_when_none():
+    lr = get_lr_schedule(None, {}, base_lr=0.42)
+    assert float(lr(0)) == pytest.approx(0.42)
+    assert float(lr(999)) == pytest.approx(0.42)
+
+
+def test_engine_uses_schedule():
+    import deepspeed_tpu
+    from tests.simple_model import SimpleModel, random_batches
+    import jax
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, sched = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_num_steps": 4, "warmup_max_lr": 0.01,
+                                         "warmup_type": "linear"}}})
+    lrs = []
+    for b in random_batches(5, 8):
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+        lrs.append(engine.get_lr()[0])
+    assert lrs[0] < lrs[-1]
+    assert lrs[-1] == pytest.approx(0.01, rel=1e-3)
+
+
+def test_dataloader_batching():
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+    data = {"x": np.arange(32).reshape(32, 1).astype(np.float32)}
+    dl = DeepSpeedDataLoader(data, batch_size=8, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 4 and batches[0]["x"].shape == (8, 1)
+    rl = RepeatingLoader(dl)
+    for _ in range(10):
+        b = next(rl)
+        assert b["x"].shape == (8, 1)
