@@ -1,0 +1,155 @@
+"""Mixtral (sparse MoE) model family.
+
+Covers the reference's Mixtral support (``inference/v2/model_implementations/
+mixtral``) as a first-class training+inference model: Llama backbone with a
+top-2-of-8 expert MLP per layer, experts sharded over the ``ep`` mesh axis via
+the MoE layer (``deepspeed_tpu/moe``). The per-layer router aux losses are
+summed into the LM loss with ``router_aux_loss_coef`` exactly as HF Mixtral
+does.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.llama import LlamaAttention, LlamaConfig, RMSNorm
+from deepspeed_tpu.moe.sharded_moe import MOELayer
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    router_aux_loss_coef: float = 0.02
+    capacity_factor: float = 2.0
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 1e6
+    remat: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def tiny(**kw):
+        return MixtralConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                             num_hidden_layers=2, num_attention_heads=4,
+                             num_key_value_heads=2, num_local_experts=4,
+                             max_position_embeddings=128, **kw)
+
+    @staticmethod
+    def mixtral_8x7b(**kw):
+        return MixtralConfig(**kw)
+
+    def as_llama(self):
+        return LlamaConfig(vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+                           intermediate_size=self.intermediate_size,
+                           num_hidden_layers=self.num_hidden_layers,
+                           num_attention_heads=self.num_attention_heads,
+                           num_key_value_heads=self.num_key_value_heads,
+                           max_position_embeddings=self.max_position_embeddings,
+                           rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+                           dtype=self.dtype)
+
+
+class MixtralExpertMLP(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(feats, use_bias=False, dtype=cfg.dtype, name=name)
+        gate = nn.silu(dense(cfg.intermediate_size, "w1")(x))
+        up = dense(cfg.intermediate_size, "w3")(x)
+        return dense(cfg.hidden_size, "w2")(gate * up)
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions, train=True):
+        cfg = self.config
+        x = x + LlamaAttention(cfg.as_llama(), name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x), positions)
+        moe_out, l_aux, _ = MOELayer(
+            lambda: MixtralExpertMLP(cfg),
+            num_experts=cfg.num_local_experts,
+            k=cfg.num_experts_per_tok,
+            capacity_factor=cfg.capacity_factor,
+            eval_capacity_factor=cfg.capacity_factor,
+            name="block_sparse_moe")(
+                RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(x),
+                train)
+        return x + moe_out, l_aux
+
+
+class MixtralForCausalLM(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic=True):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        embed = self.param("embed_tokens", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        x = embed.astype(cfg.dtype)[input_ids]
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+
+        total_aux = 0.0
+        block_cls = nn.remat(MixtralBlock, prevent_cse=False,
+                             static_argnums=(3,)) if cfg.remat else MixtralBlock
+        for i in range(cfg.num_hidden_layers):
+            x, l_aux = block_cls(cfg, name=f"layers_{i}")(x, positions,
+                                                          not deterministic)
+            total_aux = total_aux + l_aux
+
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+        lm_head = self.param("lm_head", nn.initializers.normal(0.02),
+                             (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        logits = x @ lm_head.astype(cfg.dtype).T
+        if labels is None:
+            return logits
+        from deepspeed_tpu.models.losses import next_token_loss
+        lm_loss = next_token_loss(logits, labels)
+        return lm_loss + cfg.router_aux_loss_coef * total_aux / cfg.num_hidden_layers
+
+    def param_specs(self, params):
+        """TP specs for attention + ep sharding for stacked experts."""
+        def spec_for(path, leaf):
+            names = "/".join(str(getattr(p, "key", getattr(p, "name", ""))) for p in path)
+            if "experts" in names:
+                if leaf.ndim >= 2:
+                    # [E, in, out] expert kernels: ep on expert axis, tp on the
+                    # column/row dim matching Megatron pattern
+                    if "w1" in names or "w3" in names:
+                        return P("ep", None, "tp")
+                    if "w2" in names:
+                        return P("ep", "tp", None)
+                return P("ep")
+            if leaf.ndim == 1:
+                return None
+            if "embed_tokens" in names or "lm_head" in names:
+                return P("tp", None)
+            if any(k in names for k in ("q_proj", "k_proj", "v_proj")):
+                return P(None, "tp")
+            if "o_proj" in names:
+                return P("tp", None)
+            return None
+
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        specs = [spec_for(p, l) for p, l in flat]
+        return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(params), specs)

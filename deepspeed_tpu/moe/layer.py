@@ -1,0 +1,49 @@
+"""MoE user-facing layer (mirrors reference ``deepspeed/moe/layer.py:17``).
+
+``MoE`` wraps an expert module with gating + expert-parallel dispatch, and
+optionally the PR-MoE "residual" variant (:reference ``moe/layer.py`` —
+use_residual=True runs a dense MLP in parallel and mixes with a learned
+coefficient).
+"""
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.moe.sharded_moe import MOELayer
+
+
+class MoE(nn.Module):
+    """Drop-in MoE block. Returns (output, l_aux, exp_counts) like the reference.
+
+    expert_factory: zero-arg callable building one expert module (the reference
+    takes an ``expert`` nn.Module and deep-copies it per expert; a factory is
+    the functional equivalent).
+    """
+    hidden_size: int
+    expert_factory: Callable[[], nn.Module]
+    num_experts: int = 1
+    ep_size: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    use_residual: bool = False
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states, train=True):
+        out, l_aux, exp_counts = MOELayer(
+            self.expert_factory, self.num_experts, self.k,
+            self.capacity_factor, self.eval_capacity_factor, self.min_capacity,
+            self.noisy_gate_policy, self.drop_tokens,
+            name="deepspeed_moe")(hidden_states, train)
+        if self.use_residual:
+            # PR-MoE: dense residual expert mixed via learned 2-way coefficient
+            res = self.expert_factory()(hidden_states)
+            coef = nn.Dense(2, name="coefficient")(hidden_states)
+            coef = nn.softmax(coef, axis=-1)
+            out = out * coef[..., 0:1] + res * coef[..., 1:2]
+        return out, l_aux, exp_counts

@@ -1,0 +1,199 @@
+"""Sharded MoE core: gating + expert-parallel dispatch.
+
+Mirrors reference ``deepspeed/moe/sharded_moe.py``: ``TopKGate`` (:372) with
+top-1/top-2/top-k gating, capacity factor, minimum capacity, optional noisy
+gating and the GShard load-balancing auxiliary loss (:181,:288); ``MOELayer``
+(:455) dispatch → expert FFN → combine.
+
+TPU-native design: dispatch/combine are the GShard einsum formulation over a
+token-capacity layout. The expert dimension E is sharded over the ``ep`` mesh
+axis and tokens are sharded over the data axes, so the two dispatch einsums
+*are* the all-to-alls — XLA GSPMD materializes them as such on ICI (the
+explicit ``lax.all_to_all`` path in comm.py exists for shard_map callers).
+Everything is branch-free and statically shaped (capacity fixed at trace time),
+as TPU requires — the reference's dynamic drop-token paths become masked
+writes into the fixed-capacity buffer.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def _one_hot(idx, num):
+    return jax.nn.one_hot(idx, num, dtype=jnp.float32)
+
+
+def top1gating(logits, capacity_factor=1.0, min_capacity=4, noisy_gate_policy=None,
+               rng=None, used_token_mask=None, drop_tokens=True):
+    """Top-1 gating (reference ``sharded_moe.py:181``).
+
+    logits: [S, E]. Returns (l_aux, combine [S,E,C], dispatch [S,E,C], exp_counts [E]).
+    """
+    S, E = logits.shape
+    capacity = _capacity(S, E, 1, capacity_factor, min_capacity, drop_tokens)
+
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_w_noise = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(logits_w_noise, axis=-1)  # [S]
+    mask1 = _one_hot(idx, E)  # [S, E]
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[:, None]
+
+    # position of each token within its expert's queue
+    pos_in_expert = jnp.cumsum(mask1, axis=0) * mask1  # 1-based
+    keep = (pos_in_expert <= capacity) & (mask1 > 0)
+    mask1_kept = mask1 * keep.astype(mask1.dtype)
+
+    # load-balancing loss (GShard): E * sum_e mean_s(gates) * mean_s(mask)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    gate_val = jnp.sum(gates * mask1_kept, axis=-1, keepdims=True)  # [S,1]
+    pos = jnp.sum((pos_in_expert - 1) * mask1_kept, axis=-1).astype(jnp.int32)  # [S]
+    pos_oh = _one_hot(pos, capacity) * jnp.sum(mask1_kept, axis=-1, keepdims=True)
+    combine = gate_val[:, :, None] * mask1_kept[:, :, None] * pos_oh[:, None, :]
+    dispatch = combine > 0
+    # reference returns PRE-drop routing counts (sharded_moe.py:209) so router
+    # imbalance/overflow stays observable
+    exp_counts = jnp.sum(mask1, axis=0)
+    return l_aux, combine, dispatch, exp_counts
+
+
+def topkgating(logits, k=2, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
+               normalize_gates=True):
+    """Top-k gating (reference top2gating ``sharded_moe.py:288`` generalized to k).
+
+    logits: [S, E]. Returns (l_aux, combine [S,E,C], dispatch [S,E,C], exp_counts).
+    """
+    S, E = logits.shape
+    capacity = _capacity(S, E, k, capacity_factor, min_capacity, drop_tokens)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    # iterative top-k with masking (static k)
+    masks = []
+    g = gates
+    for _ in range(k):
+        idx = jnp.argmax(g, axis=-1)
+        m = _one_hot(idx, E)
+        masks.append(m)
+        g = g * (1 - m)
+    # aux loss on first choice (reference top2gating)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # queue positions: ranks within each expert across all k choices, first
+    # choices first (matches reference ordering: locations2 += sum(mask1))
+    combined = jnp.zeros((S, E, capacity), jnp.float32)
+    offset = jnp.zeros((E,), jnp.float32)
+    total_mask = jnp.zeros((S, E), jnp.float32)
+    for m in masks:
+        pos = (jnp.cumsum(m, axis=0) - 1) * m + offset[None, :] * m  # 0-based
+        keep = (pos < capacity) & (m > 0)
+        mk = m * keep.astype(m.dtype)
+        gate_val = jnp.sum(gates * mk, axis=-1, keepdims=True)  # [S,1]
+        pos_idx = jnp.sum(pos * mk, axis=-1).astype(jnp.int32)
+        pos_oh = _one_hot(pos_idx, capacity) * jnp.sum(mk, axis=-1, keepdims=True)
+        combined = combined + gate_val[:, :, None] * mk[:, :, None] * pos_oh[:, None, :]
+        offset = offset + jnp.sum(m, axis=0)
+        total_mask = total_mask + mk
+    if normalize_gates:
+        denom = jnp.sum(combined, axis=(1, 2), keepdims=True)
+        combined = combined / jnp.maximum(denom, 1e-9)
+        # restore absolute gate mass (reference normalizes by sum of selected gates)
+    dispatch = combined > 0
+    # pre-drop routing counts (see top1gating note)
+    exp_counts = jnp.sum(sum(masks), axis=0)
+    return l_aux, combined, dispatch, exp_counts
+
+
+def _capacity(S, E, k, capacity_factor, min_capacity, drop_tokens):
+    """reference ``sharded_moe.py`` _capacity: tokens-per-expert budget (ceil,
+    matching the reference's math.ceil)."""
+    import math
+    if not drop_tokens:
+        return S  # full capacity: nothing can drop
+    cap = max(math.ceil((S * k / E) * capacity_factor), min_capacity)
+    return min(cap, S)
+
+
+class TopKGate(nn.Module):
+    """reference ``sharded_moe.py:372`` TopKGate — linear router + gating."""
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        # router in fp32 (reference casts gate input to fp32)
+        wg = self.param("wg", nn.initializers.normal(0.02),
+                        (x.shape[-1], self.num_experts), jnp.float32)
+        logits = x.astype(jnp.float32) @ wg
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        rng = self.make_rng("gating") if (train and self.noisy_gate_policy == "RSample"
+                                          and self.has_rng("gating")) else None
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity, self.noisy_gate_policy,
+                              rng=rng, drop_tokens=self.drop_tokens)
+        return topkgating(logits, self.k, cf, self.min_capacity,
+                          drop_tokens=self.drop_tokens)
+
+
+class Experts(nn.Module):
+    """E experts applied to [E, C, D] inputs; parameters stacked on the expert
+    axis and sharded over 'ep' (reference ``moe/experts.py`` DistributedExperts)."""
+    expert_factory: Callable[[], nn.Module]
+    num_experts: int
+
+    @nn.compact
+    def __call__(self, x):
+        VmappedExpert = nn.vmap(
+            lambda mdl, xs: mdl(xs),
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=0, out_axes=0,
+            axis_size=self.num_experts,
+            metadata_params={nn.meta.PARTITION_NAME: "expert"},
+        )
+        return VmappedExpert(self.expert_factory(), x)
+
+
+class MOELayer(nn.Module):
+    """reference ``sharded_moe.py:455`` MOELayer: gate → dispatch(all-to-all) →
+    experts → combine(all-to-all). Returns (output, l_aux, exp_counts)."""
+    expert_factory: Callable[[], nn.Module]
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        orig_shape = x.shape
+        D = x.shape[-1]
+        xf = x.reshape(-1, D)  # [S, D] tokens sharded over data axes
+        l_aux, combine, dispatch, exp_counts = TopKGate(
+            self.num_experts, self.k, self.capacity_factor, self.eval_capacity_factor,
+            self.min_capacity, self.noisy_gate_policy, self.drop_tokens,
+            name="gate")(xf, train)
+        # dispatch einsum == all-to-all when E is ep-sharded and S is dp-sharded
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(xf.dtype), xf)
+        expert_out = Experts(self.expert_factory, self.num_experts,
+                             name="experts")(expert_in)
+        out = jnp.einsum("sec,ecd->sd", combine.astype(expert_out.dtype), expert_out)
+        return out.reshape(orig_shape), l_aux, exp_counts

@@ -1,0 +1,37 @@
+"""MoE utilities (mirrors reference ``deepspeed/moe/utils.py``)."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def is_moe_param_path(path_str):
+    # keystr uses bracket notation: "['layers_0']['block_sparse_moe']['experts']..."
+    return "deepspeed_moe" in path_str or "experts" in path_str
+
+
+def split_params_into_different_moe_groups_for_optimizer(params):
+    """Partition a param tree into expert/non-expert groups (reference
+    ``moe/utils.py`` split_params_into_different_moe_groups_for_optimizer).
+    Returns (moe_paths, dense_paths)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    moe, dense = [], []
+    for path, leaf in flat:
+        s = jax.tree_util.keystr(path)
+        (moe if is_moe_param_path(s) else dense).append(s)
+    return moe, dense
+
+
+def moe_param_specs(params, scan_layers=False):
+    """ep-shard the stacked expert axis of every expert leaf; everything else
+    is left to the model/ZeRO partitioner."""
+
+    def spec_for(path, leaf):
+        s = jax.tree_util.keystr(path)
+        if "experts" in s and leaf.ndim >= 1:
+            prefix = (None,) if scan_layers else ()
+            return P(*prefix, "ep")
+        return None
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    specs = [spec_for(p, l) for p, l in flat]
+    return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(params), specs)

@@ -1,0 +1,3 @@
+"""Re-export surface mirroring ``deepspeed/pipe`` (reference deepspeed/pipe/__init__.py)."""
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec, PipelineModule  # noqa: F401
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine  # noqa: F401

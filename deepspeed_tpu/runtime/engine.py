@@ -321,6 +321,9 @@ class DeepSpeedEngine:
         accum_dtype = self.grad_accum_dtype
         fp16 = self.fp16_enabled
         model_fn = self._model_fn
+        # PipelineEngine pre-multiplies: its one fused call already averages over
+        # the GAS microbatches, so the apply-step's /gas must cancel
+        mult = float(getattr(self, "_grad_scale_multiplier", 1.0))
 
         def micro_step(state: TrainState, batch):
             rng, sub = jax.random.split(state.rng)
@@ -330,6 +333,8 @@ class DeepSpeedEngine:
                 if isinstance(loss, tuple):
                     loss = loss[0]
                 scaled = loss.astype(jnp.float32)
+                if mult != 1.0:
+                    scaled = scaled * mult
                 if fp16:
                     scaled = scaled * state.scale.loss_scale
                 if prescale and predivide != 1.0:

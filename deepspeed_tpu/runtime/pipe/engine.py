@@ -1,0 +1,189 @@
+"""Pipeline-parallel engine.
+
+Mirrors the reference ``PipelineEngine`` (``runtime/pipe/engine.py:327``
+``train_batch`` / :416 ``eval_batch``) — but where the reference interprets a
+1F1B instruction stream with explicit p2p sends (``pipe/p2p.py:46,67``), the
+TPU engine compiles the entire pipeline rotation into ONE XLA program:
+
+- stage s holds block parameters [L/S, ...] (leading stacked-layer axis sharded
+  over the ``pp`` mesh axis)
+- each clock tick every stage applies its blocks to its current microbatch and
+  the activations rotate to the next stage via ``lax.ppermute`` on ICI
+- fill/drain bubbles are masked compute (SPMD requires uniform programs)
+- JAX autodiff of the scan-of-ppermute program IS the backward schedule: the
+  transpose of ppermute is the reverse rotation, so backward pipelining comes
+  for free, and ``jax.checkpoint`` on the block gives the standard
+  activation-recompute memory profile
+
+Embed/head (first/last-stage-only roles in the reference) run under plain
+GSPMD outside the rotation.
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.pipe.module import PipelineModule
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def collective_pipeline(block_apply, blocks_params, x_micro, mesh, *,
+                        num_stages, remat=True, pp_axis="pp", extra=None):
+    """Run M microbatches through the rotated block pipeline — pure GSPMD form.
+
+    block_apply: (params_one_layer, x, extra) -> x
+    blocks_params: stacked [L, ...] pytree (L = num_layers), pp-sharded on axis 0
+    x_micro: [M, ...activation shape] (dp/sp shardings compose automatically)
+    Returns: [M, ...] outputs after all L layers.
+
+    Mechanics: activations live in a stage-stacked buffer [S, ...] whose leading
+    axis is sharded over ``pp``; per-tick compute is ``vmap`` over that axis (so
+    each device runs only its stage — the layer chunks differ only in the
+    pp-sharded parameter slice) and the stage hand-off is ``jnp.roll`` on the
+    sharded axis, which XLA lowers to a collective-permute over ICI. No manual
+    region is needed, so tp/sp GSPMD inside the block composes untouched, and
+    autodiff of the scan yields the reverse-rotation backward schedule.
+    """
+    body = jax.checkpoint(block_apply) if remat else block_apply
+    S = num_stages
+    M = x_micro.shape[0]
+
+    blocks = jax.tree.map(
+        lambda a: a.reshape((S, a.shape[0] // S) + a.shape[1:]), blocks_params)
+    blocks = jax.tree.map(
+        lambda a: jax.lax.with_sharding_constraint(
+            a, jax.NamedSharding(mesh, P(pp_axis))), blocks)
+
+    def apply_stage(stage_blocks, x):
+        def layer(h, p):
+            return body(p, h, extra), None
+        out, _ = lax.scan(layer, x, stage_blocks)
+        return out
+
+    stage_vmap = jax.vmap(apply_stage, in_axes=(0, 0), out_axes=0)
+    buf_spec = P(pp_axis)
+
+    def tick(carry, t):
+        buf, outputs = carry  # buf: [S, ...] pp-sharded
+        feed_idx = jnp.clip(t, 0, M - 1)
+        feed = lax.dynamic_index_in_dim(x_micro, feed_idx, 0, keepdims=False)
+        feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
+        buf = buf.at[0].set(feed)
+        out = stage_vmap(blocks, buf)
+        out = jax.lax.with_sharding_constraint(
+            out, jax.NamedSharding(mesh, buf_spec))
+        # collect the last stage's result for microbatch t-(S-1)
+        oidx = jnp.clip(t - (S - 1), 0, M - 1)
+        valid = t - (S - 1) >= 0
+        cur = lax.dynamic_index_in_dim(outputs, oidx, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, out[S - 1], cur), oidx, 0)
+        # rotate stages: s -> s+1 (slot 0 is overwritten by the next feed)
+        buf = jnp.roll(out, 1, axis=0)
+        return (buf, outputs), None
+
+    init_buf = jnp.zeros((S,) + x_micro.shape[1:], x_micro.dtype)
+    init_buf = jax.device_put(init_buf, jax.NamedSharding(mesh, buf_spec)) \
+        if not isinstance(init_buf, jax.core.Tracer) else init_buf
+    init_out = jnp.zeros_like(x_micro)
+    (_, outputs), _ = lax.scan(tick, (init_buf, init_out), jnp.arange(M + S - 1))
+    return outputs
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """Engine over a ``PipelineModule``. ``train_batch`` consumes
+    ``gradient_accumulation_steps`` microbatches per optimizer step, exactly as
+    the reference (micro_batches == gas, pipe/engine.py:55)."""
+
+    def __init__(self, config=None, model=None, **kwargs):
+        assert isinstance(model, PipelineModule), \
+            "PipelineEngine requires a deepspeed_tpu PipelineModule"
+        self.pipe_module = model
+        super().__init__(config=config, model=model, **kwargs)
+        if self.pipe_module.num_stages is None:
+            self.pipe_module.num_stages = self.topology.pp_size
+            if self.pipe_module.num_layers % self.pipe_module.num_stages != 0:
+                raise ValueError(
+                    f"compiled SPMD pipelining requires num_layers "
+                    f"({self.pipe_module.num_layers}) divisible by the mesh's "
+                    f"pp size ({self.pipe_module.num_stages})")
+        assert self.topology.pp_size == self.pipe_module.num_stages, (
+            f"mesh pp={self.topology.pp_size} != module stages "
+            f"{self.pipe_module.num_stages}")
+        self.micro_batches = self.gradient_accumulation_steps_value
+        # grads of the mean-over-all-microbatches loss are already the GAS mean;
+        # pre-multiply so the apply-step's /gas cancels
+        self._grad_scale_multiplier = float(self.gradient_accumulation_steps_value)
+
+    def _normalize_model_fn(self, model):
+        pipe = model
+
+        def model_fn(params, batch, rng, training=True):
+            M = self.micro_batches
+            micro = jax.tree.map(
+                lambda x: x.reshape((M, x.shape[0] // M) + x.shape[1:]), batch)
+            embed = jax.vmap(lambda b: pipe.embed.apply({"params": params["embed"]}, b))(micro) \
+                if pipe.embed else micro
+
+            def block_apply(p, x, extra):
+                return pipe.block.apply({"params": p}, x, *pipe.block_args)
+
+            outs = collective_pipeline(
+                block_apply, params["blocks"], embed, self.mesh,
+                num_stages=self.topology.pp_size,
+                remat=self.config.activation_checkpointing.policy != "nothing")
+            if pipe.head is not None:
+                losses = jax.vmap(
+                    lambda o, b: pipe.head.apply({"params": params["head"]}, o, b)
+                )(outs, micro)
+                return jnp.mean(losses)
+            return outs
+
+        return model_fn
+
+    def _resolve_param_specs(self, params):
+        if self._user_param_specs is not None:
+            return self._user_param_specs
+        return self.pipe_module.param_specs(params)
+
+    def _ensure_initialized(self, batch):
+        if self.state is not None:
+            return
+        mb = self.micro_batches
+        sample = jax.tree.map(lambda x: x[: x.shape[0] // mb], batch)
+        seed = self._rng_seed if isinstance(self._rng_seed, int) else 0
+        params = self.pipe_module.init_params(jax.random.PRNGKey(seed), sample)
+        self._init_state(params)
+
+    def train_batch(self, data_iter=None):
+        """reference pipe/engine.py:327: one call = gas microbatches + step."""
+        if data_iter is None:
+            assert self.training_dataloader is not None
+            if self._data_iterator is None:
+                from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+                self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._data_iterator
+        gas = self.gradient_accumulation_steps_value
+        micro_batches = [next(data_iter) for _ in range(gas)]
+        batch = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *micro_batches)
+        loss = self.forward(batch)
+        self.backward(loss)
+        # one fused call covers the whole GAS cycle; fix up the per-microstep
+        # bookkeeping step() only does once
+        self.micro_steps += gas - 1
+        self.global_samples += (gas - 1) * self.micro_batch_size * self.topology.data_parallel_size
+        self.step()
+        return float(jax.device_get(loss))
+
+    def eval_batch(self, data_iter_or_batch):
+        if hasattr(data_iter_or_batch, "__next__"):
+            gas = self.gradient_accumulation_steps_value
+            micro = [next(data_iter_or_batch) for _ in range(gas)]
+            batch = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *micro)
+        else:
+            batch = data_iter_or_batch
+        return super().eval_batch(batch)

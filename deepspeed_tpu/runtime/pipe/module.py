@@ -1,0 +1,151 @@
+"""Pipeline model container.
+
+Mirrors the reference ``PipelineModule``/``LayerSpec``/``TiedLayerSpec``
+(``runtime/pipe/module.py:86,30,77``). The reference partitions an arbitrary
+``LayerSpec`` list across stages; compiled SPMD pipelining wants a *homogeneous*
+block stack (identical programs per stage), so the TPU-native container is
+explicit about the three roles:
+
+- ``embed``   — first-stage-only computation (batch → activations)
+- ``block``   — the repeated layer, applied ``num_layers`` times; parameters are
+  stacked [L, ...] and split [pp, L/pp, ...] across stages
+- ``head``    — last-stage-only computation (activations(+batch) → loss/logits)
+
+``LayerSpec``/``TiedLayerSpec`` and uniform/parameter-count partitioning are
+retained for API parity: a LayerSpec list whose interior layers share a module
+class is converted into this form by ``PipelineModule.from_layer_specs``.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """reference pipe/module.py:30 — lazily-built layer description."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """reference pipe/module.py:77 — layer whose params are tied across stages
+    (e.g. embedding/unembedding). In the TPU container, ties are expressed by
+    the head closing over the embed params, so the spec records only the key."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_uniform(num_items, num_parts):
+    """reference ds_utils.partition_uniform: balanced contiguous split."""
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    residual = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < residual else 0)
+    return parts
+
+
+def partition_balanced(weights, num_parts):
+    """reference ds_utils.partition_balanced: prefix-sum based split by weight
+    (used for partition_method='parameters')."""
+    import bisect
+    prefix = [0]
+    for w in weights:
+        prefix.append(prefix[-1] + w)
+    total = prefix[-1]
+    parts = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        idx = bisect.bisect_left(prefix, target)
+        # snap to the nearer boundary
+        if idx > 0 and abs(prefix[idx - 1] - target) <= abs(prefix[idx] - target):
+            idx -= 1
+        parts.append(max(idx, parts[-1]))
+    parts.append(len(weights))
+    return parts
+
+
+class PipelineModule:
+    """TPU-native pipeline container (see module docstring)."""
+
+    def __init__(self, embed=None, block=None, head=None, num_layers=None,
+                 num_stages=None, partition_method="uniform",
+                 block_args: tuple = (), loss_fn=None,
+                 activation_checkpoint_interval=0):
+        assert block is not None and num_layers is not None
+        self.embed = embed
+        self.block = block
+        self.head = head
+        self.num_layers = num_layers
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self.block_args = block_args
+        self.loss_fn = loss_fn
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        if num_stages is not None and num_layers % num_stages != 0:
+            raise ValueError(
+                f"compiled SPMD pipelining requires num_layers ({num_layers}) "
+                f"divisible by num_stages ({num_stages})")
+
+    @staticmethod
+    def from_layer_specs(layers, num_stages, loss_fn=None, **kw):
+        """Parity constructor for reference-style LayerSpec lists: the first
+        spec becomes embed, the last becomes head, the homogeneous interior
+        becomes the block stack."""
+        assert len(layers) >= 3, "need embed + blocks + head"
+        interior = layers[1:-1]
+        t0 = interior[0].typename if isinstance(interior[0], LayerSpec) else type(interior[0])
+        for l in interior:
+            t = l.typename if isinstance(l, LayerSpec) else type(l)
+            if t is not t0:
+                raise ValueError(
+                    "compiled SPMD pipelining requires a homogeneous interior "
+                    f"layer stack; got {t0} and {t}")
+        embed = layers[0].build() if isinstance(layers[0], LayerSpec) else layers[0]
+        head = layers[-1].build() if isinstance(layers[-1], LayerSpec) else layers[-1]
+        block = interior[0].build() if isinstance(interior[0], LayerSpec) else interior[0]
+        return PipelineModule(embed=embed, block=block, head=head,
+                              num_layers=len(interior), num_stages=num_stages,
+                              loss_fn=loss_fn, **kw)
+
+    # --- parameter init -------------------------------------------------
+    def init_params(self, rng, sample_batch):
+        """Initialize (embed, stacked blocks [L,...], head) params."""
+        k1, k2, k3 = jax.random.split(rng, 3)
+        x = self.embed.init(k1, sample_batch)["params"] if self.embed else {}
+        embed_params = x
+        act = self.embed.apply({"params": embed_params}, sample_batch) if self.embed else sample_batch
+        keys = jax.random.split(k2, self.num_layers)
+        block_params = jax.vmap(
+            lambda k: self.block.init(k, act, *self.block_args)["params"])(keys)
+        out = self.block.apply(
+            {"params": jax.tree.map(lambda a: a[0], block_params)}, act, *self.block_args)
+        head_params = self.head.init(k3, out, sample_batch)["params"] if self.head else {}
+        return {"embed": embed_params, "blocks": block_params, "head": head_params}
+
+    def param_specs(self, params):
+        """pp-shard the stacked block axis; embed/head replicated (ZeRO/TP
+        compose on the remaining dims via the engine partitioner)."""
+        from jax.sharding import PartitionSpec as P
+
+        specs = {
+            "embed": jax.tree.map(lambda _: None, params["embed"]),
+            "blocks": jax.tree.map(lambda leaf: P("pp"), params["blocks"]),
+            "head": jax.tree.map(lambda _: None, params["head"]),
+        }
+        return specs

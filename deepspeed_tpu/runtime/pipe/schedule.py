@@ -1,0 +1,193 @@
+"""Pipeline schedules.
+
+Mirrors reference ``deepspeed/runtime/pipe/schedule.py``: ``TrainSchedule``
+(:189) / ``InferenceSchedule`` yield per-clock instruction lists
+(LoadMicroBatch/ForwardPass/SendActivation/...). On TPU the schedule is not
+*executed* instruction-by-instruction — the collective pipeline in
+``pipe/engine.py`` compiles the whole rotation into one XLA program and
+autodiff produces the reverse schedule — but the instruction stream is kept
+for parity, introspection and tick math (bubble accounting, tests).
+"""
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+class PipeSchedule:
+    """reference schedule.py PipeSchedule base."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    def num_pipe_buffers(self):
+        return self.micro_batches
+
+    def steps(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference schedule.py InferenceSchedule)."""
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for t in range(total):
+            cmds = []
+            mb = t - self.stage_id
+            if self._valid_micro_batch(mb):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=mb % self.num_pipe_buffers()))
+                else:
+                    cmds.append(RecvActivation(buffer_id=mb % self.num_pipe_buffers()))
+                cmds.append(ForwardPass(buffer_id=mb % self.num_pipe_buffers()))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=mb % self.num_pipe_buffers()))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B schedule description (reference schedule.py:189). Yields the
+    interleaved forward/backward instruction stream per clock tick."""
+
+    def steps(self):
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds = []
+            # communication (reference ordering: recv before compute)
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(micro_batch_id)))
+                    else:
+                        cmds.append(RecvActivation(buffer_id=self._buffer_idx(micro_batch_id)))
+                    cmds.append(ForwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
+                    if not self.is_last_stage:
+                        cmds.append(SendActivation(buffer_id=self._buffer_idx(micro_batch_id)))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(buffer_id=self._buffer_idx(micro_batch_id)))
+                    cmds.append(BackwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
+                    if not self.is_first_stage:
+                        cmds.append(SendGrad(buffer_id=self._buffer_idx(micro_batch_id)))
+            # boundary step
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+    def _step_to_micro_batch(self, step_id):
+        # reference TrainSchedule._step_to_micro_batch: even ticks forward,
+        # odd ticks backward, offset by stage
+        if _is_even(step_id) and _is_even(self.stage_id):
+            return self._even_step_forward_id(step_id), True
+        if _is_odd(step_id) and _is_odd(self.stage_id):
+            return self._odd_step_forward_id(step_id), True
+        if _is_even(step_id) and _is_odd(self.stage_id):
+            return self._even_step_backward_id(step_id), False
+        return self._odd_step_backward_id(step_id), False
+
+    def _even_step_forward_id(self, step_id):
+        return step_id // 2 - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id):
+        return (step_id - 1) // 2 - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id):
+        return step_id // 2 - self.stages + (self.stage_id + 1) // 2
+
+    def _odd_step_backward_id(self, step_id):
+        return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
+
+    def _buffer_idx(self, micro_batch_id):
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def num_pipe_buffers(self):
+        # reference: min(stages - stage_id, micro_batches), >= 2
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+
+def _is_even(x):
+    return x % 2 == 0
+
+
+def _is_odd(x):
+    return x % 2 != 0

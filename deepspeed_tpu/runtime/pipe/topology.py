@@ -1,0 +1,66 @@
+"""Pipeline topology shims (mirrors reference ``runtime/pipe/topology.py``).
+
+The reference's ``ProcessTopology``/``PipeModelDataParallelTopology``/
+``PipelineParallelGrid`` (:12,:244,:251) map ranks to (pipe, data, model)
+coordinates and build torch process groups per axis. On TPU those roles are
+mesh axes of ``MeshTopology``; these shims keep the reference class names and
+coordinate API for code written against them.
+"""
+
+from deepspeed_tpu.parallel.topology import MeshTopology
+
+
+class PipeDataParallelTopology(MeshTopology):
+    """axes=['pipe','data'] (reference topology.py:231)."""
+
+    def __init__(self, num_pp, num_dp, devices=None):
+        super().__init__(pp=num_pp, dp=num_dp, devices=devices)
+
+
+class PipeModelDataParallelTopology(MeshTopology):
+    """axes=['pipe','data','model'] (reference topology.py:244)."""
+
+    def __init__(self, num_pp, num_mp, num_dp, devices=None):
+        super().__init__(pp=num_pp, dp=num_dp, tp=num_mp, devices=devices)
+
+
+class PipelineParallelGrid:
+    """reference topology.py:251 — rank-coordinate views over the topology."""
+
+    def __init__(self, topology: MeshTopology, process_rank=0):
+        self.topo = topology
+        self.global_rank = process_rank
+        coords = topology.get_coord(process_rank)
+        self.stage_id = coords["pp"]
+        self.data_parallel_id = coords["dp"]
+        self.model_parallel_id = coords["tp"]
+        self.pipe_parallel_size = topology.pp_size
+        self.data_parallel_size = topology.dp_size
+        self.model_parallel_size = topology.tp_size
+
+    def get_stage_id(self):
+        return self.stage_id
+
+    def get_data_parallel_id(self):
+        return self.data_parallel_id
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    def is_last_stage(self):
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def stage_to_global(self, stage_id, **kwargs):
+        coords = self.topo.get_coord(self.global_rank)
+        coords["pp"] = stage_id
+        coords.update(kwargs)
+        return self.topo.get_rank(**coords)

@@ -27,24 +27,39 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.utils.logging import logger
 
 
-def _leaf_spec_with_zero(leaf, base_spec, zero_axes, zero_world, threshold):
-    """Compose ``base_spec`` (model-parallel) with a ZeRO shard axis choice."""
+def _leaf_spec_with_zero(leaf, base_spec, zero_axes, mesh_sizes, threshold):
+    """Compose ``base_spec`` (model-parallel) with a ZeRO shard axis choice.
+
+    Mesh axes already consumed by the model spec (e.g. 'ep' on a stacked expert
+    axis) are excluded — a NamedSharding may use each axis once."""
     shape = np.asarray(leaf.shape, dtype=np.int64) if hasattr(leaf, "shape") else None
     if shape is None or leaf.size < max(threshold, 1) or leaf.ndim == 0:
         return base_spec
     base = tuple(base_spec) if base_spec is not None else ()
     base = base + (None,) * (leaf.ndim - len(base))
-    # choose the largest dimension not already sharded that divides zero_world
+    used = set()
+    for entry in base:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            used.add(a)
+    axes = tuple(a for a in zero_axes if a not in used)
+    if not axes:
+        return base_spec
+    world = int(np.prod([mesh_sizes[a] for a in axes]))
+    if world <= 1:
+        return base_spec
+    # choose the largest dimension not already sharded that divides the world
     best_dim, best_size = None, 0
     for d in range(leaf.ndim):
         if base[d] is not None:
             continue
-        if shape[d] % zero_world == 0 and shape[d] > best_size:
+        if shape[d] % world == 0 and shape[d] > best_size:
             best_dim, best_size = d, shape[d]
     if best_dim is None:
         return base_spec
     new = list(base)
-    new[best_dim] = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    new[best_dim] = axes if len(axes) > 1 else axes[0]
     return P(*new)
 
 
@@ -71,9 +86,10 @@ class ZeroPartitioner:
         base = self._base_specs(params)
         if self.zero_world <= 1:
             return base
+        sizes = {a: self.topology.get_dim(a) for a in self.zero_axes}
         return jax.tree.map(
             lambda leaf, spec: _leaf_spec_with_zero(leaf, spec, self.zero_axes,
-                                                    self.zero_world, threshold),
+                                                    sizes, threshold),
             params, base, is_leaf=lambda x: x is None)
 
     def _to_sharding(self, spec_tree):

@@ -1,0 +1,49 @@
+"""DeepSpeed-Ulysses sequence parallelism.
+
+Mirrors reference ``deepspeed/sequence/layer.py``: ``_SeqAllToAll`` (:44) and
+``DistributedAttention`` (:60) — before attention, all-to-all over the SP group
+scatters heads and gathers sequence (each rank goes from [B, T/sp, H, Dh] to
+[B, T, H/sp, Dh]); after local attention the inverse all-to-all restores
+sequence sharding. On TPU the all-to-all is ``lax.all_to_all`` over the ``sp``
+mesh axis riding ICI; these functions are called inside ``shard_map`` (or any
+context where the ``sp`` axis name is bound).
+"""
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+
+def seq_all_to_all(x, axis_name="sp", scatter_axis=2, gather_axis=1):
+    """reference ``_SeqAllToAll.forward`` (layer.py:44): redistribute a local
+    tensor by scattering ``scatter_axis`` and gathering ``gather_axis``."""
+    return lax.all_to_all(x, axis_name, split_axis=scatter_axis,
+                          concat_axis=gather_axis, tiled=True)
+
+
+class DistributedAttention:
+    """reference ``DistributedAttention`` (layer.py:60): wraps any local
+    attention callable. Inputs are sequence-sharded [B, T/sp, H, Dh]; the
+    wrapped attention sees full sequence with H/sp heads."""
+
+    def __init__(self, local_attention: Callable, axis_name="sp",
+                 scatter_idx=2, gather_idx=1):
+        self.local_attn = local_attention
+        self.axis_name = axis_name
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        a, s, g = self.axis_name, self.scatter_idx, self.gather_idx
+        q = seq_all_to_all(query, a, s, g)
+        k = seq_all_to_all(key, a, s, g)
+        v = seq_all_to_all(value, a, s, g)
+        ctx = self.local_attn(q, k, v, *args, **kwargs)
+        # inverse: scatter seq back, gather heads
+        return seq_all_to_all(ctx, a, scatter_axis=g, gather_axis=s)
+
+
+def ulysses_attention(q, k, v, local_attention, axis_name="sp"):
+    """Functional form of DistributedAttention."""
+    return DistributedAttention(local_attention, axis_name)(q, k, v)

@@ -1,0 +1,97 @@
+"""Model family tests: Llama + Mixtral forward/train, TP specs, flops calc."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, llama_flops_per_token
+from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+from tests.simple_model import tiny_gpt2_batches
+
+
+def test_llama_forward_logits():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    ids = np.zeros((2, 16), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    logits = model.apply({"params": params}, {"input_ids": ids})
+    assert logits.shape == (2, 16, cfg.vocab_size)
+
+
+def test_llama_gqa_heads():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)  # 4 heads, 2 kv heads
+    assert cfg.num_key_value_heads == 2
+    model = LlamaForCausalLM(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    blk = params["layers"]["block"]["self_attn"] if cfg.scan_layers else \
+        params["layers_0"]["self_attn"]
+    assert blk["k_proj"]["kernel"].shape[-1] == 2 * cfg.head_dim
+    assert blk["q_proj"]["kernel"].shape[-1] == 4 * cfg.head_dim
+
+
+def test_llama_trains_under_engine():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    batches = tiny_gpt2_batches(5, 8, seq_len=16, vocab=cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8, "zero_optimization": {"stage": 3},
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}}})
+    losses = []
+    for b in batches * 8:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_llama_param_count_7b():
+    cfg = LlamaConfig.llama2_7b()
+    n = cfg.num_parameters()
+    assert 6.5e9 < n < 7.0e9, n  # llama-2-7b is 6.74B
+    assert llama_flops_per_token(cfg, 4096) > 6 * n
+
+
+def test_llama_tp_specs(eight_devices):
+    from jax.sharding import PartitionSpec as P
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    specs = model.param_specs(params)
+    flat = jax.tree_util.tree_flatten_with_path(specs, is_leaf=lambda x: x is None or isinstance(x, P))[0]
+    by_name = {jax.tree_util.keystr(p): s for p, s in flat}
+    assert any("embed_tokens" in k and s == P("tp", None) for k, s in by_name.items())
+    q = [s for k, s in by_name.items() if "q_proj" in k][0]
+    assert q[-1] == "tp"  # column parallel
+    o = [s for k, s in by_name.items() if "o_proj" in k][0]
+    assert "tp" in tuple(o)[:-1] or o[-2] == "tp"  # row parallel
+
+
+def test_mixtral_forward_and_train():
+    cfg = MixtralConfig.tiny(dtype=jnp.float32, remat=False)
+    model = MixtralForCausalLM(cfg)
+    batches = tiny_gpt2_batches(4, 8, seq_len=16, vocab=cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    # experts stacked on expert axis
+    w1 = params["layers_0"]["block_sparse_moe"]["experts"]["MixtralExpertMLP_0"]["w1"]["kernel"]
+    assert w1.shape[0] == cfg.num_local_experts
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8, "expert_parallel_size": 2,
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}}})
+    losses = []
+    for b in batches * 6:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
