@@ -35,6 +35,17 @@ class MoE(nn.Module):
 
     @nn.compact
     def __call__(self, hidden_states, train=True):
+        # ep degree comes from the mesh's ep axis, not this field; validate so a
+        # reference-style MoE(..., ep_size=N) is honored rather than ignored
+        if self.ep_size != 1:
+            from deepspeed_tpu.parallel import groups
+            topo = groups._TOPOLOGY  # peek without building a default topology
+            mesh_ep = topo.ep_size if topo is not None else None
+            if mesh_ep is not None and mesh_ep != self.ep_size:
+                raise ValueError(
+                    f"MoE(ep_size={self.ep_size}) does not match the mesh's ep "
+                    f"axis ({mesh_ep}); on TPU expert parallelism is configured "
+                    "by the MeshTopology(ep=...) axis")
         out, l_aux, exp_counts = MOELayer(
             self.expert_factory, self.num_experts, self.k,
             self.capacity_factor, self.eval_capacity_factor, self.min_capacity,

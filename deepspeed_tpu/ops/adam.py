@@ -48,7 +48,22 @@ def build_optimizer(name, params=None):
     def with_lr(factory, **kw):
         return optax.inject_hyperparams(factory)(learning_rate=lr, **kw)
 
-    if key in (ADAM_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+    if key == ONEBIT_ADAM_OPTIMIZER:
+        from deepspeed_tpu.ops.onebit import onebit_adam
+        tx = with_lr(onebit_adam, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd,
+                     freeze_step=params.get("freeze_step", 100))
+    elif key == ZERO_ONE_ADAM_OPTIMIZER:
+        from deepspeed_tpu.ops.onebit import zero_one_adam
+        tx = with_lr(zero_one_adam, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd,
+                     var_freeze_step=params.get("var_freeze_step", 100),
+                     var_update_scaler=params.get("var_update_scaler", 16))
+    elif key == ONEBIT_LAMB_OPTIMIZER:
+        from deepspeed_tpu.ops.onebit import onebit_lamb
+        tx = with_lr(onebit_lamb, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd,
+                     freeze_step=params.get("freeze_step", 100),
+                     min_coeff=params.get("min_coeff", 0.01),
+                     max_coeff=params.get("max_coeff", 10.0))
+    elif key == ADAM_OPTIMIZER:
         # reference ADAM_W_MODE_DEFAULT = True (engine.py:1290): "Adam" means
         # decoupled AdamW unless adam_w_mode=False is set explicitly
         if params.get("adam_w_mode", True):
@@ -59,7 +74,7 @@ def build_optimizer(name, params=None):
                 tx = optax.chain(optax.add_decayed_weights(wd), tx)
     elif key == ADAMW_OPTIMIZER:
         tx = with_lr(optax.adamw, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
-    elif key in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
+    elif key == LAMB_OPTIMIZER:
         tx = with_lr(optax.lamb, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
     elif key == LION_OPTIMIZER:
         b = params.get("betas", (0.9, 0.99))

@@ -1,0 +1,1 @@
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce  # noqa: F401

@@ -110,12 +110,23 @@ class PipelineModule:
         assert len(layers) >= 3, "need embed + blocks + head"
         interior = layers[1:-1]
         t0 = interior[0].typename if isinstance(interior[0], LayerSpec) else type(interior[0])
+        spec0 = interior[0]
         for l in interior:
             t = l.typename if isinstance(l, LayerSpec) else type(l)
             if t is not t0:
                 raise ValueError(
                     "compiled SPMD pipelining requires a homogeneous interior "
                     f"layer stack; got {t0} and {t}")
+            # same class is not enough: every stage is built from interior[0],
+            # so differing constructor args would silently change the model
+            if isinstance(l, LayerSpec) and isinstance(spec0, LayerSpec):
+                if (l.module_args, l.module_kwargs) != (spec0.module_args,
+                                                        spec0.module_kwargs):
+                    raise ValueError(
+                        "compiled SPMD pipelining requires identical constructor "
+                        f"args for every interior layer; {spec0!r} has "
+                        f"args={spec0.module_args} kwargs={spec0.module_kwargs} but "
+                        f"{l!r} has args={l.module_args} kwargs={l.module_kwargs}")
         embed = layers[0].build() if isinstance(layers[0], LayerSpec) else layers[0]
         head = layers[-1].build() if isinstance(layers[-1], LayerSpec) else layers[-1]
         block = interior[0].build() if isinstance(interior[0], LayerSpec) else interior[0]
