@@ -1,0 +1,75 @@
+"""Inference config (mirrors reference ``deepspeed/inference/config.py``).
+
+Covers the v1 config surface: dtype, tensor_parallel (tp_size), MoE, weight
+quantization, generation limits. Kernel-injection flags are accepted for API
+compatibility; on TPU "kernel injection" means routing attention/matmuls
+through the ops registry (Pallas kernels when available), which the engine
+always does, so ``replace_with_kernel_inject`` is a no-op knob.
+"""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+_DTYPES = {
+    "fp32": jnp.float32, "float32": jnp.float32,
+    "fp16": jnp.float16, "half": jnp.float16, "float16": jnp.float16,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Tensor-parallel settings (reference ``inference/config.py:47``)."""
+    enabled = True
+    tp_size = 1
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    """MoE inference settings (reference ``inference/config.py:65``)."""
+    enabled = True
+    ep_size = 1
+    moe_experts = [1]
+    _deprecated = {"num_experts": "moe_experts"}
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    """Weight quantization (reference ``inference/config.py:114``): groupwise
+    symmetric int8 weight-only quantization at load time."""
+    enabled = False
+    bits = 8
+    q_groups = 1
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Top-level inference config (reference ``inference/config.py:134``)."""
+    dtype = "bf16"
+    tensor_parallel = DeepSpeedTPConfig()
+    moe = DeepSpeedMoEConfig()
+    quant = QuantizationConfig()
+    checkpoint = None                 # path to a saved checkpoint dir
+    replace_with_kernel_inject = False
+    max_out_tokens = 1024
+    min_out_tokens = 1
+    max_tokens = 1024
+    replace_method = "auto"
+    enable_cuda_graph = False         # accepted for parity; jit is the analog
+    triangular_masking = True
+    return_tuple = True
+    training_mp_size = 1
+    _deprecated = {"mp_size": "tp_size_legacy", "kernel_inject": "replace_with_kernel_inject"}
+
+    tp_size_legacy = None  # landing slot for deprecated mp_size
+
+    @classmethod
+    def from_dict(cls, d, **kwargs):
+        cfg = cls(d, **kwargs)
+        if cfg.tp_size_legacy is not None:
+            cfg.tensor_parallel.tp_size = cfg.tp_size_legacy
+        return cfg
+
+    @property
+    def jax_dtype(self):
+        if not isinstance(self.dtype, str):
+            return self.dtype
+        return _DTYPES[self.dtype.lower()]

@@ -1,0 +1,117 @@
+"""Autoregressive generation over a KV-cached model.
+
+The reference's generation path is HF ``generate()`` over the kernel-injected
+module (``inference/engine.py:613``). The TPU-native equivalent is a jitted
+prefill + ``lax.while_loop`` decode over a fixed-size KV cache: no dynamic
+shapes, one compilation per (batch, prompt length, max-new-tokens) bucket.
+
+Prompts in a batch must share one length (pad on the client if needed);
+mixed-length serving is the v2 ragged engine's job
+(``deepspeed_tpu/inference/v2``).
+
+Model contract: ``model.apply({"params", "cache"}, {"input_ids": ids},
+use_cache=True, positions=pos, mutable=["cache"]) -> (logits, {"cache": ...})``
+— see ``deepspeed_tpu/models/llama.py``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """Sample next token from [B, V] logits: greedy when temperature == 0."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; always keep the top token
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -1e9, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5, 6, 8))
+def _generate_jit(model_apply, variables, input_ids, max_new_tokens,
+                  temperature, top_k, top_p, rng, eos_token_id):
+    """input_ids: [B, Tp] prompt (one shared length)."""
+    B, Tp = input_ids.shape
+
+    # prefill: run the whole prompt through the cache in one call
+    positions = jnp.broadcast_to(jnp.arange(Tp)[None, :], (B, Tp))
+    logits, vars_ = model_apply(variables, {"input_ids": input_ids},
+                                use_cache=True, positions=positions,
+                                mutable=["cache"])
+    cache = vars_["cache"]
+
+    key0, key = jax.random.split(rng)
+    first_tok = sample_logits(logits[:, -1], key0, temperature, top_k, top_p)
+    out = jnp.zeros((B, max_new_tokens), jnp.int32).at[:, 0].set(first_tok)
+    finished = (first_tok == eos_token_id) if eos_token_id is not None else jnp.zeros((B,), bool)
+
+    def cond(state):
+        i, _, _, finished, _ = state
+        return (i < max_new_tokens) & ~jnp.all(finished)
+
+    def body(state):
+        i, cache, out, finished, key = state
+        tok = out[:, i - 1]
+        pos = jnp.full((B, 1), Tp - 1, jnp.int32) + i  # position of the fed token
+        logits, vars_ = model_apply({**variables, "cache": cache},
+                                    {"input_ids": tok[:, None]},
+                                    use_cache=True, positions=pos,
+                                    mutable=["cache"])
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+        if eos_token_id is not None:
+            nxt = jnp.where(finished, eos_token_id, nxt)
+            finished = finished | (nxt == eos_token_id)
+        out = out.at[:, i].set(nxt)
+        return (i + 1, vars_["cache"], out, finished, key)
+
+    _, _, out, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(1), cache, out, finished, key))
+    if eos_token_id is not None:
+        # the loop exits early once every row has finished; pad the tail
+        is_eos = (out == eos_token_id).astype(jnp.int32)
+        seen_before = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
+        out = jnp.where(seen_before, eos_token_id, out)
+    return out
+
+
+def init_cache(model, input_ids):
+    """Allocate a zeroed KV cache shaped for this model/batch."""
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), {"input_ids": input_ids},
+                           use_cache=True))
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+def generate(model, params, input_ids, max_new_tokens=32, temperature=0.0,
+             top_k=0, top_p=1.0, rng=None, eos_token_id=None):
+    """Generate ``max_new_tokens`` continuation tokens for [B, Tp] prompts.
+
+    temperature 0.0 = greedy. Returns [B, max_new_tokens] int32.
+    """
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    max_pos = getattr(getattr(model, "config", None), "max_position_embeddings", None)
+    if max_pos is not None and input_ids.shape[1] + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt ({input_ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's KV-cache window (max_position_embeddings="
+            f"{max_pos}); the cache write index would clamp and corrupt output")
+    variables = {"params": params, "cache": init_cache(model, input_ids)}
+    return _generate_jit(model.apply, variables, input_ids, max_new_tokens,
+                         float(temperature), int(top_k), float(top_p), rng,
+                         eos_token_id)

@@ -99,7 +99,7 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, deterministic=True):
+    def __call__(self, x, positions, deterministic=True, use_cache=False):
         cfg = self.config
         B, T, D = x.shape
         H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -109,12 +109,47 @@ class LlamaAttention(nn.Module):
         v = dense(KV * Dh, "v_proj")(x).reshape(B, T, KV, Dh)
         q = rotary_embed(q, positions, cfg.rope_theta)
         k = rotary_embed(k, positions, cfg.rope_theta)
-        if KV != H:  # GQA: repeat kv heads
+        from deepspeed_tpu.ops.flash_attention import mha, NEG_INF
+
+        if use_cache:
+            # KV cache over a fixed max_position window; works for both prefill
+            # (T = prompt length at index 0) and incremental decode (T = 1).
+            # Functional analog of the reference's inference KV-cache kernels
+            # (csrc/transformer/inference/csrc/pt_binding.cpp attention path).
+            L = cfg.max_position_embeddings
+            cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                     (B, L, KV, Dh), cfg.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                     (B, L, KV, Dh), cfg.dtype)
+            cache_index = self.variable("cache", "cache_index",
+                                        lambda: jnp.zeros((), jnp.int32))
+            idx = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            cache_index.value = idx + T
+            k, v = cached_k.value, cached_v.value
+            # position j attends iff j <= idx + i (past + causal-within-block)
+            key_pos = jnp.arange(L)[None, :]
+            qry_pos = idx + jnp.arange(T)[:, None]
+            bias = jnp.where(key_pos <= qry_pos, 0.0, NEG_INF)
+            # grouped-query attention against the un-repeated cache: expanding
+            # only the [B,T,H,Dh] query (not the [B,L,KV,Dh] cache) keeps decode
+            # memory traffic at 1x the cache size
             rep = H // KV
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        from deepspeed_tpu.ops.flash_attention import mha
-        out = mha(q, k, v, causal=True)
+            qg = q.reshape(B, T, KV, rep, Dh)
+            scale = 1.0 / (Dh ** 0.5)
+            logits = jnp.einsum("btkrd,bskd->bkrts", qg, k).astype(jnp.float32) * scale
+            logits = logits + bias[None, None, None]
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            out = jnp.einsum("bkrts,bskd->btkrd", probs, v).reshape(B, T, H, Dh)
+        else:
+            if KV != H:  # GQA: repeat kv heads
+                rep = H // KV
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = mha(q, k, v, causal=True)
         out = out.reshape(B, T, H * Dh)
         return dense(D, "o_proj")(out)
 
@@ -135,11 +170,11 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, deterministic=True):
+    def __call__(self, x, positions, deterministic=True, use_cache=False):
         cfg = self.config
         x = x + LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x),
-            positions, deterministic)
+            positions, deterministic, use_cache=use_cache)
         x = x + LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(x))
         return x
@@ -147,11 +182,13 @@ class LlamaBlock(nn.Module):
 
 class ScanLlamaBlock(nn.Module):
     config: LlamaConfig
+    use_cache: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         x, positions = carry
-        x = LlamaBlock(self.config, name="block")(x, positions)
+        x = LlamaBlock(self.config, name="block")(x, positions,
+                                                  use_cache=self.use_cache)
         return (x, positions), None
 
 
@@ -160,7 +197,7 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, batch, deterministic=True):
+    def __call__(self, batch, deterministic=True, use_cache=False, positions=None):
         cfg = self.config
         if isinstance(batch, dict):
             input_ids = batch["input_ids"]
@@ -171,22 +208,24 @@ class LlamaForCausalLM(nn.Module):
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         x = embed.astype(cfg.dtype)[input_ids]
-        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
 
         if cfg.scan_layers:
             block = ScanLlamaBlock
-            if cfg.remat:
+            if cfg.remat and not use_cache:
                 block = nn.remat(ScanLlamaBlock, prevent_cse=False)
             Scanned = nn.scan(block,
-                              variable_axes={"params": 0},
+                              variable_axes={"params": 0, "cache": 0},
                               split_rngs={"params": True, "dropout": True},
                               length=cfg.num_hidden_layers,
                               metadata_params={nn.meta.PARTITION_NAME: "layers"})
-            (x, _), _ = Scanned(cfg, name="layers")((x, positions), None)
+            (x, _), _ = Scanned(cfg, use_cache, name="layers")((x, positions), None)
         else:
-            block_cls = nn.remat(LlamaBlock, prevent_cse=False) if cfg.remat else LlamaBlock
+            block_cls = nn.remat(LlamaBlock, prevent_cse=False) if (cfg.remat and not use_cache) else LlamaBlock
             for i in range(cfg.num_hidden_layers):
-                x = block_cls(cfg, name=f"layers_{i}")(x, positions, deterministic)
+                x = block_cls(cfg, name=f"layers_{i}")(x, positions, deterministic,
+                                                       use_cache=use_cache)
 
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         lm_head = self.param("lm_head", nn.initializers.normal(0.02),
