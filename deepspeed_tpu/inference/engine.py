@@ -113,4 +113,3 @@ class InferenceEngine:
     def destroy(self):
         """Release compiled functions (reference ``engine.py:189``)."""
         self._forward_fn = None
-        jax.clear_caches()

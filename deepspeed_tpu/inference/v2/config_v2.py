@@ -1,0 +1,27 @@
+"""FastGen v2 engine config (mirrors reference
+``deepspeed/inference/v2/config_v2.py`` + ``ragged/manager_configs.py``)."""
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DSStateManagerConfig(DeepSpeedConfigModel):
+    """Ragged state-manager knobs (reference ``ragged/manager_configs.py``)."""
+    max_tracked_sequences = 2048
+    max_ragged_batch_size = 768          # max total new tokens per put()
+    max_ragged_sequence_count = 512      # max sequences per put()
+    max_context = 8192                   # max tokens a single sequence may hold
+    memory_config = "reserve"            # accepted for parity
+    num_kv_blocks = None                 # explicit block count; None = derive
+
+
+class KVCacheConfig(DeepSpeedConfigModel):
+    block_size = 64
+    num_allocation_groups = 1
+    cache_dtype = "bf16"
+
+
+class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
+    """Top-level v2 config (reference ``config_v2.py:29``)."""
+    tensor_parallel = {"tp_size": 1}
+    state_manager = DSStateManagerConfig()
+    kv_cache = KVCacheConfig()

@@ -1,0 +1,141 @@
+"""FastGen-style serving engine (mirrors reference
+``deepspeed/inference/v2/engine_v2.py:30``).
+
+``put(uids, tokens)`` schedules a mixed prefill/decode ragged batch and returns
+next-token logits per sequence; ``query``/``can_schedule`` expose admission
+control for an external scheduler (DeepSpeed-MII's SplitFuse role);
+``flush`` retires a sequence and frees its KV blocks.
+"""
+
+import dataclasses
+from typing import Iterable, List, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
+from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclasses.dataclass
+class SchedulingResult:
+    """Admission verdict (reference ``scheduling_utils.py``)."""
+    success: bool
+    reason: str = "ok"
+
+
+class InferenceEngineV2:
+    """Serve a Llama-family model over a paged KV cache.
+
+    Args:
+        model: ``LlamaForCausalLM`` (scan_layers=True) — provides config.
+        params: trained parameter pytree.
+        config: ``RaggedInferenceEngineConfig`` or dict.
+    """
+
+    def __init__(self, model, params, config=None):
+        if not isinstance(config, RaggedInferenceEngineConfig):
+            config = RaggedInferenceEngineConfig(config or {})
+        self._config = config
+        self._model_config = model.config
+        if not self._model_config.scan_layers:
+            raise ValueError("ragged engine requires scan_layers=True params")
+        self._params = params
+        cfg = self._model_config
+        self._state = DSStateManager(config, cfg.num_hidden_layers,
+                                     cfg.num_key_value_heads, cfg.head_dim)
+        sm = config.state_manager
+        bs = self._state.kv_block_size
+        self._max_blocks_per_seq = -(-sm.max_context // bs)
+        logger.info(f"InferenceEngineV2: S<={sm.max_ragged_sequence_count} "
+                    f"tokens<={sm.max_ragged_batch_size} context<={sm.max_context}")
+
+    # -- admission control (reference engine_v2.py:158-241) ----------------
+    @property
+    def free_blocks(self):
+        return self._state.free_blocks
+
+    def query(self, uid: int, max_request_tokens: int,
+              max_request_blocks: int) -> Tuple[int, int]:
+        """How many tokens/blocks this sequence could schedule right now."""
+        seq = self._state.get_sequence(uid)
+        seen = seq.seen_tokens if seq else 0
+        have_blocks = seq.cur_allocated_blocks if seq else 0
+        bs = self._state.kv_block_size
+        token_room = self._config.state_manager.max_context - seen
+        block_room = have_blocks * bs - seen + min(max_request_blocks,
+                                                   self.free_blocks) * bs
+        return min(max_request_tokens, token_room, block_room), \
+            min(max_request_blocks, self.free_blocks)
+
+    def can_schedule(self, uids: Iterable[int],
+                     lengths: Iterable[int]) -> SchedulingResult:
+        uids, lengths = list(uids), list(lengths)
+        sm = self._config.state_manager
+        if len(set(uids)) != len(uids):
+            return SchedulingResult(False, "duplicate uids in batch")
+        if len(uids) > sm.max_ragged_sequence_count:
+            return SchedulingResult(False, "too many sequences")
+        if sum(lengths) > sm.max_ragged_batch_size:
+            return SchedulingResult(False, "too many tokens")
+        need, new_seqs = 0, 0
+        for uid, n in zip(uids, lengths):
+            seq = self._state.get_sequence(uid)
+            seen = seq.seen_tokens if seq else 0
+            if seq is None:
+                new_seqs += 1
+            if seen + n > sm.max_context:
+                return SchedulingResult(False, f"uid {uid} exceeds max_context")
+            have = seq.cur_allocated_blocks if seq else 0
+            need += self._state.blocks_needed_for(seen, have, n,
+                                                  self._state.kv_block_size)
+        if self._state.n_tracked_sequences + new_seqs > sm.max_tracked_sequences:
+            return SchedulingResult(False, "too many tracked sequences")
+        if need > self.free_blocks:
+            return SchedulingResult(False, "not enough KV blocks")
+        return SchedulingResult(True)
+
+    def get_remaining_block_capacity(self, uid: int) -> int:
+        seq = self._state.get_sequence(uid)
+        if seq is None:
+            return 0
+        return seq.cur_allocated_blocks * self._state.kv_block_size - seq.seen_tokens
+
+    # -- serving (reference engine_v2.py:107) ------------------------------
+    def put(self, batch_uids: List[int],
+            batch_tokens: List[np.ndarray]) -> np.ndarray:
+        """Run one ragged forward; returns [len(uids), vocab] next-token logits."""
+        verdict = self.can_schedule(batch_uids, [len(t) for t in batch_tokens])
+        if not verdict.success:
+            raise RuntimeError(f"cannot schedule batch: {verdict.reason}")
+
+        sm = self._config.state_manager
+        wrapper = RaggedBatchWrapper(sm.max_ragged_sequence_count,
+                                     sm.max_ragged_batch_size,
+                                     self._max_blocks_per_seq,
+                                     self._state.kv_cache.trash_block)
+        for uid, toks in zip(batch_uids, batch_tokens):
+            seq = self._state.get_or_create_sequence(uid)
+            self._state.ensure_capacity(seq, len(toks))
+            seq.in_flight_tokens = len(toks)
+            wrapper.insert_sequence(uid, np.asarray(toks, np.int32),
+                                    seq.seen_tokens, seq.kv_blocks)
+        arrays = wrapper.build()
+
+        from deepspeed_tpu.inference.v2.model_implementations.llama import ragged_forward
+        kv = self._state.kv_cache
+        logits, k_pool, v_pool = ragged_forward(
+            self._model_config, self._params, kv.k_pool, kv.v_pool,
+            jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["q_len"]),
+            jnp.asarray(arrays["seen"]), jnp.asarray(arrays["block_tables"]))
+        kv.update(k_pool, v_pool)
+
+        for uid in batch_uids:
+            self._state.get_sequence(uid).post_forward()
+        return np.asarray(logits[:len(batch_uids)])
+
+    def flush(self, uid: int) -> None:
+        """Retire a sequence, freeing its KV blocks (reference :242)."""
+        self._state.flush_sequence(uid)

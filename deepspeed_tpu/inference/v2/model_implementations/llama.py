@@ -1,0 +1,120 @@
+"""Ragged (paged-KV) Llama forward (mirrors reference
+``inference/v2/model_implementations/llama_v2`` + the ragged kernel set
+``inference/v2/kernels/ragged_ops``: linear_blocked_kv_rotary -> scatter into
+paged cache, blocked_flash -> paged attention, logits_gather -> last-token
+logits).
+
+Operates directly on the training param pytree of
+``deepspeed_tpu.models.llama.LlamaForCausalLM`` with ``scan_layers=True`` (the
+stacked-layer layout is exactly what ``lax.scan`` wants), so a trained
+checkpoint serves with zero conversion. All shapes are static: S sequence
+slots x Q new-token budget, MB-wide block tables, masked padding, and a trash
+block absorbing padded-slot KV writes.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import rotary_embed
+from deepspeed_tpu.ops.flash_attention import NEG_INF
+
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (norm * scale).astype(x.dtype)
+
+
+def _scatter_kv(k_pool, v_pool, k, v, block_tables, seen, q_len, block_size):
+    """Write [S, Q, KV, Dh] new KVs into the flat pool via block tables.
+
+    Padded token slots are routed to the trash block (last block of the pool).
+    Analog of the reference's linear_blocked_kv_copy kernel.
+    """
+    S, Q = k.shape[:2]
+    nb = k_pool.shape[0]          # includes trash block
+    pos = seen[:, None] + jnp.arange(Q)[None, :]              # [S, Q]
+    valid = jnp.arange(Q)[None, :] < q_len[:, None]
+    blk = jnp.take_along_axis(block_tables, pos // block_size, axis=1,
+                              mode="clip")
+    flat = jnp.where(valid, blk * block_size + pos % block_size,
+                     (nb - 1) * block_size)
+    kf = k_pool.reshape(nb * block_size, *k_pool.shape[2:])
+    vf = v_pool.reshape(nb * block_size, *v_pool.shape[2:])
+    kf = kf.at[flat.reshape(-1)].set(k.reshape(S * Q, *k.shape[2:]))
+    vf = vf.at[flat.reshape(-1)].set(v.reshape(S * Q, *v.shape[2:]))
+    return kf.reshape(k_pool.shape), vf.reshape(v_pool.shape)
+
+
+def _paged_attention(q, k_pool, v_pool, block_tables, seen, block_size):
+    """Grouped-query attention over per-sequence paged KV (blocked_flash
+    analog). q: [S, Q, H, Dh]; returns [S, Q, H, Dh]."""
+    S, Q, H, Dh = q.shape
+    KV = k_pool.shape[-2]
+    rep = H // KV
+    nb = k_pool.shape[0]
+    kf = k_pool.reshape(nb * block_size, KV, Dh)
+    vf = v_pool.reshape(nb * block_size, KV, Dh)
+    scale = 1.0 / (Dh ** 0.5)
+    MB = block_tables.shape[1]
+    slot = jnp.arange(block_size)
+
+    def one_seq(q_s, bt_s, seen_s):
+        idx = (bt_s[:, None] * block_size + slot[None, :]).reshape(-1)  # [MB*bs]
+        keys = kf[idx].astype(q_s.dtype)                                 # [L, KV, Dh]
+        vals = vf[idx].astype(q_s.dtype)
+        qg = q_s.reshape(Q, KV, rep, Dh)
+        logits = jnp.einsum("qkrd,skd->krqs", qg, keys).astype(jnp.float32) * scale
+        key_pos = jnp.arange(MB * block_size)[None, :]
+        qry_pos = (seen_s + jnp.arange(Q))[:, None]
+        logits = jnp.where(key_pos <= qry_pos, logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q_s.dtype)
+        return jnp.einsum("krqs,skd->qkrd", probs, vals).reshape(Q, H, Dh)
+
+    return jax.vmap(one_seq)(q, block_tables, seen)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
+                   block_tables):
+    """One ragged forward step.
+
+    Returns (last-token logits [S, V], new k_pool, new v_pool).
+    """
+    S, Q = tokens.shape
+    H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    bs = k_pool.shape[2]
+    positions = seen[:, None] + jnp.arange(Q)[None, :]
+
+    x = params["embed_tokens"].astype(cfg.dtype)[tokens]
+    layers = params["layers"]["block"]
+
+    def layer_step(x, xs):
+        lp, kp, vp = xs
+        attn = lp["self_attn"]
+        h = _rmsnorm(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        q = (h @ attn["q_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, H, Dh)
+        k = (h @ attn["k_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, KV, Dh)
+        v = (h @ attn["v_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, KV, Dh)
+        q = rotary_embed(q, positions, cfg.rope_theta)
+        k = rotary_embed(k, positions, cfg.rope_theta)
+        kp, vp = _scatter_kv(kp, vp, k, v, block_tables, seen, q_len, bs)
+        out = _paged_attention(q, kp, vp, block_tables, seen, bs)
+        x = x + out.reshape(S, Q, H * Dh) @ attn["o_proj"]["kernel"].astype(cfg.dtype)
+        mlp = lp["mlp"]
+        h = _rmsnorm(x, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
+        gate = jax.nn.silu(h @ mlp["gate_proj"]["kernel"].astype(cfg.dtype))
+        up = h @ mlp["up_proj"]["kernel"].astype(cfg.dtype)
+        x = x + (gate * up) @ mlp["down_proj"]["kernel"].astype(cfg.dtype)
+        return x, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(layer_step, x, (layers, k_pool, v_pool))
+
+    x = _rmsnorm(x, params["norm"]["scale"], cfg.rms_norm_eps)
+    # logits_gather analog: only the last real token of each sequence
+    last = jnp.take_along_axis(
+        x, jnp.maximum(q_len - 1, 0)[:, None, None], axis=1)[:, 0]
+    logits = last @ params["lm_head"].astype(cfg.dtype).T
+    return logits.astype(jnp.float32), k_pool, v_pool

@@ -1,0 +1,39 @@
+"""Host-side free-list allocator for KV-cache blocks (mirrors reference
+``deepspeed/inference/v2/ragged/blocked_allocator.py``).
+
+Pure Python on the host: block ids index into the device-resident KV pool.
+The reference keeps the free list in a torch tensor; here a deque is simpler
+and never touches the device.
+"""
+
+from collections import deque
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free = deque(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int):
+        """Allocate ``num_blocks`` block ids; raises ValueError if exhausted."""
+        if num_blocks > len(self._free):
+            raise ValueError(
+                f"requested {num_blocks} blocks, only {len(self._free)} free")
+        return [self._free.popleft() for _ in range(num_blocks)]
+
+    def free(self, blocks):
+        for b in blocks:
+            if not 0 <= b < self._num_blocks:
+                raise ValueError(f"block id {b} out of range")
+            self._free.append(b)

@@ -1,0 +1,51 @@
+"""Blocked (paged) KV cache (mirrors reference
+``deepspeed/inference/v2/ragged/kv_cache.py:40``).
+
+Device layout: one K pool and one V pool per cache group, shaped
+``[num_layers, num_blocks, block_size, num_kv_heads, head_dim]``. Block ids are
+handed out by the host-side ``BlockedAllocator``; the model's paged-attention
+path scatters new KVs into the pool and gathers per-sequence views through
+block tables. One extra *trash block* (index ``num_blocks``) absorbs writes
+from padded token slots, keeping every scatter shape static for XLA.
+"""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+
+_DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16, "fp32": jnp.float32}
+
+
+class BlockedKVCache:
+
+    def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
+                 head_dim, dtype="bf16"):
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.dtype = _DTYPES.get(dtype, dtype)
+        # +1 trash block for masked writes
+        shape = (num_layers, num_blocks + 1, block_size, num_kv_heads, head_dim)
+        self.k_pool = jnp.zeros(shape, self.dtype)
+        self.v_pool = jnp.zeros(shape, self.dtype)
+        self._allocator = BlockedAllocator(num_blocks)
+
+    @property
+    def free_blocks(self) -> int:
+        return self._allocator.free_blocks
+
+    @property
+    def trash_block(self) -> int:
+        return self.num_blocks
+
+    def reserve(self, num_blocks):
+        """Allocate block ids (reference ``kv_cache.py:144``)."""
+        return self._allocator.allocate(num_blocks)
+
+    def free(self, blocks):
+        """Return block ids to the pool (reference ``kv_cache.py:155``)."""
+        self._allocator.free(blocks)
+
+    def update(self, k_pool, v_pool):
+        """Swap in pools returned by the jitted forward."""
+        self.k_pool, self.v_pool = k_pool, v_pool

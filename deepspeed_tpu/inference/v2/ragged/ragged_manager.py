@@ -1,0 +1,99 @@
+"""Ragged state manager (mirrors reference
+``deepspeed/inference/v2/ragged/ragged_manager.py:19``): tracks live sequences
+and owns the blocked KV cache."""
+
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
+from deepspeed_tpu.utils.logging import logger
+
+
+class DSStateManager:
+
+    def __init__(self, config, num_layers, num_kv_heads, head_dim):
+        self._config = config
+        sm, kv = config.state_manager, config.kv_cache
+        num_blocks = sm.num_kv_blocks
+        if num_blocks is None:
+            num_blocks = self._blocks_from_memory_budget(
+                num_layers, num_kv_heads, head_dim, kv)
+        self.kv_cache = BlockedKVCache(num_layers, num_blocks, kv.block_size,
+                                       num_kv_heads, head_dim, kv.cache_dtype)
+        self._seqs = {}
+        logger.info(f"DSStateManager: {num_blocks} KV blocks x {kv.block_size} "
+                    f"tokens ({num_layers} layers, {num_kv_heads} kv heads)")
+
+    @staticmethod
+    def _blocks_from_memory_budget(num_layers, num_kv_heads, head_dim, kv):
+        """Size the pool from device memory (the reference derives block count
+        from a reserved memory fraction, ``ragged_manager.py`` memory_config):
+        ~60% of the device's memory limit, fallback 1 GiB when unknown."""
+        import jax
+        import numpy as np
+        itemsize = np.dtype("float32" if kv.cache_dtype == "fp32" else "uint16").itemsize
+        bytes_per_block = (2 * num_layers * kv.block_size * num_kv_heads
+                           * head_dim * itemsize)  # K + V pools
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            budget = int(stats.get("bytes_limit", 0) * 0.6)
+        except Exception:
+            budget = 0
+        if budget <= 0:
+            budget = 1 << 30
+        return max(16, budget // bytes_per_block)
+
+    @staticmethod
+    def blocks_needed_for(seen, have, new_tokens, block_size):
+        """Extra blocks to grow a sequence with ``seen`` cached tokens and
+        ``have`` allocated blocks by ``new_tokens`` — single source of truth
+        for admission control and allocation."""
+        return max(0, -(-(seen + new_tokens) // block_size) - have)
+
+    # -- sequence tracking (reference ragged_manager.py:100-205) -----------
+    @property
+    def tracked_sequences(self):
+        return self._seqs
+
+    @property
+    def n_tracked_sequences(self):
+        return len(self._seqs)
+
+    @property
+    def kv_block_size(self):
+        return self.kv_cache.block_size
+
+    @property
+    def free_blocks(self):
+        return self.kv_cache.free_blocks
+
+    def get_sequence(self, uid):
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid):
+        if uid in self._seqs:
+            return self._seqs[uid]
+        if len(self._seqs) >= self._config.state_manager.max_tracked_sequences:
+            raise RuntimeError(
+                f"already tracking {len(self._seqs)} sequences "
+                f"(max_tracked_sequences)")
+        seq = DSSequenceDescriptor(uid=uid)
+        self._seqs[uid] = seq
+        return seq
+
+    def flush_sequence(self, uid):
+        """Drop a sequence and free its KV blocks (reference :110)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is None:
+            logger.warning(f"flush of untracked sequence {uid}")
+            return
+        self.kv_cache.free(seq.kv_blocks)
+
+    # -- block arithmetic --------------------------------------------------
+    def blocks_needed(self, seq, new_tokens):
+        """Extra blocks required to grow ``seq`` by ``new_tokens``."""
+        return self.blocks_needed_for(seq.seen_tokens, seq.cur_allocated_blocks,
+                                      new_tokens, self.kv_block_size)
+
+    def ensure_capacity(self, seq, new_tokens):
+        extra = self.blocks_needed(seq, new_tokens)
+        if extra:
+            seq.extend_blocks(self.kv_cache.reserve(extra))

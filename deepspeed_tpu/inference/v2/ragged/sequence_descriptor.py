@@ -1,0 +1,26 @@
+"""Sequence bookkeeping (mirrors reference
+``deepspeed/inference/v2/ragged/sequence_descriptor.py``)."""
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass
+class DSSequenceDescriptor:
+    uid: int
+    seen_tokens: int = 0          # tokens already resident in the KV cache
+    in_flight_tokens: int = 0     # tokens scheduled in the current forward
+    kv_blocks: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self.kv_blocks)
+
+    def extend_blocks(self, blocks):
+        self.kv_blocks.extend(blocks)
+
+    def post_forward(self):
+        """Commit in-flight tokens after a forward (reference
+        ``sequence_descriptor.py`` seen_tokens update)."""
+        self.seen_tokens += self.in_flight_tokens
+        self.in_flight_tokens = 0
