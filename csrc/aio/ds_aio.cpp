@@ -1,0 +1,223 @@
+// Async file I/O for NVMe offload (ZeRO-Infinity tier).
+//
+// TPU-native analog of the reference's libaio-based module
+// (csrc/aio/py_lib/py_ds_aio.cpp, deepspeed_aio_thread.cpp): a C ABI exposing
+// the same aio_handle semantics — pread/pwrite ops split across a worker
+// thread pool in block_size chunks, submitted asynchronously and drained with
+// wait(). On a TPU-VM host the win comes from overlapping O_DIRECT-class
+// block I/O with XLA device execution (dispatch is async), so plain
+// pread/pwrite on a thread pool with deep queues is the right primitive;
+// queue_depth/single_submit knobs are accepted for config parity.
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct AioOp {
+    // one scheduled chunk of a user-submitted read/write
+    bool is_read;
+    int fd;
+    char* buf;
+    int64_t nbytes;
+    int64_t offset;
+    std::atomic<int64_t>* remaining;  // chunks left in parent op
+    std::atomic<int64_t>* error;      // sticky errno for parent op
+};
+
+struct ParentOp {
+    std::atomic<int64_t> remaining{0};
+    std::atomic<int64_t> error{0};
+    int fd = -1;
+};
+
+class AioHandle {
+  public:
+    AioHandle(int64_t block_size, int64_t queue_depth, bool single_submit,
+              bool overlap_events, int num_threads)
+        : block_size_(block_size > 0 ? block_size : (1 << 20)),
+          queue_depth_(queue_depth > 0 ? queue_depth : 8),
+          single_submit_(single_submit),
+          overlap_events_(overlap_events),
+          stop_(false),
+          inflight_(0),
+          completed_(0) {
+        int n = num_threads > 0 ? num_threads : 1;
+        for (int i = 0; i < n; ++i)
+            threads_.emplace_back([this] { worker(); });
+    }
+
+    ~AioHandle() {
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            stop_ = true;
+        }
+        cv_.notify_all();
+        for (auto& t : threads_) t.join();
+        for (auto* p : parents_) delete p;
+    }
+
+    int64_t block_size() const { return block_size_; }
+    int64_t queue_depth() const { return queue_depth_; }
+    bool single_submit() const { return single_submit_; }
+    bool overlap_events() const { return overlap_events_; }
+    int thread_count() const { return (int)threads_.size(); }
+
+    // schedule one logical read/write, split into block_size chunks
+    int64_t submit(bool is_read, char* buf, int64_t nbytes, const char* filename) {
+        int fd;
+        if (is_read) {
+            fd = ::open(filename, O_RDONLY);
+        } else {
+            fd = ::open(filename, O_WRONLY | O_CREAT, 0644);
+        }
+        if (fd < 0) return -1;
+        if (is_read) {
+            struct stat st;
+            if (::fstat(fd, &st) == 0 && st.st_size < nbytes) {
+                ::close(fd);
+                return -2;  // short file
+            }
+        }
+        auto* parent = new ParentOp();
+        parent->fd = fd;
+        int64_t nchunks = (nbytes + block_size_ - 1) / block_size_;
+        if (nchunks == 0) nchunks = 1;
+        parent->remaining.store(nchunks);
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            parents_.push_back(parent);
+            inflight_ += 1;
+            for (int64_t c = 0; c < nchunks; ++c) {
+                int64_t off = c * block_size_;
+                int64_t len = std::min(block_size_, nbytes - off);
+                if (len < 0) len = 0;
+                queue_.push_back(AioOp{is_read, fd, buf + off, len, off,
+                                       &parent->remaining, &parent->error});
+            }
+        }
+        cv_.notify_all();
+        return 0;
+    }
+
+    // block until all submitted ops finish; returns ops completed since last wait
+    int64_t wait() {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [this] { return inflight_ == 0; });
+        int64_t n = completed_;
+        completed_ = 0;
+        int64_t err = 0;
+        for (auto* p : parents_) {
+            if (p->error.load() != 0) err = p->error.load();
+            delete p;
+        }
+        parents_.clear();
+        return err != 0 ? -err : n;
+    }
+
+  private:
+    void worker() {
+        for (;;) {
+            AioOp op;
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+                if (stop_ && queue_.empty()) return;
+                op = queue_.front();
+                queue_.pop_front();
+            }
+            int64_t left = op.nbytes;
+            char* p = op.buf;
+            int64_t off = op.offset;
+            while (left > 0) {
+                ssize_t n = op.is_read ? ::pread(op.fd, p, left, off)
+                                       : ::pwrite(op.fd, p, left, off);
+                if (n <= 0) {
+                    op.error->store(errno ? errno : EIO);
+                    break;
+                }
+                left -= n;
+                p += n;
+                off += n;
+            }
+            if (op.remaining->fetch_sub(1) == 1) {
+                // last chunk of this logical op
+                ::close(op.fd);
+                std::lock_guard<std::mutex> lk(mu_);
+                inflight_ -= 1;
+                completed_ += 1;
+                if (inflight_ == 0) done_cv_.notify_all();
+            }
+        }
+    }
+
+    int64_t block_size_, queue_depth_;
+    bool single_submit_, overlap_events_;
+    std::mutex mu_;
+    std::condition_variable cv_, done_cv_;
+    std::deque<AioOp> queue_;
+    std::vector<std::thread> threads_;
+    std::vector<ParentOp*> parents_;
+    bool stop_;
+    int64_t inflight_;
+    int64_t completed_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* aio_handle_new(int64_t block_size, int64_t queue_depth, int single_submit,
+                     int overlap_events, int num_threads) {
+    return new AioHandle(block_size, queue_depth, single_submit != 0,
+                         overlap_events != 0, num_threads);
+}
+
+void aio_handle_free(void* h) { delete static_cast<AioHandle*>(h); }
+
+int64_t aio_get_block_size(void* h) { return static_cast<AioHandle*>(h)->block_size(); }
+int64_t aio_get_queue_depth(void* h) { return static_cast<AioHandle*>(h)->queue_depth(); }
+int aio_get_single_submit(void* h) { return static_cast<AioHandle*>(h)->single_submit(); }
+int aio_get_overlap_events(void* h) { return static_cast<AioHandle*>(h)->overlap_events(); }
+int aio_get_thread_count(void* h) { return static_cast<AioHandle*>(h)->thread_count(); }
+
+// async: schedule and return immediately; drain with aio_wait
+int64_t aio_async_pread(void* h, char* buf, int64_t nbytes, const char* filename) {
+    return static_cast<AioHandle*>(h)->submit(true, buf, nbytes, filename);
+}
+
+int64_t aio_async_pwrite(void* h, char* buf, int64_t nbytes, const char* filename) {
+    return static_cast<AioHandle*>(h)->submit(false, buf, nbytes, filename);
+}
+
+int64_t aio_wait(void* h) { return static_cast<AioHandle*>(h)->wait(); }
+
+// sync: schedule + drain
+int64_t aio_sync_pread(void* h, char* buf, int64_t nbytes, const char* filename) {
+    auto* handle = static_cast<AioHandle*>(h);
+    int64_t rc = handle->submit(true, buf, nbytes, filename);
+    if (rc != 0) return rc;
+    return handle->wait() >= 0 ? 0 : -1;
+}
+
+int64_t aio_sync_pwrite(void* h, char* buf, int64_t nbytes, const char* filename) {
+    auto* handle = static_cast<AioHandle*>(h);
+    int64_t rc = handle->submit(false, buf, nbytes, filename);
+    if (rc != 0) return rc;
+    return handle->wait() >= 0 ? 0 : -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,170 @@
+"""Async file I/O handle — the ``aio_handle`` API of the reference
+(``csrc/aio/py_lib/py_ds_aio.cpp:14-40``): async_pread/async_pwrite of flat
+tensors against files with a worker thread pool, drained by ``wait()``.
+
+Backed by the native C++ library (``csrc/aio/ds_aio.cpp``); a pure-Python
+thread-pool fallback keeps NVMe offload functional without a toolchain.
+"""
+
+import concurrent.futures
+import ctypes
+
+import numpy as np
+
+from deepspeed_tpu.ops.native import load_native
+from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder
+
+
+def _as_buffer(arr):
+    """Flat contiguous byte view of a numpy array (zero-copy)."""
+    a = np.ascontiguousarray(arr)
+    return a, a.view(np.uint8).reshape(-1)
+
+
+class AsyncIOHandle:
+    """Mirrors reference ``aio_handle(block_size, queue_depth, single_submit,
+    overlap_events, num_threads)``."""
+
+    def __init__(self, block_size=1024 * 1024, queue_depth=8, single_submit=False,
+                 overlap_events=True, num_threads=4):
+        self._lib = load_native("ds_aio")
+        self._pending = 0
+        if self._lib is not None:
+            self._lib.aio_handle_new.restype = ctypes.c_void_p
+            self._lib.aio_handle_new.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                                 ctypes.c_int, ctypes.c_int, ctypes.c_int]
+            for fn in ("aio_async_pread", "aio_async_pwrite", "aio_sync_pread",
+                       "aio_sync_pwrite"):
+                getattr(self._lib, fn).restype = ctypes.c_int64
+                getattr(self._lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                                   ctypes.c_int64, ctypes.c_char_p]
+            self._lib.aio_wait.restype = ctypes.c_int64
+            self._lib.aio_wait.argtypes = [ctypes.c_void_p]
+            self._h = ctypes.c_void_p(self._lib.aio_handle_new(
+                block_size, queue_depth, int(single_submit), int(overlap_events),
+                num_threads))
+            self._pool = None
+        else:
+            self._h = None
+            self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=num_threads)
+            self._futures = []
+        self._block_size = block_size
+        self._queue_depth = queue_depth
+        self._single_submit = single_submit
+        self._overlap_events = overlap_events
+        self._num_threads = num_threads
+        # keep submitted buffers alive until wait()
+        self._live = []
+
+    # --- config introspection (reference get_* methods) ---
+    def get_block_size(self):
+        return self._block_size
+
+    def get_queue_depth(self):
+        return self._queue_depth
+
+    def get_single_submit(self):
+        return self._single_submit
+
+    def get_overlap_events(self):
+        return self._overlap_events
+
+    def get_thread_count(self):
+        return self._num_threads
+
+    # --- I/O ---
+    def async_pread(self, tensor, filename):
+        if not getattr(tensor, "flags", None) or not tensor.flags["C_CONTIGUOUS"]:
+            raise ValueError(
+                "async_pread requires a C-contiguous destination array — a "
+                "non-contiguous input would read into a hidden copy")
+        arr, buf = _as_buffer(tensor)
+        self._live.append(arr)
+        if self._h is not None:
+            rc = self._lib.aio_async_pread(
+                self._h, buf.ctypes.data_as(ctypes.c_char_p), buf.nbytes,
+                str(filename).encode())
+            if rc != 0:
+                raise IOError(f"async_pread({filename}) failed rc={rc}")
+        else:
+            self._futures.append(self._pool.submit(self._py_read, buf, filename))
+        self._pending += 1
+        return 0
+
+    def async_pwrite(self, tensor, filename):
+        arr, buf = _as_buffer(tensor)
+        self._live.append(arr)
+        if self._h is not None:
+            rc = self._lib.aio_async_pwrite(
+                self._h, buf.ctypes.data_as(ctypes.c_char_p), buf.nbytes,
+                str(filename).encode())
+            if rc != 0:
+                raise IOError(f"async_pwrite({filename}) failed rc={rc}")
+        else:
+            self._futures.append(self._pool.submit(self._py_write, buf, filename))
+        self._pending += 1
+        return 0
+
+    def sync_pread(self, tensor, filename):
+        self.async_pread(tensor, filename)
+        return self.wait()
+
+    def sync_pwrite(self, tensor, filename):
+        self.async_pwrite(tensor, filename)
+        return self.wait()
+
+    def wait(self):
+        """Drain all in-flight ops; returns the number completed."""
+        try:
+            if self._h is not None:
+                n = self._lib.aio_wait(self._h)
+                if n < 0:
+                    raise IOError(f"aio wait reported errno={-n}")
+            else:
+                futures, self._futures = self._futures, []
+                for f in futures:
+                    f.result()
+                n = len(futures)
+        finally:
+            self._pending = 0
+            self._live = []
+        return n
+
+    @staticmethod
+    def _py_read(buf, filename):
+        with open(filename, "rb") as f:
+            data = f.read(buf.nbytes)
+        if len(data) < buf.nbytes:
+            raise IOError(f"short read from {filename}")
+        buf[:] = np.frombuffer(data, dtype=np.uint8)
+
+    @staticmethod
+    def _py_write(buf, filename):
+        with open(filename, "wb") as f:
+            f.write(buf.tobytes())
+
+    def __del__(self):
+        try:
+            if self._h is not None and self._lib is not None:
+                self._lib.aio_handle_free.argtypes = [ctypes.c_void_p]
+                self._lib.aio_handle_free(self._h)
+                self._h = None
+            elif self._pool is not None:
+                self._pool.shutdown(wait=False)
+        except Exception:
+            pass
+
+
+@register_op_builder
+class AsyncIOBuilder(OpBuilder):
+    """Parity slot for the reference async_io op builder (op_builder/async_io.py)."""
+    NAME = "async_io"
+
+    def is_compatible(self, verbose=False):
+        return load_native("ds_aio") is not None
+
+    def reference_impl(self):
+        return AsyncIOHandle
+
+    def load(self, verbose=False):
+        return AsyncIOHandle

@@ -1,0 +1,76 @@
+"""Native (C++) op loading — the JIT-build seam of the reference op_builder.
+
+The reference compiles CUDA/C++ extensions on first use via
+``torch.utils.cpp_extension`` (``op_builder/builder.py:463,482 jit_load``).
+Here the host-side native components (async NVMe I/O, CPU optimizers) are
+plain C++ shared libraries compiled once with g++ and bound through ctypes —
+no torch, no pybind11. Every native op has a pure-Python/numpy fallback so
+the framework works (slower) when no toolchain is present.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from deepspeed_tpu.utils.logging import logger
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+_CSRC = os.path.join(_REPO_ROOT, "csrc")
+_BUILD_DIR = os.environ.get(
+    "DS_TPU_BUILD_DIR", os.path.join(_REPO_ROOT, "build", "native"))
+
+_SOURCES = {
+    "ds_aio": [os.path.join(_CSRC, "aio", "ds_aio.cpp")],
+    "ds_cpu_adam": [os.path.join(_CSRC, "adam", "cpu_adam.cpp")],
+}
+
+_lock = threading.Lock()
+_cache = {}
+
+
+def _needs_build(so_path, sources):
+    if not os.path.exists(so_path):
+        return True
+    so_mtime = os.path.getmtime(so_path)
+    return any(os.path.getmtime(s) > so_mtime for s in sources if os.path.exists(s))
+
+
+def _compile(name, sources, so_path):
+    os.makedirs(os.path.dirname(so_path), exist_ok=True)
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            "-o", so_path] + sources
+    # try fastest flags first, degrade gracefully (reference is_compatible probing)
+    for extra in (["-march=native", "-fopenmp"], ["-fopenmp"], []):
+        try:
+            subprocess.run(base + extra, check=True, capture_output=True, timeout=120)
+            logger.info(f"built native op {name} ({' '.join(extra) or 'portable'})")
+            return True
+        except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired) as e:
+            err = getattr(e, "stderr", b"")
+            last_err = err.decode()[-500:] if err else str(e)
+    logger.warning(f"native op {name} failed to build, using fallback: {last_err}")
+    return False
+
+
+def load_native(name):
+    """Return the ctypes CDLL for a native op, building it if needed, or None."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        sources = _SOURCES.get(name)
+        if not sources or not all(os.path.exists(s) for s in sources):
+            _cache[name] = None
+            return None
+        so_path = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        if _needs_build(so_path, sources) and not _compile(name, sources, so_path):
+            _cache[name] = None
+            return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as e:
+            logger.warning(f"native op {name}: load failed ({e}); using fallback")
+            lib = None
+        _cache[name] = lib
+        return lib

@@ -71,6 +71,8 @@ def available_ops():
 def _populate():
     # import modules for registration side effects
     import deepspeed_tpu.ops.adam  # noqa: F401
+    import deepspeed_tpu.ops.aio  # noqa: F401
+    import deepspeed_tpu.ops.cpu_adam  # noqa: F401
     try:
         import deepspeed_tpu.ops.flash_attention  # noqa: F401
     except Exception:

@@ -207,6 +207,7 @@ class DeepSpeedEngine:
         self._micro_step_fn = None
         self._apply_step_fn = None
         self._eval_step_fn = None
+        self._offload = None  # ZeRO-Offload host tier (zero/offload.py)
         if model_parameters is not None:
             self._init_state(model_parameters)
 
@@ -251,6 +252,12 @@ class DeepSpeedEngine:
                 return None
         return None
 
+    def _offload_device(self):
+        zc = self.config.zero_config
+        if zc.cpu_offload:  # deprecated alias (reference zero/config.py)
+            return "cpu"
+        return zc.offload_optimizer_device
+
     def _init_state(self, model_parameters):
         # Force a copy: the engine's state buffers are donated to compiled steps,
         # so they must never alias the caller's arrays (astype/device_put return
@@ -260,6 +267,8 @@ class DeepSpeedEngine:
         self.partitioner = ZeroPartitioner(self.topology, self.config.zero_config,
                                            param_specs=self._resolve_param_specs(params_f32))
         self.partitioner.describe(params_f32)
+        if self._offload_device() in ("cpu", "nvme"):
+            return self._init_state_offload(params_f32)
 
         working = tree_cast(params_f32, self.working_dtype)
         param_sh = self.partitioner.param_sharding(working)
@@ -300,6 +309,89 @@ class DeepSpeedEngine:
         )
         n = count_parameters(params_f32)
         log_dist(f"model parameters: {n/1e6:.2f}M", ranks=[0])
+
+    def _init_state_offload(self, params_f32):
+        """ZeRO-Offload/Infinity state layout (zero/offload.py): the offloaded
+        leaves' fp32 master + Adam moments live on the host (DRAM or NVMe);
+        only the non-offloaded remainder keeps a device-resident master/optax
+        state. Mirrors reference ``offload_optimizer`` cpu/nvme paths."""
+        from deepspeed_tpu.runtime.zero.offload import (HostOffloadOptimizer,
+                                                        select_offload_leaves)
+        zc = self.config.zero_config
+        off_cfg = zc.offload_optimizer
+        opt_cfg = self.config.optimizer
+        opt_name = (opt_cfg.type or "adamw").lower()
+        if opt_name not in ("adam", "adamw"):
+            raise ValueError(
+                f"offload_optimizer requires an Adam-family optimizer (the host step "
+                f"runs the native CPU Adam, csrc/adam/cpu_adam.cpp); got {opt_name!r}")
+        ratio = off_cfg.ratio if off_cfg.device != "none" else 1.0
+        host_keys, _, _ = select_offload_leaves(params_f32, ratio)
+
+        flat_items = jax.tree_util.tree_flatten_with_path(params_f32)[0]
+        self._flat_keys = [jax.tree_util.keystr(p) for p, _ in flat_items]
+        self._offload_host_indices = [i for i, k in enumerate(self._flat_keys)
+                                      if k in host_keys]
+        self._offload_device_indices = [i for i, k in enumerate(self._flat_keys)
+                                        if k not in host_keys]
+
+        working = tree_cast(params_f32, self.working_dtype)
+        param_sh = self.partitioner.param_sharding(working)
+        master_sh_full = self.partitioner.master_sharding(params_f32)
+        grad_sh = self.partitioner.grad_sharding(params_f32)
+        self._flat_param_sh = [s for s in jax.tree_util.tree_leaves(param_sh)]
+
+        working = jax.tree.map(jax.device_put, working, param_sh)
+
+        flat_f32 = [l for _, l in flat_items]
+        flat_master_sh = jax.tree_util.tree_leaves(master_sh_full)
+        master_d = {self._flat_keys[i]: jax.device_put(flat_f32[i], flat_master_sh[i])
+                    for i in self._offload_device_indices}
+        self._master_sh_d = {self._flat_keys[i]: flat_master_sh[i]
+                             for i in self._offload_device_indices}
+        host_leaves = {self._flat_keys[i]: np.asarray(jax.device_get(flat_f32[i]))
+                       for i in self._offload_host_indices}
+        opt_params = dict(opt_cfg.params or {})
+        self._offload = HostOffloadOptimizer(host_leaves, off_cfg, opt_params,
+                                             self.working_dtype)
+
+        opt_state = self._tx.init(master_d)
+        rep = self.topology.replicated()
+        # sharding via the same partitioner logic as the non-offload path,
+        # scoped to the device-resident subset
+        if self.partitioner.param_specs is None:
+            specs_d = None
+        else:
+            from jax.sharding import PartitionSpec as _P
+            flat_specs = jax.tree_util.tree_flatten(
+                self.partitioner.param_specs,
+                is_leaf=lambda x: x is None or isinstance(x, _P))[0]
+            specs_d = {self._flat_keys[i]: flat_specs[i]
+                       for i in self._offload_device_indices}
+        sub_partitioner = ZeroPartitioner(self.topology, zc, param_specs=specs_d)
+        master_d_f32 = {self._flat_keys[i]: flat_f32[i]
+                        for i in self._offload_device_indices}
+        opt_sh = sub_partitioner.opt_state_sharding(opt_state, master_d_f32)
+        opt_state = jax.tree.map(jax.device_put, opt_state, opt_sh)
+
+        grad_acc = tree_zeros_like(params_f32, self.grad_accum_dtype)
+        grad_acc = jax.tree.map(jax.device_put, grad_acc, grad_sh)
+        self._shardings = dict(params=param_sh, master=self._master_sh_d,
+                               grad=grad_sh, opt=opt_sh)
+
+        scale = init_loss_scale_state(self.config.fp16) if self.fp16_enabled \
+            else LossScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(0))
+        rng_key = jax.random.PRNGKey(self._rng_seed) if isinstance(self._rng_seed, int) \
+            else self._rng_seed
+        self.state = TrainState(
+            params=working, master=master_d, opt_state=opt_state, grad_acc=grad_acc,
+            scale=jax.tree.map(lambda x: jax.device_put(x, rep), scale),
+            global_step=jax.device_put(jnp.int32(0), rep),
+            skipped=jax.device_put(jnp.int32(0), rep),
+            rng=jax.device_put(rng_key, rep))
+        n = count_parameters(params_f32)
+        log_dist(f"model parameters: {n/1e6:.2f}M (offload={off_cfg.device}, "
+                 f"ratio={ratio})", ranks=[0])
 
     def _ensure_initialized(self, batch):
         if self.state is not None:
@@ -415,10 +507,111 @@ class DeepSpeedEngine:
 
         return jax.jit(eval_step)
 
+    def _build_offload_fns(self):
+        """Compiled pieces of the offloaded apply-step: a grad-stats reduction
+        (overflow + global norm, one tiny host sync) and the device-side
+        update of the non-offloaded remainder (which also zeroes the grad
+        buffer and advances counters/loss scale)."""
+        fp16 = self.fp16_enabled
+        tx = self._tx
+        keys = self._flat_keys
+        d_idx = self._offload_device_indices
+        master_sh_d = self._master_sh_d
+        param_sh = self._shardings["params"]
+        working_dtype = self.working_dtype
+        fp16_cfg = self.config.fp16
+        dynamic = self.dynamic_loss_scale
+
+        def grad_stats(grad_acc):
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grad_acc)
+            overflow = has_overflow(g32) if fp16 else jnp.asarray(False)
+            return overflow, global_norm(g32)
+
+        def device_apply(state: TrainState, lr, inv_scale, overflow):
+            flat_g = jax.tree_util.tree_leaves(state.grad_acc)
+            grads_d = {keys[i]: flat_g[i].astype(jnp.float32) * inv_scale
+                       for i in d_idx}
+            opt_state = set_lr(state.opt_state, lr)
+            updates, new_opt = tx.update(grads_d, opt_state, state.master)
+            new_master = optax.apply_updates(state.master, updates)
+            new_master = tree_where(overflow, state.master, new_master)
+            new_opt = tree_where(overflow, opt_state, new_opt)
+            new_master = constrain_tree(new_master, master_sh_d)
+
+            flat_p, pdef = jax.tree_util.tree_flatten(state.params)
+            new_flat_p = list(flat_p)
+            for i in d_idx:
+                new_flat_p[i] = new_master[keys[i]].astype(working_dtype)
+            new_params = constrain_tree(
+                jax.tree_util.tree_unflatten(pdef, new_flat_p), param_sh)
+            new_acc = jax.tree.map(jnp.zeros_like, state.grad_acc)
+            new_scale = update_loss_scale(state.scale, overflow, fp16_cfg, dynamic)
+            return TrainState(params=new_params, master=new_master, opt_state=new_opt,
+                              grad_acc=new_acc, scale=new_scale,
+                              global_step=state.global_step + 1,
+                              skipped=state.skipped + overflow.astype(jnp.int32),
+                              rng=state.rng)
+
+        self._offload_stats_fn = jax.jit(grad_stats)
+        self._offload_apply_fn = jax.jit(device_apply, donate_argnums=(0,))
+
+    def _offload_step(self, lr):
+        """Apply-step under ZeRO-Offload: device handles the retained leaves
+        and bookkeeping; the host tier (zero/offload.py) runs CPU Adam over
+        the offloaded leaves and streams back the working copy. The device
+        program is dispatched *before* the host update so XLA execution and
+        host compute/PCIe overlap (the reference's stream overlap analog)."""
+        gas = self.gradient_accumulation_steps_value
+        overflow_a, raw_norm_a = self._offload_stats_fn(self.state.grad_acc)
+        overflow = bool(jax.device_get(overflow_a))
+        raw_norm = float(jax.device_get(raw_norm_a))
+        scale_before = self.cur_scale  # the scale this step actually ran at
+        denom = float(gas)
+        if self.fp16_enabled:
+            denom *= scale_before
+        if self.config.prescale_gradients and self.config.gradient_predivide_factor != 1.0:
+            denom /= float(self.config.gradient_predivide_factor)
+        norm = raw_norm / denom
+        clip = self.config.gradient_clipping
+        clip_coef = 1.0
+        if clip and clip > 0 and norm > clip:
+            clip_coef = clip / (norm + 1e-6)
+        inv_scale = clip_coef / denom
+
+        host_grads = None
+        if not overflow and self._offload_host_indices:
+            flat_g = jax.tree_util.tree_leaves(self.state.grad_acc)
+            host_grads = jax.device_get(
+                {self._flat_keys[i]: flat_g[i] for i in self._offload_host_indices})
+        # dispatch the device-side update first (async), then run host Adam
+        new_state = self._offload_apply_fn(self.state, jnp.float32(lr),
+                                           jnp.float32(inv_scale),
+                                           jnp.asarray(overflow))
+        if host_grads is not None:
+            new_working = self._offload.step(
+                {k: np.asarray(v, dtype=np.float32) for k, v in host_grads.items()},
+                lr, inv_scale)
+            flat_p, pdef = jax.tree_util.tree_flatten(new_state.params)
+            for i in self._offload_host_indices:
+                # copy: the host optimizer reuses its output buffers in place
+                # next step, and device_put on CPU backends can be zero-copy —
+                # params must never alias host memory (see _init_state note)
+                leaf = np.array(new_working[self._flat_keys[i]], copy=True)
+                flat_p[i] = jax.device_put(leaf, self._flat_param_sh[i])
+            new_state = new_state._replace(
+                params=jax.tree_util.tree_unflatten(pdef, flat_p))
+        self.state = new_state
+        return StepStats(grad_norm=jnp.float32(norm), overflow=jnp.asarray(overflow),
+                         lr=jnp.float32(lr), loss_scale=jnp.float32(scale_before))
+
     def _compiled(self):
         if self._micro_step_fn is None:
             self._micro_step_fn = self._build_micro_step()
-            self._apply_step_fn = self._build_apply_step()
+            if self._offload is not None:
+                self._build_offload_fns()
+                self._apply_step_fn = None
+            else:
+                self._apply_step_fn = self._build_apply_step()
             self._eval_step_fn = self._build_eval_step()
 
     # ------------------------------------------------------------------
@@ -479,7 +672,10 @@ class DeepSpeedEngine:
             self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
             lr = self._schedule_fn(self.global_steps)
-            self.state, stats = self._apply_step_fn(self.state, lr)
+            if self._offload is not None:
+                stats = self._offload_step(lr)
+            else:
+                self.state, stats = self._apply_step_fn(self.state, lr)
             self._last_stats = stats
             self._step_applied = True
             self.global_steps += 1
@@ -565,8 +761,20 @@ class DeepSpeedEngine:
     def get_model_parameters(self, dtype=jnp.float32):
         """Gathered full-precision parameters (analog of
         ``zero_gather_16bit_weights_on_model_save`` / zero_to_fp32)."""
-        src = self.state.master if self.state.master is not None else self.state.params
         rep = self.topology.replicated()
+        if self._offload is not None:
+            # merge device-resident masters with the host tier
+            flat_p, pdef = jax.tree_util.tree_flatten(self.state.params)
+            out = []
+            for i, k in enumerate(self._flat_keys):
+                if k in self.state.master:
+                    out.append(np.asarray(jax.device_get(
+                        jax.device_put(self.state.master[k], rep)), dtype=dtype))
+                else:
+                    out.append(self._offload.masters[k].reshape(
+                        self._offload.shapes[k]).astype(dtype))
+            return jax.tree_util.tree_unflatten(pdef, out)
+        src = self.state.master if self.state.master is not None else self.state.params
         return jax.tree.map(lambda x: np.asarray(jax.device_put(x, rep), dtype=dtype), src)
 
     # ------------------------------------------------------------------
@@ -589,6 +797,8 @@ class DeepSpeedEngine:
             "ds_config": self.config._param_dict,
         }
         engine.save(self.state, path, meta=meta)
+        if self._offload is not None:
+            self._offload.save(os.path.join(path, "host_optimizer_states.npz"))
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
@@ -619,6 +829,10 @@ class DeepSpeedEngine:
             if hasattr(old, "sharding") else new,
             new_state, shard_template)
         self.state = new_state
+        host_states = os.path.join(path, "host_optimizer_states.npz")
+        if self._offload is not None and load_optimizer_states and \
+                os.path.exists(host_states):
+            self._offload.load(host_states)
         c = meta.get("counters", {"global_steps": 0, "global_samples": 0,
                                   "micro_steps": 0, "skipped_steps": 0})
         self.global_steps = int(c["global_steps"])

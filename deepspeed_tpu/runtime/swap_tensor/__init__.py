@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import PartitionedOptimizerSwapper
+
+__all__ = ["AsyncTensorSwapper", "PartitionedOptimizerSwapper"]

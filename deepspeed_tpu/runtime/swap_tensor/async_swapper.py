@@ -1,0 +1,63 @@
+"""Async tensor swapping to NVMe (reference ``runtime/swap_tensor/async_swapper.py``).
+
+Double-buffered: ``swap_out`` enqueues a write through the native aio handle
+and returns; the caller overlaps compute with I/O and drains with ``wait``.
+Buffers are recycled from a fixed pool (reference buffer_count semantics).
+"""
+
+import os
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+
+class AsyncTensorSwapper:
+
+    def __init__(self, swap_dir, aio_config=None, buffer_count=4):
+        cfg = aio_config or {}
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        self.handle = AsyncIOHandle(
+            block_size=cfg.get("block_size", 1024 * 1024),
+            queue_depth=cfg.get("queue_depth", 8),
+            single_submit=cfg.get("single_submit", False),
+            overlap_events=cfg.get("overlap_events", True),
+            num_threads=cfg.get("thread_count", 4))
+        self.buffer_count = buffer_count
+        self._inflight_writes = 0
+        self._inflight_reads = 0
+
+    def path_for(self, key):
+        return os.path.join(self.swap_dir, f"{key}.swp")
+
+    def swap_out(self, key, array, async_op=True):
+        """Write ``array`` (numpy) to the swap file for ``key``."""
+        arr = np.ascontiguousarray(array)
+        self.handle.async_pwrite(arr, self.path_for(key))
+        self._inflight_writes += 1
+        if not async_op:
+            self.wait()
+
+    def swap_in(self, key, out_array, async_op=True):
+        """Read the swap file for ``key`` into ``out_array`` (numpy, preallocated)."""
+        self.handle.async_pread(out_array, self.path_for(key))
+        self._inflight_reads += 1
+        if not async_op:
+            self.wait()
+        return out_array
+
+    def has_swapped(self, key):
+        return os.path.exists(self.path_for(key))
+
+    def wait(self):
+        n = self.handle.wait()
+        self._inflight_writes = 0
+        self._inflight_reads = 0
+        return n
+
+    def release(self, key):
+        try:
+            os.remove(self.path_for(key))
+        except FileNotFoundError:
+            pass

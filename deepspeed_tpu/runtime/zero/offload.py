@@ -1,0 +1,158 @@
+"""ZeRO-Offload / ZeRO-Infinity — host- and NVMe-tier optimizer state.
+
+Capability map to the reference:
+- ``offload_optimizer.device=cpu`` (``zero/stage_1_and_2.py`` cpu-offload path,
+  ``async_accumulate_grad_in_cpu_via_gpu:1177``): fp32 master weights + Adam
+  moments live in host DRAM; gradients stream device→host at the boundary; the
+  update runs in the native C++ CPU Adam (``csrc/adam/cpu_adam.cpp``); the bf16
+  working copy streams back, produced in the same pass (fused param_copy).
+- ``offload_optimizer.device=nvme`` (ZeRO-Infinity,
+  ``swap_tensor/partitioned_optimizer_swapper.py``): moments additionally swap
+  to NVMe through the async aio handle with next-leaf read-ahead.
+- ``offload_optimizer.ratio`` (ZeRO-Offload++ Twin-Flow,
+  ``blogs/deepspeed-offloadpp``): only that fraction of parameter elements
+  (largest leaves first) is offloaded; the rest takes the normal on-device
+  sharded optax path. Unlike the reference — which interleaves CUDA and CPU
+  optimizers over flat shards — the split here is per-leaf, which keeps both
+  sides a plain pytree and lets XLA overlap the device update with host I/O.
+
+On TPU the device→host and host→device streams ride the PCIe DMA engines
+while the TPU keeps executing dispatched XLA programs, so the overlap story
+of the reference (CUDA streams) falls out of JAX's async dispatch.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.utils.logging import log_dist
+
+try:
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+
+def _keystr(path):
+    return jax.tree_util.keystr(path)
+
+
+class HostOffloadOptimizer:
+    """Owns the host tier: fp32 masters + moments for the offloaded leaves."""
+
+    def __init__(self, params_f32_leaves, offload_config, opt_params, working_dtype):
+        """``params_f32_leaves``: dict keystr -> numpy fp32 initial values."""
+        self.device = offload_config.device
+        self.working_dtype = working_dtype
+        betas = tuple(opt_params.get("betas", (0.9, 0.999)))
+        self.adam = DeepSpeedCPUAdam(
+            lr=opt_params.get("lr", 1e-3), betas=betas,
+            eps=opt_params.get("eps", 1e-8),
+            weight_decay=opt_params.get("weight_decay", 0.0),
+            adamw_mode=opt_params.get("adam_w_mode", True))
+        self.masters = {k: np.ascontiguousarray(v, dtype=np.float32).reshape(-1)
+                        for k, v in params_f32_leaves.items()}
+        self.shapes = {k: np.asarray(v).shape for k, v in params_f32_leaves.items()}
+        self._out_u16 = {k: np.empty(v.size, dtype=np.uint16)
+                         for k, v in self.masters.items()}
+        self.swapper = None
+        if self.device == "nvme":
+            from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import (
+                PartitionedOptimizerSwapper)
+            swap_dir = os.path.join(offload_config.nvme_path or "/tmp/ds_tpu_nvme",
+                                    "optimizer")
+            self.swapper = PartitionedOptimizerSwapper(
+                swap_dir, buffer_count=offload_config.buffer_count,
+                pipeline=offload_config.pipeline_read or offload_config.pipeline_write)
+            for k, m in self.masters.items():
+                self.swapper.register(k, m.size, async_op=True)
+            self.swapper.flush()
+
+    def step(self, grads, lr, scale):
+        """Update all offloaded leaves. ``grads``: dict keystr -> numpy fp32
+        (already fetched from device); ``scale`` multiplies grads (combines
+        1/(gas*loss_scale) and clip coefficient). Returns dict keystr ->
+        numpy working-precision arrays (flat) for device upload."""
+        self.adam.begin_step()
+        out = {}
+        keys = list(grads)
+        for i, k in enumerate(keys):
+            g = np.ascontiguousarray(grads[k], dtype=np.float32).reshape(-1)
+            if scale != 1.0:
+                g = g * np.float32(scale)
+            p = self.masters[k]
+            want_bf16 = self.working_dtype == jnp.bfloat16
+            u16 = self._out_u16[k] if want_bf16 else None
+            if self.swapper is not None:
+                nxt = keys[i + 1] if i + 1 < len(keys) else None
+                m, v = self.swapper.fetch(k, prefetch_next=nxt)
+                self.adam.update(k, p, g, out_bf16=u16, lr=lr, m=m, v=v)
+                self.swapper.commit(k)
+            else:
+                self.adam.update(k, p, g, out_bf16=u16, lr=lr)
+            if want_bf16 and _BF16 is not None:
+                out[k] = u16.view(_BF16).reshape(self.shapes[k])
+            elif self.working_dtype == jnp.float32:
+                out[k] = p.reshape(self.shapes[k])
+            else:  # fp16 or no ml_dtypes: numpy cast
+                out[k] = p.astype(np.float16 if self.working_dtype == jnp.float16
+                                  else np.float32).reshape(self.shapes[k])
+        if self.swapper is not None:
+            self.swapper.finish_step()
+        return out
+
+    # --- checkpointing ---
+    def save(self, path):
+        blobs = {f"master::{k}": v for k, v in self.masters.items()}
+        if self.swapper is not None:
+            for k, (m, v) in self.swapper.state_arrays().items():
+                blobs[f"m::{k}"] = m
+                blobs[f"v::{k}"] = v
+        else:
+            for k in self.masters:
+                m, v = self.adam.state_for(k, self.masters[k].size)
+                blobs[f"m::{k}"] = m
+                blobs[f"v::{k}"] = v
+        blobs["step_count"] = np.asarray(self.adam.step_count)
+        np.savez(path, **blobs)
+
+    def load(self, path):
+        data = np.load(path)
+        self.adam.step_count = int(data["step_count"])
+        swap_states = {}
+        for name in data.files:
+            if name.startswith("master::"):
+                self.masters[name[8:]] = np.ascontiguousarray(data[name])
+            elif name.startswith("m::"):
+                k = name[3:]
+                if self.swapper is not None:
+                    swap_states[k] = (data[name], data[f"v::{k}"])
+                else:
+                    self.adam.set_state(k, data[name], data[f"v::{k}"])
+        if self.swapper is not None:
+            self.swapper.load_state_arrays(swap_states)
+
+
+def select_offload_leaves(params_f32, ratio):
+    """Pick leaves to offload: largest first until ``ratio`` of total elements
+    (ZeRO-Offload++ partial offload). Returns (host_paths set, total, offloaded)."""
+    leaves = jax.tree_util.tree_flatten_with_path(params_f32)[0]
+    sized = sorted(((int(np.prod(l.shape)) if hasattr(l, "shape") else 1, _keystr(p))
+                    for p, l in leaves), reverse=True)
+    total = sum(s for s, _ in sized)
+    budget = ratio * total
+    host, acc = set(), 0
+    for s, k in sized:
+        if acc >= budget:
+            break
+        host.add(k)
+        acc += s
+    log_dist(f"ZeRO-Offload: {len(host)}/{len(sized)} leaves "
+             f"({acc/max(total,1):.0%} of {total/1e6:.1f}M elements) on host tier",
+             ranks=[0])
+    return host, total, acc
