@@ -22,6 +22,11 @@ inline uint16_t float_to_bf16(float f) {
     // round-to-nearest-even, matching XLA's convert semantics
     uint32_t bits;
     std::memcpy(&bits, &f, sizeof(bits));
+    if ((bits & 0x7FFFFFFFu) > 0x7F800000u) {
+        // NaN: keep it a NaN (the rounding bias could carry into the exponent
+        // and launder a NaN into a finite value)
+        return (uint16_t)((bits >> 16) | 0x0040u);
+    }
     uint32_t rounding_bias = 0x7FFF + ((bits >> 16) & 1);
     return (uint16_t)((bits + rounding_bias) >> 16);
 }

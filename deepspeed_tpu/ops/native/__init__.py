@@ -39,12 +39,14 @@ def _needs_build(so_path, sources):
 
 def _compile(name, sources, so_path):
     os.makedirs(os.path.dirname(so_path), exist_ok=True)
+    tmp_path = f"{so_path}.tmp.{os.getpid()}"
     base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-            "-o", so_path] + sources
+            "-o", tmp_path] + sources
     # try fastest flags first, degrade gracefully (reference is_compatible probing)
     for extra in (["-march=native", "-fopenmp"], ["-fopenmp"], []):
         try:
             subprocess.run(base + extra, check=True, capture_output=True, timeout=120)
+            os.replace(tmp_path, so_path)  # atomic: readers never see a torn .so
             logger.info(f"built native op {name} ({' '.join(extra) or 'portable'})")
             return True
         except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired) as e:
@@ -64,9 +66,20 @@ def load_native(name):
             _cache[name] = None
             return None
         so_path = os.path.join(_BUILD_DIR, f"lib{name}.so")
-        if _needs_build(so_path, sources) and not _compile(name, sources, so_path):
-            _cache[name] = None
-            return None
+        if _needs_build(so_path, sources):
+            # cross-process lock: multi-rank launches share the build dir
+            # (reference jit_load serializes builds the same way)
+            import fcntl
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            with open(so_path + ".lock", "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    if _needs_build(so_path, sources) and \
+                            not _compile(name, sources, so_path):
+                        _cache[name] = None
+                        return None
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
         try:
             lib = ctypes.CDLL(so_path)
         except OSError as e:

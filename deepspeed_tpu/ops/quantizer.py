@@ -1,0 +1,126 @@
+"""Groupwise integer quantization — the ZeRO++ quantization primitive.
+
+Reference: ``csrc/quantization/{quantize.cu,quantize_intX.cu,dequantize.cu,
+swizzled_quantize.cu,quant_reduce.cu}`` — symmetric groupwise int8/int4
+(de)quantization used by qwZ (quantized weight all-gather) and qgZ (quantized
+gradient reduction). On TPU these are elementwise ops XLA fuses into the
+surrounding program; the "swizzled layout" the reference needs for coalesced
+NCCL transfers is unnecessary — XLA lays out collective buffers itself.
+
+int4 values are packed two-per-byte into uint8 (low nibble first) so the
+wire/HBM footprint is the true 4 bits.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder
+
+DEFAULT_GROUP = 2048
+
+
+def _grouped(flat, group_size):
+    n = flat.shape[0]
+    groups = max(1, (n + group_size - 1) // group_size)
+    pad = groups * group_size - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(groups, -1), pad
+
+
+def quantize(x, num_bits=8, group_size=DEFAULT_GROUP):
+    """Symmetric groupwise quantization of any-shape ``x``.
+
+    Returns ``(q, scale)``: ``q`` is int8 (8-bit) or nibble-packed uint8
+    (4-bit, half the elements), ``scale`` is fp32 per group. Padding to a
+    whole number of groups is implicit; ``dequantize`` takes the original
+    shape back."""
+    assert num_bits in (8, 4), f"unsupported bits {num_bits}"
+    flat = x.reshape(-1).astype(jnp.float32)
+    g, _ = _grouped(flat, group_size)
+    qmax = jnp.float32(127.0 if num_bits == 8 else 7.0)
+    amax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / qmax, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(g / scale), -qmax, qmax).astype(jnp.int8)
+    if num_bits == 4:
+        # pack pairs of nibbles: ints in [-7,7] -> two's-complement nibble
+        lo = q[:, 0::2].astype(jnp.uint8) & 0xF
+        hi = q[:, 1::2].astype(jnp.uint8) & 0xF
+        q = (lo | (hi << 4)).astype(jnp.uint8)
+    return q, scale[:, 0]
+
+
+def dequantize(q, scale, shape, num_bits=8, group_size=DEFAULT_GROUP,
+               dtype=jnp.float32):
+    """Inverse of :func:`quantize` back to ``shape``."""
+    if num_bits == 4:
+        lo = (q & 0xF).astype(jnp.int8)
+        hi = ((q >> 4) & 0xF).astype(jnp.int8)
+        # sign-extend 4-bit two's complement
+        lo = jnp.where(lo > 7, lo - 16, lo)
+        hi = jnp.where(hi > 7, hi - 16, hi)
+        vals = jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
+    else:
+        vals = q
+    out = vals.astype(jnp.float32) * scale[:, None]
+    n = 1
+    for d in shape:
+        n *= d
+    return out.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def quantize_lastdim(x, num_bits=8, group_size=256):
+    """Per-row quantization over the last dimension (weight layout used by the
+    engine's qwZ working copy): groups tile the last axis, so ``q`` keeps the
+    tensor's shape and shards identically to the original."""
+    assert num_bits == 8, "lastdim layout is int8 (qwZ weights)"
+    d = x.shape[-1]
+    gs = min(group_size, d)
+    groups = (d + gs - 1) // gs
+    pad = groups * gs - d
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    gshape = xf.shape[:-1] + (groups, gs)
+    gx = xf.reshape(gshape)
+    amax = jnp.max(jnp.abs(gx), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(gx / scale), -127, 127).astype(jnp.int8)
+    q = q.reshape(xf.shape)[..., :d]
+    return q, scale[..., 0]
+
+
+def dequantize_lastdim(q, scale, num_bits=8, group_size=256, dtype=jnp.float32):
+    d = q.shape[-1]
+    gs = min(group_size, d)
+    groups = (d + gs - 1) // gs
+    pad = groups * gs - d
+    qf = q.astype(jnp.float32)
+    if pad:
+        qf = jnp.pad(qf, [(0, 0)] * (q.ndim - 1) + [(0, pad)])
+    gq = qf.reshape(qf.shape[:-1] + (groups, gs))
+    out = gq * scale[..., None]
+    return out.reshape(qf.shape)[..., :d].astype(dtype)
+
+
+@register_op_builder
+class QuantizerBuilder(OpBuilder):
+    """Parity slot for the reference quantizer op builder
+    (op_builder/quantizer.py)."""
+    NAME = "quantizer"
+
+    def reference_impl(self):
+        return quantize
+
+
+@register_op_builder
+class FPQuantizerBuilder(OpBuilder):
+    """FP6/FP12 quantization slot (reference csrc/fp_quantizer). The TPU path
+    uses int8/int4 groupwise quantization; FP6 packing is not implemented."""
+    NAME = "fp_quantizer"
+
+    def is_compatible(self, verbose=False):
+        return False
+
+    def reference_impl(self):
+        return quantize

@@ -63,7 +63,7 @@ def get_expert_parallel_world_size(group_name=None):
 
 def get_expert_data_parallel_world_size(group_name=None):
     t = get_topology()
-    return t.dp_size * t.sp_size
+    return t.dpr_size * t.dp_size * t.sp_size
 
 
 def get_sequence_parallel_world_size():

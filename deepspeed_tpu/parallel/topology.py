@@ -8,28 +8,43 @@ inserts collectives along those axes.
 
 Canonical axis order (outermost → innermost):
 
-    ("pp", "dp", "ep", "sp", "tp")
+    ("pp", "dpr", "dp", "ep", "sp", "tp")
 
 - ``pp``  pipeline stages — outermost so stages map to DCN/slice boundaries
-- ``dp``  pure data parallel (ZeRO shard axis together with ep+sp)
+- ``dpr`` ZeRO replica groups — the hierarchical split of the data-parallel
+  world used by MiCS (``runtime/zero/mics.py``) and ZeRO++ hpZ
+  (``zero_hpz_partition_size``): state is sharded *within* a ``dp`` group and
+  replicated *across* ``dpr`` groups. Size 1 unless hierarchy is requested.
+  On a TPU pod this maps shard groups to ICI-connected slices and replica
+  groups to DCN — exactly the node-local/cross-node split the reference
+  builds with nested process groups.
+- ``dp``  data parallel shard axis (ZeRO shard axis together with ep+sp)
 - ``ep``  expert parallel — carved out of the data-parallel world, exactly as the
   reference forms expert groups inside DP (``utils/groups.py:114,254``)
 - ``sp``  Ulysses sequence parallel (``deepspeed/sequence/layer.py``)
 - ``tp``  tensor parallel — innermost so its collectives ride the fastest ICI links
 
-Data-like axes: the global batch is sharded over ``(dp, ep)`` and the sequence
-over ``sp``; gradients of shared (non-expert) parameters must therefore be
-reduced over all of ``(dp, ep, sp)`` — those are also the ZeRO partition axes.
+Data-like axes: the global batch is sharded over ``(dpr, dp, ep)`` and the
+sequence over ``sp``; gradients of shared (non-expert) parameters must
+therefore be reduced over all of ``(dpr, dp, ep, sp)`` — those are also the
+ZeRO partition axes (modulo the MiCS/hpZ carve-outs below).
 """
 
 import numpy as np
 
-AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+AXIS_ORDER = ("pp", "dpr", "dp", "ep", "sp", "tp")
 
 
 class MeshTopology:
 
-    def __init__(self, pp=1, dp=-1, ep=1, sp=1, tp=1, devices=None):
+    def __init__(self, pp=1, dp=-1, ep=1, sp=1, tp=1, devices=None,
+                 zero_shard_size=None, zero_hierarchy=None):
+        """``zero_shard_size`` splits the data-parallel world hierarchically:
+        ``dp`` becomes the shard group (that size) and ``dpr`` the replica
+        groups across it. ``zero_hierarchy`` records why: "mics"
+        (``mics_shard_size``: ALL ZeRO state confined to the shard group) or
+        "hpz" (``zero_hpz_partition_size``: only the stage-3 working params —
+        the reference's secondary tensor — use the smaller group)."""
         import jax
         if devices is None:
             devices = jax.devices()
@@ -41,9 +56,23 @@ class MeshTopology:
             dp = n // fixed
         assert pp * dp * ep * sp * tp == n, (
             f"mesh {pp}x{dp}x{ep}x{sp}x{tp} != device count {n}")
+        dpr = 1
+        if zero_shard_size and zero_shard_size > 0:
+            assert zero_shard_size <= dp, (
+                f"zero shard size {zero_shard_size} exceeds the data-parallel "
+                f"world {dp} (reference mics_shard_size/zero_hpz_partition_size "
+                f"must divide the DP world)")
+            assert dp % zero_shard_size == 0, (
+                f"dp={dp} not divisible by zero shard size {zero_shard_size}")
+            assert zero_hierarchy in ("mics", "hpz"), \
+                "zero_shard_size requires zero_hierarchy of 'mics' or 'hpz'"
+            dpr = dp // zero_shard_size
+            dp = zero_shard_size
+        self.zero_hierarchy = zero_hierarchy if dpr > 1 else None
         self.pp_size, self.dp_size, self.ep_size, self.sp_size, self.tp_size = pp, dp, ep, sp, tp
-        self._sizes = dict(pp=pp, dp=dp, ep=ep, sp=sp, tp=tp)
-        dev_array = np.asarray(devices).reshape(pp, dp, ep, sp, tp)
+        self.dpr_size = dpr
+        self._sizes = dict(pp=pp, dpr=dpr, dp=dp, ep=ep, sp=sp, tp=tp)
+        dev_array = np.asarray(devices).reshape(pp, dpr, dp, ep, sp, tp)
         self.mesh = jax.sharding.Mesh(dev_array, AXIS_ORDER)
 
     @property
@@ -55,20 +84,35 @@ class MeshTopology:
 
     @property
     def zero_axes(self):
-        """Axes over which ZeRO partitions params/grads/optimizer state; the
-        reference's DP world (``groups._get_data_parallel_group``) is the
-        product of these."""
-        return ("dp", "ep", "sp")
+        """Axes over which ZeRO partitions master/optimizer state and grads;
+        the reference's DP world (``groups._get_data_parallel_group``) is the
+        product of these. MiCS confines ALL state to the shard group ("dp"),
+        replicating across "dpr" — XLA then emits reduce-scatter inside the
+        group plus a cross-group all-reduce, the MiCS hierarchical comm
+        pattern (``runtime/zero/mics.py``)."""
+        if self.zero_hierarchy == "mics":
+            return ("dp", "ep", "sp")
+        return ("dpr", "dp", "ep", "sp")
+
+    @property
+    def param_zero_axes(self):
+        """Axes for the stage-3 *working* (bf16) parameter shards. Under hpZ
+        these are the reference's secondary partitions
+        (``zero_hpz_partition_size``): sharded only within the ICI-local
+        group so backward all-gathers never cross DCN."""
+        if self.zero_hierarchy in ("hpz", "mics"):
+            return ("dp", "ep", "sp")
+        return self.zero_axes
 
     @property
     def data_parallel_size(self):
-        return self.dp_size * self.ep_size * self.sp_size
+        return self.dpr_size * self.dp_size * self.ep_size * self.sp_size
 
     @property
     def batch_spec(self):
         """PartitionSpec for a [batch, seq, ...] input."""
         from jax.sharding import PartitionSpec as P
-        return P(("dp", "ep"), "sp")
+        return P(("dpr", "dp", "ep"), "sp")
 
     def batch_sharding(self):
         from jax.sharding import NamedSharding
@@ -104,16 +148,27 @@ class MeshTopology:
         return {a: coords[a] for a in AXIS_ORDER}
 
     def __repr__(self):
+        shown = [a for a in AXIS_ORDER if a != "dpr" or self.dpr_size > 1]
         return ("MeshTopology(" +
-                ", ".join(f"{a}={self._sizes[a]}" for a in AXIS_ORDER) + ")")
+                ", ".join(f"{a}={self._sizes[a]}" for a in shown) + ")")
 
 
 def build_topology(config=None, devices=None):
     """Build a MeshTopology from a DeepSpeedConfig-like object (or defaults)."""
     pp = ep = sp = tp = 1
+    zero_shard_size = zero_hierarchy = None
     if config is not None:
         pp = getattr(config, "pipeline_stages", 1) or 1
         ep = getattr(config, "expert_parallel_size", 1) or 1
         sp = getattr(config, "sequence_parallel_size", 1) or 1
         tp = getattr(config, "tensor_parallel_size", 1) or 1
-    return MeshTopology(pp=pp, dp=-1, ep=ep, sp=sp, tp=tp, devices=devices)
+        zc = getattr(config, "zero_config", None)
+        if zc is not None:
+            if getattr(zc, "mics_shard_size", -1) and zc.mics_shard_size > 0:
+                zero_shard_size, zero_hierarchy = zc.mics_shard_size, "mics"
+            elif getattr(zc, "zero_hpz_partition_size", 1) and \
+                    zc.zero_hpz_partition_size > 1:
+                zero_shard_size, zero_hierarchy = zc.zero_hpz_partition_size, "hpz"
+    return MeshTopology(pp=pp, dp=-1, ep=ep, sp=sp, tp=tp, devices=devices,
+                        zero_shard_size=zero_shard_size,
+                        zero_hierarchy=zero_hierarchy)

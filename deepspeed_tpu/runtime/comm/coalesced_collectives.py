@@ -1,0 +1,99 @@
+"""Coalesced + quantized collectives — ZeRO++ comm kernels.
+
+Reference ``runtime/comm/coalesced_collectives.py``:
+- ``reduce_scatter_coalesced`` (:31): one fused reduce-scatter over many
+  tensors.
+- ``all_to_all_quant_reduce`` (:81, qgZ): gradients are int4-quantized,
+  exchanged all-to-all *within* the node, reduced locally, int8-quantized and
+  exchanged across nodes, reduced again — 4x less cross-node traffic.
+
+TPU mapping: these run inside ``shard_map`` over mesh axes. The hierarchy is
+``dp`` (intra-slice ICI, the reference's intra-node NVLink) and ``dpr``
+(cross-slice DCN, the reference's inter-node IB) — see
+``parallel/topology.py``. qwZ (``zero_quantized_weights``) is
+``quantized_all_gather``: the wire format is int8 + per-group scales.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.ops.quantizer import dequantize, quantize
+
+
+def reduce_scatter_coalesced(tensors, axis_name="dp"):
+    """Fused reduce-scatter of a list of tensors over ``axis_name``
+    (reference :31). Each tensor is flattened; every rank gets back its
+    1/world shard of each (padded to divide evenly)."""
+    world = lax.axis_size(axis_name)
+    out = []
+    for t in tensors:
+        flat = t.reshape(-1)
+        pad = (-flat.shape[0]) % world
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        out.append(lax.psum_scatter(flat.reshape(world, -1), axis_name,
+                                    scatter_dimension=0, tiled=False))
+    return out
+
+
+def quantized_all_gather(x, axis_name="dp", num_bits=8, group_size=2048,
+                         dtype=jnp.float32):
+    """qwZ: all-gather with an int8 wire format (reference qwZ quantized
+    all-gather: ``partition_parameters.py:728`` CUDAQuantizer +
+    ``csrc/quantization/swizzled_quantize.cu``). Gathers ``x`` (this rank's
+    shard) from every rank along ``axis_name``; only int8 values + fp32
+    group scales cross the wire."""
+    q, scale = quantize(x, num_bits=num_bits, group_size=group_size)
+    qg = lax.all_gather(q, axis_name)        # [world, groups, packed]
+    sg = lax.all_gather(scale, axis_name)    # [world, groups]
+    deq = jax.vmap(lambda qi, si: dequantize(qi, si, x.shape,
+                                             num_bits=num_bits,
+                                             group_size=group_size,
+                                             dtype=dtype))
+    parts = deq(qg, sg)                      # [world, *x.shape]
+    return parts.reshape((parts.shape[0] * x.shape[0],) + x.shape[1:])
+
+
+def all_to_all_quant_reduce(x, intra_axis="dp", inter_axis=None,
+                            intra_bits=4, inter_bits=8, group_size=2048,
+                            dtype=jnp.float32):
+    """qgZ: hierarchical quantized gradient reduction (reference :81).
+
+    ``x`` is this rank's full-size gradient; the result is this rank's
+    1/world flat shard of the *sum* over all ranks (world = intra × inter).
+    Stage 1 int4-quantizes per destination block and all-to-alls within
+    ``intra_axis`` (ICI), then dequant-reduces; stage 2 (when ``inter_axis``
+    is given) repeats with int8 across ``inter_axis`` (DCN). Cross-DCN bytes
+    are inter_bits/32 of an fp32 reduce-scatter."""
+
+    def exchange_reduce(blocks, axis, bits):
+        # blocks: [peers, m] — row j is the payload destined for peer j
+        qfn = jax.vmap(lambda row: quantize(row, num_bits=bits,
+                                            group_size=group_size))
+        q, s = qfn(blocks)
+        # send row j to peer j; receive one row from each peer
+        qx = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+        sx = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
+        m = blocks.shape[1]
+        deq = jax.vmap(lambda qi, si: dequantize(qi, si, (m,), num_bits=bits,
+                                                 group_size=group_size))
+        return deq(qx, sx).sum(axis=0)  # [m]
+
+    intra = lax.axis_size(intra_axis)
+    inter = lax.axis_size(inter_axis) if inter_axis else 1
+    world = intra * inter
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.shape[0]) % world
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = flat.shape[0] // world
+
+    # stage 1 (ICI): each intra-peer block carries all its inter-shards
+    partial = exchange_reduce(flat.reshape(intra, inter * shard),
+                              intra_axis, intra_bits)
+    if inter == 1:
+        return partial.astype(dtype)
+    # stage 2 (DCN): exchange the partial sums' inter-blocks
+    return exchange_reduce(partial.reshape(inter, shard),
+                           inter_axis, inter_bits).astype(dtype)

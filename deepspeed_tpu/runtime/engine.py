@@ -208,6 +208,7 @@ class DeepSpeedEngine:
         self._apply_step_fn = None
         self._eval_step_fn = None
         self._offload = None  # ZeRO-Offload host tier (zero/offload.py)
+        self.quantized_weights = False  # ZeRO++ qwZ (set in _init_state)
         if model_parameters is not None:
             self._init_state(model_parameters)
 
@@ -268,7 +269,21 @@ class DeepSpeedEngine:
                                            param_specs=self._resolve_param_specs(params_f32))
         self.partitioner.describe(params_f32)
         if self._offload_device() in ("cpu", "nvme"):
+            if self.config.zero_config.zero_quantized_weights:
+                raise ValueError("zero_quantized_weights cannot be combined with "
+                                 "offload_optimizer")
             return self._init_state_offload(params_f32)
+
+        # ZeRO++ qwZ (reference zero_quantized_weights, zero/config.py:40):
+        # the stage-3 working copy is stored as int8 + per-group scales, so
+        # XLA's per-use all-gathers move int8 over the wire and HBM holds
+        # half the bytes. Dequantization happens in-trace at use sites.
+        self.quantized_weights = bool(
+            self.config.zero_config.zero_quantized_weights
+            and self.zero_optimization_stage() >= 3)
+        if self.quantized_weights and not self.mixed_precision:
+            raise ValueError("zero_quantized_weights requires fp16/bf16 training "
+                             "(the fp32 master holds full precision)")
 
         working = tree_cast(params_f32, self.working_dtype)
         param_sh = self.partitioner.param_sharding(working)
@@ -276,6 +291,11 @@ class DeepSpeedEngine:
         grad_sh = self.partitioner.grad_sharding(params_f32)
 
         working = jax.tree.map(jax.device_put, working, param_sh)
+        if self.quantized_weights:
+            param_sh = self._qweight_sharding(param_sh, working)
+            working = jax.jit(self._quantize_working)(working)
+            working = jax.tree.map(jax.device_put, working, param_sh,
+                                   is_leaf=self._is_qleaf)
         if self.mixed_precision:
             master = jax.tree.map(jax.device_put, params_f32, master_sh)
         else:
@@ -403,6 +423,52 @@ class DeepSpeedEngine:
         self._init_state(variables["params"])
 
     # ------------------------------------------------------------------
+    # qwZ working-weight quantization (ZeRO++; ops/quantizer.py)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _is_qleaf(x):
+        return isinstance(x, dict) and "q" in x and "scale" in x
+
+    def _should_quantize(self, leaf):
+        return (hasattr(leaf, "ndim") and leaf.ndim >= 2
+                and jnp.issubdtype(leaf.dtype, jnp.floating)
+                and leaf.size >= self.config.zero_config.stage3_param_persistence_threshold)
+
+    def _quantize_working(self, working):
+        from deepspeed_tpu.ops.quantizer import quantize_lastdim
+
+        def q(leaf):
+            if self._should_quantize(leaf):
+                qv, s = quantize_lastdim(leaf)
+                return {"q": qv, "scale": s}
+            return leaf
+
+        return jax.tree.map(q, working)
+
+    def _dequantize_working(self, params):
+        from deepspeed_tpu.ops.quantizer import dequantize_lastdim
+        wd = self.working_dtype
+
+        def dq(leaf):
+            if self._is_qleaf(leaf):
+                return dequantize_lastdim(leaf["q"], leaf["scale"], dtype=wd)
+            return leaf
+
+        return jax.tree.map(dq, params, is_leaf=self._is_qleaf)
+
+    def _qweight_sharding(self, param_sh, working):
+        """Sharding tree matching the quantized structure: q inherits the
+        leaf's sharding (same shape/layout), scales are replicated (tiny)."""
+        rep = self.topology.replicated()
+
+        def sh(leaf, s):
+            if self._should_quantize(leaf):
+                return {"q": s, "scale": rep}
+            return s
+
+        return jax.tree.map(sh, working, param_sh)
+
+    # ------------------------------------------------------------------
     # compiled step functions
     # ------------------------------------------------------------------
     def _build_micro_step(self):
@@ -416,6 +482,9 @@ class DeepSpeedEngine:
         # PipelineEngine pre-multiplies: its one fused call already averages over
         # the GAS microbatches, so the apply-step's /gas must cancel
         mult = float(getattr(self, "_grad_scale_multiplier", 1.0))
+
+        dq = self._dequantize_working if getattr(self, "quantized_weights", False) \
+            else (lambda p: p)
 
         def micro_step(state: TrainState, batch):
             rng, sub = jax.random.split(state.rng)
@@ -433,7 +502,10 @@ class DeepSpeedEngine:
                     scaled = scaled / predivide
                 return scaled, loss
 
-            (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+            # qwZ: grads are taken w.r.t. the dequantized working weights
+            # (XLA gathers the int8 shards, dequantizes at the use site)
+            (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                dq(state.params))
             grads = tree_cast(grads, accum_dtype)
             acc = jax.tree.map(lambda a, g: a + g, state.grad_acc, grads)
             acc = constrain_tree(acc, grad_sh)
@@ -454,6 +526,8 @@ class DeepSpeedEngine:
         dynamic = self.dynamic_loss_scale
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
+        quantized = getattr(self, "quantized_weights", False)
+        quantize_fn = self._quantize_working
 
         def apply_step(state: TrainState, lr):
             denom = jnp.float32(gas)
@@ -479,7 +553,14 @@ class DeepSpeedEngine:
             new_target = constrain_tree(new_target, master_sh)
 
             if mixed:
-                new_params = constrain_tree(tree_cast(new_target, working_dtype), param_sh)
+                new_working = tree_cast(new_target, working_dtype)
+                if quantized:
+                    new_working = quantize_fn(new_working)
+                    new_params = jax.tree.map(
+                        lambda l, s: jax.lax.with_sharding_constraint(l, s),
+                        new_working, param_sh, is_leaf=DeepSpeedEngine._is_qleaf)
+                else:
+                    new_params = constrain_tree(new_working, param_sh)
                 new_master = new_target
             else:
                 new_params = new_target
@@ -500,9 +581,11 @@ class DeepSpeedEngine:
 
     def _build_eval_step(self):
         model_fn = self._model_fn
+        dq = self._dequantize_working if getattr(self, "quantized_weights", False) \
+            else (lambda p: p)
 
         def eval_step(state: TrainState, batch):
-            out = model_fn(state.params, batch, None, False)
+            out = model_fn(dq(state.params), batch, None, False)
             return out
 
         return jax.jit(eval_step)
@@ -764,7 +847,7 @@ class DeepSpeedEngine:
         rep = self.topology.replicated()
         if self._offload is not None:
             # merge device-resident masters with the host tier
-            flat_p, pdef = jax.tree_util.tree_flatten(self.state.params)
+            pdef = jax.tree_util.tree_structure(self.state.params)
             out = []
             for i, k in enumerate(self._flat_keys):
                 if k in self.state.master:

@@ -1,0 +1,44 @@
+"""MiCS / hpZ — hierarchical ZeRO partitioning (reference ``runtime/zero/mics.py``,
+``zero_hpz_partition_size`` in ``zero/config.py:39``).
+
+The reference builds nested process groups (shard group within a node, replica
+groups across nodes) and hand-writes hierarchical all-gathers
+(``mics_utils.py``). On TPU the same capability is a *mesh factorization*
+(``parallel/topology.py``): the data-parallel world splits into ``dpr``
+(replica groups, DCN) × ``dp`` (shard group, ICI), and the partitioner
+(``zero/partition.py``) picks which state shards over which factor:
+
+- **MiCS** (``mics_shard_size``): master/optimizer/grads shard over ``dp``
+  only, replicated across ``dpr``. XLA emits reduce-scatter inside the shard
+  group plus a cross-group all-reduce — exactly MiCS's hierarchical pattern,
+  but scheduled by the compiler.
+- **hpZ** (``zero_hpz_partition_size``): optimizer state shards over the full
+  world, while the stage-3 *working* (bf16) params — the reference's
+  "secondary tensor" (``partition_parameters.py`` ``ds_secondary_tensor``) —
+  shard only over ``dp``, so every backward all-gather rides ICI.
+
+Config usage (identical keys to the reference)::
+
+    {"zero_optimization": {"stage": 3, "zero_hpz_partition_size": 8}}
+    {"zero_optimization": {"stage": 3, "mics_shard_size": 8}}
+
+There is no ``MiCS_Init``/``MiCS_Optimizer`` class to thread through user
+code: ``deepspeed_tpu.initialize`` reads the config keys and builds the
+hierarchical mesh (``parallel/topology.py build_topology``).
+"""
+
+from deepspeed_tpu.parallel.topology import MeshTopology
+
+
+def mics_topology(shard_size, devices=None, **axes):
+    """Convenience constructor for a MiCS mesh (shard groups of
+    ``shard_size``, replicated across the rest of the DP world)."""
+    return MeshTopology(devices=devices, zero_shard_size=shard_size,
+                        zero_hierarchy="mics", **axes)
+
+
+def hpz_topology(partition_size, devices=None, **axes):
+    """Convenience constructor for a ZeRO++ hpZ mesh (secondary parameter
+    partition of ``partition_size``)."""
+    return MeshTopology(devices=devices, zero_shard_size=partition_size,
+                        zero_hierarchy="hpz", **axes)

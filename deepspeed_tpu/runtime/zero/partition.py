@@ -71,8 +71,12 @@ class ZeroPartitioner:
         self.config = zero_config
         self.stage = zero_config.stage
         self.mesh = topology.mesh
-        # only keep zero axes that actually have extent > 1
+        # only keep zero axes that actually have extent > 1. Master/opt/grads
+        # shard over the full ZeRO world; working params may use the smaller
+        # hierarchical group (hpZ secondary partition / MiCS shard group).
         self.zero_axes = tuple(a for a in topology.zero_axes if topology.get_dim(a) > 1)
+        self.param_axes = tuple(a for a in topology.param_zero_axes
+                                if topology.get_dim(a) > 1)
         self.zero_world = int(np.prod([topology.get_dim(a) for a in self.zero_axes])) if self.zero_axes else 1
         self.param_specs = param_specs  # pytree of P or None (model/tp specs)
         self.threshold = zero_config.stage3_param_persistence_threshold
@@ -82,13 +86,14 @@ class ZeroPartitioner:
             return jax.tree.map(lambda _: None, params)
         return self.param_specs
 
-    def _zero_tree(self, params, threshold):
+    def _zero_tree(self, params, threshold, axes=None):
         base = self._base_specs(params)
-        if self.zero_world <= 1:
+        axes = self.zero_axes if axes is None else axes
+        if not axes:
             return base
-        sizes = {a: self.topology.get_dim(a) for a in self.zero_axes}
+        sizes = {a: self.topology.get_dim(a) for a in axes}
         return jax.tree.map(
-            lambda leaf, spec: _leaf_spec_with_zero(leaf, spec, self.zero_axes,
+            lambda leaf, spec: _leaf_spec_with_zero(leaf, spec, axes,
                                                     sizes, threshold),
             params, base, is_leaf=lambda x: x is None)
 
@@ -99,9 +104,11 @@ class ZeroPartitioner:
 
     # --- public per-component sharding trees ---
     def param_sharding(self, params):
-        """Working-precision params: sharded only at stage 3 (plus model specs)."""
+        """Working-precision params: sharded only at stage 3 (plus model specs).
+        Under hpZ/MiCS hierarchy the shard axes are the ICI-local group only
+        (reference secondary tensors, ``partition_parameters.py`` hpZ)."""
         if self.stage >= 3:
-            spec = self._zero_tree(params, self.threshold)
+            spec = self._zero_tree(params, self.threshold, axes=self.param_axes)
         else:
             spec = self._base_specs(params)
         return self._to_sharding(spec)

@@ -10,7 +10,7 @@ def test_default_all_dp(eight_devices):
     t = MeshTopology()
     assert t.dp_size == 8
     assert t.world_size() == 8
-    assert t.mesh.shape == {"pp": 1, "dp": 8, "ep": 1, "sp": 1, "tp": 1}
+    assert t.mesh.shape == {"pp": 1, "dpr": 1, "dp": 8, "ep": 1, "sp": 1, "tp": 1}
 
 
 def test_mixed_axes(eight_devices):
@@ -43,5 +43,5 @@ def test_groups_registry(eight_devices):
 def test_batch_spec(eight_devices):
     t = MeshTopology(dp=4, sp=2)
     spec = t.batch_spec
-    assert spec == __import__("jax").sharding.PartitionSpec(("dp", "ep"), "sp")
+    assert spec == __import__("jax").sharding.PartitionSpec(("dpr", "dp", "ep"), "sp")
     assert t.data_parallel_size == 8
