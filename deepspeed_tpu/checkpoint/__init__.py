@@ -1,0 +1,8 @@
+"""Universal checkpointing (reference ``deepspeed/checkpoint/``)."""
+
+from deepspeed_tpu.checkpoint.universal import (
+    ds_to_universal, get_fp32_state_dict_from_zero_checkpoint,
+    load_universal_checkpoint, save_universal_checkpoint)
+
+__all__ = ["ds_to_universal", "get_fp32_state_dict_from_zero_checkpoint",
+           "load_universal_checkpoint", "save_universal_checkpoint"]

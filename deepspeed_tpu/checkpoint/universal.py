@@ -1,0 +1,204 @@
+"""Universal checkpointing — topology-independent fp32 fragments.
+
+Reference ``checkpoint/ds_to_universal.py`` (extract ``extract_zero_shards``
+:88, merge ``merge_tp_slices`` :171) + loader ``universal_checkpoint.py`` +
+offline ``utils/zero_to_fp32.py``: ZeRO shards are merged into per-parameter
+fp32 fragment files (fp32 weight, exp_avg, exp_avg_sq) keyed by parameter
+name, loadable at ANY (TP, PP, DP) topology.
+
+On TPU the engine state is a tree of GSPMD global arrays, so "merge shards"
+is a device_get and "reshard at load" is a device_put under the new mesh —
+the heavy lifting the reference does by file surgery falls out of the array
+model. The universal format here is one npz of name-keyed fragments
+(``<param>::fp32`` / ``::exp_avg`` / ``::exp_avg_sq``) + a JSON manifest
+(step counters, LR scheduler state), produced from a live engine
+(``save_universal_checkpoint``) or offline from a saved checkpoint directory
+(``ds_to_universal``), and loaded into any engine whose parameter tree has
+the same *names* — regardless of mesh shape, ZeRO stage, offload mode or
+qwZ quantization.
+"""
+
+import json
+import os
+
+import numpy as np
+
+import jax
+
+from deepspeed_tpu.utils.tensor_fragment import (moment_leaves, opt_param_paths,
+                                                 param_paths_by_key)
+
+UNIVERSAL_ARRAYS = "universal_fragments.npz"
+UNIVERSAL_META = "universal_meta.json"
+
+
+def _keyed(tree):
+    return {jax.tree_util.keystr(p): l
+            for p, l in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+def save_universal_checkpoint(engine, out_dir, tag=None):
+    """Write universal fragments from a live engine (the online equivalent of
+    reference ``ds_to_universal.py`` main)."""
+    os.makedirs(out_dir, exist_ok=True)
+    blobs = {}
+    masters = engine.get_model_parameters(dtype=np.float32)  # gathers all tiers
+    keyed = _keyed(masters)
+    for k, v in keyed.items():
+        blobs[f"{k}::fp32"] = np.asarray(v, dtype=np.float32)
+
+    if engine._offload is not None:
+        swap_states = (engine._offload.swapper.state_arrays()
+                       if engine._offload.swapper is not None else None)
+        for k in engine._offload.masters:
+            shape = engine._offload.shapes[k]
+            if swap_states is not None:
+                m, v = swap_states[k]
+            else:
+                m, v = engine._offload.adam.state_for(
+                    k, engine._offload.masters[k].size)
+            blobs[f"{k}::exp_avg"] = np.asarray(m, np.float32).reshape(shape)
+            blobs[f"{k}::exp_avg_sq"] = np.asarray(v, np.float32).reshape(shape)
+    # device-resident moments (the whole tree, or the offload remainder)
+    for fk, (_, leaf) in moment_leaves(engine.state.opt_state,
+                                       opt_param_paths(engine)).items():
+        blobs[fk] = np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+    np.savez(os.path.join(out_dir, UNIVERSAL_ARRAYS), **blobs)
+    meta = {
+        "counters": {
+            "global_steps": engine.global_steps,
+            "global_samples": engine.global_samples,
+            "micro_steps": engine.micro_steps,
+        },
+        "lr_scheduler": engine.lr_scheduler.state_dict(),
+        "param_keys": sorted(keyed),
+        "format": "deepspeed_tpu_universal_v1",
+    }
+    with open(os.path.join(out_dir, UNIVERSAL_META), "w") as f:
+        json.dump(meta, f)
+    return out_dir
+
+
+def ds_to_universal(ckpt_dir, out_dir, engine):
+    """Offline conversion of an engine checkpoint directory (reference
+    ``ds_to_universal.py``): load it into ``engine`` (any topology), then
+    re-emit universal fragments."""
+    engine.load_checkpoint(os.path.dirname(ckpt_dir), tag=os.path.basename(ckpt_dir))
+    return save_universal_checkpoint(engine, out_dir)
+
+
+def _set_all_masters(engine, new_by_key):
+    """Replace every master value named in ``new_by_key`` in ONE pass over
+    each tier (linear, unlike per-param safe_set); returns the count set."""
+    import jax.numpy as jnp  # noqa: F401 (used in both branches)
+    loaded = [0]
+
+    def rep(path, leaf):
+        k = jax.tree_util.keystr(path)
+        if k in new_by_key:
+            loaded[0] += 1
+            val = np.asarray(new_by_key[k], dtype=np.float32)
+            return jax.device_put(jnp.asarray(val, dtype=leaf.dtype),
+                                  leaf.sharding) if hasattr(leaf, "sharding") \
+                else val
+        return leaf
+
+    if engine._offload is not None:
+        for k, buf in engine._offload.masters.items():
+            if k in new_by_key:
+                buf[:] = np.asarray(new_by_key[k], np.float32).reshape(-1)
+                loaded[0] += 1
+        # device remainder: the master dict's keys ARE the canonical names
+        new_master = {}
+        for k, leaf in engine.state.master.items():
+            if k in new_by_key:
+                loaded[0] += 1
+                new_master[k] = jax.device_put(
+                    jnp.asarray(np.asarray(new_by_key[k], np.float32),
+                                dtype=leaf.dtype), leaf.sharding)
+            else:
+                new_master[k] = leaf
+        engine.state = engine.state._replace(master=new_master)
+    elif engine.state.master is not None:
+        engine.state = engine.state._replace(
+            master=jax.tree_util.tree_map_with_path(rep, engine.state.master))
+    else:
+        engine.state = engine.state._replace(
+            params=jax.tree_util.tree_map_with_path(rep, engine.state.params))
+    return loaded[0]
+
+
+def load_universal_checkpoint(engine, universal_dir, load_optimizer_states=True):
+    """Load universal fragments into ``engine`` at its CURRENT topology
+    (reference ``universal_checkpoint.py:117`` load_hp_checkpoint_state):
+    fragments are matched by parameter name; device_put under the engine's
+    mesh reshards them."""
+    data = np.load(os.path.join(universal_dir, UNIVERSAL_ARRAYS))
+    with open(os.path.join(universal_dir, UNIVERSAL_META)) as f:
+        meta = json.load(f)
+    frags = {k: data[k] for k in data.files}
+
+    weights = {k: frags[f"{k}::fp32"] for k in meta["param_keys"]
+               if f"{k}::fp32" in frags}
+    missing = [k for k in meta["param_keys"] if k not in weights]
+    if missing:
+        raise ValueError(f"universal checkpoint missing fp32 fragments for {missing}")
+    loaded = _set_all_masters(engine, weights)
+    if loaded != len(weights):
+        raise ValueError(
+            f"only {loaded}/{len(weights)} parameters matched this engine's tree — "
+            f"model structure differs from the checkpoint")
+    # refresh the working copy from the new masters (the engine normally does
+    # this inside the apply-step)
+    engine._refresh_working_from_master()
+
+    # counters BEFORE moments: the host Adam's step count derives from them
+    c = meta.get("counters", {})
+    engine.global_steps = int(c.get("global_steps", 0))
+    engine.global_samples = int(c.get("global_samples", 0))
+    engine.micro_steps = int(c.get("micro_steps", 0))
+    if load_optimizer_states:
+        _load_moments(engine, frags)
+    if "lr_scheduler" in meta:
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    return loaded
+
+
+def _load_moments(engine, frags):
+    import jax.numpy as jnp
+    if engine._offload is not None:
+        swap_updates = {}
+        for k in engine._offload.masters:
+            if f"{k}::exp_avg" not in frags or f"{k}::exp_avg_sq" not in frags:
+                continue
+            m = frags[f"{k}::exp_avg"].reshape(-1)
+            v = frags[f"{k}::exp_avg_sq"].reshape(-1)
+            if engine._offload.swapper is not None:
+                swap_updates[k] = (m, v)  # NVMe owns the moments; keep DRAM clean
+            else:
+                engine._offload.adam.set_state(k, m, v)
+        if swap_updates:
+            engine._offload.swapper.load_state_arrays(swap_updates)
+        engine._offload.adam.step_count = engine.global_steps
+
+    # device-resident optax moments (covers both normal and offload-remainder)
+    matches = moment_leaves(engine.state.opt_state, opt_param_paths(engine))
+    by_path = {}
+    for fk, (path, leaf) in matches.items():
+        if fk in frags:
+            by_path[path] = jax.device_put(
+                jnp.asarray(frags[fk], leaf.dtype), leaf.sharding)
+
+    def rep(path, leaf):
+        return by_path.get(tuple(path), leaf)
+
+    engine.state = engine.state._replace(
+        opt_state=jax.tree_util.tree_map_with_path(rep, engine.state.opt_state))
+
+
+def get_fp32_state_dict_from_zero_checkpoint(universal_dir):
+    """Offline fp32 weights extraction (reference ``utils/zero_to_fp32.py:604``)
+    from a universal directory: returns {param_name: np.ndarray}."""
+    data = np.load(os.path.join(universal_dir, UNIVERSAL_ARRAYS))
+    return {k[:-len("::fp32")]: data[k] for k in data.files if k.endswith("::fp32")}

@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+__all__ = ["checkpointing"]

@@ -860,6 +860,32 @@ class DeepSpeedEngine:
         src = self.state.master if self.state.master is not None else self.state.params
         return jax.tree.map(lambda x: np.asarray(jax.device_put(x, rep), dtype=dtype), src)
 
+    def _refresh_working_from_master(self):
+        """Recompute the working-precision params from the fp32 masters (all
+        tiers) — used after external master edits (tensor-fragment sets,
+        universal checkpoint load)."""
+        if self._offload is not None:
+            flat_p, pdef = jax.tree_util.tree_flatten(self.state.params)
+            for i, k in enumerate(self._flat_keys):
+                if k in self.state.master:
+                    leaf = self.state.master[k].astype(self.working_dtype)
+                else:
+                    leaf = jnp.asarray(
+                        self._offload.masters[k].reshape(self._offload.shapes[k]),
+                        dtype=self.working_dtype)
+                flat_p[i] = jax.device_put(leaf, self._flat_param_sh[i])
+            self.state = self.state._replace(
+                params=jax.tree_util.tree_unflatten(pdef, flat_p))
+        elif self.state.master is not None:
+            working = tree_cast(self.state.master, self.working_dtype)
+            if self.quantized_weights:
+                working = jax.jit(self._quantize_working)(working)
+            working = jax.tree.map(jax.device_put, working,
+                                   self._shardings["params"],
+                                   is_leaf=self._is_qleaf)
+            self.state = self.state._replace(params=working)
+        # pure-fp32: params ARE the masters; nothing to refresh
+
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:3056 save / :2712 load)
     # ------------------------------------------------------------------
@@ -926,6 +952,16 @@ class DeepSpeedEngine:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         log_dist(f"loaded checkpoint {path} (step {self.global_steps})", ranks=[0])
         return path, meta.get("client_state", {})
+
+    def save_universal_checkpoint(self, out_dir, tag=None):
+        """Universal (topology-independent) checkpoint (checkpoint/universal.py)."""
+        from deepspeed_tpu.checkpoint import save_universal_checkpoint
+        return save_universal_checkpoint(self, out_dir, tag=tag)
+
+    def load_universal_checkpoint(self, universal_dir, load_optimizer_states=True):
+        from deepspeed_tpu.checkpoint import load_universal_checkpoint
+        return load_universal_checkpoint(self, universal_dir,
+                                         load_optimizer_states=load_optimizer_states)
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
         """reference engine ``save_16bit_model`` — gathered half-precision dump."""

@@ -55,7 +55,9 @@ class HostOffloadOptimizer:
             eps=opt_params.get("eps", 1e-8),
             weight_decay=opt_params.get("weight_decay", 0.0),
             adamw_mode=opt_params.get("adam_w_mode", True))
-        self.masters = {k: np.ascontiguousarray(v, dtype=np.float32).reshape(-1)
+        # copy=True: device_get can hand back read-only views, and the host
+        # tier updates masters in place
+        self.masters = {k: np.array(v, dtype=np.float32, copy=True).reshape(-1)
                         for k, v in params_f32_leaves.items()}
         self.shapes = {k: np.asarray(v).shape for k, v in params_f32_leaves.items()}
         self._out_u16 = {k: np.empty(v.size, dtype=np.uint16)

@@ -1,0 +1,151 @@
+"""Tensor fragment API — safe access to fp32 master weights, optimizer state
+and gradients across ZeRO stages.
+
+Reference ``utils/tensor_fragment.py:123`` (``safe_get_full_fp32_param``,
+``safe_set_full_fp32_param``, ``safe_get_full_optimizer_state``,
+``safe_get_full_grad``): under ZeRO the "real" fp32 value of a parameter is
+scattered over the DP world, so user/debugging code needs gather/scatter
+helpers. Here state lives in the engine's TrainState as GSPMD global arrays,
+so "gather" is a device_get (XLA all-gathers) and "scatter" a device_put with
+the original sharding; the host-offload tier is handled transparently.
+
+Parameters are addressed by tree path string (``jax.tree_util.keystr``) — the
+functional analog of the reference's param object, e.g.
+``"['Dense_0']['kernel']"``.
+"""
+
+import numpy as np
+
+import jax
+
+
+def _find_leaf(tree, key):
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if jax.tree_util.keystr(path) == key:
+            return leaf
+    return None
+
+
+def moment_leaves(opt_state, param_path_by_key):
+    """Map optimizer-moment leaves to their parameters by *path components*.
+
+    ``param_path_by_key``: {keystr: path-tuple} of the tree the optimizer was
+    built over. A moment leaf matches parameter P iff its path ends with P's
+    exact component sequence AND the component just before it is the optax
+    field ``mu``/``nu`` — this disambiguates params whose paths are suffixes
+    of other params' and is robust to dict-keyed (offload) master trees,
+    unlike string suffix matching on keystr. Returns
+    {"<key>::exp_avg"/"::exp_avg_sq": (path-tuple, leaf)}."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
+        path = tuple(path)
+        for pk, ppath in param_path_by_key.items():
+            ppath = tuple(ppath)
+            L = len(ppath)
+            if len(path) > L and path[-L:] == ppath:
+                field = getattr(path[-L - 1], "name", None)
+                if field == "mu":
+                    out[f"{pk}::exp_avg"] = (path, leaf)
+                elif field == "nu":
+                    out[f"{pk}::exp_avg_sq"] = (path, leaf)
+    return out
+
+
+def param_paths_by_key(tree):
+    return {jax.tree_util.keystr(p): tuple(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+def opt_param_paths(engine):
+    """{canonical param key: path tuple inside the optimizer target tree}.
+    In offload mode the optimizer target is the dict {key: leaf} of the
+    device remainder, so the path is a single DictKey whose value IS the
+    canonical key (keystr-of-path would double-quote it)."""
+    if engine._offload is not None:
+        from jax.tree_util import DictKey
+        return {k: (DictKey(k),) for k in engine.state.master}
+    tree = engine.state.master if engine.state.master is not None \
+        else engine.state.params
+    return param_paths_by_key(tree)
+
+
+def _replace_leaf(tree, key, value):
+    def rep(path, leaf):
+        if jax.tree_util.keystr(path) == key:
+            return jax.device_put(value.astype(leaf.dtype), leaf.sharding) \
+                if hasattr(leaf, "sharding") else value
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(rep, tree)
+
+
+def param_names(engine):
+    """All addressable parameter paths."""
+    if engine._offload is not None:
+        return list(engine._flat_keys)
+    tree = engine.state.master if engine.state.master is not None else engine.state.params
+    return [jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]]
+
+
+def safe_get_full_fp32_param(engine, key):
+    """Gathered fp32 master value of a parameter (reference :123)."""
+    if engine._offload is not None:
+        if key in engine._offload.masters:
+            return engine._offload.masters[key].reshape(engine._offload.shapes[key]).copy()
+        leaf = engine.state.master.get(key)
+        return None if leaf is None else np.asarray(jax.device_get(leaf))
+    tree = engine.state.master if engine.state.master is not None else engine.state.params
+    leaf = _find_leaf(tree, key)
+    return None if leaf is None else np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, key, value):
+    """Scatter a new fp32 master value (reference safe_set_full_fp32_param).
+    The working copy is NOT updated until the next optimizer step, matching
+    the reference's master/working split."""
+    value = np.asarray(value, dtype=np.float32)
+    if engine._offload is not None:
+        if key in engine._offload.masters:
+            engine._offload.masters[key][:] = value.reshape(-1)
+            return True
+        if key in engine.state.master:
+            engine.state = engine.state._replace(
+                master=_replace_leaf(engine.state.master, key, value))
+            return True
+        return False
+    if engine.state.master is not None:
+        engine.state = engine.state._replace(
+            master=_replace_leaf(engine.state.master, key, value))
+    else:
+        engine.state = engine.state._replace(
+            params=_replace_leaf(engine.state.params, key, value))
+    return True
+
+
+def safe_get_full_optimizer_state(engine, key, state_name):
+    """Gathered optimizer-state fragment, ``state_name`` in
+    {"exp_avg", "exp_avg_sq"} (reference safe_get_full_optimizer_state)."""
+    field = {"exp_avg": "mu", "exp_avg_sq": "nu"}.get(state_name, state_name)
+    if engine._offload is not None and key in engine._offload.masters:
+        n = engine._offload.masters[key].size
+        if engine._offload.swapper is not None:
+            m, v = engine._offload.swapper.fetch(key)
+            out = (m if field == "mu" else v).reshape(engine._offload.shapes[key]).copy()
+            engine._offload.swapper.commit(key)
+            engine._offload.swapper.finish_step()
+            return out
+        m, v = engine._offload.adam.state_for(key, n)
+        return (m if field == "mu" else v).reshape(engine._offload.shapes[key]).copy()
+    frag_name = {"mu": "exp_avg", "nu": "exp_avg_sq"}[field]
+    frags = moment_leaves(engine.state.opt_state, opt_param_paths(engine))
+    hit = frags.get(f"{key}::{frag_name}")
+    return None if hit is None else np.asarray(jax.device_get(hit[1]),
+                                               dtype=np.float32)
+
+
+def safe_get_full_grad(engine, key):
+    """Gathered accumulated gradient (reference safe_get_full_grad). Nonzero
+    between backward and the accumulation-boundary step."""
+    leaf = _find_leaf(engine.state.grad_acc, key)
+    return None if leaf is None else np.asarray(jax.device_get(leaf), dtype=np.float32)
