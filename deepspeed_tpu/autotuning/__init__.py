@@ -1,0 +1,3 @@
+from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune
+
+__all__ = ["Autotuner", "autotune"]

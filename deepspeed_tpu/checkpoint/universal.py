@@ -37,9 +37,24 @@ def _keyed(tree):
             for p, l in jax.tree_util.tree_flatten_with_path(tree)[0]}
 
 
+def _opt_step_count(opt_state):
+    """The optax Adam step counter (max over ``count`` leaves; 0 if none)."""
+    best = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
+        if any(getattr(p, "name", None) == "count" for p in path):
+            try:
+                best = max(best, int(np.asarray(jax.device_get(leaf))))
+            except (TypeError, ValueError):
+                pass
+    return best
+
+
 def save_universal_checkpoint(engine, out_dir, tag=None):
     """Write universal fragments from a live engine (the online equivalent of
-    reference ``ds_to_universal.py`` main)."""
+    reference ``ds_to_universal.py`` main). ``tag`` becomes a subdirectory,
+    mirroring ``save_checkpoint``'s dir/tag layout."""
+    if tag is not None:
+        out_dir = os.path.join(out_dir, str(tag))
     os.makedirs(out_dir, exist_ok=True)
     blobs = {}
     masters = engine.get_model_parameters(dtype=np.float32)  # gathers all tiers
@@ -73,6 +88,9 @@ def save_universal_checkpoint(engine, out_dir, tag=None):
         },
         "lr_scheduler": engine.lr_scheduler.state_dict(),
         "param_keys": sorted(keyed),
+        # optax bias-correction step (distinct from global_steps when fp16
+        # overflow skips occurred)
+        "optimizer_step": _opt_step_count(engine.state.opt_state),
         "format": "deepspeed_tpu_universal_v1",
     }
     with open(os.path.join(out_dir, UNIVERSAL_META), "w") as f:
@@ -160,6 +178,9 @@ def load_universal_checkpoint(engine, universal_dir, load_optimizer_states=True)
     engine.micro_steps = int(c.get("micro_steps", 0))
     if load_optimizer_states:
         _load_moments(engine, frags)
+        _restore_opt_step_count(engine,
+                                int(meta.get("optimizer_step",
+                                             engine.global_steps)))
     if "lr_scheduler" in meta:
         engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
     return loaded
@@ -180,7 +201,7 @@ def _load_moments(engine, frags):
                 engine._offload.adam.set_state(k, m, v)
         if swap_updates:
             engine._offload.swapper.load_state_arrays(swap_updates)
-        engine._offload.adam.step_count = engine.global_steps
+        # host adam.step_count is restored by _restore_opt_step_count
 
     # device-resident optax moments (covers both normal and offload-remainder)
     matches = moment_leaves(engine.state.opt_state, opt_param_paths(engine))
@@ -195,6 +216,24 @@ def _load_moments(engine, frags):
 
     engine.state = engine.state._replace(
         opt_state=jax.tree_util.tree_map_with_path(rep, engine.state.opt_state))
+
+
+def _restore_opt_step_count(engine, step):
+    """Set every optax ``count`` leaf to the saved optimizer step so Adam
+    bias correction resumes where it left off (the host tier's
+    ``adam.step_count`` analog for device-resident state)."""
+    import jax.numpy as jnp
+
+    def rep(path, leaf):
+        if any(getattr(p, "name", None) == "count" for p in path):
+            return jax.device_put(jnp.asarray(step, leaf.dtype), leaf.sharding) \
+                if hasattr(leaf, "sharding") else jnp.asarray(step, leaf.dtype)
+        return leaf
+
+    engine.state = engine.state._replace(
+        opt_state=jax.tree_util.tree_map_with_path(rep, engine.state.opt_state))
+    if engine._offload is not None:
+        engine._offload.adam.step_count = step
 
 
 def get_fp32_state_dict_from_zero_checkpoint(universal_dir):

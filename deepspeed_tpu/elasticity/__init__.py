@@ -1,0 +1,5 @@
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 elasticity_enabled,
+                                                 get_compatible_gpus)
+
+__all__ = ["compute_elastic_config", "elasticity_enabled", "get_compatible_gpus"]

@@ -1,0 +1,123 @@
+"""Elastic training — batch-size/chip-count compatibility sets.
+
+Reference ``elasticity/elasticity.py``: pre-computes the set of (total batch,
+micro batch, accelerator count) combinations that keep the global batch fixed,
+so training can resume at any permitted world size without changing
+optimization dynamics (:83 v0.1, :126 v0.2 which adds model-parallel
+awareness); ``compute_elastic_config`` (:233) resolves the final batch triple
+for the current world size, and the engine enforces membership at init.
+
+The chip-count analog of "GPUs" is TPU chips (``jax.device_count`` across
+hosts); elastic re-launch itself is the scheduler's job (GKE/Borg preemption
++ ``jax.distributed`` re-init) — this module owns the batch math and
+enforcement, and universal checkpoints (checkpoint/universal.py) own the
+state resharding on resume.
+"""
+
+from functools import reduce
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ElasticityError(Exception):
+    pass
+
+
+def _candidate_batch_sizes(micro_batches, max_acceptable_batch_size):
+    """All feasible total batch sizes: mbs * gas <= max (reference
+    _get_candidate_batch_sizes)."""
+    candidates = set()
+    for mbs in micro_batches:
+        gas = max_acceptable_batch_size // mbs
+        if gas > 0:
+            candidates.add(mbs * gas)
+    return sorted(candidates)
+
+
+def _compatible_gpus_for_batch(batch, micro_batches, min_gpus, max_gpus):
+    """Accelerator counts that evenly consume ``batch`` with some micro batch
+    (reference _get_compatible_gpus)."""
+    valid = set()
+    for mbs in micro_batches:
+        if batch % mbs:
+            continue
+        total_micro = batch // mbs
+        for g in range(min_gpus, min(max_gpus, total_micro) + 1):
+            if total_micro % g == 0:
+                valid.add(g)
+    return sorted(valid)
+
+
+def get_compatible_gpus(micro_batches, max_acceptable_batch_size,
+                        min_gpus=1, max_gpus=10000, prefer_larger=True,
+                        version=0.2, model_parallel_size=1):
+    """Pick the total batch size maximizing chip-count coverage (reference
+    v0.1 :83 / v0.2 :126; v0.2 scales counts by the model-parallel size).
+
+    Returns (final_batch_size, valid_chip_counts)."""
+    if version >= 0.2 and model_parallel_size > 1:
+        # chips come in model-parallel groups; DP world = chips / mp
+        min_gpus = max(1, min_gpus // model_parallel_size)
+        max_gpus = max_gpus // model_parallel_size
+    best = (0, 0, [])  # (coverage, batch, gpus)
+    for batch in _candidate_batch_sizes(micro_batches, max_acceptable_batch_size):
+        gpus = _compatible_gpus_for_batch(batch, micro_batches, min_gpus, max_gpus)
+        if not gpus:
+            continue
+        coverage = len(gpus)
+        key = (coverage, batch if prefer_larger else -batch)
+        if key > (best[0], best[1] if prefer_larger else -best[1]):
+            best = (coverage, batch, gpus)
+    if not best[2]:
+        raise ElasticityError(
+            f"no compatible batch size for micro_batches={micro_batches}, "
+            f"max={max_acceptable_batch_size}, gpus=[{min_gpus},{max_gpus}]")
+    if version >= 0.2 and model_parallel_size > 1:
+        return best[1], [g * model_parallel_size for g in best[2]]
+    return best[1], best[2]
+
+
+def elasticity_enabled(ds_config):
+    ec = ds_config.get("elasticity", {}) if isinstance(ds_config, dict) \
+        else getattr(ds_config, "elasticity_config", None)
+    if isinstance(ec, dict):
+        return bool(ec.get("enabled", False))
+    return bool(ec and ec.enabled)
+
+
+def compute_elastic_config(ds_config, target_deployment=None, world_size=0,
+                           return_microbatch=False):
+    """Resolve the elastic batch configuration (reference :233).
+
+    Returns (final_batch_size, valid_gpus[, micro_batch]) — and when
+    ``world_size`` > 0, validates membership and computes the micro batch
+    that satisfies batch = mbs * gas * world_size."""
+    ec = ds_config.get("elasticity", {}) if isinstance(ds_config, dict) else {}
+    if not ec.get("enabled", False):
+        raise ElasticityError("elasticity not enabled in config")
+    micro_batches = ec.get("micro_batch_sizes", [2, 4, 6])
+    final_batch, valid_gpus = get_compatible_gpus(
+        micro_batches=micro_batches,
+        max_acceptable_batch_size=ec.get("max_train_batch_size", 2000),
+        min_gpus=ec.get("min_gpus", 1), max_gpus=ec.get("max_gpus", 10000),
+        prefer_larger=ec.get("prefer_larger_batch", True),
+        version=float(ec.get("version", 0.2)),
+        model_parallel_size=int(ec.get("model_parallel_size", 1)))
+    logger.info(f"elasticity: final_batch={final_batch} valid_chip_counts={valid_gpus}")
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityError(
+                f"world size {world_size} not in the elastic-compatible set "
+                f"{valid_gpus} for batch {final_batch}")
+        # largest micro batch that divides this world's per-chip share
+        per_gpu = final_batch // world_size
+        mbs = max((m for m in micro_batches if per_gpu % m == 0), default=None)
+        if mbs is None:
+            raise ElasticityError(
+                f"no micro batch in {micro_batches} divides per-chip batch {per_gpu}")
+        if return_microbatch:
+            return final_batch, valid_gpus, mbs
+        return final_batch, valid_gpus
+    if return_microbatch:
+        return final_batch, valid_gpus, None
+    return final_batch, valid_gpus

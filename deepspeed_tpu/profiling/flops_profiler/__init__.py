@@ -1,0 +1,4 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (FlopsProfiler,
+                                                             get_model_profile)
+
+__all__ = ["FlopsProfiler", "get_model_profile"]

@@ -112,6 +112,27 @@ class DeepSpeedEngine:
                                               config=self.config)
         self.mesh = self.topology.mesh
 
+        # --- elasticity enforcement (reference engine.py:243, elasticity.py:233) ---
+        if self.config.elasticity_config.enabled:
+            from deepspeed_tpu.elasticity import compute_elastic_config
+            from deepspeed_tpu.elasticity.elasticity import ElasticityError
+            ec = self.config.elasticity_config
+            has_batch_info = (self.config.train_batch_size is not None
+                              or self.config.train_micro_batch_size_per_gpu is not None
+                              or self.config.gradient_accumulation_steps is not None)
+            if has_batch_info and not ec.ignore_non_elastic_batch_info:
+                raise ElasticityError(
+                    "elasticity is enabled but the config also fixes batch sizes; "
+                    "set ignore_non_elastic_batch_info to override (reference "
+                    "elasticity/config.py semantics)")
+            world = self.topology.data_parallel_size
+            fb, _, mbs = compute_elastic_config(self.config._param_dict,
+                                                world_size=world,
+                                                return_microbatch=True)
+            self.config.train_batch_size = fb
+            self.config.train_micro_batch_size_per_gpu = mbs
+            self.config.gradient_accumulation_steps = fb // (mbs * world)
+
         # --- batch arithmetic (reference config.py:789) ---
         tb, mb, gas = self.config.resolve_batch_params(self.topology.data_parallel_size)
         self.train_batch_size_value = tb
@@ -209,6 +230,7 @@ class DeepSpeedEngine:
         self._eval_step_fn = None
         self._offload = None  # ZeRO-Offload host tier (zero/offload.py)
         self.quantized_weights = False  # ZeRO++ qwZ (set in _init_state)
+        self.flops_profiler = None  # lazy (profiling/flops_profiler)
         if model_parameters is not None:
             self._init_state(model_parameters)
 
@@ -724,6 +746,13 @@ class DeepSpeedEngine:
         reference is invalid either way."""
         self._ensure_initialized(batch)
         self._compiled()
+        # flops profiler (reference engine.py:1823 profile-step hook)
+        if self.config.flops_profiler_config.enabled:
+            if self.flops_profiler is None:
+                from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+                self.flops_profiler = FlopsProfiler(self)
+            if self.flops_profiler.should_profile(self.global_steps):
+                self.flops_profiler.profile_engine_step(batch)
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         self.tput_timer.start()

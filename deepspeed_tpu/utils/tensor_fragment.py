@@ -36,18 +36,26 @@ def moment_leaves(opt_state, param_path_by_key):
     of other params' and is robust to dict-keyed (offload) master trees,
     unlike string suffix matching on keystr. Returns
     {"<key>::exp_avg"/"::exp_avg_sq": (path-tuple, leaf)}."""
+    by_suffix = {}
+    lengths = set()
+    for pk, ppath in param_path_by_key.items():
+        ppath = tuple(ppath)
+        by_suffix[ppath] = pk
+        lengths.add(len(ppath))
     out = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
         path = tuple(path)
-        for pk, ppath in param_path_by_key.items():
-            ppath = tuple(ppath)
-            L = len(ppath)
-            if len(path) > L and path[-L:] == ppath:
-                field = getattr(path[-L - 1], "name", None)
-                if field == "mu":
-                    out[f"{pk}::exp_avg"] = (path, leaf)
-                elif field == "nu":
-                    out[f"{pk}::exp_avg_sq"] = (path, leaf)
+        for L in lengths:  # O(opt_leaves x distinct-depths), not x params
+            if len(path) <= L:
+                continue
+            pk = by_suffix.get(path[-L:])
+            if pk is None:
+                continue
+            field = getattr(path[-L - 1], "name", None)
+            if field == "mu":
+                out[f"{pk}::exp_avg"] = (path, leaf)
+            elif field == "nu":
+                out[f"{pk}::exp_avg_sq"] = (path, leaf)
     return out
 
 
@@ -126,7 +134,9 @@ def safe_set_full_fp32_param(engine, key, value):
 def safe_get_full_optimizer_state(engine, key, state_name):
     """Gathered optimizer-state fragment, ``state_name`` in
     {"exp_avg", "exp_avg_sq"} (reference safe_get_full_optimizer_state)."""
-    field = {"exp_avg": "mu", "exp_avg_sq": "nu"}.get(state_name, state_name)
+    field = {"exp_avg": "mu", "exp_avg_sq": "nu"}.get(state_name)
+    if field is None:
+        return None  # reference returns None for absent state names
     if engine._offload is not None and key in engine._offload.masters:
         n = engine._offload.masters[key].size
         if engine._offload.swapper is not None:

@@ -185,6 +185,19 @@ def test_universal_offload_partial_moments(tmp_path):
     assert e2._offload.adam.step_count == e.global_steps
 
 
+def test_universal_restores_adam_step_count(tmp_path):
+    """Bias correction must resume at the saved optimizer step (regression:
+    the optax count leaf was never saved/restored)."""
+    from deepspeed_tpu.checkpoint.universal import _opt_step_count
+    e = _train(dict(_BASE, zero_optimization={"stage": 1}), steps=3)
+    assert _opt_step_count(e.state.opt_state) == 3
+    save_universal_checkpoint(e, str(tmp_path / "uni"))
+    groups.reset()
+    e2 = _train(dict(_BASE, zero_optimization={"stage": 1}), steps=1, seed=13)
+    load_universal_checkpoint(e2, str(tmp_path / "uni"))
+    assert _opt_step_count(e2.state.opt_state) == 3
+
+
 def test_moment_matching_disambiguation():
     """A param whose path is a suffix of another's must not capture its
     moments (regression for string-suffix matching)."""

@@ -1,0 +1,148 @@
+"""Flops profiler, autotuner, elasticity tests (reference
+``tests/unit/profiling/flops_profiler``, ``tests/unit/autotuning``,
+``tests/unit/elasticity``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.elasticity import compute_elastic_config, get_compatible_gpus
+from deepspeed_tpu.elasticity.elasticity import ElasticityError
+from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler, get_model_profile
+from deepspeed_tpu.profiling.flops_profiler.profiler import count_macs_jaxpr
+from tests.simple_model import SimpleModel, random_batches
+
+
+# ---------------------------------------------------------------- profiler
+
+def test_count_macs_dot():
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((32, 64))
+    b = jnp.ones((64, 16))
+    jaxpr = jax.make_jaxpr(f)(a, b)
+    assert count_macs_jaxpr(jaxpr.jaxpr) == 32 * 64 * 16
+
+
+def test_count_macs_scan():
+    def layer(x, _):
+        return x @ jnp.ones((16, 16)), None
+
+    def f(x):
+        y, _ = jax.lax.scan(layer, x, None, length=4)
+        return y
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((8, 16)))
+    assert count_macs_jaxpr(jaxpr.jaxpr) == 4 * 8 * 16 * 16
+
+
+def test_get_model_profile():
+    model = SimpleModel(hidden_dim=64)
+    batch = random_batches(1, batch_size=8)[0]
+    flops, macs, n_params = get_model_profile(model, batch, print_profile=False)
+    # two dense layers: 8x8x64 + 8x64x4 MACs
+    assert macs == 8 * 8 * 64 + 8 * 64 * 4
+    assert flops >= 2 * macs * 0.5  # XLA estimate in the right ballpark
+    assert n_params == (8 * 64 + 64) + (64 * 4 + 4)
+
+
+def test_engine_flops_profiler_hook():
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "flops_profiler": {"enabled": True, "profile_step": 1}}
+    model = SimpleModel(hidden_dim=32)
+    batches = random_batches(3, batch_size=8)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config=cfg)
+    for b in batches:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+    assert engine.flops_profiler is not None and engine.flops_profiler.profiled
+    # fused micro-step includes fwd+bwd: > forward-only MACs
+    fwd_macs = 8 * 8 * 32 + 8 * 32 * 4
+    assert engine.flops_profiler.macs > fwd_macs
+
+
+# ---------------------------------------------------------------- elasticity
+
+def test_compatible_gpus_basic():
+    batch, gpus = get_compatible_gpus(micro_batches=[2, 4],
+                                      max_acceptable_batch_size=64,
+                                      min_gpus=1, max_gpus=16)
+    assert batch <= 64 and gpus
+    for g in gpus:
+        assert any(batch % (m * g) == 0 for m in [2, 4])
+
+
+def test_compute_elastic_config_membership():
+    ds = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                         "micro_batch_sizes": [2, 4, 8], "min_gpus": 1,
+                         "max_gpus": 16, "version": 0.2}}
+    fb, valid, mbs = compute_elastic_config(ds, world_size=8,
+                                            return_microbatch=True)
+    assert 8 in valid
+    assert fb % (mbs * 8) == 0
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds, world_size=7)
+
+
+def test_elastic_config_v02_model_parallel():
+    fb, gpus = get_compatible_gpus(micro_batches=[2, 4],
+                                   max_acceptable_batch_size=32,
+                                   min_gpus=1, max_gpus=32,
+                                   version=0.2, model_parallel_size=2)
+    assert all(g % 2 == 0 for g in gpus)
+
+
+def test_engine_elasticity_enforcement():
+    cfg = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [1, 2, 4], "min_gpus": 1,
+                          "max_gpus": 64, "version": 0.2}}
+    model = SimpleModel(hidden_dim=16)
+    batch = random_batches(1, batch_size=8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config=cfg)
+    # dp world is 8; elastic batch must be divisible by mbs*8
+    assert engine.train_batch_size() % (engine.train_micro_batch_size_per_gpu() * 8) == 0
+
+    # fixed batch + elasticity (without ignore flag) must fail fast
+    from deepspeed_tpu.parallel import groups
+    groups.reset()
+    bad = dict(cfg, train_batch_size=16)
+    with pytest.raises(ElasticityError):
+        deepspeed_tpu.initialize(model=model, model_parameters=params, config=bad)
+
+
+# ---------------------------------------------------------------- autotuner
+
+def test_autotuner_picks_feasible_config():
+    model = SimpleModel(hidden_dim=32)
+    data = random_batches(1, batch_size=64)[0]
+
+    def batch_fn(bs):
+        return {k: v[:bs] for k, v in data.items()}
+
+    base = {"train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+    tuner = Autotuner(model, None, base, batch_fn,
+                      tuning_space={"zero_stage": [0, 1],
+                                    "micro_batch_size": [1, 2],
+                                    "remat_policy": ["nothing"]},
+                      warmup_steps=1, measure_steps=2)
+    cfg, metric = tuner.tune()
+    assert metric > 0
+    assert cfg["zero_optimization"]["stage"] in (0, 1)
+    assert cfg["train_micro_batch_size_per_gpu"] in (1, 2)
+    assert tuner.model_info["num_params"] > 0
+    # every experiment either produced a metric or a recorded error
+    for overrides, m, err in tuner.summary():
+        assert (m is not None) or (err is not None)
