@@ -1,0 +1,3 @@
+from deepspeed_tpu.launcher import runner
+
+__all__ = ["runner"]

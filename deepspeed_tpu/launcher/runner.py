@@ -1,0 +1,228 @@
+"""Multi-host launcher — the ``deepspeed`` CLI for TPU pods.
+
+Reference ``launcher/runner.py``: parses a hostfile (:200), applies
+include/exclude filters (:255), encodes world info, and uses
+PDSH/MPI/SLURM runners (``multinode_runner.py``) to start ``launch.py`` on
+every node, which spawns one process per GPU.
+
+TPU differences that shape this port:
+- One process per HOST, not per chip: a single JAX process drives all local
+  chips, and ``jax.distributed.initialize(coordinator, num_processes,
+  process_id)`` forms the multi-host mesh over ICI/DCN.
+- "Slots" in the hostfile are chips per host (informational — JAX discovers
+  local chips itself).
+- The per-node contract is environment variables (MASTER_ADDR/PORT, RANK,
+  WORLD_SIZE, LOCAL_RANK) consumed by ``comm.init_distributed``
+  (comm/comm.py analog), same names as the reference so user scripts port
+  unchanged.
+
+Usage::
+
+    python -m deepspeed_tpu.launcher.runner --hostfile hosts.txt \
+        [--include "host1@host2"] [--master_addr ...] train.py --args
+"""
+
+import argparse
+import base64
+import collections
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ("PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "XLA_FLAGS",
+               "LIBTPU_INIT_ARGS", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST_BOUNDS")
+
+
+def parse_hostfile(path):
+    """hostfile lines: ``hostname slots=N`` (reference fetch_hostfile :200).
+    Returns an ordered {hostname: slots}."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"hostfile {path} not found")
+    resource_pool = collections.OrderedDict()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(f"hostfile line not of form 'host slots=n': {line!r}")
+            if hostname in resource_pool:
+                raise ValueError(f"hostfile contains duplicate host {hostname}")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_filter(spec):
+    """``host1:0,1@host2`` -> {host: [slot,...] or None} (reference
+    _parse_hostfile inclusion syntax)."""
+    out = {}
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = [int(s) for s in slots.split(",")]
+        else:
+            out[part] = None
+    return out
+
+
+def filter_resources(resource_pool, include="", exclude=""):
+    """Apply include/exclude filters (reference parse_resource_filter :255)."""
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    pool = collections.OrderedDict(resource_pool)
+    if include:
+        inc = _parse_filter(include)
+        unknown = set(inc) - set(pool)
+        if unknown:
+            raise ValueError(f"include names unknown hosts {sorted(unknown)}")
+        pool = collections.OrderedDict(
+            (h, len(inc[h]) if inc[h] is not None else pool[h])
+            for h in pool if h in inc)
+    elif exclude:
+        exc = _parse_filter(exclude)
+        unknown = set(exc) - set(pool)
+        if unknown:
+            raise ValueError(f"exclude names unknown hosts {sorted(unknown)}")
+        out = collections.OrderedDict()
+        for h, slots in pool.items():
+            if h in exc:
+                if exc[h] is None:
+                    continue  # whole host excluded
+                ids = set(exc[h])
+                bad = [i for i in ids if i < 0 or i >= slots]
+                if bad:
+                    raise ValueError(f"exclude lists invalid slot ids {bad} "
+                                     f"for {h} (has {slots})")
+                remaining = slots - len(ids)
+                if remaining > 0:
+                    out[h] = remaining
+            else:
+                out[h] = slots
+        pool = out
+    if not pool:
+        raise ValueError("no hosts remain after include/exclude filtering")
+    return pool
+
+
+def encode_world_info(resource_pool):
+    """base64 world info passed to per-node launchers (reference
+    encode_world_info)."""
+    return base64.urlsafe_b64encode(
+        json.dumps(dict(resource_pool)).encode()).decode()
+
+
+def decode_world_info(blob):
+    return json.loads(base64.urlsafe_b64decode(blob.encode()).decode())
+
+
+def node_env(node_rank, n_nodes, master_addr, master_port):
+    """The per-node environment contract (reference launch.py env setup —
+    same variable names, but RANK is the host/process rank)."""
+    return {
+        "MASTER_ADDR": str(master_addr),
+        "MASTER_PORT": str(master_port),
+        "RANK": str(node_rank),
+        "LOCAL_RANK": "0",
+        "WORLD_SIZE": str(n_nodes),
+        "NODE_RANK": str(node_rank),
+    }
+
+
+def build_ssh_command(host, env, program):
+    """One node's ssh launch line (the PDSHRunner analog,
+    ``multinode_runner.py:51``). Every program token is quoted so args with
+    spaces/metacharacters survive the remote shell."""
+    exports = [f"export {k}={shlex.quote(v)};" for k, v in env.items()]
+    for k in EXPORT_ENVS:
+        if k in os.environ:
+            exports.append(f"export {k}={shlex.quote(os.environ[k])};")
+    quoted = [shlex.quote(tok) for tok in program]
+    remote = " ".join(exports + [f"cd {shlex.quote(os.getcwd())};"] + quoted)
+    return ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
+
+
+def main(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu multi-host launcher")
+    parser.add_argument("--hostfile", default=DLTS_HOSTFILE)
+    parser.add_argument("--include", default="")
+    parser.add_argument("--exclude", default="")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--master_addr", default=None)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", default="ssh", choices=["ssh", "local"],
+                        help="'ssh' launches remote hosts over ssh; 'local' "
+                             "spawns every node locally (debug/dry-run)")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="use the ssh path even for localhost entries")
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(args)
+
+    if os.path.isfile(args.hostfile):
+        pool = parse_hostfile(args.hostfile)
+        pool = filter_resources(pool, args.include, args.exclude)
+        if args.num_nodes > 0:
+            pool = collections.OrderedDict(list(pool.items())[:args.num_nodes])
+    else:
+        pool = collections.OrderedDict([("localhost", 0)])
+
+    hosts = list(pool)
+    remote_hosts = [h for h in hosts if h not in _LOCAL_HOSTS]
+    master = args.master_addr or hosts[0]
+    if remote_hosts and master in _LOCAL_HOSTS:
+        raise ValueError(
+            "remote hosts present but the coordinator address resolves to "
+            "localhost — pass --master_addr with an address the workers can "
+            "reach (reference runner.py master_addr resolution)")
+    program = [sys.executable, args.user_script] + args.user_args
+    world_info = encode_world_info(pool)
+    logger.info(f"launching on {len(hosts)} host(s): {hosts} "
+                f"(coordinator {master}:{args.master_port})")
+
+    procs = []
+    for rank, host in enumerate(hosts):
+        env = node_env(rank, len(hosts), master, args.master_port)
+        env["DS_WORLD_INFO"] = world_info  # slots-per-host for user scripts
+        use_ssh = (args.launcher == "ssh"
+                   and (host not in _LOCAL_HOSTS or args.force_multi))
+        if use_ssh:
+            procs.append(subprocess.Popen(build_ssh_command(host, env, program)))
+        else:
+            procs.append(subprocess.Popen(program, env=dict(os.environ, **env)))
+
+    def forward_signal(signum, frame):  # reference launch.py:132 signal handling
+        for p in procs:
+            try:
+                p.send_signal(signum)
+            except ProcessLookupError:
+                pass
+
+    signal.signal(signal.SIGINT, forward_signal)
+    signal.signal(signal.SIGTERM, forward_signal)
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

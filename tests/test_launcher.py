@@ -1,0 +1,151 @@
+"""Launcher + ds_report tests (reference ``tests/unit/launcher/``)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (build_ssh_command, decode_world_info,
+                                           encode_world_info, filter_resources,
+                                           main as runner_main, node_env,
+                                           parse_hostfile)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("# comment\n"
+                 "worker-0 slots=4\n"
+                 "worker-1 slots=4\n"
+                 "worker-2 slots=8\n")
+    return str(p)
+
+
+def test_parse_hostfile(hostfile):
+    pool = parse_hostfile(hostfile)
+    assert list(pool) == ["worker-0", "worker-1", "worker-2"]
+    assert pool["worker-2"] == 8
+
+
+def test_parse_hostfile_errors(tmp_path):
+    bad = tmp_path / "bad"
+    bad.write_text("worker-0 slots=4\nworker-0 slots=2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_hostfile(str(bad))
+    bad2 = tmp_path / "bad2"
+    bad2.write_text("worker-0\n")
+    with pytest.raises(ValueError, match="slots"):
+        parse_hostfile(str(bad2))
+    with pytest.raises(FileNotFoundError):
+        parse_hostfile(str(tmp_path / "missing"))
+
+
+def test_include_filter(hostfile):
+    pool = parse_hostfile(hostfile)
+    inc = filter_resources(pool, include="worker-0@worker-2:0,1")
+    assert list(inc) == ["worker-0", "worker-2"]
+    assert inc["worker-2"] == 2  # two named slots
+
+
+def test_exclude_filter(hostfile):
+    pool = parse_hostfile(hostfile)
+    exc = filter_resources(pool, exclude="worker-1")
+    assert list(exc) == ["worker-0", "worker-2"]
+    exc2 = filter_resources(pool, exclude="worker-2:0,1")
+    assert exc2["worker-2"] == 6
+
+
+def test_filter_errors(hostfile):
+    pool = parse_hostfile(hostfile)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        filter_resources(pool, include="worker-0", exclude="worker-1")
+    with pytest.raises(ValueError, match="unknown"):
+        filter_resources(pool, include="nope")
+    with pytest.raises(ValueError, match="no hosts"):
+        filter_resources(pool, exclude="worker-0@worker-1@worker-2")
+
+
+def test_world_info_roundtrip():
+    pool = {"a": 4, "b": 8}
+    assert decode_world_info(encode_world_info(pool)) == pool
+
+
+def test_node_env_contract():
+    env = node_env(2, 4, "10.0.0.1", 29500)
+    assert env["RANK"] == "2" and env["WORLD_SIZE"] == "4"
+    assert env["MASTER_ADDR"] == "10.0.0.1" and env["MASTER_PORT"] == "29500"
+    assert env["LOCAL_RANK"] == "0"  # one process drives all local chips
+
+
+def test_build_ssh_command():
+    cmd = build_ssh_command("worker-1", {"RANK": "1"}, ["python", "train.py"])
+    assert cmd[0] == "ssh" and "worker-1" in cmd
+    remote = cmd[-1]
+    assert "export RANK=1;" in remote and "python train.py" in remote
+
+
+def test_build_ssh_command_quotes_args():
+    cmd = build_ssh_command("w", {}, ["python", "t.py", "--name", "my run",
+                                      "--evil", "$(rm -rf /)"])
+    remote = cmd[-1]
+    assert "'my run'" in remote
+    assert "$(rm" not in remote.replace("'$(rm -rf /)'", "")
+
+
+def test_exclude_invalid_slots(hostfile):
+    pool = parse_hostfile(hostfile)
+    with pytest.raises(ValueError, match="invalid slot"):
+        filter_resources(pool, exclude="worker-0:7")
+
+
+def test_remote_with_localhost_master_rejected(tmp_path):
+    hf = tmp_path / "hf"
+    hf.write_text("localhost slots=4\nworker-1 slots=4\n")
+    with pytest.raises(ValueError, match="master_addr"):
+        runner_main(["--hostfile", str(hf), "--launcher", "local", "x.py"])
+
+
+def test_local_launch_runs_script(tmp_path):
+    """Single-node path: the launcher must run the user script with the env
+    contract set (reference launch.py end-to-end)."""
+    script = tmp_path / "probe.py"
+    out = tmp_path / "out.txt"
+    script.write_text(
+        "import os\n"
+        f"open({str(out)!r}, 'w').write("
+        "os.environ['RANK'] + ' ' + os.environ['WORLD_SIZE'] + ' ' + "
+        "os.environ['MASTER_ADDR'])\n")
+    rc = runner_main(["--hostfile", str(tmp_path / "nonexistent"),
+                      str(script)])
+    assert rc == 0
+    rank, ws, master = out.read_text().split()
+    assert rank == "0" and ws == "1" and master == "localhost"
+
+
+def test_local_launch_exports_world_info(tmp_path):
+    script = tmp_path / "probe.py"
+    out = tmp_path / "wi.txt"
+    script.write_text(
+        "import os\n"
+        f"open({str(out)!r}, 'w').write(os.environ['DS_WORLD_INFO'])\n")
+    rc = runner_main(["--hostfile", str(tmp_path / "none"), str(script)])
+    assert rc == 0
+    assert decode_world_info(out.read_text()) == {"localhost": 0}
+
+
+def test_launch_propagates_exit_code(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    rc = runner_main(["--hostfile", str(tmp_path / "none"), str(script)])
+    assert rc == 3
+
+
+def test_ds_report_runs(capsys):
+    from deepspeed_tpu.env_report import main
+    assert main() == 0
+    out = capsys.readouterr().out
+    assert "op compatibility" in out
+    assert "fused_adam" in out
+    assert "native/ds_aio" in out
+    assert "platform" in out
