@@ -225,6 +225,8 @@ def init_distributed(dist_backend=None,
     coordinator = os.environ.get("DST_COORDINATOR_ADDRESS") or os.environ.get("MASTER_ADDR")
     num_proc = int(os.environ.get("DST_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1")))
     proc_id = int(os.environ.get("DST_PROCESS_ID", os.environ.get("RANK", "0")))
+    # the launcher's env contract (launcher/runner.py node_env) carries the port
+    distributed_port = int(os.environ.get("MASTER_PORT", distributed_port))
     # SLURM discovery (reference comm.py:673 mpi_discovery analog)
     if coordinator is None and "SLURM_JOB_NODELIST" in os.environ:
         num_proc = int(os.environ.get("SLURM_NTASKS", "1"))

@@ -1,0 +1,5 @@
+from deepspeed_tpu.compression.compress import (CompressionState, apply_compression,
+                                                init_compression, redundancy_clean)
+
+__all__ = ["CompressionState", "apply_compression", "init_compression",
+           "redundancy_clean"]

@@ -1,0 +1,222 @@
+"""Compression — QAT quantization + structured/unstructured pruning.
+
+Reference ``deepspeed/compression/``: ``init_compression`` (:239
+``compress.py``) replaces matched layers with compressed variants
+(``LinearLayer_Compress``, ``basic_layer.py:840L``) whose forward fake-
+quantizes weights/activations and applies pruning masks; a scheduler
+(``scheduler.py:173L``) enables each technique at its ``schedule_offset``;
+``redundancy_clean`` materializes the pruned model.
+
+TPU-native design: compression is a *pure parameter transform* applied
+inside the jitted micro-step, not a module surgery. ``init_compression``
+inspects the config + parameter tree once and returns a ``CompressionState``
+whose ``transform(params, step)`` fake-quantizes and masks matched leaves —
+XLA fuses the transform into the forward, exactly where the reference's
+compressed-layer forward does it eagerly. The engine applies it via its
+``param_transform`` hook. Masks for structured pruning are computed from
+weight magnitude at the technique's ``schedule_offset`` boundary (dense
+warmup, like the reference) and can be refreshed with ``update_masks``.
+"""
+
+import fnmatch
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.config import CompressionConfig
+from deepspeed_tpu.ops.quantizer import dequantize_lastdim, quantize_lastdim
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _fake_quant(x, bits, group_size=256):
+    """Symmetric groupwise fake quantization (QAT forward; reference
+    ``basic_layer.py`` weight quantization with STE — the straight-through
+    gradient falls out of dequant(quant(x)) being piecewise identity-shaped)."""
+    if x.ndim < 2:
+        return x  # biases/scalars stay full precision (reference behavior)
+    if bits >= 16:
+        return x
+    if bits in (8,):
+        q, s = quantize_lastdim(x, group_size=group_size)
+        return dequantize_lastdim(q, s, group_size=group_size, dtype=x.dtype)
+    # generic low-bit (4/2/1): per-row amax scaling
+    qmax = float(2 ** (bits - 1) - 1) if bits > 1 else 1.0
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax)
+    return (q * scale).astype(x.dtype)
+
+
+def _sparse_mask(w, ratio, structured=None):
+    """Magnitude mask keeping the top (1-ratio) fraction.
+
+    structured=None: elementwise (sparse_pruning, method "l1"/"topk").
+    structured="row": whole output rows (row_pruning) — score rows by L1.
+    structured="head": groups of rows (head_pruning) — needs num_heads.
+    structured="channel": input columns (channel_pruning).
+    """
+    if structured is None:
+        flat = jnp.abs(w).reshape(-1)
+        k = max(1, int(flat.shape[0] * (1.0 - ratio)))
+        thresh = jnp.sort(flat)[-k]
+        return (jnp.abs(w) >= thresh).astype(w.dtype)
+    if structured == "row":
+        score = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+        k = max(1, int(score.shape[0] * (1.0 - ratio)))
+        thresh = jnp.sort(score)[-k]
+        mask = (score >= thresh).astype(w.dtype)
+        return jnp.broadcast_to(mask, w.shape)
+    if structured == "channel":
+        score = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+        k = max(1, int(score.shape[0] * (1.0 - ratio)))
+        thresh = jnp.sort(score)[-k]
+        mask = (score >= thresh).astype(w.dtype)
+        return jnp.broadcast_to(mask.reshape((-1,) + (1,) * (w.ndim - 1)), w.shape)
+    raise ValueError(f"unknown structure {structured}")
+
+
+def _head_mask(w, ratio, num_heads):
+    """head_pruning: the last dim is [heads * head_dim] (attention output
+    projection input, reference ``head_pruning`` on attn output matrices)."""
+    d = w.shape[-1]
+    assert d % num_heads == 0, f"dim {d} not divisible by heads {num_heads}"
+    hd = d // num_heads
+    grouped = w.reshape(w.shape[:-1] + (num_heads, hd))
+    head_axis = grouped.ndim - 2
+    score = jnp.sum(jnp.abs(grouped),
+                    axis=tuple(i for i in range(grouped.ndim) if i != head_axis))
+    k = max(1, int(num_heads * (1.0 - ratio)))
+    thresh = jnp.sort(score)[-k]
+    mask = (score >= thresh).astype(w.dtype)  # [heads]
+    mask = jnp.broadcast_to(mask[:, None], (num_heads, hd)).reshape(d)
+    return jnp.broadcast_to(mask, w.shape)
+
+
+class CompressionState:
+    """Per-leaf technique plan + frozen pruning masks."""
+
+    def __init__(self, config, params):
+        self.config = config
+        self.plans = {}   # keystr -> list of (technique, params dict)
+        self.masks = {}   # keystr -> mask array (pruning techniques)
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        for path, leaf in leaves:
+            key = jax.tree_util.keystr(path)
+            plan = []
+            for tname, tcfg in config.techniques.items():
+                if not tcfg.enabled or tname == "activation_quantization":
+                    continue
+                group = tcfg.group_for(key)
+                if group is None or (not hasattr(leaf, "ndim")) or leaf.ndim < 2:
+                    continue
+                plan.append((tname, dict(group.params),
+                             tcfg.schedule_offset))
+            if plan:
+                self.plans[key] = plan
+        n = sum(len(p) for p in self.plans.values())
+        log_dist(f"compression: {n} technique applications over "
+                 f"{len(self.plans)} leaves", ranks=[0])
+
+    def update_masks(self, params):
+        """(Re)compute pruning masks from current magnitudes (called at each
+        technique's schedule_offset; reference scheduler boundary)."""
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        for path, leaf in leaves:
+            key = jax.tree_util.keystr(path)
+            for tname, p, _ in self.plans.get(key, []):
+                mkey = f"{key}::{tname}"
+                if tname == "sparse_pruning":
+                    self.masks[mkey] = _sparse_mask(
+                        jnp.asarray(leaf), p.get("dense_ratio", 0.5))
+                elif tname == "row_pruning":
+                    self.masks[mkey] = _sparse_mask(
+                        jnp.asarray(leaf), p.get("dense_ratio", 0.5), "row")
+                elif tname == "channel_pruning":
+                    self.masks[mkey] = _sparse_mask(
+                        jnp.asarray(leaf), p.get("dense_ratio", 0.5), "channel")
+                elif tname == "head_pruning":
+                    self.masks[mkey] = _head_mask(
+                        jnp.asarray(leaf), p.get("dense_ratio", 0.5),
+                        int(p.get("num_heads", 1)))
+
+    def transform(self, params, step):
+        """Pure transform applied inside the jitted step. ``step`` may be a
+        traced scalar; technique activation uses jnp.where so the program
+        stays static."""
+        def tx(path, leaf):
+            key = jax.tree_util.keystr(path)
+            plan = self.plans.get(key)
+            if not plan:
+                return leaf
+            out = leaf
+            for tname, p, offset in plan:
+                if tname == "weight_quantization":
+                    bits = int(p.get("target_bits", p.get("start_bits", 8)))
+                    # STE: forward sees quantized values, gradients flow as if
+                    # identity (reference QAT straight-through estimator)
+                    qd = out + jax.lax.stop_gradient(_fake_quant(out, bits) - out)
+                    out = jnp.where(step >= offset, qd, out)
+                else:
+                    mask = self.masks.get(f"{key}::{tname}")
+                    if mask is not None:
+                        out = jnp.where(step >= offset, out * mask, out)
+            return out
+
+        return jax.tree_util.tree_map_with_path(tx, params)
+
+    def sparsity_report(self, params):
+        rows = {}
+        p = self.transform(params, step=jnp.int32(10**9))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(p)[0]:
+            key = jax.tree_util.keystr(path)
+            if key in self.plans:
+                arr = np.asarray(jax.device_get(leaf))
+                rows[key] = float((arr == 0).mean())
+        return rows
+
+
+def init_compression(params, ds_config):
+    """Build the compression plan (reference ``init_compression``,
+    ``compress.py:239``). ``ds_config`` is the raw dict (or DeepSpeedConfig
+    ``_param_dict``)."""
+    pd = ds_config._param_dict if hasattr(ds_config, "_param_dict") else ds_config
+    cfg = CompressionConfig(pd)
+    state = CompressionState(cfg, params)
+    state.update_masks(params)
+    return state
+
+
+def apply_compression(engine, ds_config=None):
+    """Attach compression to a live engine: the transform runs inside the
+    jitted micro/eval steps via the engine's param_transform hook."""
+    state = init_compression(
+        engine.state.master if engine.state.master is not None
+        else engine.state.params,
+        ds_config or engine.config)
+    engine.set_param_transform(
+        lambda p, step: state.transform(p, step))
+    return state
+
+
+def redundancy_clean(params, state):
+    """Materialize pruning into the stored weights (reference
+    ``redundancy_clean``). Shapes are preserved (XLA needs static shapes);
+    pruned entries become exact zeros so sparsity is checkpointed."""
+    return state.transform(params, step=jnp.int32(10**9))
+
+
+def layer_reduction(stacked_params, keep_layers):
+    """Layer-reduction / depth distillation (reference ``layer_reduction``
+    config): for scan-stacked layer trees (leading axis = layer), keep the
+    given layer indices."""
+    idx = jnp.asarray(keep_layers)
+
+    def slice_leaf(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and \
+                leaf.shape[0] > int(idx.max()):
+            return jnp.take(leaf, idx, axis=0)
+        return leaf
+
+    return jax.tree.map(slice_leaf, stacked_params)

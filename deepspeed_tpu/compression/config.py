@@ -1,0 +1,62 @@
+"""Compression config (reference ``deepspeed/compression/config.py`` +
+``constants.py`` key names).
+
+The reference nests each technique under ``compression_training`` with
+``shared_parameters`` and per-module-pattern ``different_groups``. The same
+shape is accepted here; ``modules`` patterns are matched against parameter
+tree paths (``jax.tree_util.keystr``) instead of nn.Module names.
+"""
+
+TECHNIQUES = ("weight_quantization", "activation_quantization",
+              "sparse_pruning", "row_pruning", "head_pruning",
+              "channel_pruning")
+
+
+class TechniqueGroup:
+
+    def __init__(self, name, params, modules):
+        self.name = name
+        self.params = dict(params)
+        self.modules = list(modules) if modules else ["*"]
+
+    def matches(self, key):
+        return any(m == "*" or m in key for m in self.modules)
+
+
+class TechniqueConfig:
+
+    def __init__(self, name, section):
+        self.name = name
+        shared = dict(section.get("shared_parameters", {}))
+        self.enabled = bool(shared.get("enabled", False))
+        self.schedule_offset = int(shared.get("schedule_offset", 0))
+        self.frequency = int(shared.get("frequency", 1) or 1)
+        self.shared = shared
+        self.groups = []
+        for gname, g in section.get("different_groups", {}).items():
+            self.groups.append(TechniqueGroup(
+                gname, g.get("params", {}), g.get("modules", ["*"])))
+        if self.enabled and not self.groups:
+            self.groups.append(TechniqueGroup("default", {}, ["*"]))
+
+    def group_for(self, key):
+        for g in self.groups:
+            if g.matches(key):
+                return g
+        return None
+
+
+class CompressionConfig:
+
+    def __init__(self, param_dict):
+        section = (param_dict or {}).get("compression_training", {})
+        self.techniques = {t: TechniqueConfig(t, section.get(t, {}))
+                           for t in TECHNIQUES}
+        lr = section.get("layer_reduction", {})
+        self.layer_reduction_enabled = bool(lr.get("enabled", False))
+        self.layer_reduction = lr
+
+    @property
+    def any_enabled(self):
+        return self.layer_reduction_enabled or \
+            any(t.enabled for t in self.techniques.values())

@@ -88,8 +88,14 @@ def filter_resources(resource_pool, include="", exclude=""):
         unknown = set(inc) - set(pool)
         if unknown:
             raise ValueError(f"include names unknown hosts {sorted(unknown)}")
+        for h, ids in inc.items():
+            if ids is not None:
+                bad = [i for i in set(ids) if i < 0 or i >= pool[h]]
+                if bad:
+                    raise ValueError(f"include lists invalid slot ids {bad} "
+                                     f"for {h} (has {pool[h]})")
         pool = collections.OrderedDict(
-            (h, len(inc[h]) if inc[h] is not None else pool[h])
+            (h, len(set(inc[h])) if inc[h] is not None else pool[h])
             for h in pool if h in inc)
     elif exclude:
         exc = _parse_filter(exclude)
@@ -180,6 +186,10 @@ def main(args=None):
         pool = filter_resources(pool, args.include, args.exclude)
         if args.num_nodes > 0:
             pool = collections.OrderedDict(list(pool.items())[:args.num_nodes])
+    elif args.hostfile != DLTS_HOSTFILE:
+        # an explicitly passed hostfile must exist — only the default path
+        # silently falls back to single-node (reference runner behavior)
+        raise FileNotFoundError(f"hostfile {args.hostfile} not found")
     else:
         pool = collections.OrderedDict([("localhost", 0)])
 

@@ -10,6 +10,7 @@ TPU kernel; ``load()`` returns the best available implementation, and
 from deepspeed_tpu.utils.logging import logger
 
 _REGISTRY = {}
+_POPULATED = False
 
 
 class OpBuilder:
@@ -57,19 +58,23 @@ def register_op_builder(cls):
 
 
 def get_op_builder(name):
-    if not _REGISTRY:
-        _populate()
+    _populate()
     return _REGISTRY.get(name)
 
 
 def available_ops():
-    if not _REGISTRY:
-        _populate()
+    _populate()
     return sorted(_REGISTRY)
 
 
 def _populate():
-    # import modules for registration side effects
+    # import modules for registration side effects. Guarded by a flag, not
+    # registry emptiness: a direct `import deepspeed_tpu.ops.X` elsewhere
+    # partially fills the registry and must not suppress the full population.
+    global _POPULATED
+    if _POPULATED:
+        return
+    _POPULATED = True
     import deepspeed_tpu.ops.adam  # noqa: F401
     import deepspeed_tpu.ops.aio  # noqa: F401
     import deepspeed_tpu.ops.cpu_adam  # noqa: F401

@@ -205,6 +205,12 @@ class DeepSpeedConfig:
         self.compile_config = CompileConfig(pd.get(C.COMPILE, {}))
         self.autotuning_config = AutotuningConfig(pd.get(C.AUTOTUNING, {}))
         self.seed = get_scalar_param(pd, "seed", 42)
+        # data efficiency (reference runtime/data_pipeline/config.py):
+        # legacy "curriculum_learning" section + "data_efficiency" umbrella
+        self.curriculum_learning = dict(pd.get("curriculum_learning", {}))
+        self.curriculum_enabled_legacy = bool(
+            self.curriculum_learning.get("enabled", False))
+        self.data_efficiency = dict(pd.get("data_efficiency", {}))
 
         # convenience views used by topology building
         self.pipeline_stages = self.pipeline.stages

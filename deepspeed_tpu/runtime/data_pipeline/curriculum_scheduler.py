@@ -1,0 +1,78 @@
+"""Curriculum scheduler (reference
+``runtime/data_pipeline/curriculum_scheduler.py``): maps the global step to a
+difficulty value under fixed_linear / fixed_root / fixed_discrete / custom
+schedules. Difficulty is most commonly sequence length (legacy
+``curriculum_learning`` config) or a data-sampler metric percentile
+(``data_efficiency`` config).
+"""
+
+import math
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config):
+        self.state = {}
+        self.config = dict(config)
+        self.curriculum_type = config.get("curriculum_type", "seqlen")
+        self.min_difficulty = config.get("min_difficulty", 8)
+        self.max_difficulty = config.get("max_difficulty", 1024)
+        self.schedule_type = config.get("schedule_type", "fixed_linear")
+        cfg = config.get("schedule_config", config)
+        self.total_step = cfg.get("total_curriculum_step", 10000)
+        self.difficulty_step = cfg.get("difficulty_step", 8)
+        self.root_degree = cfg.get("root_degree", 2)
+        self.difficulties = cfg.get("difficulty", [])
+        self.max_steps = cfg.get("max_step", [])
+        self.custom_fn = None
+        self.current_difficulty = self.min_difficulty
+
+    def set_custom_get_difficulty(self, fn):
+        self.custom_fn = fn
+
+    def __fixed_linear(self, step):
+        frac = min(1.0, step / max(1, self.total_step))
+        d = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        return self._round(d)
+
+    def __fixed_root(self, step):
+        frac = min(1.0, step / max(1, self.total_step)) ** (1.0 / self.root_degree)
+        d = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        return self._round(d)
+
+    def __fixed_discrete(self, step):
+        for d, s in zip(self.difficulties, self.max_steps):
+            if step <= s:
+                return d
+        return self.difficulties[-1] if self.difficulties else self.max_difficulty
+
+    def _round(self, d):
+        # quantize to difficulty_step multiples (reference behavior keeps
+        # seqlen a multiple of 8 for tensor-core/MXU alignment)
+        step = max(1, self.difficulty_step)
+        return int(min(self.max_difficulty,
+                       max(self.min_difficulty, step * math.floor(d / step))))
+
+    def get_difficulty(self, global_step):
+        if self.custom_fn is not None:
+            d = self.custom_fn(global_step)
+        elif self.schedule_type == "fixed_linear":
+            d = self.__fixed_linear(global_step)
+        elif self.schedule_type == "fixed_root":
+            d = self.__fixed_root(global_step)
+        elif self.schedule_type == "fixed_discrete":
+            d = self.__fixed_discrete(global_step)
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type}")
+        self.current_difficulty = d
+        return d
+
+    def update_difficulty(self, global_step):
+        return self.get_difficulty(global_step)
+
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd.get("current_difficulty",
+                                         self.min_difficulty)

@@ -1,0 +1,115 @@
+"""Curriculum data sampling + data analysis.
+
+Reference ``runtime/data_pipeline/data_sampling/``:
+- ``DataAnalyzer`` (``data_analyzer.py:828L``) precomputes per-sample metric
+  values over the dataset and writes index maps (sample→metric,
+  metric-bucket→samples) backed by mmap ``indexed_dataset.py``.
+- ``DeepSpeedDataSampler`` (``data_sampler.py:349L``) draws each batch only
+  from samples whose metric is within the current curriculum difficulty.
+
+TPU notes: batches must keep a static shape for jit, so difficulty gates the
+*candidate pool*, not the batch size; sampling with replacement tops up when
+the pool is smaller than a batch.
+"""
+
+import os
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.utils.logging import logger
+
+
+class DataAnalyzer:
+    """Compute per-sample metrics and (optionally) persist index maps."""
+
+    def __init__(self, dataset, metric_names_and_fns, save_path=None,
+                 num_workers=1):
+        self.dataset = dataset
+        self.metrics = dict(metric_names_and_fns)
+        self.save_path = save_path
+
+    def _samples(self):
+        if isinstance(self.dataset, dict):
+            n = len(next(iter(self.dataset.values())))
+            for i in range(n):
+                yield {k: v[i] for k, v in self.dataset.items()}
+        else:
+            yield from self.dataset
+
+    def run_map_reduce(self):
+        """Returns {metric_name: np.array of per-sample values}, sorted index
+        map per metric (ascending difficulty), persisted when save_path set."""
+        values = {m: [] for m in self.metrics}
+        for sample in self._samples():
+            for m, fn in self.metrics.items():
+                values[m].append(fn(sample))
+        out = {}
+        for m, vals in values.items():
+            arr = np.asarray(vals)
+            order = np.argsort(arr, kind="stable")
+            out[m] = {"values": arr, "index_sorted_by_metric": order}
+            if self.save_path:
+                os.makedirs(self.save_path, exist_ok=True)
+                np.save(os.path.join(self.save_path, f"{m}_values.npy"), arr)
+                np.save(os.path.join(self.save_path, f"{m}_index.npy"), order)
+        return out
+
+    @staticmethod
+    def load(save_path, metric):
+        return {"values": np.load(os.path.join(save_path, f"{metric}_values.npy")),
+                "index_sorted_by_metric":
+                    np.load(os.path.join(save_path, f"{metric}_index.npy"))}
+
+
+class CurriculumDataSampler:
+    """Difficulty-gated batch sampler (reference ``DeepSpeedDataSampler``).
+
+    ``difficulty_type``: "value" (metric <= difficulty) or "percentile"
+    (easiest difficulty% of samples are eligible)."""
+
+    def __init__(self, metric_values, batch_size, curriculum_config,
+                 difficulty_type="percentile", seed=0, drop_last=True):
+        self.values = np.asarray(metric_values)
+        self.order = np.argsort(self.values, kind="stable")
+        self.batch_size = batch_size
+        self.scheduler = CurriculumScheduler(curriculum_config)
+        self.difficulty_type = difficulty_type
+        self._rng = np.random.default_rng(seed)
+        self.global_step = 0
+
+    def set_step(self, step):
+        self.global_step = step
+
+    def _eligible(self):
+        d = self.scheduler.get_difficulty(self.global_step)
+        if self.difficulty_type == "percentile":
+            k = max(1, int(len(self.order) * min(100, d) / 100.0))
+            return self.order[:k]
+        return np.nonzero(self.values <= d)[0]
+
+    def next_batch_indices(self):
+        pool = self._eligible()
+        if len(pool) == 0:
+            pool = self.order[:1]
+            logger.warning("curriculum pool empty at current difficulty; "
+                           "falling back to the single easiest sample")
+        replace = len(pool) < self.batch_size
+        idx = self._rng.choice(pool, size=self.batch_size, replace=replace)
+        self.global_step += 1
+        return idx
+
+    def __iter__(self):
+        while True:
+            yield self.next_batch_indices()
+
+
+def apply_seqlen_curriculum(batch, seqlen):
+    """Legacy seqlen curriculum (reference engine.py curriculum_seqlen
+    truncation): truncate every [batch, seq, ...] array to ``seqlen``."""
+    def trunc(v):
+        if hasattr(v, "ndim") and v.ndim >= 2 and v.shape[1] > seqlen:
+            return v[:, :seqlen]
+        return v
+
+    return {k: trunc(v) for k, v in batch.items()}

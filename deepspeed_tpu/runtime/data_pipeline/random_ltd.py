@@ -1,0 +1,63 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference ``runtime/data_pipeline/data_routing/`` + ``csrc/random_ltd/``
+(token_sort.cu, gather_scatter.cu): middle transformer layers process only a
+random subset of tokens; the subset grows over training per a schedule. The
+CUDA token sort/gather/scatter kernels are one-liners in XLA
+(``jnp.argsort``/``take``/``scatter``) — exactly the "trivial in XLA" row of
+the native-component inventory.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_gather(x, keep, rng):
+    """Pick ``keep`` random token positions per sequence.
+
+    x: [batch, seq, ...]; returns (selected [batch, keep, ...], sorted index
+    [batch, keep]) — indices are sorted so relative order (and any causal
+    mask logic) is preserved, matching the reference's token_sort."""
+    b, s = x.shape[0], x.shape[1]
+    scores = jax.random.uniform(rng, (b, s))
+    idx = jnp.argsort(scores, axis=1)[:, :keep]
+    idx = jnp.sort(idx, axis=1)
+    sel = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return sel, idx
+
+
+def random_ltd_scatter(base, updates, idx):
+    """Scatter processed tokens back into the full sequence (gather_scatter.cu
+    inverse): base [batch, seq, ...], updates [batch, keep, ...]."""
+    batch_idx = jnp.arange(base.shape[0])[:, None]
+    return base.at[batch_idx, idx].set(updates)
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference ``data_routing/scheduler.py``): grows
+    linearly from min_value to max_value (full sequence) over
+    total_layer_budget steps, in multiples of ``step_size``."""
+
+    def __init__(self, config=None, **kw):
+        cfg = dict(config or {}, **kw)
+        sched = cfg.get("schedule_config", cfg)
+        self.min_value = sched.get("min_value", 128)
+        self.max_value = sched.get("max_value", 1024)
+        self.step_size = sched.get("step_size", 16)
+        self.total_steps = sched.get("total_layer_budget",
+                                     sched.get("total_step", 10000))
+        self.current_value = self.min_value
+
+    def get_value(self, global_step):
+        frac = min(1.0, global_step / max(1, self.total_steps))
+        v = self.min_value + frac * (self.max_value - self.min_value)
+        v = int(self.step_size * (v // self.step_size))
+        self.current_value = max(self.min_value, min(self.max_value, v))
+        return self.current_value
+
+    def state_dict(self):
+        return {"current_value": self.current_value}
+
+    def load_state_dict(self, sd):
+        self.current_value = sd.get("current_value", self.min_value)

@@ -231,6 +231,24 @@ class DeepSpeedEngine:
         self._offload = None  # ZeRO-Offload host tier (zero/offload.py)
         self.quantized_weights = False  # ZeRO++ qwZ (set in _init_state)
         self.flops_profiler = None  # lazy (profiling/flops_profiler)
+        self._param_transform = None  # compression hook (compression/compress.py)
+        # legacy seqlen curriculum (reference engine.py:1826 curriculum hook)
+        self.curriculum_scheduler = None
+        if self.config.curriculum_enabled_legacy:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+                CurriculumScheduler)
+            self.curriculum_scheduler = CurriculumScheduler(
+                self.config.curriculum_learning)
+        # data_efficiency umbrella (reference data_pipeline/config.py):
+        # random-LTD scheduler exposed for model code to query kept tokens
+        self.random_ltd_scheduler = None
+        de = self.config.data_efficiency
+        routing = de.get("data_routing", {})
+        if routing.get("enabled") and routing.get("random_ltd", {}).get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+                RandomLTDScheduler)
+            self.random_ltd_scheduler = RandomLTDScheduler(
+                routing["random_ltd"])
         if model_parameters is not None:
             self._init_state(model_parameters)
 
@@ -507,11 +525,16 @@ class DeepSpeedEngine:
 
         dq = self._dequantize_working if getattr(self, "quantized_weights", False) \
             else (lambda p: p)
+        ptx = self._param_transform
 
         def micro_step(state: TrainState, batch):
             rng, sub = jax.random.split(state.rng)
 
             def loss_fn(p):
+                if ptx is not None:
+                    # compression transform inside the grad: QAT quant uses
+                    # STE, pruning masks the gradient (compression/compress.py)
+                    p = ptx(p, state.global_step)
                 loss = model_fn(p, batch, sub, True)
                 if isinstance(loss, tuple):
                     loss = loss[0]
@@ -605,12 +628,24 @@ class DeepSpeedEngine:
         model_fn = self._model_fn
         dq = self._dequantize_working if getattr(self, "quantized_weights", False) \
             else (lambda p: p)
+        ptx = self._param_transform
 
         def eval_step(state: TrainState, batch):
-            out = model_fn(dq(state.params), batch, None, False)
+            p = dq(state.params)
+            if ptx is not None:
+                p = ptx(p, state.global_step)
+            out = model_fn(p, batch, None, False)
             return out
 
         return jax.jit(eval_step)
+
+    def set_param_transform(self, fn):
+        """Install a pure (params, step) -> params transform applied inside
+        the jitted steps (compression QAT/pruning hook). Forces recompile."""
+        self._param_transform = fn
+        self._micro_step_fn = None
+        self._apply_step_fn = None
+        self._eval_step_fn = None
 
     def _build_offload_fns(self):
         """Compiled pieces of the offloaded apply-step: a grad-stats reduction
@@ -744,6 +779,21 @@ class DeepSpeedEngine:
         bookkeeping. The state is committed immediately — the old state buffers
         are donated to the compiled step, so holding the previous ``state``
         reference is invalid either way."""
+        if self.curriculum_scheduler is not None and \
+                self.curriculum_scheduler.curriculum_type == "seqlen":
+            # curriculum BEFORE init/compile/profiling so every consumer sees
+            # the real step shape. Difficulties are bucketed to powers of two
+            # by default: a jitted step recompiles per distinct shape, so raw
+            # per-step lengths would mean O(curriculum_steps) XLA compiles —
+            # bucketing bounds it at log2(max/min) (set
+            # curriculum_learning.tpu_shape_buckets=false for exact lengths).
+            from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+                apply_seqlen_curriculum)
+            seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
+            if self.config.curriculum_learning.get("tpu_shape_buckets", True):
+                bucket = 1 << max(0, (int(seqlen) - 1).bit_length())
+                seqlen = min(bucket, self.curriculum_scheduler.max_difficulty)
+            batch = apply_seqlen_curriculum(batch, seqlen)
         self._ensure_initialized(batch)
         self._compiled()
         # flops profiler (reference engine.py:1823 profile-step hook)

@@ -1,0 +1,235 @@
+"""Compression + data-efficiency tests (reference ``tests/unit/compression``,
+curriculum/data-sampling units)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import apply_compression, init_compression, redundancy_clean
+from deepspeed_tpu.compression.compress import layer_reduction
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumDataSampler,
+                                                 CurriculumScheduler, DataAnalyzer,
+                                                 RandomLTDScheduler,
+                                                 random_ltd_gather,
+                                                 random_ltd_scatter)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import apply_seqlen_curriculum
+from tests.simple_model import SimpleModel, random_batches
+
+_BASE = {
+    "train_batch_size": 8,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+}
+
+
+def _engine(extra, steps=0, hidden=32):
+    model = SimpleModel(hidden_dim=hidden)
+    batches = random_batches(max(steps, 1), batch_size=8)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    cfg = dict(_BASE, **extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config=cfg)
+    for b in batches[:steps]:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+    return engine, batches
+
+
+# ---------------------------------------------------------------- compression
+
+def test_weight_quant_qat_trains():
+    comp = {"compression_training": {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"wq1": {"params": {"target_bits": 8},
+                                     "modules": ["kernel"]}}}}}
+    engine, batches = _engine(comp)
+    state = apply_compression(engine)
+    assert state.plans, "kernels should be planned for quantization"
+    losses = []
+    for b in batches * 6:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0], "QAT training must still converge"
+
+
+def test_sparse_pruning_masks_apply():
+    comp = {"compression_training": {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                              "method": "l1"},
+        "different_groups": {"sp1": {"params": {"dense_ratio": 0.5},
+                                     "modules": ["kernel"]}}}}}
+    engine, batches = _engine(comp)
+    state = apply_compression(engine)
+    rep = state.sparsity_report(engine.get_model_parameters())
+    kernels = {k: v for k, v in rep.items() if "kernel" in k}
+    assert kernels
+    for k, sparsity in kernels.items():
+        assert 0.4 <= sparsity <= 0.6, f"{k}: {sparsity}"
+    # training with masks: pruned entries stay (effectively) dead in forward
+    loss0 = engine(batches[0])
+    engine.backward(loss0)
+    engine.step()
+
+
+def test_row_and_head_pruning_structured():
+    rng = np.random.default_rng(0)
+    params = {"attn": {"kernel": jnp.asarray(rng.normal(size=(16, 32)),
+                                             dtype=jnp.float32)}}
+    cfg = {"compression_training": {
+        "row_pruning": {"shared_parameters": {"enabled": True, "schedule_offset": 0},
+                        "different_groups": {"r": {"params": {"dense_ratio": 0.5},
+                                                   "modules": ["attn"]}}}}}
+    state = init_compression(params, cfg)
+    out = redundancy_clean(params, state)
+    w = np.asarray(out["attn"]["kernel"])
+    zero_rows = (np.abs(w).sum(axis=0) == 0).sum()
+    assert zero_rows == 16  # half of 32 output rows zeroed
+
+    cfg_h = {"compression_training": {
+        "head_pruning": {"shared_parameters": {"enabled": True, "schedule_offset": 0},
+                         "different_groups": {"h": {"params": {"dense_ratio": 0.5,
+                                                               "num_heads": 4},
+                                                    "modules": ["attn"]}}}}}
+    state_h = init_compression(params, cfg_h)
+    out_h = redundancy_clean(params, state_h)
+    w_h = np.asarray(out_h["attn"]["kernel"])
+    head_alive = [np.abs(w_h[:, h * 8:(h + 1) * 8]).sum() > 0 for h in range(4)]
+    assert sum(head_alive) == 2
+
+
+def test_schedule_offset_delays_compression():
+    w = jnp.arange(1, 65, dtype=jnp.float32).reshape(8, 8)
+    params = {"m": {"kernel": w}}
+    cfg = {"compression_training": {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 100},
+        "different_groups": {"g": {"params": {"dense_ratio": 0.5},
+                                   "modules": ["*"]}}}}}
+    state = init_compression(params, cfg)
+    before = state.transform(params, step=jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(before["m"]["kernel"]), np.asarray(w))
+    after = state.transform(params, step=jnp.int32(100))
+    assert (np.asarray(after["m"]["kernel"]) == 0).sum() == 32
+
+
+def test_layer_reduction():
+    stacked = {"w": jnp.arange(6 * 4).reshape(6, 4).astype(jnp.float32)}
+    kept = layer_reduction(stacked, [0, 2, 4])
+    assert kept["w"].shape == (3, 4)
+    np.testing.assert_array_equal(np.asarray(kept["w"][1]),
+                                  np.asarray(stacked["w"][2]))
+
+
+# ---------------------------------------------------------------- curriculum
+
+def test_curriculum_schedules():
+    lin = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                               "schedule_type": "fixed_linear",
+                               "schedule_config": {"total_curriculum_step": 100,
+                                                   "difficulty_step": 8}})
+    assert lin.get_difficulty(0) == 8
+    assert lin.get_difficulty(100) == 64
+    mid = lin.get_difficulty(50)
+    assert 8 < mid < 64 and mid % 8 == 0
+
+    root = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                "schedule_type": "fixed_root",
+                                "schedule_config": {"total_curriculum_step": 100,
+                                                    "difficulty_step": 8,
+                                                    "root_degree": 2}})
+    assert root.get_difficulty(25) >= lin.get_difficulty(25)
+
+    disc = CurriculumScheduler({"schedule_type": "fixed_discrete",
+                                "schedule_config": {"difficulty": [8, 16, 32],
+                                                    "max_step": [10, 20, 30]}})
+    assert disc.get_difficulty(5) == 8
+    assert disc.get_difficulty(15) == 16
+    assert disc.get_difficulty(99) == 32
+
+
+def test_seqlen_curriculum_truncation():
+    batch = {"input_ids": np.ones((4, 64), np.int32),
+             "labels": np.ones((4, 64), np.int32)}
+    out = apply_seqlen_curriculum(batch, 16)
+    assert out["input_ids"].shape == (4, 16)
+
+
+def test_engine_seqlen_curriculum():
+    cfg = dict(_BASE)
+    cfg["curriculum_learning"] = {
+        "enabled": True, "curriculum_type": "seqlen", "min_difficulty": 4,
+        "max_difficulty": 8, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 4}}
+    from tests.simple_model import tiny_gpt2_batches
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    batches = tiny_gpt2_batches(1, batch_size=8, seq_len=8,
+                                vocab=GPT2Config.tiny().vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    from deepspeed_tpu.parallel import groups
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config=cfg)
+    loss = engine(batches[0])  # step 0: seqlen truncated to 4 — must not crash
+    engine.backward(loss)
+    engine.step()
+    assert engine.curriculum_scheduler.current_difficulty == 4
+
+
+# ---------------------------------------------------------------- sampler
+
+def test_data_analyzer_and_sampler(tmp_path):
+    data = {"x": np.random.default_rng(0).normal(size=(100, 8)).astype(np.float32)}
+    analyzer = DataAnalyzer(data, {"norm": lambda s: float(np.abs(s["x"]).sum())},
+                            save_path=str(tmp_path))
+    res = analyzer.run_map_reduce()
+    vals = res["norm"]["values"]
+    order = res["norm"]["index_sorted_by_metric"]
+    assert (np.diff(vals[order]) >= 0).all()
+    loaded = DataAnalyzer.load(str(tmp_path), "norm")
+    np.testing.assert_array_equal(loaded["values"], vals)
+
+    sampler = CurriculumDataSampler(
+        vals, batch_size=8,
+        curriculum_config={"min_difficulty": 10, "max_difficulty": 100,
+                           "schedule_type": "fixed_linear",
+                           "schedule_config": {"total_curriculum_step": 10,
+                                               "difficulty_step": 1}},
+        difficulty_type="percentile")
+    easy_batch = sampler.next_batch_indices()
+    easy_pool = set(order[:10])
+    assert set(easy_batch).issubset(easy_pool)
+    sampler.set_step(100)  # fully open
+    late_batch = sampler.next_batch_indices()
+    assert len(late_batch) == 8
+
+
+# ---------------------------------------------------------------- random-LTD
+
+def test_random_ltd_gather_scatter():
+    x = jnp.arange(2 * 8 * 4).reshape(2, 8, 4).astype(jnp.float32)
+    sel, idx = random_ltd_gather(x, keep=3, rng=jax.random.PRNGKey(0))
+    assert sel.shape == (2, 3, 4)
+    assert (np.diff(np.asarray(idx), axis=1) > 0).all()  # sorted, unique
+    # selected rows match their source positions
+    for b in range(2):
+        for j in range(3):
+            np.testing.assert_array_equal(np.asarray(sel[b, j]),
+                                          np.asarray(x[b, idx[b, j]]))
+    back = random_ltd_scatter(x, sel * 2, idx)
+    for b in range(2):
+        for j in range(3):
+            np.testing.assert_array_equal(np.asarray(back[b, idx[b, j]]),
+                                          np.asarray(x[b, idx[b, j]] * 2))
+
+
+def test_random_ltd_scheduler():
+    s = RandomLTDScheduler({"schedule_config": {"min_value": 16, "max_value": 64,
+                                                "step_size": 16,
+                                                "total_layer_budget": 100}})
+    assert s.get_value(0) == 16
+    assert s.get_value(100) == 64
+    assert s.get_value(50) in (32, 48)

@@ -97,6 +97,15 @@ def test_exclude_invalid_slots(hostfile):
     pool = parse_hostfile(hostfile)
     with pytest.raises(ValueError, match="invalid slot"):
         filter_resources(pool, exclude="worker-0:7")
+    with pytest.raises(ValueError, match="invalid slot"):
+        filter_resources(pool, include="worker-0:7")
+    # duplicate slot ids count once
+    assert filter_resources(pool, include="worker-0:1,1")["worker-0"] == 1
+
+
+def test_explicit_missing_hostfile_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        runner_main(["--hostfile", str(tmp_path / "typo"), "x.py"])
 
 
 def test_remote_with_localhost_master_rejected(tmp_path):
@@ -116,8 +125,7 @@ def test_local_launch_runs_script(tmp_path):
         f"open({str(out)!r}, 'w').write("
         "os.environ['RANK'] + ' ' + os.environ['WORLD_SIZE'] + ' ' + "
         "os.environ['MASTER_ADDR'])\n")
-    rc = runner_main(["--hostfile", str(tmp_path / "nonexistent"),
-                      str(script)])
+    rc = runner_main([str(script)])  # default hostfile path absent -> local
     assert rc == 0
     rank, ws, master = out.read_text().split()
     assert rank == "0" and ws == "1" and master == "localhost"
@@ -129,7 +137,7 @@ def test_local_launch_exports_world_info(tmp_path):
     script.write_text(
         "import os\n"
         f"open({str(out)!r}, 'w').write(os.environ['DS_WORLD_INFO'])\n")
-    rc = runner_main(["--hostfile", str(tmp_path / "none"), str(script)])
+    rc = runner_main([str(script)])
     assert rc == 0
     assert decode_world_info(out.read_text()) == {"localhost": 0}
 
@@ -137,7 +145,7 @@ def test_local_launch_exports_world_info(tmp_path):
 def test_launch_propagates_exit_code(tmp_path):
     script = tmp_path / "fail.py"
     script.write_text("import sys; sys.exit(3)\n")
-    rc = runner_main(["--hostfile", str(tmp_path / "none"), str(script)])
+    rc = runner_main([str(script)])
     assert rc == 3
 
 
