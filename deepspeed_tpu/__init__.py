@@ -76,7 +76,17 @@ def initialize(args=None,
     if config is None and args is not None and hasattr(args, "deepspeed_config"):
         config = args.deepspeed_config
 
-    engine = DeepSpeedEngine(config=config,
+    # engine selection (reference deepspeed/__init__.py:166-206): hybrid
+    # engine for RLHF configs, else the standard engine (PipelineEngine is
+    # selected by passing a PipelineModule to deepspeed_tpu.pipe)
+    ds_config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
+    engine_cls = DeepSpeedEngine
+    if ds_config.hybrid_engine_enabled:
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine_cls = DeepSpeedHybridEngine
+    config = ds_config
+
+    engine = engine_cls(config=config,
                              model=model,
                              optimizer=optimizer,
                              model_parameters=model_parameters,

@@ -39,6 +39,7 @@ class QuantizationConfig(DeepSpeedConfigModel):
     enabled = False
     bits = 8
     q_groups = 1
+    group_size = 256
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

@@ -25,6 +25,26 @@ from deepspeed_tpu.inference.generation import generate as _generate
 from deepspeed_tpu.utils.logging import logger
 
 
+class _DequantizingModule:
+    """Module proxy that dequantizes QuantizedParameter leaves in-trace
+    before every apply (the reference's on-the-fly weight dequant forward)."""
+
+    def __init__(self, module):
+        self._module = module
+
+    def apply(self, variables, *args, **kwargs):
+        from deepspeed_tpu.inference.quantization import dequantize_param_tree
+        v = dict(variables)
+        v["params"] = dequantize_param_tree(v["params"])
+        return self._module.apply(v, *args, **kwargs)
+
+    def init(self, *args, **kwargs):
+        return self._module.init(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._module, name)
+
+
 class InferenceEngine:
     """Serve a flax model with TP sharding and KV-cached generation.
 
@@ -46,6 +66,7 @@ class InferenceEngine:
         if params is None and config.checkpoint:
             params = self._load_checkpoint(config.checkpoint)
         self.params = self._shard_params(params) if params is not None else None
+        self.params, self._serve_module = self._maybe_quantize(self.params)
         self._forward_fn = None
         self._rng = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**32))
 
@@ -84,13 +105,33 @@ class InferenceEngine:
 
     def set_params(self, params):
         self.params = self._shard_params(params)
+        self.params, self._serve_module = self._maybe_quantize(self.params)
+        self._forward_fn = None
+
+    def _maybe_quantize(self, params):
+        """ZeRO-Inference weight-only quantization (inference/quantization):
+        weights live int8/int4 in HBM; dequant fuses into consumer matmuls."""
+        q = self._config.quant
+        if not q.enabled or params is None:
+            return params, self.module
+        from deepspeed_tpu.inference.quantization import quantize_param_tree
+        from deepspeed_tpu.inference.quantization.quantization import (
+            quantized_nbytes)
+        before = quantized_nbytes(params)
+        params = quantize_param_tree(params, num_bits=q.bits,
+                                     group_size=getattr(q, "group_size", 256))
+        after = quantized_nbytes(params)
+        logger.info(f"weight quantization: {before/1e6:.1f}MB -> "
+                    f"{after/1e6:.1f}MB ({q.bits}-bit)")
+        return params, _DequantizingModule(self.module)
 
     # -- serving -----------------------------------------------------------
     def forward(self, batch, **kwargs):
         """Logits forward (reference ``engine.py:584``)."""
         if self._forward_fn is None:
+            mod = self._serve_module
             self._forward_fn = jax.jit(
-                lambda p, b: self.module.apply({"params": p}, b))
+                lambda p, b: mod.apply({"params": p}, b))
         if isinstance(batch, (np.ndarray, jnp.ndarray)):
             batch = {"input_ids": jnp.asarray(batch, jnp.int32)}
         with self.mesh:
@@ -105,7 +146,7 @@ class InferenceEngine:
         if rng is None and temperature > 0.0:
             self._rng, rng = jax.random.split(self._rng)
         with self.mesh:
-            return _generate(self.module, self.params, input_ids,
+            return _generate(self._serve_module, self.params, input_ids,
                              max_new_tokens=max_new_tokens,
                              temperature=temperature, top_k=top_k, top_p=top_p,
                              rng=rng, eos_token_id=eos_token_id)

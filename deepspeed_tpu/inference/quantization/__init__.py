@@ -1,0 +1,4 @@
+from deepspeed_tpu.inference.quantization.quantization import (
+    QuantizedParameter, dequantize_param_tree, quantize_param_tree)
+
+__all__ = ["QuantizedParameter", "dequantize_param_tree", "quantize_param_tree"]

@@ -28,6 +28,8 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    attention_bias: bool = False      # qkv bias (Qwen2-family)
+    sliding_window: Any = None        # local-window attention (Mistral-family)
     scan_layers: bool = True
     remat: bool = True
     dtype: Any = jnp.bfloat16
@@ -103,10 +105,12 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         B, T, D = x.shape
         H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-        dense = lambda feats, name: nn.Dense(feats, use_bias=False, dtype=cfg.dtype, name=name)
-        q = dense(H * Dh, "q_proj")(x).reshape(B, T, H, Dh)
-        k = dense(KV * Dh, "k_proj")(x).reshape(B, T, KV, Dh)
-        v = dense(KV * Dh, "v_proj")(x).reshape(B, T, KV, Dh)
+        dense = lambda feats, name, bias=False: nn.Dense(
+            feats, use_bias=bias, dtype=cfg.dtype, name=name)
+        ab = cfg.attention_bias
+        q = dense(H * Dh, "q_proj", ab)(x).reshape(B, T, H, Dh)
+        k = dense(KV * Dh, "k_proj", ab)(x).reshape(B, T, KV, Dh)
+        v = dense(KV * Dh, "v_proj", ab)(x).reshape(B, T, KV, Dh)
         q = rotary_embed(q, positions, cfg.rope_theta)
         k = rotary_embed(k, positions, cfg.rope_theta)
         from deepspeed_tpu.ops.flash_attention import mha, NEG_INF
@@ -133,7 +137,10 @@ class LlamaAttention(nn.Module):
             # position j attends iff j <= idx + i (past + causal-within-block)
             key_pos = jnp.arange(L)[None, :]
             qry_pos = idx + jnp.arange(T)[:, None]
-            bias = jnp.where(key_pos <= qry_pos, 0.0, NEG_INF)
+            visible = key_pos <= qry_pos
+            if cfg.sliding_window:
+                visible = visible & (key_pos > qry_pos - cfg.sliding_window)
+            bias = jnp.where(visible, 0.0, NEG_INF)
             # grouped-query attention against the un-repeated cache: expanding
             # only the [B,T,H,Dh] query (not the [B,L,KV,Dh] cache) keeps decode
             # memory traffic at 1x the cache size
@@ -149,7 +156,13 @@ class LlamaAttention(nn.Module):
                 rep = H // KV
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            out = mha(q, k, v, causal=True)
+            bias = None
+            if cfg.sliding_window:
+                # Mistral-style local window (sliding_window keys back)
+                pos = jnp.arange(T)
+                near = pos[:, None] - pos[None, :] < cfg.sliding_window
+                bias = jnp.where(near, 0.0, NEG_INF)[None, None]
+            out = mha(q, k, v, bias=bias, causal=True)
         out = out.reshape(B, T, H * Dh)
         return dense(D, "o_proj")(out)
 

@@ -1,0 +1,31 @@
+"""Mistral — Llama architecture + sliding-window attention + GQA.
+
+Reference support: ``deepspeed/inference/v2/model_implementations/mistral``
+(``engine_factory.py:83``). Architecturally Mistral is Llama with
+``sliding_window`` local attention and 8 KV heads; the TPU implementation is
+the Llama module parameterized accordingly (models/llama.py carries the
+window mask in both the training and KV-cache paths).
+"""
+
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+MistralForCausalLM = LlamaForCausalLM
+
+
+def mistral_config(**kw):
+    """mistralai/Mistral-7B-v0.1 geometry."""
+    defaults = dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                    num_hidden_layers=32, num_attention_heads=32,
+                    num_key_value_heads=8, max_position_embeddings=4096,
+                    sliding_window=4096, rope_theta=10000.0)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+def tiny_mistral_config(**kw):
+    defaults = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=128,
+                    sliding_window=16)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)

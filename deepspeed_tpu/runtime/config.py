@@ -207,6 +207,9 @@ class DeepSpeedConfig:
         self.seed = get_scalar_param(pd, "seed", 42)
         # data efficiency (reference runtime/data_pipeline/config.py):
         # legacy "curriculum_learning" section + "data_efficiency" umbrella
+        # RLHF hybrid engine (reference runtime/hybrid_engine.py config section)
+        self.hybrid_engine = dict(pd.get("hybrid_engine", {}))
+        self.hybrid_engine_enabled = bool(self.hybrid_engine.get("enabled", False))
         self.curriculum_learning = dict(pd.get("curriculum_learning", {}))
         self.curriculum_enabled_legacy = bool(
             self.curriculum_learning.get("enabled", False))

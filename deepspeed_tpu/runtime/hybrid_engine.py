@@ -1,0 +1,91 @@
+"""Hybrid engine — train + generate in one engine (RLHF).
+
+Reference ``runtime/hybrid_engine.py:32`` (``DeepSpeedHybridEngine``):
+RLHF rollout needs fast generation from the *training* weights, so the
+reference flips ZeRO-3 partitioned params into inference kernel containers
+before ``generate`` (:174) and back afterwards (``_zero3_forward`` :363),
+with LoRA fuse/unfuse around each flip.
+
+On TPU the flip is unnecessary by construction: training params are GSPMD
+global arrays — the KV-cached decode program simply *reads the same buffers*
+under their training shardings, and XLA inserts whatever gathers the decode
+needs (the analog of the reference's gather-once-per-generate, but scheduled
+by the compiler and cached per shape). What remains of the reference surface:
+
+- ``generate()``: jitted prefill + while-loop decode over the live weights
+  (inference/generation.py), with qwZ int8 weights dequantized in-trace.
+- ``eval()`` / ``train()``: mode flags (reference nn.Module semantics).
+- per-call latency bookkeeping (reference ``_generate_latency`` timers).
+"""
+
+import time
+
+import jax
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._training_mode = True
+        self._generate_latency = 0.0
+        self._generate_tokens = 0
+        self._num_generations = 0
+        he = self.config.hybrid_engine
+        self._max_out_tokens = he.get("max_out_tokens", 512)
+        log_dist("DeepSpeedHybridEngine: generation reads training shards "
+                 "in place (no container flip needed under GSPMD)", ranks=[0])
+
+    # --- mode flags (reference module.eval()/train() flow) ---
+    def eval(self):
+        self._training_mode = False
+        return self
+
+    def train(self, mode=True):
+        self._training_mode = mode
+        return self
+
+    def is_in_training_mode(self):
+        return self._training_mode
+
+    def _inference_params(self):
+        """The weights generation reads: the live working copy, dequantized
+        when qwZ stores it as int8 (the reference's gather+dequant flip)."""
+        p = self.state.params
+        if self.quantized_weights:
+            p = jax.jit(self._dequantize_working)(p)
+        return p
+
+    def generate(self, input_ids, max_new_tokens=None, temperature=0.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, rng=None):
+        """RLHF rollout generation (reference ``hybrid_engine.generate`` :174).
+        Requires the wrapped model to support the KV-cache contract
+        (``use_cache=True``; see models/llama.py)."""
+        assert hasattr(self.module, "apply"), \
+            "hybrid engine generation needs a flax module with a KV-cache path"
+        from deepspeed_tpu.inference.generation import generate as _generate
+        max_new_tokens = max_new_tokens or self._max_out_tokens
+        t0 = time.perf_counter()
+        out = _generate(self.module, self._inference_params(), input_ids,
+                        max_new_tokens=max_new_tokens, temperature=temperature,
+                        top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+                        rng=rng)
+        out = jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        self._generate_latency += dt
+        self._num_generations += 1
+        self._generate_tokens += int(out.shape[0]) * int(out.shape[1])
+        return out
+
+    def generation_stats(self):
+        """(total seconds, generations, tokens, tokens/sec) — the reference's
+        latency bookkeeping used by DS-Chat throughput reports."""
+        tps = self._generate_tokens / self._generate_latency \
+            if self._generate_latency else 0.0
+        return {"latency_s": self._generate_latency,
+                "generations": self._num_generations,
+                "tokens": self._generate_tokens,
+                "tokens_per_sec": tps}

@@ -1,0 +1,53 @@
+"""Progressive layer drop (reference ``runtime/progressive_layer_drop.py``).
+
+PLD accelerates BERT-style pretraining by stochastically skipping transformer
+layers with a keep probability theta(t) that decays over training:
+
+    theta(t) = (1 - theta_min) * gamma_decay(t) + theta_min,
+    where gamma_decay(t) = exp(-gamma * t)  -> theta decays from 1 to theta_min
+
+The reference injects ``progressive_layer_drop`` kwargs into forward
+(``engine.py:1826-1828``); here models consume ``theta`` via
+``should_keep_layer`` inside their scan body (a Bernoulli draw per layer —
+static shapes preserved by weighting the residual branch, not by skipping
+compilation)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta=0.5, gamma=0.001):
+        """``theta``: final (minimum) keep probability; ``gamma``: decay rate
+        (reference defaults)."""
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        decay = math.exp(-self.gamma * global_step)
+        self.current_theta = (1.0 - self.theta) * decay + self.theta
+        return self.current_theta
+
+
+def should_keep_layer(rng, layer_idx, theta):
+    """Per-layer Bernoulli keep draw; deeper layers drop more often
+    (keep prob theta^(i/L) scaling is left to the caller — the reference uses
+    a uniform theta per step)."""
+    return jax.random.bernoulli(jax.random.fold_in(rng, layer_idx), theta)
+
+
+def pld_residual(keep, layer_out, residual, theta):
+    """Stochastic-depth combine: keep ? residual + layer_out/theta : residual
+    (inverted scaling keeps expectation; static shapes either way)."""
+    scale = jnp.where(theta > 0, 1.0 / jnp.maximum(theta, 1e-6), 1.0)
+    return jnp.where(keep, residual + layer_out * scale, residual)

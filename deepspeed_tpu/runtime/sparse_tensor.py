@@ -1,0 +1,60 @@
+"""SparseTensor — sparse embedding-gradient representation.
+
+Reference ``runtime/sparse_tensor.py`` + the engine's ``sparse_allreduce_*``
+(``engine.py:2470-2539``): embedding gradients touch few rows per step, so
+they travel as (indices, values) pairs and are reduced by concatenating and
+re-deduplicating instead of dense allreduce.
+
+On TPU dense gradients ride ICI cheaply, so this is mostly an interop/API
+surface; the rendezvous math (dedupe + sum by index) is still useful for
+host-side gradient post-processing and for DCN-frugal multi-slice setups.
+"""
+
+import numpy as np
+
+
+class SparseTensor:
+    """(indices, values) rows of a [num_rows, dim] dense tensor."""
+
+    def __init__(self, indices, values, dense_size):
+        self.indices = np.asarray(indices, dtype=np.int64)
+        self.values = np.asarray(values)
+        self.dense_size = tuple(dense_size)
+        assert self.values.shape[0] == self.indices.shape[0]
+
+    @classmethod
+    def from_dense(cls, dense, threshold=0.0):
+        dense = np.asarray(dense)
+        row_nonzero = np.abs(dense).max(axis=tuple(range(1, dense.ndim))) > threshold
+        idx = np.nonzero(row_nonzero)[0]
+        return cls(idx, dense[idx], dense.shape)
+
+    def to_dense(self):
+        out = np.zeros(self.dense_size, dtype=self.values.dtype)
+        np.add.at(out, self.indices, self.values)
+        return out
+
+    def deduplicate(self):
+        """Sum values of repeated indices (reference sparse reduce merge)."""
+        uniq, inv = np.unique(self.indices, return_inverse=True)
+        summed = np.zeros((uniq.shape[0],) + self.values.shape[1:],
+                          dtype=self.values.dtype)
+        np.add.at(summed, inv, self.values)
+        return SparseTensor(uniq, summed, self.dense_size)
+
+    def sparse_size(self):
+        return self.indices.size + self.values.size
+
+    def __repr__(self):
+        return (f"SparseTensor(nnz_rows={self.indices.shape[0]}, "
+                f"dense={self.dense_size})")
+
+
+def sparse_all_reduce(sparse_tensors):
+    """Reduce a list of SparseTensors (one per rank) into the dense sum —
+    the in-process analog of the engine's sparse allreduce rendezvous."""
+    assert sparse_tensors
+    base = sparse_tensors[0]
+    all_idx = np.concatenate([s.indices for s in sparse_tensors])
+    all_val = np.concatenate([s.values for s in sparse_tensors])
+    return SparseTensor(all_idx, all_val, base.dense_size).deduplicate()
