@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the fast-kernel layer of the framework.
+
+This package is the TPU-native analog of the reference's ``csrc/`` CUDA kernel
+tree (``csrc/transformer/inference/csrc/softmax.cu``, the blocked_flash family
+under ``deepspeed/inference/v2/kernels/ragged_ops/``, ``csrc/quantization/``):
+hand-written kernels for the ops where XLA's automatic fusion is not enough.
+Every kernel has a pure-XLA reference twin in ``deepspeed_tpu/ops`` and is
+selected through the op-builder registry (``ops/registry.py``).
+"""
