@@ -22,6 +22,10 @@ def __getattr__(name):
     if name == "DeepSpeedEngine":
         from deepspeed_tpu.runtime.engine import DeepSpeedEngine
         return DeepSpeedEngine
+    if name == "zero":
+        # deepspeed.zero namespace parity (zero.Init lives here)
+        from deepspeed_tpu.runtime import zero
+        return zero
     raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")
 
 

@@ -152,10 +152,9 @@ class LlamaAttention(nn.Module):
             probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
             out = jnp.einsum("bkrts,bskd->btkrd", probs, v).reshape(B, T, H, Dh)
         else:
-            if KV != H:  # GQA: repeat kv heads
-                rep = H // KV
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            # GQA k/v pass through un-repeated — both mha implementations
+            # handle head grouping internally (flash kernel maps q head h to
+            # kv head h // rep in its index maps; no rep× HBM traffic).
             bias = None
             if cfg.sliding_window:
                 # Mistral-style local window (sliding_window keys back)

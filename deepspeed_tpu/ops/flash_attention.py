@@ -3,23 +3,36 @@
 ``mha`` is the framework-wide attention entry point (the analog of the
 reference's fused attention kernels, ``csrc/transformer/inference/csrc/softmax.cu``
 and the blocked_flash kernel family): callers always go through here, and the
-best implementation for the backend is selected — a Pallas TPU flash-attention
-kernel when on TPU, else the XLA einsum path (which XLA fuses well on its own).
-"""
+best implementation for the backend is selected — the Pallas TPU
+flash-attention kernel (``ops/pallas/flash_attention.py``) when on TPU and the
+shapes are tileable, else the XLA einsum path (which XLA fuses well on its
+own). Fallbacks are logged once per call-shape so a missing fast path is never
+silent.
 
-import functools
+Grouped-query attention is first-class: k/v may carry fewer heads than q
+(H % KV == 0) and both implementations handle the head grouping internally —
+no caller-side ``jnp.repeat`` (which would materialize rep× K/V HBM traffic).
+"""
 
 import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder
+from deepspeed_tpu.utils.logging import logger
 
 NEG_INF = -1e9  # large finite; -inf breaks softmax rows that are fully masked
 
+_warned_shapes = set()
+
 
 def mha_reference(q, k, v, bias=None, causal=True, softmax_scale=None):
-    """Plain XLA attention. Shapes: q,k,v [B, T, H, Dh] -> [B, T, H, Dh]."""
-    *_, T, H, Dh = q.shape
+    """Plain XLA attention. q [B,Tq,H,Dh]; k/v [B,Tk,KV,Dh] -> [B,Tq,H,Dh]."""
+    *_, H, Dh = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = softmax_scale if softmax_scale is not None else 1.0 / (Dh ** 0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if bias is not None:
@@ -33,8 +46,20 @@ def mha_reference(q, k, v, bias=None, causal=True, softmax_scale=None):
 
 
 def mha(q, k, v, bias=None, causal=True, softmax_scale=None):
-    impl = FlashAttnBuilder().load()
-    return impl(q, k, v, bias=bias, causal=causal, softmax_scale=softmax_scale)
+    builder = FlashAttnBuilder()
+    if builder.is_compatible():
+        from deepspeed_tpu.ops.pallas import flash_attention as fa
+        reason = fa.unsupported_reason(q.shape, k.shape,
+                                       None if bias is None else bias.shape)
+        if reason is None:
+            return fa.flash_mha(q, k, v, bias=bias, causal=causal,
+                                softmax_scale=softmax_scale)
+        key = (q.shape, k.shape, None if bias is None else bias.shape)
+        if key not in _warned_shapes:
+            _warned_shapes.add(key)
+            logger.warning(f"flash_attn: {reason}; using XLA fallback")
+    return mha_reference(q, k, v, bias=bias, causal=causal,
+                         softmax_scale=softmax_scale)
 
 
 @register_op_builder
@@ -50,4 +75,6 @@ class FlashAttnBuilder(OpBuilder):
             from deepspeed_tpu.ops.pallas.flash_attention import flash_mha
             return flash_mha
         except Exception:
+            # jax/libtpu version skew can surface as RuntimeError/AttributeError
+            # from the pallas import, not just ImportError — fall back either way
             return None

@@ -1,0 +1,410 @@
+"""Pallas TPU flash attention (fwd + bwd), the framework's core fast kernel.
+
+Capability analog of the reference's fused attention kernels
+(``csrc/transformer/inference/csrc/softmax.cu`` and the blocked_flash family
+under ``deepspeed/inference/v2/kernels/ragged_ops/blocked_flash/``), designed
+TPU-first rather than translated: a 4D grid ``(batch, head, q_block, k_block)``
+with the k dimension innermost so Mosaic double-buffers K/V block DMAs while
+the MXU works, online-softmax state (running max / sum / accumulator) carried
+in VMEM scratch across the k iterations, and causal blocks above the diagonal
+skipped entirely.
+
+Features: causal masking, additive bias (broadcast over batch/head dims),
+grouped-query attention (q heads share k/v heads in-kernel — no HBM-side
+``jnp.repeat``), softmax scale, custom VJP with flash backward kernels.
+
+Layout: q [B, Tq, H, Dh], k/v [B, Tk, KV, Dh] with H % KV == 0; output
+[B, Tq, H, Dh] (same as ``ops.flash_attention.mha_reference``).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9  # finite: -inf poisons fully-masked softmax rows
+
+LANES = 128  # TPU lane width; m/l scratch rows are broadcast across lanes
+
+
+def _largest_divisor(n, candidates):
+    for c in candidates:
+        if n % c == 0:
+            return c
+    return None
+
+
+def _pick_blocks(tq, tk):
+    bq = _largest_divisor(tq, (512, 256, 128))
+    bk = _largest_divisor(tk, (512, 256, 128))
+    return bq, bk
+
+
+def unsupported_reason(q_shape, k_shape, bias_shape=None):
+    """None if the kernel can handle these shapes, else a human reason."""
+    if len(q_shape) != 4 or len(k_shape) != 4:
+        return f"expected 4D [B,T,H,Dh] tensors, got q={q_shape} k={k_shape}"
+    B, tq, H, dh = q_shape
+    _, tk, kv, _ = k_shape
+    if kv == 0 or H % kv != 0:
+        return f"q heads {H} not a multiple of kv heads {kv}"
+    if dh > 256:
+        return f"head dim {dh} > 256"
+    bq, bk = _pick_blocks(tq, tk)
+    if bq is None or bk is None:
+        return f"seq lens (q={tq}, k={tk}) not multiples of 128"
+    if bias_shape is not None:
+        if len(bias_shape) != 4:
+            return f"bias must be 4D [B|1, H|1, Tq, Tk], got {bias_shape}"
+        bb, bh, btq, btk = bias_shape
+        if (btq, btk) != (tq, tk) or bb not in (1, B) or bh not in (1, H):
+            return (f"bias {bias_shape} not broadcastable to "
+                    f"[{B}|1, {H}|1, {tq}, {tk}]")
+    return None
+
+
+def is_supported(q_shape, k_shape, bias_shape=None):
+    """Whether the kernel can handle these shapes (else callers fall back)."""
+    return unsupported_reason(q_shape, k_shape, bias_shape) is None
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, causal, scale, bq, bk, nk, off):
+    # ``off = Tk - Tq``: causal masking is bottom-right aligned (query i sees
+    # keys j <= i + off), matching mha_reference's tril offset for Tq != Tk.
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # whole block above the causal diagonal -> nothing visible, skip
+    should_run = (iq * bq + bq - 1 + off >= ik * bk) if causal else (ik >= 0)
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0, 0]                                   # [bq, dh]
+        k = k_ref[0, 0]                                   # [bk, dh]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * scale                                     # [bq, bk]
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                             # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                            # [bq, bk] f32
+        l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_cur, l_scr.shape)
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0, 0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30)))
+
+
+def _bias_spec(bias, bq, bk, H):
+    """BlockSpec for a [1|B, 1|H, Tq, Tk] additive bias."""
+    bb, bh = bias.shape[0], bias.shape[1]
+
+    def index(b, h, iq, ik):
+        return (b if bb > 1 else 0, h if bh > 1 else 0, iq, ik)
+
+    return pl.BlockSpec((1, 1, bq, bk), index)
+
+
+def _fwd(q, k, v, bias, causal, scale, interpret):
+    B, tq, H, dh = q.shape
+    _, tk, KV, _ = k.shape
+    rep = H // KV
+    bq, bk = _pick_blocks(tq, tk)
+    nq, nk = tq // bq, tk // bk
+
+    # [B, T, H, Dh] -> [B, H, T, Dh] so (T, Dh) are the tiled minor dims
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    body = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                             bq=bq, bk=bk, nk=nk, off=tk - tq)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0)),
+        pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0)),
+    ]
+    args = [qt, kt, vt]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias, bq, bk, H))
+        args.append(bias)
+        kernel = body
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m, l, acc):
+            body(q_ref, k_ref, v_ref, None, o_ref, lse_ref, m, l, acc)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, iq, ik: (b, h, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, tq, dh), q.dtype),
+            jax.ShapeDtypeStruct((B, H, tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+                   dq_ref, dq_scr, *, causal, scale, bq, bk, nk, off):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    should_run = (iq * bq + bq - 1 + off >= ik * bk) if causal else (ik >= 0)
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
+        lse = lse_ref[0, 0][:, None]                      # [bq, 1]
+        p = jnp.exp(s - lse)                              # [bq, bk]
+        do = do_ref[0, 0].astype(jnp.float32)             # [bq, dh]
+        dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, 0][:, None]
+        ds = p * (dp - delta) * scale                     # [bq, bk]
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale, bq, bk, nq, off):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    should_run = (iq * bq + bq - 1 + off >= ik * bk) if causal else (iq >= 0)
+
+    @pl.when(should_run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
+        lse = lse_ref[0, 0][:, None]
+        p = jnp.exp(s - lse)                              # [bq, bk]
+        do = do_ref[0, 0].astype(jnp.float32)
+        # dV += P^T @ dO
+        dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0, 0][:, None]
+        ds = p * (dp - delta) * scale
+        # dK += dS^T @ Q
+        dk_scr[...] += jax.lax.dot_general(ds, q.astype(jnp.float32),
+                                           (((0,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, interpret, res, g):
+    q, k, v, bias, out, lse = res
+    B, tq, H, dh = q.shape
+    _, tk, KV, _ = k.shape
+    rep = H // KV
+    bq, bk = _pick_blocks(tq, tk)
+    nq, nk = tq // bq, tk // bk
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = g.transpose(0, 2, 1, 3)
+    ot = out.transpose(0, 2, 1, 3)
+
+    # delta_i = rowsum(dO_i * O_i) — cheap in XLA, feeds both bwd kernels
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+
+    qspec = pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0))
+    kspec = pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0))
+    dospec = qspec
+    lspec = pl.BlockSpec((1, 1, bq), lambda b, h, iq, ik: (b, h, iq))
+    common = [qt, kt, vt, dot, lse, delta]
+
+    def specs_with_bias(base, order):
+        sp = list(base)
+        args = list(common)
+        if bias is not None:
+            bb, bh = bias.shape[0], bias.shape[1]
+
+            def index(b, h, i, j):
+                iq, ik = (i, j) if order == "qk" else (j, i)
+                return (b if bb > 1 else 0, h if bh > 1 else 0, iq, ik)
+
+            sp.append(pl.BlockSpec((1, 1, bq, bk), index))
+            args.append(bias)
+        return sp, args
+
+    # dQ: grid (B, H, nq, nk), k innermost
+    dq_specs, dq_args = specs_with_bias([qspec, kspec, kspec, dospec, lspec, lspec], "qk")
+    dq_body = functools.partial(
+        _bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk,
+        off=tk - tq)
+    if bias is None:
+        def dq_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r, scr):
+            dq_body(q_r, k_r, v_r, do_r, lse_r, dl_r, None, dq_r, scr)
+    else:
+        dq_kernel = dq_body
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, nq, nk),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, tq, dh), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
+        interpret=interpret,
+    )(*dq_args)
+
+    # dK/dV: grid (B, H, nk, nq), q innermost; per-q-head results, GQA head
+    # groups summed afterwards in XLA (rep is 1 for MHA so this is free there)
+    kspec2 = pl.BlockSpec((1, 1, bk, dh), lambda b, h, ik, iq: (b, h // rep, ik, 0))
+    qspec2 = pl.BlockSpec((1, 1, bq, dh), lambda b, h, ik, iq: (b, h, iq, 0))
+    lspec2 = pl.BlockSpec((1, 1, bq), lambda b, h, ik, iq: (b, h, iq))
+    dkv_specs, dkv_args = specs_with_bias(
+        [qspec2, kspec2, kspec2, qspec2, lspec2, lspec2], "kq")
+    dkv_body = functools.partial(
+        _bwd_dkv_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nq=nq,
+        off=tk - tq)
+    if bias is None:
+        def dkv_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r, dks, dvs):
+            dkv_body(q_r, k_r, v_r, do_r, lse_r, dl_r, None, dk_r, dv_r, dks, dvs)
+    else:
+        dkv_kernel = dkv_body
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, nk, nq),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, dh), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, dh), lambda b, h, ik, iq: (b, h, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, tk, dh), k.dtype),
+            jax.ShapeDtypeStruct((B, H, tk, dh), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, dh), jnp.float32),
+            pltpu.VMEM((bk, dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*dkv_args)
+
+    if rep > 1:
+        dk = dk.reshape(B, KV, rep, tk, dh).sum(axis=2)
+        dv = dv.reshape(B, KV, rep, tk, dh).sum(axis=2)
+
+    dq = dq.transpose(0, 2, 1, 3)
+    dk = dk.transpose(0, 2, 1, 3)
+    dv = dv.transpose(0, 2, 1, 3)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, bias, causal, scale, interpret):
+    out, _ = _fwd(q, k, v, bias, causal, scale, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, causal, scale, interpret):
+    out, lse = _fwd(q, k, v, bias, causal, scale, interpret)
+    return out, (q, k, v, bias, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_mha(q, k, v, bias=None, causal=True, softmax_scale=None,
+              interpret=False):
+    """Flash attention. q [B,Tq,H,Dh]; k/v [B,Tk,KV,Dh], H % KV == 0.
+
+    Raises ValueError on unsupported shapes — callers (the op registry) are
+    expected to gate on :func:`is_supported` and fall back to the XLA path.
+    The additive ``bias`` is treated as a constant (zero cotangent): every
+    in-tree caller passes masks built from positions, never learned tensors.
+    """
+    if not is_supported(q.shape, k.shape, None if bias is None else bias.shape):
+        raise ValueError(
+            f"flash_mha: unsupported shapes q={q.shape} k={k.shape} "
+            f"bias={None if bias is None else bias.shape}")
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    return _flash(q, k, v, bias, causal, float(scale), interpret)

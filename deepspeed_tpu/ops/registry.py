@@ -21,6 +21,8 @@ class OpBuilder:
     def __init__(self):
         self._loaded = None
 
+    _warned_fallback = set()
+
     def is_compatible(self, verbose=False):
         try:
             import jax
@@ -28,7 +30,16 @@ class OpBuilder:
         except Exception:
             return False
         ok = self.pallas_available() and plat in ("tpu", "axon")
-        if verbose and not ok:
+        has_pallas_slot = type(self).pallas_impl is not OpBuilder.pallas_impl
+        if (not ok and plat in ("tpu", "axon") and has_pallas_slot
+                and self.NAME not in OpBuilder._warned_fallback):
+            # A builder that declares a Pallas slot but can't load it on TPU is
+            # a performance bug — say so loudly. Builders whose pure-XLA path
+            # IS the implementation (fused optimizers etc.) stay quiet.
+            OpBuilder._warned_fallback.add(self.NAME)
+            logger.warning(f"op {self.NAME}: Pallas kernel failed to load on TPU; "
+                           f"falling back to pure-XLA implementation")
+        elif verbose and not ok:
             logger.info(f"op {self.NAME}: falling back to pure-XLA implementation")
         return ok
 

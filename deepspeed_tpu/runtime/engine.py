@@ -456,11 +456,28 @@ class DeepSpeedEngine:
     def _ensure_initialized(self, batch):
         if self.state is not None:
             return
+        self.init_params(batch)
+
+    def init_params(self, sample_batch, rng=None):
+        """Sharded (partition-at-construction) initialization — the ``zero.Init``
+        analog (reference ``zero/partition_parameters.py:783``). The model's
+        init is shape-evaluated abstractly, shardings are derived from the
+        partitioner, and the real init runs under jit with those out_shardings
+        so parameters are born sharded: no device ever holds the full tree."""
         if not (hasattr(self.module, "init")):
             raise ValueError("model_parameters required for non-flax models")
-        key = jax.random.PRNGKey(self._rng_seed if isinstance(self._rng_seed, int) else 0)
-        variables = self.module.init(key, batch)
-        self._init_state(variables["params"])
+        from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+        from deepspeed_tpu.runtime.zero.sharded_init import (abstract_params,
+                                                             materialize_sharded)
+        if rng is None:
+            rng = jax.random.PRNGKey(
+                self._rng_seed if isinstance(self._rng_seed, int) else 0)
+        abstract = abstract_params(self.module, sample_batch, rng)
+        partitioner = ZeroPartitioner(self.topology, self.config.zero_config,
+                                      param_specs=self._resolve_param_specs(abstract))
+        params = materialize_sharded(self.module, sample_batch, partitioner, rng,
+                                     abstract=abstract)
+        self._init_state(params)
 
     # ------------------------------------------------------------------
     # qwZ working-weight quantization (ZeRO++; ops/quantizer.py)

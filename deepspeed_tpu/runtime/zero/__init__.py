@@ -1,0 +1,10 @@
+"""ZeRO — sharding-based partitioning of optimizer state, gradients, and
+parameters (reference ``deepspeed/runtime/zero/``).
+
+The public surface mirrors ``deepspeed.zero``: :class:`Init` for
+partition-at-construction model initialization (reference
+``partition_parameters.py:783``), with the partitioning rules themselves in
+:mod:`deepspeed_tpu.runtime.zero.partition`.
+"""
+
+from deepspeed_tpu.runtime.zero.sharded_init import Init  # noqa: F401

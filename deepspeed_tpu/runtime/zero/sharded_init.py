@@ -1,0 +1,99 @@
+"""Sharded (partition-at-construction) parameter initialization — the
+``zero.Init`` analog.
+
+The reference's ``zero.Init`` (``runtime/zero/partition_parameters.py:783``)
+monkey-patches ``nn.Module.__init__`` so every parameter is partitioned the
+moment it is constructed, letting models larger than one device be built at
+all. The TPU-native equivalent needs no patching: flax initialization is
+already lazy, so we
+
+  1. ``jax.eval_shape`` the model's init to get the abstract parameter tree
+     (zero bytes allocated),
+  2. derive the ZeRO + model-parallel shardings from the abstract tree via
+     :class:`~deepspeed_tpu.runtime.zero.partition.ZeroPartitioner`,
+  3. run the real init under ``jax.jit`` with those ``out_shardings`` —
+     XLA materializes every parameter directly into its shard; no device
+     (and no host) ever holds the full tree.
+
+Used automatically by ``DeepSpeedEngine`` when ``model_parameters`` is omitted,
+and available standalone as :func:`materialize_sharded` (e.g. to build the
+param tree before constructing an engine). ``Init`` is the context-manager
+spelling for reference API parity.
+"""
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def abstract_params(model, sample_batch, rng=None):
+    """Shape-evaluate a flax model's parameter tree without allocating it."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(lambda r: model.init(r, sample_batch), rng)
+    return shapes["params"]
+
+
+def materialize_sharded(model, sample_batch, partitioner, rng=None,
+                        abstract=None):
+    """Initialize ``model``'s parameters born-sharded per ``partitioner``.
+
+    Returns the fp32 parameter tree laid out with the partitioner's *master*
+    sharding (the stage>=1 fully-sharded layout), so no device holds more
+    than its shard at any point during initialization. Pass ``abstract`` (a
+    precomputed :func:`abstract_params` tree) to skip re-tracing the init.
+    """
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if abstract is None:
+        abstract = abstract_params(model, sample_batch, rng)
+    master_sh = partitioner.master_sharding(abstract)
+
+    init_fn = jax.jit(lambda r, b: model.init(r, b)["params"],
+                      out_shardings=master_sh)
+    params = init_fn(rng, sample_batch)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    log_dist(f"zero.Init: materialized {n/1e6:.2f}M params sharded "
+             f"(stage {partitioner.stage}, world {partitioner.zero_world})",
+             ranks=[0])
+    return params
+
+
+class Init:
+    """Context-manager spelling for reference API parity
+    (``deepspeed.zero.Init``). Construction in JAX/flax allocates nothing, so
+    the context only captures the config/mesh used by
+    :meth:`materialize` afterwards::
+
+        with zero.Init(config=ds_config, mesh=topology) as zinit:
+            model = LlamaForCausalLM(cfg)          # lazy — no allocation
+        params = zinit.materialize(model, sample_batch)
+    """
+
+    def __init__(self, config=None, mesh=None, rng=None):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from deepspeed_tpu.parallel.topology import MeshTopology
+        self.config = config if isinstance(config, DeepSpeedConfig) \
+            else DeepSpeedConfig(config or {})
+        if mesh is not None and not isinstance(mesh, MeshTopology):
+            raise ValueError("pass a deepspeed_tpu.parallel.topology.MeshTopology")
+        self.topology = mesh if mesh is not None else MeshTopology()
+        self.rng = rng
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def materialize(self, model, sample_batch):
+        from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+        abstract = abstract_params(model, sample_batch, self.rng)
+        specs = None
+        if hasattr(model, "param_specs"):
+            try:
+                specs = model.param_specs(abstract)
+            except Exception:
+                specs = None
+        partitioner = ZeroPartitioner(self.topology, self.config.zero_config,
+                                      param_specs=specs)
+        return materialize_sharded(model, sample_batch, partitioner, self.rng,
+                                   abstract=abstract)

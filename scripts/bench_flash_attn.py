@@ -1,0 +1,81 @@
+"""Microbench: Pallas flash attention vs XLA einsum attention on the TPU chip.
+
+Run standalone (one TPU job at a time — the chip is exclusive):
+    python scripts/bench_flash_attn.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.flash_attention import mha_reference
+from deepspeed_tpu.ops.pallas.flash_attention import flash_mha
+
+
+def bench(f, *args, n=20):
+    jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = f(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n * 1000
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    B, T, H, Dh = 16, 1024, 12, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, T, H, Dh), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, T, H, Dh), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, T, H, Dh), jnp.bfloat16)
+
+    f_flash = jax.jit(lambda q, k, v: flash_mha(q, k, v, causal=True))
+    f_ref = jax.jit(lambda q, k, v: mha_reference(q, k, v, causal=True))
+    print("compiling flash fwd...", flush=True)
+    o1 = jax.block_until_ready(f_flash(q, k, v))
+    print("compiling ref fwd...", flush=True)
+    o2 = jax.block_until_ready(f_ref(q, k, v))
+    err = jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32)))
+    print("fwd max err:", float(err), flush=True)
+
+    def loss_f(q, k, v):
+        return jnp.sum(flash_mha(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    g_flash = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))
+    g_ref = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))
+    print("compiling flash bwd...", flush=True)
+    gf = jax.block_until_ready(g_flash(q, k, v))
+    print("compiling ref bwd...", flush=True)
+    gr = jax.block_until_ready(g_ref(q, k, v))
+    for name, a, b in zip("qkv", gf, gr):
+        e = jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+        m = jnp.max(jnp.abs(b.astype(jnp.float32)))
+        print(f"d{name} max abs err: {float(e):.4f} (max |ref| {float(m):.1f})", flush=True)
+
+    print(f"fwd    flash {bench(f_flash, q, k, v):.2f}ms  ref {bench(f_ref, q, k, v):.2f}ms", flush=True)
+    print(f"fwdbwd flash {bench(g_flash, q, k, v):.2f}ms  ref {bench(g_ref, q, k, v):.2f}ms", flush=True)
+
+    # long-context leg: 4k sequence, GQA 4:1 — where flash matters most
+    B2, T2, H2, KV2, Dh2 = 2, 4096, 16, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q2 = jax.random.normal(ks[0], (B2, T2, H2, Dh2), jnp.bfloat16)
+    k2 = jax.random.normal(ks[1], (B2, T2, KV2, Dh2), jnp.bfloat16)
+    v2 = jax.random.normal(ks[2], (B2, T2, KV2, Dh2), jnp.bfloat16)
+    f2 = jax.jit(lambda q, k, v: flash_mha(q, k, v, causal=True))
+    r2 = jax.jit(lambda q, k, v: mha_reference(q, k, v, causal=True))
+    print("compiling 4k...", flush=True)
+    e2 = jnp.max(jnp.abs(jax.block_until_ready(f2(q2, k2, v2)).astype(jnp.float32)
+                         - r2(q2, k2, v2).astype(jnp.float32)))
+    print(f"4k GQA fwd max err: {float(e2)}", flush=True)
+    print(f"4k GQA fwd flash {bench(f2, q2, k2, v2):.2f}ms  ref {bench(r2, q2, k2, v2):.2f}ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Numerics tests for the Pallas flash-attention kernel vs the XLA reference.
+
+Runs in Pallas interpret mode on the CPU mesh (the kernel itself is exercised
+compiled on real TPU by bench.py); mirrors the reference's per-kernel numerics
+tests under ``tests/unit/ops/``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.flash_attention import mha_reference
+from deepspeed_tpu.ops.pallas.flash_attention import flash_mha, is_supported
+
+
+def make_qkv(B=2, T=256, H=4, KV=None, Dh=64, dtype=jnp.float32, seed=0):
+    KV = KV or H
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, T, H, Dh), dtype)
+    k = jax.random.normal(ks[1], (B, T, KV, Dh), dtype)
+    v = jax.random.normal(ks[2], (B, T, KV, Dh), dtype)
+    return q, k, v
+
+
+def assert_close(a, b, atol=2e-3):
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32), atol=atol, rtol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = make_qkv()
+    out = flash_mha(q, k, v, causal=causal, interpret=True)
+    ref = mha_reference(q, k, v, causal=causal)
+    assert_close(out, ref)
+
+
+def test_forward_gqa():
+    q, k, v = make_qkv(H=8, KV=2)
+    out = flash_mha(q, k, v, causal=True, interpret=True)
+    ref = mha_reference(q, k, v, causal=True)
+    assert_close(out, ref)
+
+
+def test_forward_bias_broadcast():
+    B, T, H = 2, 256, 4
+    q, k, v = make_qkv(B=B, T=T, H=H)
+    # [1, 1, T, T] sliding-window-style mask bias (the llama/mistral shape)
+    pos = jnp.arange(T)
+    near = (pos[:, None] - pos[None, :]) < 64
+    bias = jnp.where(near, 0.0, -1e9)[None, None]
+    out = flash_mha(q, k, v, bias=bias, causal=True, interpret=True)
+    ref = mha_reference(q, k, v, bias=bias, causal=True)
+    assert_close(out, ref)
+
+
+def test_forward_bias_full_batch_head():
+    B, T, H = 2, 128, 4
+    q, k, v = make_qkv(B=B, T=T, H=H)
+    bias = jax.random.normal(jax.random.PRNGKey(7), (B, H, T, T)) * 0.5
+    out = flash_mha(q, k, v, bias=bias, causal=False, interpret=True)
+    ref = mha_reference(q, k, v, bias=bias, causal=False)
+    assert_close(out, ref)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_rectangular(causal):
+    # Tq != Tk: causal must be bottom-right aligned (tril offset Tk-Tq),
+    # matching mha_reference — the chunked-prefill / cross-attention shape
+    B, H, Dh = 2, 4, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, 128, H, Dh))
+    k = jax.random.normal(ks[1], (B, 384, H, Dh))
+    v = jax.random.normal(ks[2], (B, 384, H, Dh))
+    out = flash_mha(q, k, v, causal=causal, interpret=True)
+    ref = mha_reference(q, k, v, causal=causal)
+    assert_close(out, ref)
+
+
+def test_gradients_rectangular_causal():
+    B, H, Dh = 1, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, 128, H, Dh))
+    k = jax.random.normal(ks[1], (B, 256, H, Dh))
+    v = jax.random.normal(ks[2], (B, 256, H, Dh))
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        flash_mha(q, k, v, causal=True, interpret=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        mha_reference(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert_close(a, b, atol=5e-3)
+
+
+def test_softmax_scale():
+    q, k, v = make_qkv(T=128)
+    out = flash_mha(q, k, v, causal=True, softmax_scale=0.25, interpret=True)
+    ref = mha_reference(q, k, v, causal=True, softmax_scale=0.25)
+    assert_close(out, ref)
+
+
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_gradients_match_reference(kv_heads):
+    q, k, v = make_qkv(B=1, T=128, H=4, KV=kv_heads)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_mha(q, k, v, causal=True, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert_close(a, b, atol=5e-3)
+
+
+def test_gradients_with_bias():
+    q, k, v = make_qkv(B=1, T=128, H=2)
+    pos = jnp.arange(128)
+    bias = jnp.where((pos[:, None] - pos[None, :]) < 32, 0.0, -1e9)[None, None]
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_mha(q, k, v, bias=bias, causal=True, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, bias=bias, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert_close(a, b, atol=5e-3)
+
+
+def test_bf16_tolerances():
+    q, k, v = make_qkv(T=256, dtype=jnp.bfloat16)
+    out = flash_mha(q, k, v, causal=True, interpret=True)
+    ref = mha_reference(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    assert_close(out, ref, atol=2e-2)
+
+
+def test_is_supported_gating():
+    assert is_supported((2, 256, 4, 64), (2, 256, 4, 64))
+    assert is_supported((2, 256, 8, 64), (2, 256, 2, 64))        # GQA
+    assert not is_supported((2, 100, 4, 64), (2, 100, 4, 64))    # not tileable
+    assert not is_supported((2, 256, 3, 64), (2, 256, 2, 64))    # H % KV != 0
+    assert not is_supported((2, 256, 4, 512), (2, 256, 4, 512))  # Dh too big
+    assert is_supported((2, 256, 4, 64), (2, 256, 4, 64), (1, 1, 256, 256))
+    assert not is_supported((2, 256, 4, 64), (2, 256, 4, 64), (3, 1, 256, 256))
+
+
+def test_mha_entry_point_falls_back_on_cpu():
+    # on the CPU test mesh the builder is incompatible -> reference path
+    from deepspeed_tpu.ops.flash_attention import mha
+    q, k, v = make_qkv(T=64)
+    out = mha(q, k, v, causal=True)
+    assert_close(out, mha_reference(q, k, v, causal=True))
