@@ -55,6 +55,24 @@ def quantized_all_gather(x, axis_name="dp", num_bits=8, group_size=2048,
     return parts.reshape((parts.shape[0] * x.shape[0],) + x.shape[1:])
 
 
+def exchange_reduce(blocks, axis, bits, group_size=2048):
+    """Quantized all-to-all + local reduce: the qgZ exchange primitive.
+
+    ``blocks``: [peers, m] — row j is this rank's payload destined for peer j.
+    Each row is groupwise-quantized to ``bits``, exchanged over ``axis``
+    (row j -> peer j), dequantized, and summed: returns this rank's [m]
+    partial sum over the ``axis`` group."""
+    qfn = jax.vmap(lambda row: quantize(row, num_bits=bits,
+                                        group_size=group_size))
+    q, s = qfn(blocks)
+    qx = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    sx = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
+    m = blocks.shape[1]
+    deq = jax.vmap(lambda qi, si: dequantize(qi, si, (m,), num_bits=bits,
+                                             group_size=group_size))
+    return deq(qx, sx).sum(axis=0)  # [m]
+
+
 def all_to_all_quant_reduce(x, intra_axis="dp", inter_axis=None,
                             intra_bits=4, inter_bits=8, group_size=2048,
                             dtype=jnp.float32):
@@ -67,19 +85,6 @@ def all_to_all_quant_reduce(x, intra_axis="dp", inter_axis=None,
     is given) repeats with int8 across ``inter_axis`` (DCN). Cross-DCN bytes
     are inter_bits/32 of an fp32 reduce-scatter."""
 
-    def exchange_reduce(blocks, axis, bits):
-        # blocks: [peers, m] — row j is the payload destined for peer j
-        qfn = jax.vmap(lambda row: quantize(row, num_bits=bits,
-                                            group_size=group_size))
-        q, s = qfn(blocks)
-        # send row j to peer j; receive one row from each peer
-        qx = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
-        sx = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
-        m = blocks.shape[1]
-        deq = jax.vmap(lambda qi, si: dequantize(qi, si, (m,), num_bits=bits,
-                                                 group_size=group_size))
-        return deq(qx, sx).sum(axis=0)  # [m]
-
     intra = lax.axis_size(intra_axis)
     inter = lax.axis_size(inter_axis) if inter_axis else 1
     world = intra * inter
@@ -91,9 +96,9 @@ def all_to_all_quant_reduce(x, intra_axis="dp", inter_axis=None,
 
     # stage 1 (ICI): each intra-peer block carries all its inter-shards
     partial = exchange_reduce(flat.reshape(intra, inter * shard),
-                              intra_axis, intra_bits)
+                              intra_axis, intra_bits, group_size)
     if inter == 1:
         return partial.astype(dtype)
     # stage 2 (DCN): exchange the partial sums' inter-blocks
     return exchange_reduce(partial.reshape(inter, shard),
-                           inter_axis, inter_bits).astype(dtype)
+                           inter_axis, inter_bits, group_size).astype(dtype)

@@ -152,6 +152,48 @@ class MoEConfig(DeepSpeedConfigModel):
     use_residual = False
 
 
+# Every key DeepSpeedConfig understands at the top level. A key outside this
+# set is a config bug (e.g. the classic "zero_optimisation" typo silently
+# training at stage 0) and raises — the reference's config system similarly
+# validates via pydantic models (``runtime/config_utils.py``).
+KNOWN_TOP_LEVEL_KEYS = {
+    C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+    C.GRADIENT_ACCUMULATION_STEPS, C.STEPS_PER_PRINT, C.WALL_CLOCK_BREAKDOWN,
+    C.DUMP_STATE, C.GRADIENT_CLIPPING, C.PRESCALE_GRADIENTS,
+    C.GRADIENT_PREDIVIDE_FACTOR, C.SPARSE_GRADIENTS, C.OPTIMIZER, C.SCHEDULER,
+    C.FP16, C.BF16, C.DATA_TYPES, C.ZERO_OPTIMIZATION,
+    C.ACTIVATION_CHECKPOINTING, C.PIPELINE, C.TENSOR_PARALLEL,
+    C.SEQUENCE_PARALLEL_SIZE, C.EXPERT_PARALLEL_SIZE, C.COMMS_LOGGER,
+    C.MONITOR_TENSORBOARD, C.MONITOR_CSV, C.MONITOR_WANDB, C.FLOPS_PROFILER,
+    C.ELASTICITY, C.AUTOTUNING, C.CHECKPOINT, C.COMPILE,
+    "moe", "seed", "hybrid_engine", "curriculum_learning", "data_efficiency",
+    "compression_training", "eigenvalue", "progressive_layer_drop",
+}
+
+# Reference keys that are accepted but have no TPU effect (the GPU-side
+# machinery they control is subsumed by XLA); they log once instead of raising.
+INERT_TOP_LEVEL_KEYS = {
+    "zero_allow_untested_optimizer", "communication_data_type",
+    "seq_parallel_communication_data_type", "memory_breakdown",
+    "dataloader_drop_last", "amp", "aio", "use_node_local_storage",
+    # further reference keys common in shipped HF/DeepSpeed example configs
+    # whose GPU-side machinery XLA subsumes — accepted, logged, inert
+    "zero_force_ds_cpu_optimizer", "sparse_attention", "timers",
+    "gradient_noise_scale", "sparse_gradients_enabled", "fp8",
+}
+
+# Renamed/retired keys (reference pydantic ``deprecated``/``new_param`` field
+# metadata, ``config_utils.py``): old key -> replacement hint.
+DEPRECATED_TOP_LEVEL_KEYS = {
+    "cpu_offload": "zero_optimization.offload_optimizer",
+    "cpu_offload_params": "zero_optimization.offload_param",
+    "scheduler_params": "scheduler.params",
+    "disable_allgather": None,
+}
+
+AUTO = "auto"
+
+
 class DeepSpeedConfig:
 
     def __init__(self, config, mpu=None, mesh_topology=None):
@@ -167,18 +209,46 @@ class DeepSpeedConfig:
         else:
             raise ValueError(f"Expected dict or path for config, got {type(config)}")
         self.mesh_topology = mesh_topology
+        self._validate_top_level_keys(self._param_dict)
         self._initialize_params(self._param_dict)
         self._do_sanity_check()
 
+    def _validate_top_level_keys(self, pd):
+        import difflib
+        for key in pd:
+            if key in KNOWN_TOP_LEVEL_KEYS:
+                continue
+            if key in INERT_TOP_LEVEL_KEYS:
+                logger.info(f"config key '{key}' accepted but has no effect on TPU")
+                continue
+            if key in DEPRECATED_TOP_LEVEL_KEYS:
+                new = DEPRECATED_TOP_LEVEL_KEYS[key]
+                hint = f"; use '{new}'" if new else " and has no replacement"
+                logger.warning(f"config key '{key}' is deprecated{hint}")
+                continue
+            close = difflib.get_close_matches(
+                key, KNOWN_TOP_LEVEL_KEYS | INERT_TOP_LEVEL_KEYS, n=1)
+            hint = f" (did you mean '{close[0]}'?)" if close else ""
+            raise ValueError(f"Unknown top-level config key '{key}'{hint}. "
+                             f"Valid keys: {sorted(KNOWN_TOP_LEVEL_KEYS)}")
+
+    @staticmethod
+    def _auto(pd, name, default):
+        """Scalar lookup with HF-style "auto" support: "auto" means "derive it"
+        and resolves to the default (for the batch triple, to None so
+        ``resolve_batch_params`` fills it from the other two)."""
+        v = get_scalar_param(pd, name, default)
+        return default if v == AUTO else v
+
     # mirrors reference config.py:798 _initialize_params
     def _initialize_params(self, pd):
-        self.train_batch_size = get_scalar_param(pd, C.TRAIN_BATCH_SIZE, None)
-        self.train_micro_batch_size_per_gpu = get_scalar_param(pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, None)
-        self.gradient_accumulation_steps = get_scalar_param(pd, C.GRADIENT_ACCUMULATION_STEPS, None)
+        self.train_batch_size = self._auto(pd, C.TRAIN_BATCH_SIZE, None)
+        self.train_micro_batch_size_per_gpu = self._auto(pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, None)
+        self.gradient_accumulation_steps = self._auto(pd, C.GRADIENT_ACCUMULATION_STEPS, None)
         self.steps_per_print = get_scalar_param(pd, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
         self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN, False)
         self.dump_state = get_scalar_param(pd, C.DUMP_STATE, False)
-        self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING, 0.0)
+        self.gradient_clipping = self._auto(pd, C.GRADIENT_CLIPPING, 0.0)
         self.prescale_gradients = get_scalar_param(pd, C.PRESCALE_GRADIENTS, False)
         self.gradient_predivide_factor = get_scalar_param(pd, C.GRADIENT_PREDIVIDE_FACTOR, 1.0)
         self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS, False)

@@ -50,6 +50,10 @@ class DeepSpeedConfigModel:
                 logger.warning(f"Config param {key} is deprecated, use {new}")
                 key = new
             if key in known:
+                if v == "auto":
+                    # HF-style "auto": keep the default (reference "auto"
+                    # values are filled in by the HF integration layer)
+                    continue
                 cur = getattr(self, key)
                 if isinstance(cur, DeepSpeedConfigModel) and isinstance(v, dict):
                     setattr(self, key, type(cur)(v))

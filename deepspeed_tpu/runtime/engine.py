@@ -67,14 +67,47 @@ class StepStats(NamedTuple):
 
 class OptimizerShim:
     """Minimal object with the torch-optimizer surface the reference returns
-    from initialize() — param_groups for LR introspection/HF compat."""
+    from initialize() — param_groups for LR introspection/HF compat.
+
+    ``state_dict``/``load_state_dict`` round-trip the real optimizer state so
+    HF-Trainer-side checkpointing does not silently drop it."""
 
     def __init__(self, engine, base_lr):
         self._engine = engine
         self.param_groups = [{"lr": base_lr}]
 
+    @staticmethod
+    def _fetch(leaf):
+        # multi-host safe: leaves spanning non-addressable devices need the
+        # cross-process gather; device_get alone raises there
+        if getattr(leaf, "is_fully_addressable", True):
+            return jax.device_get(leaf)
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(leaf, tiled=True)
+
     def state_dict(self):
-        return {}
+        st = self._engine.state
+        if st is None:
+            logger.warning("OptimizerShim.state_dict(): engine state not yet "
+                           "initialized; returning empty dict")
+            return {}
+        return {"opt_state": jax.tree.map(self._fetch, st.opt_state),
+                "global_step": int(self._fetch(st.global_step))}
+
+    def load_state_dict(self, sd):
+        if not sd:
+            return
+        st = self._engine.state
+        if st is None:
+            # lazy init (no model_parameters yet): defer and apply at init
+            self._engine._pending_opt_state = sd
+            return
+        opt = jax.tree.map(
+            lambda cur, new: jax.device_put(jnp.asarray(new, cur.dtype), cur.sharding),
+            st.opt_state, sd["opt_state"])
+        gs = jax.device_put(jnp.int32(sd.get("global_step", 0)),
+                            st.global_step.sharding)
+        self._engine.state = st._replace(opt_state=opt, global_step=gs)
 
     def zero_grad(self, set_to_none=True):
         pass  # grads live in the engine's accumulation buffer
@@ -230,6 +263,8 @@ class DeepSpeedEngine:
         self._eval_step_fn = None
         self._offload = None  # ZeRO-Offload host tier (zero/offload.py)
         self.quantized_weights = False  # ZeRO++ qwZ (set in _init_state)
+        self._qgz_plan = None  # ZeRO++ qgZ (set in _init_state, zero/qgz.py)
+        self._pending_opt_state = None  # OptimizerShim.load_state_dict pre-init
         self.flops_profiler = None  # lazy (profiling/flops_profiler)
         self._param_transform = None  # compression hook (compression/compress.py)
         # legacy seqlen curriculum (reference engine.py:1826 curriculum hook)
@@ -312,6 +347,9 @@ class DeepSpeedEngine:
             if self.config.zero_config.zero_quantized_weights:
                 raise ValueError("zero_quantized_weights cannot be combined with "
                                  "offload_optimizer")
+            if self.config.zero_config.zero_quantized_gradients:
+                raise ValueError("zero_quantized_gradients cannot be combined "
+                                 "with offload_optimizer")
             return self._init_state_offload(params_f32)
 
         # ZeRO++ qwZ (reference zero_quantized_weights, zero/config.py:40):
@@ -348,8 +386,24 @@ class DeepSpeedEngine:
         opt_sh = self.partitioner.opt_state_sharding(opt_state, params_f32)
         opt_state = jax.tree.map(jax.device_put, opt_state, opt_sh)
 
-        grad_acc = tree_zeros_like(params_f32, self.grad_accum_dtype)
-        grad_acc = jax.tree.map(jax.device_put, grad_acc, grad_sh)
+        # qgZ (ZeRO++ zero_quantized_gradients, reference stage3.py:1249):
+        # gradients accumulate locally per device in a stacked buffer and are
+        # quantize-reduced at the GAS boundary (zero/qgz.py)
+        self._qgz_plan = None
+        if self.config.zero_config.zero_quantized_gradients:
+            if self.zero_optimization_stage() < 2:
+                raise ValueError("zero_quantized_gradients requires ZeRO stage >= 2 "
+                                 "(gradients must be partitioned)")
+            if self.quantized_weights:
+                raise ValueError("zero_quantized_gradients + zero_quantized_weights "
+                                 "is not supported yet on TPU")
+            from deepspeed_tpu.runtime.zero.qgz import QgzPlan
+            self._qgz_plan = QgzPlan(self.topology, self.partitioner, params_f32)
+            grad_acc = self._qgz_plan.stacked_zeros(params_f32, self.grad_accum_dtype)
+            grad_sh = self._qgz_plan.stacked_shardings(params_f32)
+        else:
+            grad_acc = tree_zeros_like(params_f32, self.grad_accum_dtype)
+            grad_acc = jax.tree.map(jax.device_put, grad_acc, grad_sh)
 
         self._shardings = dict(params=param_sh, master=master_sh, grad=grad_sh, opt=opt_sh)
         rep = self.topology.replicated()
@@ -369,6 +423,9 @@ class DeepSpeedEngine:
         )
         n = count_parameters(params_f32)
         log_dist(f"model parameters: {n/1e6:.2f}M", ranks=[0])
+        if self._pending_opt_state is not None:
+            sd, self._pending_opt_state = self._pending_opt_state, None
+            self.optimizer.load_state_dict(sd)
 
     def _init_state_offload(self, params_f32):
         """ZeRO-Offload/Infinity state layout (zero/offload.py): the offloaded
@@ -544,14 +601,12 @@ class DeepSpeedEngine:
             else (lambda p: p)
         ptx = self._param_transform
 
-        def micro_step(state: TrainState, batch):
-            rng, sub = jax.random.split(state.rng)
-
+        def make_loss_fn(batch, sub, loss_scale, global_step):
             def loss_fn(p):
                 if ptx is not None:
                     # compression transform inside the grad: QAT quant uses
                     # STE, pruning masks the gradient (compression/compress.py)
-                    p = ptx(p, state.global_step)
+                    p = ptx(p, global_step)
                 loss = model_fn(p, batch, sub, True)
                 if isinstance(loss, tuple):
                     loss = loss[0]
@@ -559,11 +614,57 @@ class DeepSpeedEngine:
                 if mult != 1.0:
                     scaled = scaled * mult
                 if fp16:
-                    scaled = scaled * state.scale.loss_scale
+                    scaled = scaled * loss_scale
                 if prescale and predivide != 1.0:
                     scaled = scaled / predivide
                 return scaled, loss
+            return loss_fn
 
+        plan = self._qgz_plan
+        if plan is not None:
+            # qgZ: manual over the ZeRO data axes — per-device local grads
+            # accumulated unreduced in the stacked buffer (zero/qgz.py)
+            def micro_step(state: TrainState, batch):
+                rng, sub = jax.random.split(state.rng)
+
+                def body(params_local, acc_local, batch_local, loss_scale,
+                         key, gstep):
+                    # distinct dropout/noise per data-parallel replica (the
+                    # auto path draws bits over the global batch shape)
+                    idx = jnp.int32(0)
+                    for a in plan.axes:
+                        idx = idx * plan.sizes[a] + jax.lax.axis_index(a)
+                    key = jax.random.fold_in(key, idx)
+                    p = plan.gather_params(params_local)
+                    loss_fn = make_loss_fn(batch_local, key, loss_scale, gstep)
+                    (_, loss), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(p)
+                    new_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(accum_dtype)[None],
+                        acc_local, grads)
+                    return new_acc, loss.astype(jnp.float32).reshape(1)
+
+                from jax.sharding import PartitionSpec as P
+                fn = jax.shard_map(
+                    body, mesh=plan.mesh,
+                    in_specs=(plan.param_in_specs(state.params),
+                              plan.stacked_specs(state.grad_acc, project=True),
+                              P(plan.axes), P(), P(), P()),
+                    out_specs=(plan.stacked_specs(state.grad_acc, project=True),
+                               P(plan.axes)),
+                    axis_names=plan.manual, check_vma=False)
+                new_acc, losses = fn(state.params, state.grad_acc, batch,
+                                     state.scale.loss_scale, sub,
+                                     state.global_step)
+                # equal per-device micro-batch slices -> global mean
+                return state._replace(grad_acc=new_acc, rng=rng), losses.mean()
+
+            return jax.jit(micro_step, donate_argnums=(0,))
+
+        def micro_step(state: TrainState, batch):
+            rng, sub = jax.random.split(state.rng)
+            loss_fn = make_loss_fn(batch, sub, state.scale.loss_scale,
+                                   state.global_step)
             # qwZ: grads are taken w.r.t. the dequantized working weights
             # (XLA gathers the int8 shards, dequantizes at the use site)
             (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -591,13 +692,23 @@ class DeepSpeedEngine:
         quantized = getattr(self, "quantized_weights", False)
         quantize_fn = self._quantize_working
 
+        plan = self._qgz_plan
+
         def apply_step(state: TrainState, lr):
             denom = jnp.float32(gas)
             if fp16:
                 denom = denom * state.scale.loss_scale
             if prescale and predivide != 1.0:
                 denom = denom / jnp.float32(predivide)
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, state.grad_acc)
+            if plan is not None:
+                # qgZ boundary: quantized hierarchical reduction of the stacked
+                # local grads (zero/qgz.py). The sum over the world of local
+                # batch-means is world x the global mean — fold into the denom.
+                summed = plan.reduce(state.grad_acc)
+                qdenom = denom * jnp.float32(plan.world)
+                grads = jax.tree.map(lambda g: g / qdenom, summed)
+            else:
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, state.grad_acc)
 
             overflow = has_overflow(grads) if fp16 else jnp.asarray(False)
             safe_grads = jax.tree.map(lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)

@@ -1,0 +1,211 @@
+"""qgZ — ZeRO++ quantized gradient reduction, wired into the engine grad path.
+
+Reference: ``zero_quantized_gradients`` routes the stage-3 gradient reduction
+through ``all_to_all_quant_reduce`` (``runtime/zero/stage3.py:1249`` →
+``runtime/comm/coalesced_collectives.py:81``): int4 all-to-all + reduce within
+the node, int8 across nodes — ~4x less cross-node gradient traffic.
+
+TPU design: under GSPMD the gradient all-reduce is emitted by XLA and cannot be
+intercepted, so the qgZ engine path flips the ZeRO data axes to *manual*
+(``jax.shard_map(axis_names={dp, dpr}, check_vma=False)``) while every other
+axis (tp/sp/ep) stays compiler-managed:
+
+- the micro-step computes **local** (unreduced) per-device gradients and
+  accumulates them in a stacked ``[zero_world, ...]`` buffer sharded over the
+  manual axes — exactly the reference's unreduced per-rank grad buffers;
+- at the GAS boundary :func:`QgzPlan.reduce` performs the hierarchical
+  quantized exchange per leaf along its ZeRO shard dimension: int4 blocks
+  all-to-all'd over ``dp`` (ICI) and locally reduced, then int8 over ``dpr``
+  (DCN), landing each device exactly its GSPMD gradient shard (axes-major
+  chunk order). Leaves with no ZeRO-shardable dimension fall back to a plain
+  ``psum``.
+
+Trade-off vs the auto path (documented, inherent to manual-mode): stage-3
+params are all-gathered at micro-step entry instead of per-use inside the
+layer scan.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime.comm.coalesced_collectives import exchange_reduce
+
+
+class QgzPlan:
+    """Everything the engine needs to run qgZ: manual axes, spec trees for the
+    stacked local-grad buffer, and the boundary reduction."""
+
+    def __init__(self, topology, partitioner, params_abstract, group_size=2048,
+                 intra_bits=4, inter_bits=8):
+        self.topology = topology
+        self.mesh = topology.mesh
+        self.group_size = group_size
+        self.intra_bits = intra_bits
+        self.inter_bits = inter_bits
+        # hierarchy: dp rides ICI (intra), dpr rides DCN (inter)
+        axes = tuple(a for a in ("dpr", "dp") if topology.get_dim(a) > 1)
+        for a in ("ep", "sp"):
+            if topology.get_dim(a) > 1:
+                raise ValueError(
+                    f"zero_quantized_gradients currently supports dp/dpr ZeRO "
+                    f"axes only (got {a} size {topology.get_dim(a)} in the "
+                    f"ZeRO world)")
+        if not axes:
+            raise ValueError("zero_quantized_gradients requires a data-parallel "
+                             "world > 1")
+        self.axes = axes                      # GSPMD chunk-major order
+        self.sizes = {a: topology.get_dim(a) for a in axes}
+        self.world = int(np.prod(list(self.sizes.values())))
+        self.manual = set(axes)
+
+        # per-leaf target gradient spec (the partitioner's stage>=2 layout)
+        self.grad_specs = partitioner._zero_tree(params_abstract, threshold=0)
+        self.base_specs = partitioner._base_specs(params_abstract)
+        self.param_specs = (partitioner._zero_tree(params_abstract,
+                                                   partitioner.threshold,
+                                                   axes=partitioner.param_axes)
+                            if partitioner.stage >= 3 else self.base_specs)
+
+    # --- spec plumbing -------------------------------------------------
+    def _project(self, spec):
+        """Spec projected onto the manual axes (auto-axis entries dropped) —
+        what shard_map in_specs must describe."""
+        if spec is None:
+            return P()
+        out = []
+        for e in spec:
+            if e is None:
+                out.append(None)
+                continue
+            axes = tuple(a for a in (e if isinstance(e, tuple) else (e,))
+                         if a in self.manual)
+            out.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+        return P(*out)
+
+    def param_in_specs(self, params):
+        return jax.tree.map(lambda _, s: self._project(s), params,
+                            self.param_specs)
+
+    def batch_in_spec(self):
+        return P(self.axes)
+
+    def stacked_spec(self, base_spec, project=False):
+        base = tuple(base_spec) if base_spec is not None else ()
+        stacked = P(self.axes, *base)
+        return self._project(stacked) if project else stacked
+
+    def stacked_specs(self, params, project=False):
+        """Full specs (for buffer shardings) or manual-axis-projected specs
+        (for shard_map in/out_specs — those may only mention manual axes)."""
+        return jax.tree.map(
+            lambda _, s: self.stacked_spec(s, project=project), params,
+            self.base_specs)
+
+    def stacked_shardings(self, params):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self.stacked_specs(params),
+            is_leaf=lambda x: isinstance(x, P))
+
+    def stacked_zeros(self, params, dtype):
+        return jax.tree.map(
+            lambda leaf, sh: jax.device_put(
+                jnp.zeros((self.world,) + tuple(leaf.shape), dtype), sh),
+            params, self.stacked_shardings(params))
+
+    def gather_params(self, params_local):
+        """Inside the shard_map body: all-gather stage-3 param shards over the
+        manual axes (the reference's param all-gather, done at step entry)."""
+        def gather(x, spec):
+            if spec is None:
+                return x
+            for d, e in enumerate(spec):
+                if e is None:
+                    continue
+                man = tuple(a for a in (e if isinstance(e, tuple) else (e,))
+                            if a in self.manual)
+                if man:
+                    x = lax.all_gather(x, man, axis=d, tiled=True)
+            return x
+        return jax.tree.map(gather, params_local, self.param_specs)
+
+    # --- leaf-wise zero-dim discovery ---------------------------------
+    def _zero_dim(self, grad_spec, base_spec):
+        """(dim, axes) the partitioner chose for this leaf's ZeRO shard, or
+        (None, None) when the leaf stays replicated over the manual axes."""
+        if grad_spec is None:
+            return None, None
+        base = tuple(base_spec) if base_spec is not None else ()
+        for d, e in enumerate(grad_spec):
+            if e is None:
+                continue
+            be = base[d] if d < len(base) else None
+            if e == be:
+                continue  # model-parallel entry, unchanged by the partitioner
+            axes = tuple(a for a in (e if isinstance(e, tuple) else (e,))
+                         if a in self.manual)
+            if axes:
+                return d, axes
+        return None, None
+
+    # --- boundary reduction --------------------------------------------
+    def _reduce_leaf(self, local, d, axes):
+        """Hierarchical quantized exchange of one leaf's chunks along dim d.
+
+        ``local``: this device's full-shape accumulated gradient. Returns this
+        device's chunk (the GSPMD shard for spec entry ``axes`` on dim d, in
+        axes-major order)."""
+        moved = jnp.moveaxis(local, d, 0)
+        rest = moved.shape[1:]
+        if axes == ("dpr", "dp"):
+            R, D = self.sizes["dpr"], self.sizes["dp"]
+            chunks = moved.reshape(R, D, -1)                  # [R, D, m]
+            # stage 1 (ICI): dp-peer i receives slab chunks[:, i]
+            slabs = chunks.transpose(1, 0, 2).reshape(D, -1)  # [D, R*m]
+            partial = exchange_reduce(slabs, "dp", self.intra_bits,
+                                      self.group_size)        # [R*m]
+            # stage 2 (DCN): dpr-peer r receives row r of the partial
+            m = chunks.shape[2]
+            out = exchange_reduce(partial.reshape(R, m), "dpr",
+                                  self.inter_bits, self.group_size)  # [m]
+        else:
+            (axis,) = axes
+            n = self.sizes[axis]
+            bits = self.intra_bits if axis == "dp" else self.inter_bits
+            out = exchange_reduce(moved.reshape(n, -1), axis, bits,
+                                  self.group_size)
+        chunk_shape = (moved.shape[0] // self.world
+                       if axes == ("dpr", "dp") else
+                       moved.shape[0] // self.sizes[axes[0]],) + rest
+        return jnp.moveaxis(out.reshape(chunk_shape), 0, d)
+
+    def reduce(self, acc_stacked):
+        """Stacked local-grad buffer -> GSPMD-sharded summed gradients.
+
+        Runs one shard_map over the manual axes; inside, each leaf either does
+        the quantized hierarchical exchange along its ZeRO dim or (no shardable
+        dim) a plain fp psum."""
+        grad_specs, base_specs = self.grad_specs, self.base_specs
+
+        def body(acc_local):
+            def one(leaf, gspec, bspec):
+                local = leaf[0].astype(jnp.float32)        # [*shape]
+                d, axes = self._zero_dim(gspec, bspec)
+                if d is None:
+                    return lax.psum(local, tuple(self.axes))
+                return self._reduce_leaf(local, d, axes)
+            return jax.tree.map(one, acc_local, grad_specs, base_specs)
+
+        out_specs = jax.tree.map(
+            lambda _, s: self._project(s), acc_stacked, grad_specs)
+        fn = jax.shard_map(body, mesh=self.mesh,
+                           in_specs=(self.stacked_specs(acc_stacked,
+                                                        project=True),),
+                           out_specs=out_specs,
+                           axis_names=self.manual, check_vma=False)
+        return fn(acc_stacked)

@@ -90,3 +90,72 @@ def test_optimizer_scheduler_sections():
     assert cfg.optimizer.type == "Adam"
     assert cfg.optimizer.params["lr"] == 1e-3
     assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_unknown_top_level_key_raises():
+    # the classic typo: "zero_optimisation" must not silently train at stage 0
+    with pytest.raises(ValueError, match="did you mean 'zero_optimization'"):
+        DeepSpeedConfig({"train_batch_size": 8, "zero_optimisation": {"stage": 3}})
+
+
+def test_unknown_top_level_key_no_suggestion():
+    with pytest.raises(ValueError, match="Unknown top-level config key"):
+        DeepSpeedConfig({"train_batch_size": 8, "qqqqq": 1})
+
+
+def test_inert_reference_keys_accepted():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_allow_untested_optimizer": True,
+                           "communication_data_type": "fp16"})
+    assert cfg.train_batch_size == 8
+
+
+def test_deprecated_top_level_key_warns_not_raises():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "cpu_offload": True})
+    assert cfg.train_batch_size == 8
+
+
+def test_auto_values():
+    # HF integration style: "auto" means derive/fill-in (reference "auto" support)
+    cfg = DeepSpeedConfig({"train_batch_size": 16,
+                           "train_micro_batch_size_per_gpu": "auto",
+                           "gradient_accumulation_steps": "auto",
+                           "gradient_clipping": "auto",
+                           "fp16": {"enabled": "auto"},
+                           "zero_optimization": {"stage": 2,
+                                                 "reduce_bucket_size": "auto"}})
+    tb, mb, gas = cfg.resolve_batch_params(dp_world_size=4)
+    assert (tb, mb, gas) == (16, 4, 1)
+    assert cfg.gradient_clipping == 0.0
+    assert cfg.fp16.enabled is False  # auto keeps the default
+    assert cfg.zero_config.stage == 2
+
+
+def test_optimizer_shim_state_dict_roundtrip():
+    import numpy as np
+    import jax
+    import deepspeed_tpu
+    from tests.simple_model import SimpleModel, random_batches
+
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    mk = lambda: deepspeed_tpu.initialize(
+        model=model,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 1}})
+    engine, opt, _, _ = mk()
+    for _ in range(3):
+        loss = engine(batch); engine.backward(loss); engine.step()
+    sd = opt.state_dict()
+    assert sd and sd["global_step"] == 3
+    assert any(np.any(np.asarray(l) != 0) for l in jax.tree.leaves(sd["opt_state"])
+               if hasattr(l, "shape") and getattr(l, "ndim", 0) > 0)
+
+    engine2, opt2, _, _ = mk()
+    loss0 = engine2(batch); engine2.backward(loss0); engine2.step()  # init state
+    opt2.load_state_dict(sd)
+    sd2 = opt2.state_dict()
+    assert sd2["global_step"] == 3
+    for a, b in zip(jax.tree.leaves(sd["opt_state"]), jax.tree.leaves(sd2["opt_state"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
