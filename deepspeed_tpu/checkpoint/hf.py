@@ -1,0 +1,558 @@
+"""HuggingFace checkpoint interop: safetensors/torch state dicts <-> flax trees.
+
+Capability analog of the reference's HF loading stack
+(``inference/v2/checkpoint/huggingface_engine.py``,
+``module_inject/replace_module.py:182`` checkpoint injection): real pretrained
+weights in, servable/trainable parameter trees out — plus the inverse export so
+``save_16bit_model`` emits a checkpoint ``from_pretrained`` can read.
+
+Supported families: llama (llama/llama2/mistral/qwen2 — qwen2 adds qkv bias),
+gpt2, opt, mixtral. Conventions handled:
+
+- torch ``nn.Linear`` stores ``[out, in]`` -> flax kernels are ``[in, out]``
+  (transposed); GPT-2's Conv1D is already ``[in, out]``.
+- HF llama-family rotary is half-split (``rotate_half``: pairs ``(j, j+d/2)``)
+  while the TPU models use interleaved pairs ``(2j, 2j+1)`` (better for the
+  VPU's even/odd lanes): q/k projection output columns are permuted so the
+  models compute identical attention. The export applies the inverse.
+- ``scan_layers`` models stack per-layer tensors along axis 0.
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+LLAMA_FAMILY = ("llama", "mistral", "qwen2")
+SUPPORTED = LLAMA_FAMILY + ("gpt2", "opt", "mixtral")
+
+
+class UnsupportedModelError(ValueError):
+    """Model family the converters don't cover — callers may fall back
+    (e.g. ``save_16bit_model`` degrades to an npz dump on exactly this)."""
+
+
+# ---------------------------------------------------------------------------
+# state-dict IO
+# ---------------------------------------------------------------------------
+
+def load_state_dict(model_dir):
+    """Read every ``*.safetensors`` (preferred) or ``pytorch_model*.bin`` in
+    ``model_dir`` into one {name: np.ndarray} dict."""
+    sd = {}
+    st_files = sorted(f for f in os.listdir(model_dir) if f.endswith(".safetensors"))
+    if st_files:
+        for f in st_files:
+            path = os.path.join(model_dir, f)
+            try:
+                from safetensors.numpy import load_file
+                sd.update(load_file(path))
+            except (TypeError, ValueError):
+                # bf16 tensors aren't numpy-native; round-trip through torch
+                from safetensors.torch import load_file as load_torch
+                for k, v in load_torch(path).items():
+                    sd[k] = v.float().numpy()
+        return sd
+    bin_files = sorted(f for f in os.listdir(model_dir)
+                       if re.match(r"pytorch_model.*\.bin$", f))
+    if not bin_files:
+        raise FileNotFoundError(f"no safetensors/bin weights in {model_dir}")
+    import torch
+    for f in bin_files:
+        for k, v in torch.load(os.path.join(model_dir, f), map_location="cpu",
+                               weights_only=True).items():
+            sd[k] = v.float().numpy() if v.dtype == torch.bfloat16 else v.numpy()
+    return sd
+
+
+def save_safetensors(state_dict, model_dir, filename="model.safetensors"):
+    from safetensors.numpy import save_file
+    os.makedirs(model_dir, exist_ok=True)
+    save_file({k: np.ascontiguousarray(v) for k, v in state_dict.items()},
+              os.path.join(model_dir, filename))
+    return os.path.join(model_dir, filename)
+
+
+def detect_model_type(model_dir):
+    with open(os.path.join(model_dir, "config.json")) as f:
+        return json.load(f)["model_type"]
+
+
+# ---------------------------------------------------------------------------
+# rotary convention permutation (half-split <-> interleaved)
+# ---------------------------------------------------------------------------
+
+def _rotary_perm(dh):
+    """perm such that interleaved[..., p[i]] reads half-split[..., i]."""
+    perm = np.empty(dh, dtype=np.int64)
+    perm[0::2] = np.arange(dh // 2)
+    perm[1::2] = np.arange(dh // 2) + dh // 2
+    return perm
+
+
+def _permute_qk_out(mat, n_heads, dh, inverse=False):
+    """Permute the per-head output dim (last axis) of a q/k projection
+    (kernel [in, H*Dh] or bias [H*Dh]) between rotary conventions."""
+    perm = _rotary_perm(dh)
+    if inverse:
+        perm = np.argsort(perm)
+    shaped = mat.reshape(mat.shape[:-1] + (n_heads, dh))
+    return shaped[..., perm].reshape(mat.shape)
+
+
+# ---------------------------------------------------------------------------
+# llama family (llama / mistral / qwen2)
+# ---------------------------------------------------------------------------
+
+def _stack(layers):
+    return np.stack(layers, axis=0)
+
+
+def llama_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
+    """HF llama/mistral/qwen2 state dict -> our LlamaForCausalLM tree
+    (models/llama.py)."""
+    H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    L = cfg.num_hidden_layers
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def lin(name, heads=None):
+        w = g(name).T  # [out,in] -> [in,out]
+        if heads is not None:
+            w = _permute_qk_out(w, heads, Dh)
+        return w
+
+    def bias(name, heads=None):
+        key = name
+        if key not in sd:
+            return None
+        b = g(key)
+        if heads is not None:
+            b = _permute_qk_out(b, heads, Dh)
+        return b
+
+    def layer(i):
+        p = f"model.layers.{i}."
+        attn = {"q_proj": {"kernel": lin(p + "self_attn.q_proj.weight", H)},
+                "k_proj": {"kernel": lin(p + "self_attn.k_proj.weight", KV)},
+                "v_proj": {"kernel": lin(p + "self_attn.v_proj.weight")},
+                "o_proj": {"kernel": lin(p + "self_attn.o_proj.weight")}}
+        for nm, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", None)):
+            b = bias(p + f"self_attn.{nm}.bias", heads)
+            if b is not None:
+                attn[nm]["bias"] = b
+        return {
+            "input_layernorm": {"scale": g(p + "input_layernorm.weight")},
+            "post_attention_layernorm": {"scale": g(p + "post_attention_layernorm.weight")},
+            "self_attn": attn,
+            "mlp": {"gate_proj": {"kernel": lin(p + "mlp.gate_proj.weight")},
+                    "up_proj": {"kernel": lin(p + "mlp.up_proj.weight")},
+                    "down_proj": {"kernel": lin(p + "mlp.down_proj.weight")}},
+        }
+
+    embed = g("model.embed_tokens.weight")
+    lm_head = g("lm_head.weight") if "lm_head.weight" in sd else embed
+    tree = {"embed_tokens": embed,
+            "norm": {"scale": g("model.norm.weight")},
+            "lm_head": lm_head}
+    layers = [layer(i) for i in range(L)]
+    if scan_layers:
+        import jax
+        tree["layers"] = {"block": jax.tree.map(lambda *xs: _stack(xs), *layers)}
+    else:
+        for i, l in enumerate(layers):
+            tree[f"layers_{i}"] = l
+    return tree
+
+
+def llama_from_flax(params, cfg, dtype=np.float32):
+    """Inverse of :func:`llama_to_flax` -> HF-named state dict."""
+    import jax
+    H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    L = cfg.num_hidden_layers
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+
+    def layer_tree(i):
+        if "layers" in params:
+            return jax.tree.map(lambda x: x[i], params["layers"]["block"])
+        return params[f"layers_{i}"]
+
+    sd = {"model.embed_tokens.weight": params["embed_tokens"],
+          "model.norm.weight": params["norm"]["scale"],
+          "lm_head.weight": params["lm_head"]}
+    for i in range(L):
+        l = layer_tree(i)
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = l["input_layernorm"]["scale"]
+        sd[p + "post_attention_layernorm.weight"] = l["post_attention_layernorm"]["scale"]
+        at = l["self_attn"]
+        sd[p + "self_attn.q_proj.weight"] = _permute_qk_out(
+            at["q_proj"]["kernel"], H, Dh, inverse=True).T
+        sd[p + "self_attn.k_proj.weight"] = _permute_qk_out(
+            at["k_proj"]["kernel"], KV, Dh, inverse=True).T
+        sd[p + "self_attn.v_proj.weight"] = at["v_proj"]["kernel"].T
+        sd[p + "self_attn.o_proj.weight"] = at["o_proj"]["kernel"].T
+        for nm, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", None)):
+            if "bias" in at[nm]:
+                b = at[nm]["bias"]
+                if heads is not None:
+                    b = _permute_qk_out(b, heads, Dh, inverse=True)
+                sd[p + f"self_attn.{nm}.bias"] = b
+        sd[p + "mlp.gate_proj.weight"] = l["mlp"]["gate_proj"]["kernel"].T
+        sd[p + "mlp.up_proj.weight"] = l["mlp"]["up_proj"]["kernel"].T
+        sd[p + "mlp.down_proj.weight"] = l["mlp"]["down_proj"]["kernel"].T
+    return sd
+
+
+def llama_config_from_hf(hf_cfg, **overrides):
+    """transformers LlamaConfig/MistralConfig/Qwen2Config -> our LlamaConfig."""
+    from deepspeed_tpu.models.llama import LlamaConfig
+    kw = dict(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_hidden_layers=hf_cfg.num_hidden_layers,
+        num_attention_heads=hf_cfg.num_attention_heads,
+        num_key_value_heads=getattr(hf_cfg, "num_key_value_heads", None)
+        or hf_cfg.num_attention_heads,
+        max_position_embeddings=hf_cfg.max_position_embeddings,
+        rms_norm_eps=hf_cfg.rms_norm_eps,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        head_dim=getattr(hf_cfg, "head_dim", None),
+        attention_bias=bool(getattr(hf_cfg, "attention_bias", False)
+                            or hf_cfg.model_type == "qwen2"),
+        sliding_window=getattr(hf_cfg, "sliding_window", None)
+        if getattr(hf_cfg, "use_sliding_window", True) else None,
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# gpt2
+# ---------------------------------------------------------------------------
+
+def gpt2_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
+    """HF GPT-2 (Conv1D: weights already [in, out]) -> models/gpt2.py tree."""
+    L = cfg.n_layer
+
+    def g(name):
+        t = sd[name]
+        return t.astype(dtype)
+
+    def layer(i):
+        p = f"h.{i}."
+        return {
+            "ln_1": {"scale": g(p + "ln_1.weight"), "bias": g(p + "ln_1.bias")},
+            "ln_2": {"scale": g(p + "ln_2.weight"), "bias": g(p + "ln_2.bias")},
+            "attn": {"c_attn": {"kernel": g(p + "attn.c_attn.weight"),
+                                "bias": g(p + "attn.c_attn.bias")},
+                     "c_proj": {"kernel": g(p + "attn.c_proj.weight"),
+                                "bias": g(p + "attn.c_proj.bias")}},
+            "mlp": {"c_fc": {"kernel": g(p + "mlp.c_fc.weight"),
+                             "bias": g(p + "mlp.c_fc.bias")},
+                    "c_proj": {"kernel": g(p + "mlp.c_proj.weight"),
+                               "bias": g(p + "mlp.c_proj.bias")}},
+        }
+
+    sd = {k.removeprefix("transformer."): v for k, v in sd.items()}
+    tree = {"wte": g("wte.weight"), "wpe": g("wpe.weight"),
+            "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")}}
+    layers = [layer(i) for i in range(L)]
+    if scan_layers:
+        import jax
+        tree["h"] = {"block": jax.tree.map(lambda *xs: _stack(xs), *layers)}
+    else:
+        for i, l in enumerate(layers):
+            tree[f"h_{i}"] = l
+    return tree
+
+
+def gpt2_from_flax(params, cfg, dtype=np.float32):
+    import jax
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+    L = cfg.n_layer
+    sd = {"wte.weight": params["wte"], "wpe.weight": params["wpe"],
+          "ln_f.weight": params["ln_f"]["scale"],
+          "ln_f.bias": params["ln_f"]["bias"]}
+    for i in range(L):
+        l = (jax.tree.map(lambda x: x[i], params["h"]["block"])
+             if "h" in params else params[f"h_{i}"])
+        p = f"h.{i}."
+        sd[p + "ln_1.weight"] = l["ln_1"]["scale"]
+        sd[p + "ln_1.bias"] = l["ln_1"]["bias"]
+        sd[p + "ln_2.weight"] = l["ln_2"]["scale"]
+        sd[p + "ln_2.bias"] = l["ln_2"]["bias"]
+        for blk, names in (("attn", ("c_attn", "c_proj")),
+                           ("mlp", ("c_fc", "c_proj"))):
+            for nm in names:
+                sd[p + f"{blk}.{nm}.weight"] = l[blk][nm]["kernel"]
+                sd[p + f"{blk}.{nm}.bias"] = l[blk][nm]["bias"]
+    return sd
+
+
+# ---------------------------------------------------------------------------
+# opt
+# ---------------------------------------------------------------------------
+
+def opt_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
+    L = cfg.num_hidden_layers
+    sd = {k.removeprefix("model."): v for k, v in sd.items()}
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def lin(p, nm):
+        return {"kernel": g(p + nm + ".weight").T, "bias": g(p + nm + ".bias")}
+
+    def ln(name):
+        return {"scale": g(name + ".weight"), "bias": g(name + ".bias")}
+
+    def layer(i):
+        p = f"decoder.layers.{i}."
+        return {
+            "self_attn": {nm: lin(p + "self_attn.", nm)
+                          for nm in ("q_proj", "k_proj", "v_proj", "out_proj")},
+            "self_attn_layer_norm": ln(p + "self_attn_layer_norm"),
+            "final_layer_norm": ln(p + "final_layer_norm"),
+            "fc1": lin(p, "fc1"),
+            "fc2": lin(p, "fc2"),
+        }
+
+    tree = {"embed_tokens": g("decoder.embed_tokens.weight"),
+            "embed_positions": g("decoder.embed_positions.weight"),
+            "final_layer_norm": ln("decoder.final_layer_norm")}
+    layers = [layer(i) for i in range(L)]
+    if scan_layers:
+        import jax
+        tree["layers"] = {"block": jax.tree.map(lambda *xs: _stack(xs), *layers)}
+    else:
+        for i, l in enumerate(layers):
+            tree[f"layers_{i}"] = l
+    return tree
+
+
+def opt_from_flax(params, cfg, dtype=np.float32):
+    import jax
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+    L = cfg.num_hidden_layers
+    sd = {"model.decoder.embed_tokens.weight": params["embed_tokens"],
+          "model.decoder.embed_positions.weight": params["embed_positions"],
+          "model.decoder.final_layer_norm.weight": params["final_layer_norm"]["scale"],
+          "model.decoder.final_layer_norm.bias": params["final_layer_norm"]["bias"],
+          "lm_head.weight": params["embed_tokens"]}
+    for i in range(L):
+        l = (jax.tree.map(lambda x: x[i], params["layers"]["block"])
+             if "layers" in params else params[f"layers_{i}"])
+        p = f"model.decoder.layers.{i}."
+        for nm in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            sd[p + f"self_attn.{nm}.weight"] = l["self_attn"][nm]["kernel"].T
+            sd[p + f"self_attn.{nm}.bias"] = l["self_attn"][nm]["bias"]
+        for nm in ("fc1", "fc2"):
+            sd[p + f"{nm}.weight"] = l[nm]["kernel"].T
+            sd[p + f"{nm}.bias"] = l[nm]["bias"]
+        for nm in ("self_attn_layer_norm", "final_layer_norm"):
+            sd[p + f"{nm}.weight"] = l[nm]["scale"]
+            sd[p + f"{nm}.bias"] = l[nm]["bias"]
+    return sd
+
+
+# ---------------------------------------------------------------------------
+# mixtral
+# ---------------------------------------------------------------------------
+
+def mixtral_to_flax(sd, cfg, dtype=np.float32):
+    """HF Mixtral -> models/mixtral.py tree (experts stacked [E, in, out])."""
+    H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+    Dh = cfg.hidden_size // H
+    L, E = cfg.num_hidden_layers, cfg.num_local_experts
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def lin(name, heads=None):
+        w = g(name).T
+        if heads is not None:
+            w = _permute_qk_out(w, heads, Dh)
+        return w
+
+    tree = {"embed_tokens": g("model.embed_tokens.weight"),
+            "norm": {"scale": g("model.norm.weight")},
+            "lm_head": g("lm_head.weight") if "lm_head.weight" in sd
+            else g("model.embed_tokens.weight")}
+    for i in range(L):
+        p = f"model.layers.{i}."
+        experts = {w: _stack([g(p + f"block_sparse_moe.experts.{e}.{w}.weight").T
+                              for e in range(E)]) for w in ("w1", "w2", "w3")}
+        tree[f"layers_{i}"] = {
+            "input_layernorm": {"scale": g(p + "input_layernorm.weight")},
+            "post_attention_layernorm": {"scale": g(p + "post_attention_layernorm.weight")},
+            "self_attn": {"q_proj": {"kernel": lin(p + "self_attn.q_proj.weight", H)},
+                          "k_proj": {"kernel": lin(p + "self_attn.k_proj.weight", KV)},
+                          "v_proj": {"kernel": lin(p + "self_attn.v_proj.weight")},
+                          "o_proj": {"kernel": lin(p + "self_attn.o_proj.weight")}},
+            "block_sparse_moe": {
+                "gate": {"wg": lin(p + "block_sparse_moe.gate.weight")},
+                "experts": {"MixtralExpertMLP_0": {
+                    w: {"kernel": experts[w]} for w in ("w1", "w2", "w3")}}},
+        }
+    return tree
+
+
+def mixtral_from_flax(params, cfg, dtype=np.float32):
+    import jax
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+    H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+    Dh = cfg.hidden_size // H
+    L, E = cfg.num_hidden_layers, cfg.num_local_experts
+    sd = {"model.embed_tokens.weight": params["embed_tokens"],
+          "model.norm.weight": params["norm"]["scale"],
+          "lm_head.weight": params["lm_head"]}
+    for i in range(L):
+        l = params[f"layers_{i}"]
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = l["input_layernorm"]["scale"]
+        sd[p + "post_attention_layernorm.weight"] = l["post_attention_layernorm"]["scale"]
+        at = l["self_attn"]
+        sd[p + "self_attn.q_proj.weight"] = _permute_qk_out(
+            at["q_proj"]["kernel"], H, Dh, inverse=True).T
+        sd[p + "self_attn.k_proj.weight"] = _permute_qk_out(
+            at["k_proj"]["kernel"], KV, Dh, inverse=True).T
+        sd[p + "self_attn.v_proj.weight"] = at["v_proj"]["kernel"].T
+        sd[p + "self_attn.o_proj.weight"] = at["o_proj"]["kernel"].T
+        sd[p + "block_sparse_moe.gate.weight"] = l["block_sparse_moe"]["gate"]["wg"].T
+        ex = l["block_sparse_moe"]["experts"]["MixtralExpertMLP_0"]
+        for w in ("w1", "w2", "w3"):
+            for e in range(E):
+                sd[p + f"block_sparse_moe.experts.{e}.{w}.weight"] = ex[w]["kernel"][e].T
+    return sd
+
+
+# ---------------------------------------------------------------------------
+# top-level API
+# ---------------------------------------------------------------------------
+
+def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
+    """Load an HF checkpoint directory -> (model, flax params).
+
+    The model family is detected from ``config.json``; returns one of the
+    in-tree flax models configured to match, with weights converted."""
+    import transformers
+    hf_cfg = transformers.AutoConfig.from_pretrained(model_dir)
+    sd = load_state_dict(model_dir)
+    mt = hf_cfg.model_type
+    if mt in LLAMA_FAMILY:
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        cfg = llama_config_from_hf(hf_cfg, scan_layers=scan_layers)
+        return (LlamaForCausalLM(cfg),
+                llama_to_flax(sd, cfg, scan_layers=scan_layers, dtype=dtype))
+    if mt == "gpt2":
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        cfg = GPT2Config(vocab_size=hf_cfg.vocab_size, n_positions=hf_cfg.n_positions,
+                         n_embd=hf_cfg.n_embd, n_layer=hf_cfg.n_layer,
+                         n_head=hf_cfg.n_head,
+                         layer_norm_epsilon=hf_cfg.layer_norm_epsilon,
+                         scan_layers=scan_layers)
+        return GPT2LMHeadModel(cfg), gpt2_to_flax(sd, cfg, scan_layers=scan_layers,
+                                                  dtype=dtype)
+    if mt == "opt":
+        from deepspeed_tpu.models.opt import OPTConfig, OPTForCausalLM
+        cfg = OPTConfig(vocab_size=hf_cfg.vocab_size,
+                        hidden_size=hf_cfg.hidden_size,
+                        ffn_dim=hf_cfg.ffn_dim,
+                        num_hidden_layers=hf_cfg.num_hidden_layers,
+                        num_attention_heads=hf_cfg.num_attention_heads,
+                        max_position_embeddings=hf_cfg.max_position_embeddings,
+                        scan_layers=scan_layers)
+        return OPTForCausalLM(cfg), opt_to_flax(sd, cfg, scan_layers=scan_layers,
+                                                dtype=dtype)
+    if mt == "mixtral":
+        from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+        cfg = MixtralConfig(vocab_size=hf_cfg.vocab_size,
+                            hidden_size=hf_cfg.hidden_size,
+                            intermediate_size=hf_cfg.intermediate_size,
+                            num_hidden_layers=hf_cfg.num_hidden_layers,
+                            num_attention_heads=hf_cfg.num_attention_heads,
+                            num_key_value_heads=hf_cfg.num_key_value_heads,
+                            num_local_experts=hf_cfg.num_local_experts,
+                            num_experts_per_tok=hf_cfg.num_experts_per_tok,
+                            max_position_embeddings=hf_cfg.max_position_embeddings)
+        return MixtralForCausalLM(cfg), mixtral_to_flax(sd, cfg, dtype=dtype)
+    raise UnsupportedModelError(
+        f"unsupported model_type {mt!r}; supported: {SUPPORTED}")
+
+
+def export_pretrained(params, cfg, save_dir, dtype=np.float32):
+    """Inverse of :func:`load_pretrained`: write ``model.safetensors`` +
+    ``config.json`` that ``transformers.from_pretrained`` can load."""
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    name = type(cfg).__name__
+    if isinstance(cfg, LlamaConfig):
+        sd = llama_from_flax(params, cfg, dtype=dtype)
+        # pick the faithful HF family: sliding_window => mistral (global
+        # attention would silently diverge past the window), qkv-bias => qwen2
+        if cfg.sliding_window:
+            mt, arch = "mistral", "MistralForCausalLM"
+        elif cfg.attention_bias:
+            mt, arch = "qwen2", "Qwen2ForCausalLM"
+        else:
+            mt, arch = "llama", "LlamaForCausalLM"
+        hf = {"model_type": mt, "architectures": [arch],
+              "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+              "intermediate_size": cfg.intermediate_size,
+              "num_hidden_layers": cfg.num_hidden_layers,
+              "num_attention_heads": cfg.num_attention_heads,
+              "num_key_value_heads": cfg.num_key_value_heads,
+              "max_position_embeddings": cfg.max_position_embeddings,
+              "rms_norm_eps": cfg.rms_norm_eps, "rope_theta": cfg.rope_theta,
+              "tie_word_embeddings": False,
+              "torch_dtype": {np.dtype(np.float16): "float16",
+                              np.dtype(np.float32): "float32"}.get(
+                                  np.dtype(dtype), "bfloat16")}
+        if cfg.sliding_window:
+            hf["sliding_window"] = int(cfg.sliding_window)
+        if mt != "qwen2":
+            hf["attention_bias"] = cfg.attention_bias
+        if cfg.head_dim != cfg.hidden_size // cfg.num_attention_heads:
+            hf["head_dim"] = int(cfg.head_dim)
+    elif name == "GPT2Config":
+        sd = gpt2_from_flax(params, cfg, dtype=dtype)
+        hf = {"model_type": "gpt2", "architectures": ["GPT2LMHeadModel"],
+              "vocab_size": cfg.vocab_size, "n_positions": cfg.n_positions,
+              "n_embd": cfg.n_embd, "n_layer": cfg.n_layer, "n_head": cfg.n_head,
+              "layer_norm_epsilon": cfg.layer_norm_epsilon}
+    elif name == "OPTConfig":
+        sd = opt_from_flax(params, cfg, dtype=dtype)
+        hf = {"model_type": "opt", "architectures": ["OPTForCausalLM"],
+              "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+              "ffn_dim": cfg.ffn_dim, "num_hidden_layers": cfg.num_hidden_layers,
+              "num_attention_heads": cfg.num_attention_heads,
+              "max_position_embeddings": cfg.max_position_embeddings,
+              "do_layer_norm_before": True, "word_embed_proj_dim": cfg.hidden_size}
+    elif name == "MixtralConfig":
+        sd = mixtral_from_flax(params, cfg, dtype=dtype)
+        hf = {"model_type": "mixtral", "architectures": ["MixtralForCausalLM"],
+              "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+              "intermediate_size": cfg.intermediate_size,
+              "num_hidden_layers": cfg.num_hidden_layers,
+              "num_attention_heads": cfg.num_attention_heads,
+              "num_key_value_heads": cfg.num_key_value_heads,
+              "num_local_experts": cfg.num_local_experts,
+              "num_experts_per_tok": cfg.num_experts_per_tok,
+              "max_position_embeddings": cfg.max_position_embeddings,
+              "tie_word_embeddings": False}
+    else:
+        raise UnsupportedModelError(f"unsupported model config {name}")
+
+    os.makedirs(save_dir, exist_ok=True)
+    path = save_safetensors(sd, save_dir)
+    with open(os.path.join(save_dir, "config.json"), "w") as f:
+        json.dump(hf, f, indent=2)
+    logger.info(f"exported HF checkpoint to {save_dir} "
+                f"({sum(v.size for v in sd.values())/1e6:.1f}M params)")
+    return path

@@ -97,6 +97,17 @@ class InferenceEngine:
         return jax.device_put(params, shardings)
 
     def _load_checkpoint(self, path):
+        import os
+        if os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json")):
+            # HF checkpoint dir (reference huggingface_engine.py capability):
+            # convert safetensors/bin into the model's flax tree, directly in
+            # the serving dtype (no transient fp32 copy of a 70B model)
+            from deepspeed_tpu.checkpoint import hf as hf_interop
+            model, params = hf_interop.load_pretrained(
+                path, dtype=np.dtype(self._config.jax_dtype))
+            if self.module is None:
+                self.module = model
+            return params
         from deepspeed_tpu.runtime.checkpoint_engine.native_engine import NativeCheckpointEngine
         eng = NativeCheckpointEngine()
         state = eng.load(path)

@@ -30,9 +30,16 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     attention_bias: bool = False      # qkv bias (Qwen2-family)
     sliding_window: Any = None        # local-window attention (Mistral-family)
+    head_dim: Any = None              # explicit override (Mistral-Nemo style);
+    # None derives hidden_size // num_attention_heads (resolved in __post_init__)
     scan_layers: bool = True
     remat: bool = True
     dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            object.__setattr__(self, "head_dim",
+                               self.hidden_size // self.num_attention_heads)
 
     @staticmethod
     def tiny(**kw):
@@ -56,15 +63,12 @@ class LlamaConfig:
                            num_hidden_layers=80, num_attention_heads=64,
                            num_key_value_heads=8, **kw)
 
-    @property
-    def head_dim(self):
-        return self.hidden_size // self.num_attention_heads
-
     def num_parameters(self):
         c = self
-        per_layer = (c.hidden_size * c.hidden_size  # q
+        qo = c.num_attention_heads * c.head_dim
+        per_layer = (c.hidden_size * qo  # q
                      + 2 * c.hidden_size * c.num_key_value_heads * c.head_dim  # k,v
-                     + c.hidden_size * c.hidden_size  # o
+                     + qo * c.hidden_size  # o
                      + 3 * c.hidden_size * c.intermediate_size  # gate,up,down
                      + 2 * c.hidden_size)  # norms
         return (c.vocab_size * c.hidden_size * 2  # embed + lm_head

@@ -91,8 +91,14 @@ class OptimizerShim:
             logger.warning("OptimizerShim.state_dict(): engine state not yet "
                            "initialized; returning empty dict")
             return {}
-        return {"opt_state": jax.tree.map(self._fetch, st.opt_state),
-                "global_step": int(self._fetch(st.global_step))}
+        sd = {"opt_state": jax.tree.map(self._fetch, st.opt_state),
+              "global_step": int(self._fetch(st.global_step)),
+              "scale": jax.tree.map(self._fetch, st.scale),
+              "skipped": int(self._fetch(st.skipped))}
+        if self._engine._offload is not None:
+            # ZeRO-Offload: most (ratio=1.0: all) moments live in the host tier
+            sd["offload"] = self._engine._offload.state_dict()
+        return sd
 
     def load_state_dict(self, sd):
         if not sd:
@@ -107,7 +113,18 @@ class OptimizerShim:
             st.opt_state, sd["opt_state"])
         gs = jax.device_put(jnp.int32(sd.get("global_step", 0)),
                             st.global_step.sharding)
-        self._engine.state = st._replace(opt_state=opt, global_step=gs)
+        repl = {"opt_state": opt, "global_step": gs}
+        if "scale" in sd:
+            repl["scale"] = jax.tree.map(
+                lambda cur, new: jax.device_put(jnp.asarray(new, cur.dtype),
+                                                cur.sharding),
+                st.scale, LossScaleState(*sd["scale"]))
+            repl["skipped"] = jax.device_put(jnp.int32(sd.get("skipped", 0)),
+                                             st.skipped.sharding)
+        self._engine.state = st._replace(**repl)
+        if "offload" in sd and self._engine._offload is not None:
+            self._engine._offload.load_state_dict(sd["offload"])
+            self._engine._refresh_working_from_master()
 
     def zero_grad(self, set_to_none=True):
         pass  # grads live in the engine's accumulation buffer
@@ -509,6 +526,9 @@ class DeepSpeedEngine:
         n = count_parameters(params_f32)
         log_dist(f"model parameters: {n/1e6:.2f}M (offload={off_cfg.device}, "
                  f"ratio={ratio})", ranks=[0])
+        if self._pending_opt_state is not None:
+            sd, self._pending_opt_state = self._pending_opt_state, None
+            self.optimizer.load_state_dict(sd)
 
     def _ensure_initialized(self, batch):
         if self.state is not None:
@@ -1170,12 +1190,59 @@ class DeepSpeedEngine:
         return load_universal_checkpoint(self, universal_dir,
                                          load_optimizer_states=load_optimizer_states)
 
-    def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
-        """reference engine ``save_16bit_model`` — gathered half-precision dump."""
+    def save_16bit_model(self, save_dir, save_filename=None):
+        """reference engine ``save_16bit_model`` — gathered half-precision dump.
+
+        For the in-tree model families (llama/mistral/qwen2/gpt2/opt/mixtral)
+        this writes a real HF checkpoint (``model.safetensors`` +
+        ``config.json``) that ``transformers.from_pretrained`` loads
+        (checkpoint/hf.py export). Other models get an honest flax npz
+        (``model_weights.npz`` — NOT named like a torch file)."""
         os.makedirs(save_dir, exist_ok=True)
-        params = self.get_model_parameters(dtype=np.float16 if self.fp16_enabled else np.float32)
+        # fp16 stays 16-bit end to end; bf16 exports fp32 (numpy/safetensors
+        # have no native bfloat16 — documented widening, not a silent one)
+        dtype = np.float16 if self.fp16_enabled else np.float32
+        params = self.get_model_parameters(dtype=dtype)
+        cfg = getattr(self.module, "config", None)
+        if save_filename is None and cfg is not None:
+            from deepspeed_tpu.checkpoint import hf as hf_interop
+            try:
+                return hf_interop.export_pretrained(params, cfg, save_dir,
+                                                    dtype=dtype)
+            except hf_interop.UnsupportedModelError:
+                pass  # unknown family -> npz fallback (real errors propagate)
+        save_filename = save_filename or "model_weights.npz"
         flat = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
             flat[jax.tree_util.keystr(path)] = leaf
         np.savez(os.path.join(save_dir, save_filename), **flat)
         return os.path.join(save_dir, save_filename)
+
+    def load_hf_weights(self, model_dir):
+        """Load a HuggingFace checkpoint directory into the live engine (the
+        ``load_checkpoint(load_module_only=True)`` analog for HF checkpoints;
+        reference ``module_inject/replace_module.py:182`` checkpoint path).
+        The converted tree replaces params/master in place (shapes must match
+        the engine's model)."""
+        from deepspeed_tpu.checkpoint import hf as hf_interop
+        _, params = hf_interop.load_pretrained(model_dir)
+        if self.state is None:
+            self._init_state(params)
+            return params
+        if self._offload is not None:
+            raise NotImplementedError("load_hf_weights with offload_optimizer: "
+                                      "load before the first step instead")
+        if self.state.master is not None:
+            master = jax.tree.map(
+                lambda cur, new: jax.device_put(
+                    jnp.asarray(new, cur.dtype), cur.sharding),
+                self.state.master, params)
+            self.state = self.state._replace(master=master)
+            self._refresh_working_from_master()
+        else:
+            working = jax.tree.map(
+                lambda cur, new: jax.device_put(
+                    jnp.asarray(new, cur.dtype), cur.sharding),
+                self.state.params, params)
+            self.state = self.state._replace(params=working)
+        return params

@@ -109,7 +109,8 @@ class HostOffloadOptimizer:
         return out
 
     # --- checkpointing ---
-    def save(self, path):
+    def state_dict(self):
+        """Host-tier state as one dict (masters + Adam moments + step)."""
         blobs = {f"master::{k}": v for k, v in self.masters.items()}
         if self.swapper is not None:
             for k, (m, v) in self.swapper.state_arrays().items():
@@ -121,23 +122,30 @@ class HostOffloadOptimizer:
                 blobs[f"m::{k}"] = m
                 blobs[f"v::{k}"] = v
         blobs["step_count"] = np.asarray(self.adam.step_count)
-        np.savez(path, **blobs)
+        return blobs
 
-    def load(self, path):
-        data = np.load(path)
-        self.adam.step_count = int(data["step_count"])
+    def load_state_dict(self, blobs):
+        self.adam.step_count = int(blobs["step_count"])
         swap_states = {}
-        for name in data.files:
+        for name in blobs:
             if name.startswith("master::"):
-                self.masters[name[8:]] = np.ascontiguousarray(data[name])
+                self.masters[name[8:]] = np.ascontiguousarray(
+                    blobs[name], dtype=np.float32)
             elif name.startswith("m::"):
                 k = name[3:]
                 if self.swapper is not None:
-                    swap_states[k] = (data[name], data[f"v::{k}"])
+                    swap_states[k] = (blobs[name], blobs[f"v::{k}"])
                 else:
-                    self.adam.set_state(k, data[name], data[f"v::{k}"])
+                    self.adam.set_state(k, blobs[name], blobs[f"v::{k}"])
         if self.swapper is not None:
             self.swapper.load_state_arrays(swap_states)
+
+    def save(self, path):
+        np.savez(path, **self.state_dict())
+
+    def load(self, path):
+        data = np.load(path)
+        self.load_state_dict({name: data[name] for name in data.files})
 
 
 def select_offload_leaves(params_f32, ratio):

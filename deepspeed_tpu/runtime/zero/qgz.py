@@ -113,10 +113,17 @@ class QgzPlan:
             is_leaf=lambda x: isinstance(x, P))
 
     def stacked_zeros(self, params, dtype):
-        return jax.tree.map(
-            lambda leaf, sh: jax.device_put(
-                jnp.zeros((self.world,) + tuple(leaf.shape), dtype), sh),
-            params, self.stacked_shardings(params))
+        # allocate directly sharded (jit with out_shardings): device_put of a
+        # host/default-device zeros would transiently stage world x leaf bytes
+        # on one device — the OOM ZeRO exists to avoid
+        shardings = self.stacked_shardings(params)
+        shapes = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct((self.world,) + tuple(leaf.shape),
+                                              dtype), params)
+        make = jax.jit(
+            lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes),
+            out_shardings=shardings)
+        return make()
 
     def gather_params(self, params_local):
         """Inside the shard_map body: all-gather stage-3 param shards over the
