@@ -196,7 +196,11 @@ def main(args=None):
     hosts = list(pool)
     remote_hosts = [h for h in hosts if h not in _LOCAL_HOSTS]
     master = args.master_addr or hosts[0]
-    if remote_hosts and master in _LOCAL_HOSTS:
+    if args.launcher == "local":
+        # every "node" is a local process; the coordinator must be reachable
+        # locally no matter what the hostfile names the nodes
+        master = args.master_addr or "127.0.0.1"
+    elif remote_hosts and master in _LOCAL_HOSTS:
         raise ValueError(
             "remote hosts present but the coordinator address resolves to "
             "localhost — pass --master_addr with an address the workers can "

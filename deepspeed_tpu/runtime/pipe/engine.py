@@ -32,7 +32,8 @@ from deepspeed_tpu.utils.logging import log_dist
 
 
 def collective_pipeline(block_apply, blocks_params, x_micro, mesh, *,
-                        num_stages, remat=True, pp_axis="pp", extra=None):
+                        num_stages, remat=True, pp_axis="pp", extra=None,
+                        num_layers=None):
     """Run M microbatches through the rotated block pipeline — pure GSPMD form.
 
     block_apply: (params_one_layer, x, extra) -> x
@@ -52,19 +53,33 @@ def collective_pipeline(block_apply, blocks_params, x_micro, mesh, *,
     S = num_stages
     M = x_micro.shape[0]
 
+    # non-uniform partitioning: the stored stack is padded to S x ceil(L/S)
+    # (PipelineModule.init_params) so the pp sharding divides evenly; padded
+    # slots are masked no-ops here. With a homogeneous interior, balanced
+    # partitioning (reference partition_method="parameters") == uniform slots.
+    total = jax.tree.leaves(blocks_params)[0].shape[0]
+    assert total % S == 0, f"padded layer stack {total} must divide stages {S}"
+    K = total // S
+    L = num_layers if num_layers is not None else total
+    valid = (jnp.arange(S * K) < L).reshape(S, K)
+
     blocks = jax.tree.map(
-        lambda a: a.reshape((S, a.shape[0] // S) + a.shape[1:]), blocks_params)
+        lambda a: a.reshape((S, K) + a.shape[1:]), blocks_params)
     blocks = jax.tree.map(
         lambda a: jax.lax.with_sharding_constraint(
             a, jax.NamedSharding(mesh, P(pp_axis))), blocks)
 
-    def apply_stage(stage_blocks, x):
-        def layer(h, p):
-            return body(p, h, extra), None
-        out, _ = lax.scan(layer, x, stage_blocks)
+    def apply_stage(stage_blocks, stage_valid, x):
+        def layer(h, pv):
+            p, v = pv
+            out = body(p, h, extra)
+            # padded slot -> identity (out from zero params stays finite for
+            # standard blocks, so the where-grad is clean)
+            return jnp.where(v, out, h), None
+        out, _ = lax.scan(layer, x, (stage_blocks, stage_valid))
         return out
 
-    stage_vmap = jax.vmap(apply_stage, in_axes=(0, 0), out_axes=0)
+    stage_vmap = jax.vmap(apply_stage, in_axes=(0, 0, 0), out_axes=0)
     buf_spec = P(pp_axis)
 
     def tick(carry, t):
@@ -73,15 +88,15 @@ def collective_pipeline(block_apply, blocks_params, x_micro, mesh, *,
         feed = lax.dynamic_index_in_dim(x_micro, feed_idx, 0, keepdims=False)
         feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
         buf = buf.at[0].set(feed)
-        out = stage_vmap(blocks, buf)
+        out = stage_vmap(blocks, valid, buf)
         out = jax.lax.with_sharding_constraint(
             out, jax.NamedSharding(mesh, buf_spec))
         # collect the last stage's result for microbatch t-(S-1)
         oidx = jnp.clip(t - (S - 1), 0, M - 1)
-        valid = t - (S - 1) >= 0
+        out_ready = t - (S - 1) >= 0
         cur = lax.dynamic_index_in_dim(outputs, oidx, 0, keepdims=False)
         outputs = lax.dynamic_update_index_in_dim(
-            outputs, jnp.where(valid, out[S - 1], cur), oidx, 0)
+            outputs, jnp.where(out_ready, out[S - 1], cur), oidx, 0)
         # rotate stages: s -> s+1 (slot 0 is overwritten by the next feed)
         buf = jnp.roll(out, 1, axis=0)
         return (buf, outputs), None
@@ -106,11 +121,6 @@ class PipelineEngine(DeepSpeedEngine):
         super().__init__(config=config, model=model, **kwargs)
         if self.pipe_module.num_stages is None:
             self.pipe_module.num_stages = self.topology.pp_size
-            if self.pipe_module.num_layers % self.pipe_module.num_stages != 0:
-                raise ValueError(
-                    f"compiled SPMD pipelining requires num_layers "
-                    f"({self.pipe_module.num_layers}) divisible by the mesh's "
-                    f"pp size ({self.pipe_module.num_stages})")
         assert self.topology.pp_size == self.pipe_module.num_stages, (
             f"mesh pp={self.topology.pp_size} != module stages "
             f"{self.pipe_module.num_stages}")
@@ -135,7 +145,16 @@ class PipelineEngine(DeepSpeedEngine):
             outs = collective_pipeline(
                 block_apply, params["blocks"], embed, self.mesh,
                 num_stages=self.topology.pp_size,
-                remat=self.config.activation_checkpointing.policy != "nothing")
+                remat=self.config.activation_checkpointing.policy != "nothing",
+                num_layers=pipe.num_layers)
+            if pipe.tied_head_fn is not None:
+                # tied embedding head: reads params["embed"], so autodiff
+                # accumulates embed+unembed grads into one leaf (the
+                # reference's tied-grad allreduce, pipe/engine.py:266)
+                losses = jax.vmap(
+                    lambda o, b: pipe.tied_head_fn(pipe.embed, params["embed"], o, b)
+                )(outs, micro)
+                return jnp.mean(losses)
             if pipe.head is not None:
                 losses = jax.vmap(
                     lambda o, b: pipe.head.apply({"params": params["head"]}, o, b)
@@ -149,6 +168,26 @@ class PipelineEngine(DeepSpeedEngine):
         if self._user_param_specs is not None:
             return self._user_param_specs
         return self.pipe_module.param_specs(params)
+
+    def _init_state(self, model_parameters):
+        # user-supplied trees (e.g. checkpoint-converted, naturally [L, ...])
+        # get the same padded stack as init_params so the pp sharding divides
+        padded = self.pipe_module.padded_layers()
+        blocks = model_parameters.get("blocks") if isinstance(model_parameters, dict) else None
+        if blocks is not None:
+            have = jax.tree.leaves(blocks)[0].shape[0]
+            if have == self.pipe_module.num_layers and have != padded:
+                pad = padded - have
+                model_parameters = dict(model_parameters)
+                model_parameters["blocks"] = jax.tree.map(
+                    lambda a: jnp.concatenate(
+                        [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0),
+                    blocks)
+            elif have not in (self.pipe_module.num_layers, padded):
+                raise ValueError(
+                    f"model_parameters blocks stack has {have} layers; module "
+                    f"expects {self.pipe_module.num_layers} (or padded {padded})")
+        super()._init_state(model_parameters)
 
     def _ensure_initialized(self, batch):
         if self.state is not None:

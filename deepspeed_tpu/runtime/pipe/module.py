@@ -86,7 +86,18 @@ class PipelineModule:
     def __init__(self, embed=None, block=None, head=None, num_layers=None,
                  num_stages=None, partition_method="uniform",
                  block_args: tuple = (), loss_fn=None,
-                 activation_checkpoint_interval=0):
+                 activation_checkpoint_interval=0, tied_head_fn=None):
+        """``tied_head_fn(embed_module, embed_params, acts, batch) -> loss``:
+        the tied-embedding head (reference TiedLayerSpec, pipe/module.py:77).
+        The head reads the *embed* parameters, so autodiff accumulates the
+        embedding + unembedding gradients into the same leaf — the reference's
+        tied-grad allreduce (pipe/engine.py:266) emerges from GSPMD because the
+        embed params are replicated over pp.
+
+        ``num_layers`` need not divide ``num_stages``: the block stack is
+        padded to ``stages x ceil(L/S)`` with masked no-op slots (non-uniform
+        partitioning — the reference's partition_method machinery; with a
+        homogeneous interior, balanced == uniform-with-padding)."""
         assert block is not None and num_layers is not None
         self.embed = embed
         self.block = block
@@ -97,17 +108,19 @@ class PipelineModule:
         self.block_args = block_args
         self.loss_fn = loss_fn
         self.activation_checkpoint_interval = activation_checkpoint_interval
-        if num_stages is not None and num_layers % num_stages != 0:
-            raise ValueError(
-                f"compiled SPMD pipelining requires num_layers ({num_layers}) "
-                f"divisible by num_stages ({num_stages})")
+        self.tied_head_fn = tied_head_fn
+        if tied_head_fn is not None and head is not None:
+            raise ValueError("pass either head or tied_head_fn, not both")
 
     @staticmethod
     def from_layer_specs(layers, num_stages, loss_fn=None, **kw):
         """Parity constructor for reference-style LayerSpec lists: the first
         spec becomes embed, the last becomes head, the homogeneous interior
-        becomes the block stack."""
+        becomes the block stack. A ``TiedLayerSpec`` pair (same key) at both
+        ends becomes a tied embed/head (one parameter set, head via the spec's
+        ``forward_fn(module, params, acts, batch)``)."""
         assert len(layers) >= 3, "need embed + blocks + head"
+        first, last = layers[0], layers[-1]
         interior = layers[1:-1]
         t0 = interior[0].typename if isinstance(interior[0], LayerSpec) else type(interior[0])
         spec0 = interior[0]
@@ -127,16 +140,33 @@ class PipelineModule:
                         f"args for every interior layer; {spec0!r} has "
                         f"args={spec0.module_args} kwargs={spec0.module_kwargs} but "
                         f"{l!r} has args={l.module_args} kwargs={l.module_kwargs}")
-        embed = layers[0].build() if isinstance(layers[0], LayerSpec) else layers[0]
-        head = layers[-1].build() if isinstance(layers[-1], LayerSpec) else layers[-1]
         block = interior[0].build() if isinstance(interior[0], LayerSpec) else interior[0]
+        if (isinstance(first, TiedLayerSpec) and isinstance(last, TiedLayerSpec)
+                and first.key == last.key):
+            if last.forward_fn is None:
+                raise ValueError(
+                    f"tied head spec {last!r} needs forward_fn(module, params, "
+                    f"acts, batch) -> loss")
+            return PipelineModule(embed=first.build(), block=block, head=None,
+                                  num_layers=len(interior),
+                                  num_stages=num_stages, loss_fn=loss_fn,
+                                  tied_head_fn=last.forward_fn, **kw)
+        embed = first.build() if isinstance(first, LayerSpec) else first
+        head = last.build() if isinstance(last, LayerSpec) else last
         return PipelineModule(embed=embed, block=block, head=head,
                               num_layers=len(interior), num_stages=num_stages,
                               loss_fn=loss_fn, **kw)
 
+    def padded_layers(self):
+        """Stored stack length: num_layers padded up to a multiple of the
+        stage count (masked no-op slots; see __init__)."""
+        if not self.num_stages:
+            return self.num_layers
+        return self.num_stages * (-(-self.num_layers // self.num_stages))
+
     # --- parameter init -------------------------------------------------
     def init_params(self, rng, sample_batch):
-        """Initialize (embed, stacked blocks [L,...], head) params."""
+        """Initialize (embed, stacked blocks [padded_layers,...], head)."""
         k1, k2, k3 = jax.random.split(rng, 3)
         x = self.embed.init(k1, sample_batch)["params"] if self.embed else {}
         embed_params = x
@@ -144,6 +174,12 @@ class PipelineModule:
         keys = jax.random.split(k2, self.num_layers)
         block_params = jax.vmap(
             lambda k: self.block.init(k, act, *self.block_args)["params"])(keys)
+        pad = self.padded_layers() - self.num_layers
+        if pad:
+            block_params = jax.tree.map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0),
+                block_params)
         out = self.block.apply(
             {"params": jax.tree.map(lambda a: a[0], block_params)}, act, *self.block_args)
         head_params = self.head.init(k3, out, sample_batch)["params"] if self.head else {}

@@ -1,0 +1,44 @@
+"""Worker script for the two-process launcher smoke test.
+
+Launched by ``deepspeed_tpu.launcher.runner`` in ``--launcher local`` mode:
+consumes the env contract (MASTER_ADDR/PORT, RANK, WORLD_SIZE), forms a real
+2-process JAX CPU cluster via ``dist.init_distributed``, runs a cross-process
+collective, and writes a per-rank result file the test asserts on.
+"""
+
+import os
+import sys
+
+# cpu-only BEFORE any backend init: two workers grabbing the TPU would wedge it
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu import dist  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_distributed()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, f"expected 2 processes, got {world}"
+    assert int(os.environ["WORLD_SIZE"]) == 2
+    assert int(os.environ["RANK"]) == rank
+
+    # cross-process collective over the global 2-device cpu mesh
+    import numpy as np
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(np.asarray([rank + 1.0]))
+    total = float(gathered.sum())
+    assert total == 3.0, f"allgather sum {total}"
+
+    dist.barrier()
+    with open(os.path.join(out_dir, f"rank{rank}.ok"), "w") as f:
+        f.write(f"world={world} sum={total}\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""End-to-end two-process launcher smoke test (VERDICT weak #9 / next #10).
+
+The reference tests real multi-process groups in-process
+(``tests/unit/common.py:373`` DistributedTest); here the actual launcher CLI
+(``launcher/runner.py --launcher local``) spawns two real OS processes that
+form a JAX CPU cluster via ``jax.distributed.initialize`` and run a
+cross-process collective — the full env contract, not mocks.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_local_launch(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("node0 slots=1\nnode1 slots=1\n")
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    worker = os.path.join(repo, "tests", "launcher_worker.py")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # the launcher runs in a subprocess so the pytest process's jax (already
+    # initialized on the virtual mesh) is not disturbed
+    cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+           "--hostfile", str(hostfile), "--launcher", "local",
+           "--master_port", str(_free_port()),
+           worker, str(out_dir)]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, f"launcher failed:\n{proc.stdout}\n{proc.stderr}"
+    for rank in (0, 1):
+        f = out_dir / f"rank{rank}.ok"
+        assert f.exists(), f"rank {rank} produced no result: {proc.stderr}"
+        assert "world=2 sum=3.0" in f.read_text()

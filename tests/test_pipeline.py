@@ -174,5 +174,145 @@ def test_layer_spec_conversion():
         PipelineModule.from_layer_specs(
             [LayerSpec(Embed), LayerSpec(Blk), LayerSpec(Embed), LayerSpec(Head)],
             num_stages=2)
-    with pytest.raises(ValueError):
-        PipelineModule(block=Blk(), num_layers=7, num_stages=2)
+    # indivisible layer counts are supported via padded masked slots
+    pipe7 = PipelineModule(block=Blk(), num_layers=7, num_stages=2)
+    assert pipe7.padded_layers() == 8
+
+
+# --- tied embed/head + non-uniform partitioning (VERDICT next #8) ---
+class TokEmbed(nn.Module):
+    vocab: int = 64
+    d: int = 16
+
+    @nn.compact
+    def __call__(self, batch):
+        emb = self.param("emb", nn.initializers.normal(0.02), (self.vocab, self.d))
+        return emb[batch["input_ids"]]
+
+
+def tied_lm_head(module, embed_params, acts, batch):
+    """Unembed with the tied embedding matrix; next-token cross-entropy."""
+    logits = acts @ embed_params["emb"].T
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = labels[:, 1:]
+    return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], axis=-1))
+
+
+def lm_batches(n, batch=4, seq=16, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        start = rng.integers(0, vocab, size=(batch, 1))
+        ids = (start + np.arange(seq)) % vocab  # learnable: consecutive tokens
+        ids = ids.astype(np.int32)
+        out.append({"input_ids": ids, "labels": ids})
+    return out
+
+
+def make_tied_pipe(num_layers=8, num_stages=4):
+    from deepspeed_tpu.runtime.pipe.module import TiedLayerSpec
+    specs = ([TiedLayerSpec("embed", TokEmbed)]
+             + [LayerSpec(Blk, 16) for _ in range(num_layers)]
+             + [TiedLayerSpec("embed", TokEmbed, forward_fn=tied_lm_head)])
+    return PipelineModule.from_layer_specs(specs, num_stages=num_stages)
+
+
+def test_tied_pipeline_parity_vs_dp(eight_devices):
+    """Tied-embedding pipeline (pp=4) must match a plain DP run step for step
+    (reference pipe tied-grad allreduce correctness, pipe/engine.py:266)."""
+    batches = lm_batches(4, batch=8)
+    pipe = make_tied_pipe()
+    params0 = pipe.init_params(jax.random.PRNGKey(3), batches[0])
+    cfg = {"train_batch_size": 8, "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "Adam", "params": {"lr": 5e-3}}}
+
+    pp_engine = PipelineEngine(config=dict(cfg), model=make_tied_pipe(),
+                               mesh=MeshTopology(pp=4),
+                               model_parameters=params0)
+
+    # DP twin: same math as one fused callable over the same param tree
+    def dp_model(params, batch, rng=None):
+        mb = 2
+        micro = jax.tree.map(
+            lambda x: x.reshape((mb, x.shape[0] // mb) + x.shape[1:]), batch)
+
+        def one(b):
+            x = pipe.embed.apply({"params": params["embed"]}, b)
+
+            def layer(h, p):
+                return pipe.block.apply({"params": p}, h), None
+            real = jax.tree.map(lambda a: a[:pipe.num_layers], params["blocks"])
+            x, _ = jax.lax.scan(layer, x, real)
+            return tied_lm_head(None, params["embed"], x, b)
+
+        return jnp.mean(jax.vmap(one)(micro))
+
+    dp_engine, _, _, _ = deepspeed_tpu.initialize(
+        model=dp_model, model_parameters=params0,
+        config={**cfg, "gradient_accumulation_steps": 1,
+                "train_batch_size": 8})
+
+    pp_losses, dp_losses = [], []
+    for i in range(4):
+        b = batches[i % len(batches)]
+        halves = [jax.tree.map(lambda x: x[:4], b), jax.tree.map(lambda x: x[4:], b)]
+        pp_losses.append(pp_engine.train_batch(iter(halves)))
+        loss = dp_engine(b)
+        dp_engine.backward(loss)
+        dp_engine.step()
+        dp_losses.append(float(jax.device_get(loss)))
+    np.testing.assert_allclose(pp_losses, dp_losses, rtol=2e-2)
+    assert pp_losses[-1] < pp_losses[0]
+
+
+def test_tied_grads_accumulate_both_paths(eight_devices):
+    """The tied embedding leaf's grad includes embed AND unembed terms."""
+    batches = lm_batches(1, batch=4)
+    pipe = make_tied_pipe(num_layers=4, num_stages=4)
+    params = pipe.init_params(jax.random.PRNGKey(0), batches[0])
+    engine = PipelineEngine(
+        config={"train_batch_size": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}},
+        model=pipe, mesh=MeshTopology(pp=4), model_parameters=params)
+    before = np.asarray(jax.device_get(engine.state.params["embed"]["emb"]))
+    engine.train_batch(iter([batches[0]]))
+    after = np.asarray(jax.device_get(engine.state.params["embed"]["emb"]))
+    assert not np.allclose(before, after)  # tied leaf updated
+    assert engine.was_step_applied()
+
+
+def test_nonuniform_layer_partitioning(eight_devices):
+    """num_layers not divisible by stages: padded masked slots (non-uniform
+    stage partitioning, reference pipe/module.py:370 partition methods)."""
+    batches = lm_batches(3, batch=4)
+    pipe = make_tied_pipe(num_layers=6, num_stages=4)  # 6 layers / 4 stages
+    assert pipe.padded_layers() == 8
+    params = pipe.init_params(jax.random.PRNGKey(1), batches[0])
+    assert jax.tree.leaves(params["blocks"])[0].shape[0] == 8
+    engine = PipelineEngine(
+        config={"train_batch_size": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 5e-3}}},
+        model=pipe, mesh=MeshTopology(pp=4), model_parameters=params)
+    losses = [engine.train_batch(iter([batches[i % 3]])) for i in range(5)]
+    assert losses[-1] < losses[0], f"not learning: {losses}"
+
+    # parity: the same 6 real layers run unpipelined
+    def ref_model(params, batch, rng=None):
+        x = pipe.embed.apply({"params": params["embed"]}, batch)
+
+        def layer(h, p):
+            return pipe.block.apply({"params": p}, h), None
+        real = jax.tree.map(lambda a: a[:6], params["blocks"])
+        x, _ = jax.lax.scan(layer, x, real)
+        return tied_lm_head(None, params["embed"], x, batch)
+
+    ref_loss = float(jax.device_get(ref_model(
+        jax.tree.map(np.asarray, jax.device_get(params)), batches[0])))
+    eng2 = PipelineEngine(
+        config={"train_batch_size": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 5e-3}}},
+        model=make_tied_pipe(num_layers=6, num_stages=4),
+        mesh=MeshTopology(pp=4), model_parameters=params)
+    first = eng2.train_batch(iter([batches[0]]))
+    np.testing.assert_allclose(first, ref_loss, rtol=2e-2)
