@@ -1,0 +1,70 @@
+"""Shared ctypes binding for the host optimizer kernels (csrc/adam/cpu_adam.cpp).
+
+Split out of cpu_adam.py so cpu_adam / cpu_adagrad / cpu_lion can all bind the
+library without importing each other (no circular imports).
+"""
+
+import ctypes
+
+import numpy as np
+
+from deepspeed_tpu.ops.native import load_native
+
+try:
+    import ml_dtypes
+    BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    BF16 = None
+
+
+def _bind(lib):
+    f64 = ctypes.c_int64
+    f32 = ctypes.c_float
+    i32 = ctypes.c_int
+    pf = ctypes.POINTER(ctypes.c_float)
+    pu16 = ctypes.POINTER(ctypes.c_uint16)
+    lib.ds_adam_step.argtypes = [f64, f32, f32, f32, f32, f32, i32, i32,
+                                 pf, pf, pf, pf, f64]
+    lib.ds_adam_step_copy_bf16.argtypes = [f64, f32, f32, f32, f32, f32, i32, i32,
+                                           pf, pf, pf, pf, pu16, f64]
+    lib.ds_adam_step_scalar.argtypes = lib.ds_adam_step.argtypes
+    lib.ds_adagrad_step.argtypes = [f32, f32, f32, pf, pf, pf, f64]
+    lib.ds_lion_step.argtypes = [f32, f32, f32, f32, pf, pf, pf, f64]
+    lib.ds_copy_bf16.argtypes = [pf, pu16, f64]
+    lib.ds_built_with_avx512.restype = i32
+    return lib
+
+
+_lib = None
+
+
+def native():
+    """The bound CDLL for the host optimizer kernels, or None."""
+    global _lib
+    if _lib is None:
+        lib = load_native("ds_cpu_adam")
+        _lib = _bind(lib) if lib is not None else False
+    return _lib or None
+
+
+def pf(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def pu16(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def copy_bf16(src_f32, dst_u16=None):
+    """Bulk fp32->bf16 (round-to-nearest-even) on the host."""
+    src = np.ascontiguousarray(src_f32, dtype=np.float32).reshape(-1)
+    if dst_u16 is None:
+        dst_u16 = np.empty(src.size, dtype=np.uint16)
+    lib = native()
+    if lib is not None:
+        lib.ds_copy_bf16(pf(src), pu16(dst_u16), src.size)
+    elif BF16 is not None:
+        dst_u16.view(BF16)[:] = src.astype(BF16)
+    else:  # truncation fallback
+        dst_u16[:] = (src.view(np.uint32) >> 16).astype(np.uint16)
+    return dst_u16

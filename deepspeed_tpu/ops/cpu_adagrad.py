@@ -1,0 +1,65 @@
+"""Host-side (CPU) Adagrad for ZeRO-Offload.
+
+Reference ``csrc/adagrad/cpu_adagrad.cpp`` + ``ops/adagrad/cpu_adagrad.py``:
+the Adagrad host step over flat fp32 master shards (native kernel
+``ds_adagrad_step`` in ``csrc/adam/cpu_adam.cpp``, numpy fallback), with the
+same fused bf16 working-copy write-back contract as the Adam host step.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.ops._cpu_opt_common import copy_bf16, native as _native, pf as _pf
+from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder
+
+
+class DeepSpeedCPUAdagrad:
+    """Flat-shard Adagrad on the host (one moment: grad-square accumulator)."""
+
+    MOMENT_NAMES = ("v",)
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 initial_accumulator_value=0.1):
+        # initial_accumulator_value/eps-inside-sqrt follow optax.adagrad so
+        # host-tier leaves step identically to device-resident ones
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+        self.initial_accumulator_value = initial_accumulator_value
+        self.step_count = 0
+        self._v = {}
+
+    def begin_step(self):
+        self.step_count += 1
+
+    def state_for(self, key, n):
+        if key not in self._v:
+            self._v[key] = np.full(n, self.initial_accumulator_value,
+                                   dtype=np.float32)
+        return (self._v[key],)
+
+    def set_state(self, key, v):
+        self._v[key] = np.ascontiguousarray(v, dtype=np.float32).reshape(-1)
+
+    def update(self, key, params, grads, lr=None, out_bf16=None):
+        params = np.ascontiguousarray(params, dtype=np.float32).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32).reshape(-1)
+        (v,) = self.state_for(key, params.size)
+        lr = self.lr if lr is None else lr
+        lib = _native()
+        if lib is not None:
+            lib.ds_adagrad_step(lr, self.eps, self.weight_decay,
+                                _pf(params), _pf(grads), _pf(v), params.size)
+        else:
+            g = grads + self.weight_decay * params if self.weight_decay > 0 else grads
+            v += g * g
+            params -= lr * g / np.sqrt(v + self.eps)
+        if out_bf16 is not None:
+            copy_bf16(params, out_bf16)
+        return params
+
+
+@register_op_builder
+class CPUAdagradBuilder(OpBuilder):
+    """Parity slot for op_builder/cpu_adagrad.py."""
+    NAME = "cpu_adagrad"
+
+    def reference_impl(self):
+        return DeepSpeedCPUAdagrad

@@ -1,58 +1,22 @@
-"""Host-side (CPU) optimizers for ZeRO-Offload.
+"""Host-side (CPU) Adam for ZeRO-Offload.
 
 The reference runs the optimizer step on the host when ``offload_optimizer``
 is enabled, with AVX-vectorized C++ kernels (``csrc/adam/cpu_adam_impl.cpp``,
 ``DeepSpeedCPUAdam`` in ``deepspeed/ops/adam/cpu_adam.py``). This module binds
-the native kernels (``csrc/adam/cpu_adam.cpp``) through ctypes over flat numpy
-arrays, with exact-math numpy fallbacks. The ``copy_bf16`` fused write-back
-produces the device-upload working copy in the same sweep (reference
-param_copy semantics).
+the native kernels (``csrc/adam/cpu_adam.cpp`` — explicit AVX-512 hot loop)
+through ctypes over flat numpy arrays, with exact-math numpy fallbacks. The
+``copy_bf16`` fused write-back produces the device-upload working copy in the
+same sweep (reference param_copy semantics). Adagrad/Lion live in
+``ops/cpu_adagrad.py`` / ``ops/cpu_lion.py`` (mirroring the reference's
+op_builder split).
 """
-
-import ctypes
 
 import numpy as np
 
-from deepspeed_tpu.ops.native import load_native
+from deepspeed_tpu.ops._cpu_opt_common import (BF16 as _BF16, _bind,  # noqa: F401
+                                               copy_bf16, native as _native,
+                                               pf as _pf, pu16 as _pu16)
 from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder
-
-try:
-    import ml_dtypes
-    _BF16 = np.dtype(ml_dtypes.bfloat16)
-except ImportError:  # pragma: no cover
-    _BF16 = None
-
-
-def _bind(lib):
-    f64 = ctypes.c_int64
-    f32 = ctypes.c_float
-    i32 = ctypes.c_int
-    pf = ctypes.POINTER(ctypes.c_float)
-    pu16 = ctypes.POINTER(ctypes.c_uint16)
-    lib.ds_adam_step.argtypes = [f64, f32, f32, f32, f32, f32, i32, i32,
-                                 pf, pf, pf, pf, f64]
-    lib.ds_adam_step_copy_bf16.argtypes = [f64, f32, f32, f32, f32, f32, i32, i32,
-                                           pf, pf, pf, pf, pu16, f64]
-    lib.ds_adagrad_step.argtypes = [f32, f32, f32, pf, pf, pf, f64]
-    lib.ds_lion_step.argtypes = [f32, f32, f32, f32, pf, pf, pf, f64]
-    lib.ds_copy_bf16.argtypes = [pf, pu16, f64]
-    return lib
-
-
-_lib = None
-
-
-def _native():
-    global _lib
-    if _lib is None:
-        lib = load_native("ds_cpu_adam")
-        _lib = _bind(lib) if lib is not None else False
-    return _lib or None
-
-
-def _pf(a):
-    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-
 
 class DeepSpeedCPUAdam:
     """Flat-shard Adam/AdamW on the host (reference ops/adam/cpu_adam.py:26).
@@ -61,6 +25,8 @@ class DeepSpeedCPUAdam:
     per-tensor keyed by id; ``step`` updates params in place and optionally
     writes the bf16 working copy.
     """
+
+    MOMENT_NAMES = ("m", "v")
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
                  bias_correction=True, adamw_mode=True):
@@ -105,8 +71,7 @@ class DeepSpeedCPUAdam:
                 lib.ds_adam_step_copy_bf16(
                     self.step_count, lr, self.betas[0], self.betas[1], self.eps,
                     self.weight_decay, int(self.bias_correction), int(self.adamw_mode),
-                    _pf(params), _pf(grads), _pf(m), _pf(v),
-                    out_bf16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), n)
+                    _pf(params), _pf(grads), _pf(m), _pf(v), _pu16(out_bf16), n)
             else:
                 lib.ds_adam_step(
                     self.step_count, lr, self.betas[0], self.betas[1], self.eps,
@@ -136,89 +101,11 @@ class DeepSpeedCPUAdam:
         return params
 
 
-def copy_bf16(src_f32, dst_u16=None):
-    """Bulk fp32→bf16 (round-to-nearest-even) on the host."""
-    src = np.ascontiguousarray(src_f32, dtype=np.float32).reshape(-1)
-    if dst_u16 is None:
-        dst_u16 = np.empty(src.size, dtype=np.uint16)
-    lib = _native()
-    if lib is not None:
-        lib.ds_copy_bf16(_pf(src), dst_u16.ctypes.data_as(
-            ctypes.POINTER(ctypes.c_uint16)), src.size)
-    elif _BF16 is not None:
-        dst_u16.view(_BF16)[:] = src.astype(_BF16)
-    else:  # truncation fallback
-        dst_u16[:] = (src.view(np.uint32) >> 16).astype(np.uint16)
-    return dst_u16
+# copy_bf16 is re-exported from _cpu_opt_common (import at top).
 
-
-class DeepSpeedCPUAdagrad:
-    """reference ops/adagrad/cpu_adagrad.py."""
-
-    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
-        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
-        self._v = {}
-
-    def update(self, key, params, grads, lr=None):
-        params = np.ascontiguousarray(params, dtype=np.float32).reshape(-1)
-        grads = np.ascontiguousarray(grads, dtype=np.float32).reshape(-1)
-        v = self._v.setdefault(key, np.zeros(params.size, dtype=np.float32))
-        lr = self.lr if lr is None else lr
-        lib = _native()
-        if lib is not None:
-            lib.ds_adagrad_step(lr, self.eps, self.weight_decay,
-                                _pf(params), _pf(grads), _pf(v), params.size)
-            return params
-        g = grads + self.weight_decay * params if self.weight_decay > 0 else grads
-        v += g * g
-        params -= lr * g / (np.sqrt(v) + self.eps)
-        return params
-
-
-class DeepSpeedCPULion:
-    """reference ops/lion/cpu_lion.py."""
-
-    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
-        self.lr, self.betas, self.weight_decay = lr, tuple(betas), weight_decay
-        self._m = {}
-
-    def update(self, key, params, grads, lr=None):
-        params = np.ascontiguousarray(params, dtype=np.float32).reshape(-1)
-        grads = np.ascontiguousarray(grads, dtype=np.float32).reshape(-1)
-        m = self._m.setdefault(key, np.zeros(params.size, dtype=np.float32))
-        lr = self.lr if lr is None else lr
-        lib = _native()
-        if lib is not None:
-            lib.ds_lion_step(lr, self.betas[0], self.betas[1], self.weight_decay,
-                             _pf(params), _pf(grads), _pf(m), params.size)
-            return params
-        b1, b2 = self.betas
-        u = np.sign(b1 * m + (1 - b1) * grads)
-        if self.weight_decay > 0:
-            u = u + self.weight_decay * params
-        params -= lr * u
-        m *= b2
-        m += (1 - b2) * grads
-        return params
-
-
-@register_op_builder
-class CPUAdagradBuilder(OpBuilder):
-    NAME = "cpu_adagrad"
-
-    def reference_impl(self):
-        return DeepSpeedCPUAdagrad
-
-    def load(self, verbose=False):
-        return DeepSpeedCPUAdagrad
-
-
-@register_op_builder
-class CPULionBuilder(OpBuilder):
-    NAME = "cpu_lion"
-
-    def reference_impl(self):
-        return DeepSpeedCPULion
-
-    def load(self, verbose=False):
-        return DeepSpeedCPULion
+# DeepSpeedCPUAdagrad / DeepSpeedCPULion live in their own modules
+# (ops/cpu_adagrad.py, ops/cpu_lion.py — mirroring the reference's
+# op_builder/cpu_adagrad.py, op_builder/cpu_lion.py split); re-exported here
+# for back-compat.
+from deepspeed_tpu.ops.cpu_adagrad import DeepSpeedCPUAdagrad  # noqa: E402,F401
+from deepspeed_tpu.ops.cpu_lion import DeepSpeedCPULion  # noqa: E402,F401

@@ -1,0 +1,64 @@
+"""Host-side (CPU) Lion for ZeRO-Offload.
+
+Reference ``csrc/lion/cpu_lion_impl.cpp`` + ``ops/lion/cpu_lion.py``: the
+sign-based Lion host step over flat fp32 master shards (native kernel
+``ds_lion_step`` in ``csrc/adam/cpu_adam.cpp``, numpy fallback), with the same
+fused bf16 working-copy write-back contract as the Adam host step.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.ops._cpu_opt_common import copy_bf16, native as _native, pf as _pf
+from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder
+
+
+class DeepSpeedCPULion:
+    """Flat-shard Lion on the host (one moment)."""
+
+    MOMENT_NAMES = ("m",)
+
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        self.lr, self.betas, self.weight_decay = lr, tuple(betas), weight_decay
+        self.step_count = 0
+        self._m = {}
+
+    def begin_step(self):
+        self.step_count += 1
+
+    def state_for(self, key, n):
+        if key not in self._m:
+            self._m[key] = np.zeros(n, dtype=np.float32)
+        return (self._m[key],)
+
+    def set_state(self, key, m):
+        self._m[key] = np.ascontiguousarray(m, dtype=np.float32).reshape(-1)
+
+    def update(self, key, params, grads, lr=None, out_bf16=None):
+        params = np.ascontiguousarray(params, dtype=np.float32).reshape(-1)
+        grads = np.ascontiguousarray(grads, dtype=np.float32).reshape(-1)
+        (m,) = self.state_for(key, params.size)
+        lr = self.lr if lr is None else lr
+        lib = _native()
+        if lib is not None:
+            lib.ds_lion_step(lr, self.betas[0], self.betas[1], self.weight_decay,
+                             _pf(params), _pf(grads), _pf(m), params.size)
+        else:
+            b1, b2 = self.betas
+            u = np.sign(b1 * m + (1 - b1) * grads)
+            if self.weight_decay > 0:
+                u = u + self.weight_decay * params
+            params -= lr * u
+            m *= b2
+            m += (1 - b2) * grads
+        if out_bf16 is not None:
+            copy_bf16(params, out_bf16)
+        return params
+
+
+@register_op_builder
+class CPULionBuilder(OpBuilder):
+    """Parity slot for op_builder/cpu_lion.py."""
+    NAME = "cpu_lion"
+
+    def reference_impl(self):
+        return DeepSpeedCPULion

@@ -455,10 +455,10 @@ class DeepSpeedEngine:
         off_cfg = zc.offload_optimizer
         opt_cfg = self.config.optimizer
         opt_name = (opt_cfg.type or "adamw").lower()
-        if opt_name not in ("adam", "adamw"):
+        if opt_name not in ("adam", "adamw", "adagrad", "lion"):
             raise ValueError(
-                f"offload_optimizer requires an Adam-family optimizer (the host step "
-                f"runs the native CPU Adam, csrc/adam/cpu_adam.cpp); got {opt_name!r}")
+                f"offload_optimizer supports adam/adamw/adagrad/lion host steps "
+                f"(csrc/adam/cpu_adam.cpp kernels); got {opt_name!r}")
         ratio = off_cfg.ratio if off_cfg.device != "none" else 1.0
         host_keys, _, _ = select_offload_leaves(params_f32, ratio)
 
@@ -487,7 +487,8 @@ class DeepSpeedEngine:
                        for i in self._offload_host_indices}
         opt_params = dict(opt_cfg.params or {})
         self._offload = HostOffloadOptimizer(host_leaves, off_cfg, opt_params,
-                                             self.working_dtype)
+                                             self.working_dtype,
+                                             opt_name=opt_name)
 
         opt_state = self._tx.init(master_d)
         rep = self.topology.replicated()

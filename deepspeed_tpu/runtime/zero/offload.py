@@ -45,16 +45,43 @@ def _keystr(path):
 class HostOffloadOptimizer:
     """Owns the host tier: fp32 masters + moments for the offloaded leaves."""
 
-    def __init__(self, params_f32_leaves, offload_config, opt_params, working_dtype):
-        """``params_f32_leaves``: dict keystr -> numpy fp32 initial values."""
+    def __init__(self, params_f32_leaves, offload_config, opt_params, working_dtype,
+                 opt_name="adamw"):
+        """``params_f32_leaves``: dict keystr -> numpy fp32 initial values.
+        ``opt_name``: adam/adamw (native SIMD step), adagrad, or lion
+        (reference csrc/adagrad/cpu_adagrad.cpp, csrc/lion/cpu_lion.cpp)."""
         self.device = offload_config.device
         self.working_dtype = working_dtype
-        betas = tuple(opt_params.get("betas", (0.9, 0.999)))
-        self.adam = DeepSpeedCPUAdam(
-            lr=opt_params.get("lr", 1e-3), betas=betas,
-            eps=opt_params.get("eps", 1e-8),
-            weight_decay=opt_params.get("weight_decay", 0.0),
-            adamw_mode=opt_params.get("adam_w_mode", True))
+        self.opt_name = opt_name = opt_name.lower()
+        wd = opt_params.get("weight_decay", 0.0)
+        if opt_name in ("adam", "adamw"):
+            # adam_w_mode defaults True for BOTH spellings, matching the
+            # device-side optax mapping (ops/adam.py ADAM_W_MODE_DEFAULT):
+            # offloaded and resident leaves must decay identically
+            self.adam = DeepSpeedCPUAdam(
+                lr=opt_params.get("lr", 1e-3),
+                betas=tuple(opt_params.get("betas", (0.9, 0.999))),
+                eps=opt_params.get("eps", 1e-8), weight_decay=wd,
+                adamw_mode=opt_params.get("adam_w_mode", True))
+        elif opt_name == "adagrad":
+            from deepspeed_tpu.ops.cpu_adagrad import DeepSpeedCPUAdagrad
+            self.adam = DeepSpeedCPUAdagrad(
+                lr=opt_params.get("lr", 1e-2),
+                eps=opt_params.get("eps", 1e-10), weight_decay=wd)
+        elif opt_name == "lion":
+            from deepspeed_tpu.ops.cpu_lion import DeepSpeedCPULion
+            self.adam = DeepSpeedCPULion(
+                lr=opt_params.get("lr", 1e-4),
+                betas=tuple(opt_params.get("betas", (0.9, 0.99))),
+                weight_decay=wd)
+        else:
+            raise ValueError(
+                f"offload_optimizer supports adam/adamw/adagrad/lion host "
+                f"steps, got {opt_name!r}")
+        if self.device == "nvme" and opt_name not in ("adam", "adamw"):
+            raise ValueError("NVMe optimizer-state swapping is Adam-only "
+                             "(two-moment swap layout); use device 'cpu' for "
+                             f"{opt_name}")
         # copy=True: device_get can hand back read-only views, and the host
         # tier updates masters in place
         self.masters = {k: np.array(v, dtype=np.float32, copy=True).reshape(-1)
@@ -110,33 +137,47 @@ class HostOffloadOptimizer:
 
     # --- checkpointing ---
     def state_dict(self):
-        """Host-tier state as one dict (masters + Adam moments + step)."""
+        """Host-tier state as one dict (masters + optimizer moments + step).
+        Moment blob names follow the optimizer's MOMENT_NAMES (Adam: m/v,
+        Adagrad: v, Lion: m)."""
         blobs = {f"master::{k}": v for k, v in self.masters.items()}
         if self.swapper is not None:
             for k, (m, v) in self.swapper.state_arrays().items():
                 blobs[f"m::{k}"] = m
                 blobs[f"v::{k}"] = v
         else:
+            names = getattr(self.adam, "MOMENT_NAMES", ("m", "v"))
             for k in self.masters:
-                m, v = self.adam.state_for(k, self.masters[k].size)
-                blobs[f"m::{k}"] = m
-                blobs[f"v::{k}"] = v
+                for name, arr in zip(names,
+                                     self.adam.state_for(k, self.masters[k].size)):
+                    blobs[f"{name}::{k}"] = arr
         blobs["step_count"] = np.asarray(self.adam.step_count)
         return blobs
 
     def load_state_dict(self, blobs):
         self.adam.step_count = int(blobs["step_count"])
+        names = getattr(self.adam, "MOMENT_NAMES", ("m", "v"))
         swap_states = {}
         for name in blobs:
             if name.startswith("master::"):
                 self.masters[name[8:]] = np.ascontiguousarray(
                     blobs[name], dtype=np.float32)
-            elif name.startswith("m::"):
-                k = name[3:]
-                if self.swapper is not None:
-                    swap_states[k] = (blobs[name], blobs[f"v::{k}"])
-                else:
-                    self.adam.set_state(k, blobs[name], blobs[f"v::{k}"])
+        has_moments = any("::" in n and not n.startswith("master::")
+                          for n in blobs)
+        for k in self.masters:
+            moms = [blobs[f"{nm}::{k}"] for nm in names if f"{nm}::{k}" in blobs]
+            if len(moms) != len(names):
+                if has_moments:
+                    raise ValueError(
+                        f"offload checkpoint moment blobs do not match the "
+                        f"{self.opt_name} optimizer (expected {names} for "
+                        f"leaf {k!r}; was it saved under a different "
+                        f"optimizer?)")
+                continue  # checkpoint carries no moment state at all
+            if self.swapper is not None:
+                swap_states[k] = tuple(moms)
+            else:
+                self.adam.set_state(k, *moms)
         if self.swapper is not None:
             self.swapper.load_state_arrays(swap_states)
 

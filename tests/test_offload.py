@@ -229,3 +229,95 @@ def test_offload_fp16_overflow_skip():
     assert engine.skipped_steps >= 1
     for k in masters:
         np.testing.assert_array_equal(engine._offload.masters[k], masters[k])
+
+
+@pytest.mark.parametrize("opt,params", [
+    ("Adagrad", {"lr": 5e-2}),
+    ("Lion", {"lr": 1e-3, "betas": (0.9, 0.99), "weight_decay": 0.0}),
+])
+def test_offload_adagrad_lion_match_device(opt, params):
+    """Offload host steps for Adagrad/Lion (csrc kernels) must match the
+    on-device optax step (reference csrc/adagrad, csrc/lion parity)."""
+    base = dict(_BASE, optimizer={"type": opt, "params": params})
+    cfg_dev = dict(base)
+    cfg_off = dict(base, zero_optimization={
+        "stage": 1, "offload_optimizer": {"device": "cpu"}})
+    eng_dev, losses_dev = _train(cfg_dev)
+    eng_off, losses_off = _train(cfg_off)
+    assert eng_off._offload is not None
+    assert eng_off._offload.opt_name == opt.lower()
+    np.testing.assert_allclose(losses_off, losses_dev, rtol=3e-2, atol=3e-2)
+    p_dev = eng_dev.get_model_parameters()
+    p_off = eng_off.get_model_parameters()
+    for a, b in zip(jax.tree.leaves(p_dev), jax.tree.leaves(p_off)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-2, atol=3e-3)
+
+
+def test_offload_adagrad_checkpoint_roundtrip(tmp_path):
+    cfg = dict(_BASE, optimizer={"type": "Adagrad", "params": {"lr": 5e-2}},
+               zero_optimization={"stage": 1,
+                                  "offload_optimizer": {"device": "cpu"}})
+    eng, _ = _train(cfg, steps=3)
+    sd = eng._offload.state_dict()
+    assert any(k.startswith("v::") for k in sd)
+    assert not any(k.startswith("m::") for k in sd)  # adagrad: one moment
+    eng2, _ = _train(cfg, steps=1)
+    eng2._offload.load_state_dict(sd)
+    np.testing.assert_allclose(eng2._offload.adam.step_count,
+                               eng._offload.adam.step_count)
+
+
+def test_offload_nvme_non_adam_raises():
+    cfg = dict(_BASE, optimizer={"type": "Lion", "params": {"lr": 1e-3}},
+               zero_optimization={"stage": 1,
+                                  "offload_optimizer": {"device": "nvme"}})
+    with pytest.raises(ValueError, match="Adam-only"):
+        _train(cfg, steps=1)
+
+
+def test_simd_adam_speedup_over_scalar():
+    """The AVX-512 Adam step must beat the unvectorized build >=3x (VERDICT:
+    vectorize the host step — the bottleneck under ZeRO-Offload). Both sides
+    are OpenMP-parallel, so the ratio isolates vectorization."""
+    import ctypes, time
+    from deepspeed_tpu.ops.cpu_adam import _native
+    lib = _native()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    if not lib.ds_built_with_avx512():
+        pytest.skip("library built without AVX-512")
+    n = 1 << 21
+    rng = np.random.default_rng(0)
+    pf = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    p = rng.normal(size=n).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    m = (rng.normal(size=n) ** 2 * 0.01).astype(np.float32)
+    v = (rng.normal(size=n) ** 2 * 0.01).astype(np.float32)
+    args = (3, 1e-3, 0.9, 0.999, 1e-8, 0.01, 1, 1, pf(p), pf(g), pf(m), pf(v), n)
+
+    def bench(fn, iters=8):
+        fn(*args)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn(*args)
+        return (time.perf_counter() - t0) / iters
+
+    t_scalar = bench(lib.ds_adam_step_scalar)
+    t_simd = bench(lib.ds_adam_step)
+    assert t_scalar / t_simd >= 3.0, (
+        f"SIMD speedup only {t_scalar/t_simd:.1f}x "
+        f"(scalar {t_scalar*1e3:.1f}ms simd {t_simd*1e3:.1f}ms)")
+
+
+def test_offload_moment_mismatch_raises(tmp_path):
+    """Loading a Lion-saved host state into an Adam host tier must fail loud."""
+    cfg_lion = dict(_BASE, optimizer={"type": "Lion", "params": {"lr": 1e-3}},
+                    zero_optimization={"stage": 1,
+                                       "offload_optimizer": {"device": "cpu"}})
+    eng_lion, _ = _train(cfg_lion, steps=2)
+    sd = eng_lion._offload.state_dict()
+    cfg_adam = dict(_BASE, zero_optimization={
+        "stage": 1, "offload_optimizer": {"device": "cpu"}})
+    eng_adam, _ = _train(cfg_adam, steps=1)
+    with pytest.raises(ValueError, match="different optimizer"):
+        eng_adam._offload.load_state_dict(sd)
