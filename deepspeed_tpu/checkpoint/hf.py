@@ -480,7 +480,9 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
                             num_key_value_heads=hf_cfg.num_key_value_heads,
                             num_local_experts=hf_cfg.num_local_experts,
                             num_experts_per_tok=hf_cfg.num_experts_per_tok,
-                            max_position_embeddings=hf_cfg.max_position_embeddings)
+                            max_position_embeddings=hf_cfg.max_position_embeddings,
+                            rms_norm_eps=hf_cfg.rms_norm_eps,
+                            rope_theta=getattr(hf_cfg, "rope_theta", 1e6))
         return MixtralForCausalLM(cfg), mixtral_to_flax(sd, cfg, dtype=dtype)
     raise UnsupportedModelError(
         f"unsupported model_type {mt!r}; supported: {SUPPORTED}")

@@ -1,0 +1,70 @@
+"""v2 engine factory (mirrors reference ``inference/v2/engine_factory.py:68``
+``build_hf_engine``): HF checkpoint directory in, ragged serving engine out.
+
+Families (reference maps eight policies, :68-129): llama / llama2 / mistral /
+qwen2 route to the scanned llama ragged implementation (qkv-bias and
+sliding-window handled per config), mixtral to the MoE ragged implementation.
+Weights come through the HF converter (``checkpoint/hf.py``) directly in the
+serving dtype.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.utils.logging import logger
+
+SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral")
+
+
+def build_hf_engine(path, engine_config=None, dtype=None):
+    """Build a ragged engine from a HuggingFace checkpoint dir.
+
+    Args:
+        path: directory with config.json + safetensors/bin weights.
+        engine_config: ``RaggedInferenceEngineConfig`` or dict.
+        dtype: serving dtype (default bfloat16).
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from deepspeed_tpu.checkpoint import hf as hf_interop
+
+    mt = hf_interop.detect_model_type(path)
+    if mt not in SUPPORTED_FAMILIES:
+        raise ValueError(f"ragged engine supports {SUPPORTED_FAMILIES}, "
+                         f"got model_type {mt!r}")
+    dtype = np.dtype(dtype) if dtype is not None else np.dtype(ml_dtypes.bfloat16)
+    model, params = hf_interop.load_pretrained(path, dtype=dtype)
+    # thread the serving dtype through to COMPUTE, not just storage: the
+    # ragged forwards cast with cfg.dtype at every use site
+    jdt = {np.dtype(np.float32): jnp.float32,
+           np.dtype(np.float16): jnp.float16}.get(dtype, jnp.bfloat16)
+    model = type(model)(dataclasses.replace(model.config, dtype=jdt))
+    logger.info(f"build_hf_engine: {mt} from {path} "
+                f"({sum(x.size for x in jax.tree.leaves(params))/1e6:.1f}M params, "
+                f"dtype {jdt.__name__})")
+    return build_engine(model, params, engine_config, family=mt)
+
+
+def resolve_forward_fn(model, family=None):
+    """The ragged implementation for a model family (the reference's policy
+    map, ``engine_factory.py:68-129``)."""
+    if family is None:
+        family = ("mixtral" if type(model.config).__name__ == "MixtralConfig"
+                  else "llama")
+    if family == "mixtral":
+        from deepspeed_tpu.inference.v2.model_implementations.mixtral import (
+            ragged_forward)
+    else:
+        from deepspeed_tpu.inference.v2.model_implementations.llama import (
+            ragged_forward)
+    return ragged_forward
+
+
+def build_engine(model, params, engine_config=None, family=None):
+    """Build a ragged engine from an in-tree flax model + param tree."""
+    return InferenceEngineV2(model, params, engine_config,
+                             forward_fn=resolve_forward_fn(model, family))

@@ -35,17 +35,25 @@ class InferenceEngineV2:
         config: ``RaggedInferenceEngineConfig`` or dict.
     """
 
-    def __init__(self, model, params, config=None):
+    def __init__(self, model, params, config=None, forward_fn=None):
         if not isinstance(config, RaggedInferenceEngineConfig):
             config = RaggedInferenceEngineConfig(config or {})
         self._config = config
         self._model_config = model.config
-        if not self._model_config.scan_layers:
-            raise ValueError("ragged engine requires scan_layers=True params")
         self._params = params
         cfg = self._model_config
+        if forward_fn is None:
+            # standalone construction: infer via the factory's policy map
+            from deepspeed_tpu.inference.v2.engine_factory import resolve_forward_fn
+            forward_fn = resolve_forward_fn(model)
+        if type(cfg).__name__ != "MixtralConfig" and \
+                not getattr(cfg, "scan_layers", True):
+            raise ValueError("ragged llama engine requires scan_layers=True params")
+        self._ragged_forward = forward_fn
+        head_dim = getattr(cfg, "head_dim", None) or \
+            cfg.hidden_size // cfg.num_attention_heads
         self._state = DSStateManager(config, cfg.num_hidden_layers,
-                                     cfg.num_key_value_heads, cfg.head_dim)
+                                     cfg.num_key_value_heads, head_dim)
         sm = config.state_manager
         bs = self._state.kv_block_size
         self._max_blocks_per_seq = -(-sm.max_context // bs)
@@ -124,9 +132,8 @@ class InferenceEngineV2:
                                     seq.seen_tokens, seq.kv_blocks)
         arrays = wrapper.build()
 
-        from deepspeed_tpu.inference.v2.model_implementations.llama import ragged_forward
         kv = self._state.kv_cache
-        logits, k_pool, v_pool = ragged_forward(
+        logits, k_pool, v_pool = self._ragged_forward(
             self._model_config, self._params, kv.k_pool, kv.v_pool,
             jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["q_len"]),
             jnp.asarray(arrays["seen"]), jnp.asarray(arrays["block_tables"]))

@@ -48,9 +48,27 @@ def _scatter_kv(k_pool, v_pool, k, v, block_tables, seen, q_len, block_size):
     return kf.reshape(k_pool.shape), vf.reshape(v_pool.shape)
 
 
-def _paged_attention(q, k_pool, v_pool, block_tables, seen, block_size):
-    """Grouped-query attention over per-sequence paged KV (blocked_flash
-    analog). q: [S, Q, H, Dh]; returns [S, Q, H, Dh]."""
+def _paged_attention(q, k_pool, v_pool, block_tables, seen, block_size,
+                     q_len=None, window=None):
+    """Grouped-query attention over per-sequence paged KV: the Pallas
+    blocked-flash kernel (ops/pallas/paged_attention.py — O(seen) HBM reads)
+    when the heuristics layer selects it, dense gather fallback elsewhere.
+    ``window``: Mistral-style sliding window. q: [S,Q,H,Dh] -> [S,Q,H,Dh]."""
+    if q_len is not None:
+        from deepspeed_tpu.inference.v2.modules.heuristics import (
+            instantiate_attention)
+        impl, fn = instantiate_attention(q.shape, k_pool.shape)
+        if impl == "pallas_paged":
+            return fn(q, k_pool, v_pool, block_tables, seen, q_len,
+                      window=window)
+    return _paged_attention_dense(q, k_pool, v_pool, block_tables, seen,
+                                  block_size, window=window)
+
+
+def _paged_attention_dense(q, k_pool, v_pool, block_tables, seen, block_size,
+                           window=None):
+    """Pure-XLA reference path (gathers the full table; numerics twin of the
+    Pallas kernel)."""
     S, Q, H, Dh = q.shape
     KV = k_pool.shape[-2]
     rep = H // KV
@@ -69,7 +87,10 @@ def _paged_attention(q, k_pool, v_pool, block_tables, seen, block_size):
         logits = jnp.einsum("qkrd,skd->krqs", qg, keys).astype(jnp.float32) * scale
         key_pos = jnp.arange(MB * block_size)[None, :]
         qry_pos = (seen_s + jnp.arange(Q))[:, None]
-        logits = jnp.where(key_pos <= qry_pos, logits, NEG_INF)
+        visible = key_pos <= qry_pos
+        if window:
+            visible = visible & (key_pos > qry_pos - window)
+        logits = jnp.where(visible, logits, NEG_INF)
         probs = jax.nn.softmax(logits, axis=-1).astype(q_s.dtype)
         return jnp.einsum("krqs,skd->qkrd", probs, vals).reshape(Q, H, Dh)
 
@@ -95,13 +116,21 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
         lp, kp, vp = xs
         attn = lp["self_attn"]
         h = _rmsnorm(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
-        q = (h @ attn["q_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, H, Dh)
-        k = (h @ attn["k_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, KV, Dh)
-        v = (h @ attn["v_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, KV, Dh)
+
+        def proj(p):
+            y = h @ p["kernel"].astype(cfg.dtype)
+            if "bias" in p:  # qwen2-family qkv bias
+                y = y + p["bias"].astype(cfg.dtype)
+            return y
+
+        q = proj(attn["q_proj"]).reshape(S, Q, H, Dh)
+        k = proj(attn["k_proj"]).reshape(S, Q, KV, Dh)
+        v = proj(attn["v_proj"]).reshape(S, Q, KV, Dh)
         q = rotary_embed(q, positions, cfg.rope_theta)
         k = rotary_embed(k, positions, cfg.rope_theta)
         kp, vp = _scatter_kv(kp, vp, k, v, block_tables, seen, q_len, bs)
-        out = _paged_attention(q, kp, vp, block_tables, seen, bs)
+        out = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len,
+                               window=cfg.sliding_window)
         x = x + out.reshape(S, Q, H * Dh) @ attn["o_proj"]["kernel"].astype(cfg.dtype)
         mlp = lp["mlp"]
         h = _rmsnorm(x, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)

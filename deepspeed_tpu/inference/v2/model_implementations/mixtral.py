@@ -1,0 +1,114 @@
+"""Ragged (paged-KV) Mixtral forward — MoE continuous batching.
+
+Capability analog of the reference's Mixtral v2 implementation
+(``inference/v2/model_implementations/mixtral`` + the ragged MoE kernel set
+``kernels/ragged_ops/{moe_gather,moe_scatter,top_k_gating}`` and the grouped
+``cutlass_ops/moe_gemm``). TPU design: GShard dense dispatch-combine —
+top-k gating builds a [tokens, experts, capacity] dispatch tensor, one einsum
+gathers tokens per expert (moe_scatter), a batched einsum over stacked expert
+weights runs all expert FFNs as grouped MXU GEMMs (cutlass moe_gemm), and the
+transpose einsum scatters weighted results back (moe_gather).
+
+Operates on the training param tree of
+``deepspeed_tpu.models.mixtral.MixtralForCausalLM`` (non-scanned
+``layers_{i}`` naming; experts stacked [E, ...]).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import rotary_embed
+from deepspeed_tpu.inference.v2.model_implementations.llama import (
+    _paged_attention, _rmsnorm, _scatter_kv)
+
+
+def _moe_ffn(x, gate_wg, w1, w2, w3, *, k, dtype):
+    """Grouped-expert FFN over a flat token batch.
+
+    x: [T, D]; gate_wg: [D, E]; w1/w3: [E, D, F]; w2: [E, F, D].
+    Returns [T, D].
+
+    Inference uses LOSSLESS capacity C = T: HF Mixtral never drops tokens, and
+    ragged batches carry identical padding rows that would otherwise route to
+    one expert and steal bucket slots from real tokens. The training-side
+    capacity_factor machinery (moe/sharded_moe.py) does not apply here.
+    """
+    T, D = x.shape
+    E = gate_wg.shape[1]
+    C = T
+
+    logits = (x @ gate_wg).astype(jnp.float32)          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, k)          # [T, k]
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+
+    # top_k_gating: position of each (token, slot) inside its expert's bucket
+    onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)       # [T, k, E]
+    flat = onehot.reshape(T * k, E)
+    pos = jnp.cumsum(flat, axis=0) * flat - flat                 # [T*k, E]
+    keep = (pos < C).astype(jnp.float32) * flat
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+    # dispatch [T, k, E, C] -> moe_scatter matrix [T, E, C]
+    disp = (keep[..., None] * pos_oh).reshape(T, k, E, C)
+    dispatch = disp.sum(axis=1)
+    combine = (disp * top_vals[..., None, None]).sum(axis=1)     # [T, E, C]
+
+    xe = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32)).astype(dtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, w1)) * \
+        jnp.einsum("ecd,edf->ecf", xe, w3)                        # grouped GEMMs
+    out_e = jnp.einsum("ecf,efd->ecd", h, w2)                    # [E, C, D]
+    return jnp.einsum("tec,ecd->td", combine,
+                      out_e.astype(jnp.float32)).astype(dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
+                   block_tables):
+    """One ragged Mixtral forward step -> (last-token logits, new pools)."""
+    S, Q = tokens.shape
+    H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+    Dh = cfg.hidden_size // H
+    bs = k_pool.shape[2]
+    positions = seen[:, None] + jnp.arange(Q)[None, :]
+
+    x = params["embed_tokens"].astype(cfg.dtype)[tokens]
+
+    def layer_step(x, lp, kp, vp):
+        attn = lp["self_attn"]
+        h = _rmsnorm(x, lp["input_layernorm"]["scale"], cfg.rms_norm_eps)
+        q = (h @ attn["q_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, H, Dh)
+        k = (h @ attn["k_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, KV, Dh)
+        v = (h @ attn["v_proj"]["kernel"].astype(cfg.dtype)).reshape(S, Q, KV, Dh)
+        q = rotary_embed(q, positions, cfg.rope_theta)
+        k = rotary_embed(k, positions, cfg.rope_theta)
+        kp, vp = _scatter_kv(kp, vp, k, v, block_tables, seen, q_len, bs)
+        out = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len)
+        x = x + out.reshape(S, Q, H * Dh) @ attn["o_proj"]["kernel"].astype(cfg.dtype)
+
+        moe = lp["block_sparse_moe"]
+        ex = moe["experts"]["MixtralExpertMLP_0"]
+        h = _rmsnorm(x, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
+        y = _moe_ffn(h.reshape(S * Q, -1),
+                     moe["gate"]["wg"].astype(cfg.dtype),
+                     ex["w1"]["kernel"].astype(cfg.dtype),
+                     ex["w2"]["kernel"].astype(cfg.dtype),
+                     ex["w3"]["kernel"].astype(cfg.dtype),
+                     k=cfg.num_experts_per_tok,
+                     dtype=cfg.dtype)
+        return x + y.reshape(S, Q, -1), kp, vp
+
+    # non-scanned stack: per-layer pools are [L, ...]; loop is unrolled (the
+    # layer count is static and the weights differ per layer)
+    for i in range(cfg.num_hidden_layers):
+        x, kpi, vpi = layer_step(x, params[f"layers_{i}"],
+                                 k_pool[i], v_pool[i])
+        k_pool = k_pool.at[i].set(kpi)
+        v_pool = v_pool.at[i].set(vpi)
+
+    x = _rmsnorm(x, params["norm"]["scale"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(q_len - 1, 0)[:, None, None], axis=1)[:, 0]
+    logits = last @ params["lm_head"].astype(cfg.dtype).T
+    return logits.astype(jnp.float32), k_pool, v_pool

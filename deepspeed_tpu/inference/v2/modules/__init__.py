@@ -1,0 +1,4 @@
+"""Swappable module-implementation layer (reference ``inference/v2/modules/``)."""
+
+from deepspeed_tpu.inference.v2.modules.heuristics import (  # noqa: F401
+    instantiate_attention, instantiate_moe)

@@ -1,0 +1,42 @@
+"""Per-backend module-implementation selection (reference
+``inference/v2/modules/heuristics.py:186`` — "pick the best kernel config for
+this hardware").
+
+The reference registry maps module interfaces (attention/embedding/linear/moe)
+to CUDA implementations chosen by heuristics; here the same seam picks between
+the Pallas TPU kernels and the pure-XLA twins. Centralizing the choice keeps
+model implementations free of backend probing.
+"""
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+_warned = set()
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def instantiate_attention(q_shape, pool_shape):
+    """-> ('pallas_paged' | 'dense', callable) for ragged paged attention."""
+    from deepspeed_tpu.ops.pallas import paged_attention as pa
+    if _on_tpu() and pa.is_supported(q_shape, pool_shape):
+        return "pallas_paged", pa.paged_mha
+    if _on_tpu() and "attention" not in _warned:
+        _warned.add("attention")
+        logger.warning(f"paged attention: shapes q={q_shape} pool={pool_shape} "
+                       f"not kernel-compatible; dense fallback (O(max_context))")
+    return "dense", None
+
+
+def instantiate_moe():
+    """-> name of the MoE dispatch implementation. The TPU grouped-GEMM
+    (dense dispatch-combine einsum over stacked expert weights — the
+    cutlass_multi_gemm analog) is used everywhere: XLA lowers the batched
+    einsum to grouped MXU GEMMs."""
+    return "grouped_gemm"

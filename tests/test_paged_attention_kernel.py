@@ -1,0 +1,102 @@
+"""Pallas paged (blocked-flash) attention kernel vs the dense gather path.
+
+Mirrors the reference's ragged-ops kernel tests
+(``tests/unit/inference/v2/kernels/ragged_ops/test_blocked_flash.py``):
+same numerics as the dense path across decode (Q=1), chunked prefill (Q>1),
+GQA, and ragged ``seen`` lengths.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.model_implementations.llama import (
+    _paged_attention_dense)
+from deepspeed_tpu.ops.pallas.paged_attention import is_supported, paged_mha
+
+
+def make_case(S=3, Q=1, H=4, KV=2, Dh=64, NB=10, bs=16, MB=4, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (S, Q, H, Dh), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (NB, bs, KV, Dh), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (NB, bs, KV, Dh), jnp.float32)
+    rng = np.random.default_rng(seed)
+    # distinct blocks per sequence (last pool block is the trash block)
+    bt = rng.permutation((NB - 1) * MB)[: S * MB].reshape(S, MB) % (NB - 1)
+    block_tables = jnp.asarray(bt, jnp.int32)
+    seen = jnp.asarray(rng.integers(0, MB * bs - Q, size=S), jnp.int32)
+    q_len = jnp.full((S,), Q, jnp.int32)
+    return q, k_pool, v_pool, block_tables, seen, q_len
+
+
+def run_both(case):
+    q, kp, vp, bt, seen, q_len = case
+    bs = kp.shape[1]
+    out_k = paged_mha(q, kp, vp, bt, seen, q_len, interpret=True)
+    out_d = _paged_attention_dense(q, kp, vp, bt, seen, bs)
+    return out_k, out_d
+
+
+def valid_rows(out, q_len):
+    # rows past q_len are padding; compare only live ones
+    S, Q = out.shape[:2]
+    mask = np.arange(Q)[None, :] < np.asarray(q_len)[:, None]
+    return np.asarray(out)[mask]
+
+
+@pytest.mark.parametrize("Q", [1, 4])
+def test_matches_dense(Q):
+    case = make_case(Q=Q)
+    out_k, out_d = run_both(case)
+    np.testing.assert_allclose(valid_rows(out_k, case[5]),
+                               valid_rows(out_d, case[5]), atol=2e-4, rtol=1e-3)
+
+
+def test_mha_no_gqa():
+    case = make_case(H=4, KV=4)
+    out_k, out_d = run_both(case)
+    np.testing.assert_allclose(valid_rows(out_k, case[5]),
+                               valid_rows(out_d, case[5]), atol=2e-4, rtol=1e-3)
+
+
+def test_zero_seen_decode_first_token():
+    q, kp, vp, bt, seen, q_len = make_case(S=2, Q=1)
+    seen = jnp.zeros_like(seen)
+    out_k = paged_mha(q, kp, vp, bt, seen, q_len, interpret=True)
+    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[1])
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_bf16():
+    q, kp, vp, bt, seen, q_len = make_case(Dh=128)
+    q, kp, vp = (x.astype(jnp.bfloat16) for x in (q, kp, vp))
+    out_k = paged_mha(q, kp, vp, bt, seen, q_len, interpret=True)
+    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[1])
+    assert out_k.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        valid_rows(out_k, q_len).astype(np.float32),
+        valid_rows(out_d, q_len).astype(np.float32), atol=3e-2, rtol=3e-2)
+
+
+def test_is_supported():
+    assert is_supported((2, 1, 8, 64), (8, 16, 2, 64))
+    assert not is_supported((2, 1, 8, 64), (8, 16, 3, 64))   # H % KV
+    assert not is_supported((2, 1, 8, 512), (8, 16, 2, 512))  # Dh
+    assert not is_supported((2, 1, 8, 64), (8, 12, 2, 64))   # bs % 8
+
+
+@pytest.mark.parametrize("window", [8, 24])
+def test_sliding_window_matches_dense(window):
+    """Mistral-style windowed masking in the kernel (the only path serving
+    windowed models on real TPU) vs the dense twin."""
+    from deepspeed_tpu.inference.v2.model_implementations.llama import (
+        _paged_attention_dense)
+    q, kp, vp, bt, seen, q_len = make_case(S=3, Q=2, seed=7)
+    out_k = paged_mha(q, kp, vp, bt, seen, q_len, window=window, interpret=True)
+    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[1],
+                                   window=window)
+    np.testing.assert_allclose(valid_rows(out_k, q_len),
+                               valid_rows(out_d, q_len), atol=2e-4, rtol=1e-3)
