@@ -102,10 +102,12 @@ def initialize(args=None,
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
-def init_inference(model=None, config=None, **kwargs):
+def init_inference(model=None, config=None, params=None, **kwargs):
     """Build an inference engine (mirrors ``deepspeed.init_inference``,
-    reference ``deepspeed/__init__.py:273``)."""
+    reference ``deepspeed/__init__.py:273``). ``params`` is the parameter
+    pytree (TPU analog of the reference's already-loaded torch module
+    weights); remaining kwargs overlay the config."""
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
     cfg = DeepSpeedInferenceConfig.from_dict(config or {}, **kwargs)
-    return InferenceEngine(model, cfg)
+    return InferenceEngine(model, cfg, params=params)

@@ -82,13 +82,48 @@ class Autotuner:
             max(1, self.base_config.get("train_batch_size", 8) // 8)
         return sorted({max(1, base // 2), base, base * 2})
 
-    def _grid(self):
-        stages = self.space.get("zero_stage") or [self.base_config.get(
-            "zero_optimization", {}).get("stage", 0)]
-        mbs_list = self._micro_batch_candidates()
-        remats = self.space.get("remat_policy") or ["everything"]
-        grid = list(itertools.product(stages, mbs_list, remats))
-        return grid[: self.max_trials]
+    # ---- memory cost model (reference :404 model-info-based pruning) ----
+    def device_hbm_budget(self):
+        """Per-device memory budget in bytes (memory_stats when the backend
+        reports it, else a v5e-class 16GB default)."""
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        return 16 * (1 << 30)
+
+    def estimate_state_bytes(self, stage, dp_world):
+        """Static training-state bytes per device for a ZeRO stage: working
+        params (bf16/fp16: 2B) + fp32 master (4B) + Adam moments (8B) + fp32
+        grad accumulator (4B), each sharded per the stage semantics
+        (zero/partition.py). Activation memory is left as headroom — the
+        cheap static-state estimate is what separates feasible stages."""
+        n = self.model_info["num_params"] if self.model_info else 0
+        mixed = (self.base_config.get("bf16", {}).get("enabled")
+                 or self.base_config.get("fp16", {}).get("enabled"))
+        working = 2 * n if mixed else 4 * n
+        master = 4 * n if mixed else 0
+        opt = 8 * n
+        grads = 4 * n
+        if stage >= 1:
+            master, opt = master / dp_world, opt / dp_world
+        if stage >= 2:
+            grads = grads / dp_world
+        if stage >= 3:
+            working = working / dp_world
+        return working + master + opt + grads
+
+    def prune(self, stage, mbs, remat, dp_world, headroom=0.4):
+        """None if the experiment is worth running, else the prune reason.
+        ``headroom`` reserves budget for activations/XLA workspace."""
+        budget = self.device_hbm_budget() * (1.0 - headroom)
+        est = self.estimate_state_bytes(stage, dp_world)
+        if est > budget:
+            return (f"estimated state {est/1e9:.2f}GB > "
+                    f"{budget/1e9:.2f}GB budget at stage {stage}")
+        return None
 
     def _build_config(self, stage, mbs, remat):
         cfg = dict(self.base_config)
@@ -140,23 +175,65 @@ class Autotuner:
             logger.info(f"autotuning experiment failed: {exp}")
         return exp
 
-    def tune(self):
-        """Run the grid; return (best_config_dict, best_metric). Mirrors the
-        reference tuning loop (:523) with fast-mode early stopping."""
+    def tune(self, early_stopping=5, min_gain=0.02):
+        """Run the (pruned) experiment schedule; return (best_config, metric).
+
+        Mirrors the reference tuning loop (:523) + scheduler (:433) behavior
+        in-process: the memory cost model prunes infeasible stage combos
+        without running them; within each (stage, remat) group micro-batches
+        run ascending and stop growing once throughput regresses (larger mbs
+        past the MXU saturation point only adds memory); and the whole search
+        stops after ``early_stopping`` consecutive non-improving experiments
+        (reference ``tuner_early_stopping``)."""
         self.profile_model_info()
         log_dist(f"autotuning: model_info={self.model_info}", ranks=[0])
+        try:
+            dp_world = max(1, jax.device_count())
+        except Exception:
+            dp_world = 1
+
+        stages = self.space.get("zero_stage") or [self.base_config.get(
+            "zero_optimization", {}).get("stage", 0)]
+        remats = self.space.get("remat_policy") or ["everything"]
+        mbs_list = sorted(self._micro_batch_candidates())
+
         best = None
-        for stage, mbs, remat in self._grid():
-            exp = Experiment({"zero_stage": stage, "micro_batch_size": mbs,
-                              "remat_policy": remat})
-            self.experiments.append(exp)
-            self._run_experiment(exp)
-            if exp.metric is not None and (best is None or
-                                           exp.metric > best.metric):
-                best = exp
-            log_dist(f"autotuning: {exp}", ranks=[0])
+        since_improvement = 0
+        trials = 0
+        for stage, remat in itertools.product(stages, remats):
+            group_best = None
+            for mbs in mbs_list:
+                if trials >= self.max_trials or \
+                        since_improvement >= early_stopping:
+                    break
+                exp = Experiment({"zero_stage": stage, "micro_batch_size": mbs,
+                                  "remat_policy": remat})
+                self.experiments.append(exp)
+                reason = self.prune(stage, mbs, remat, dp_world)
+                if reason:
+                    exp.error = f"pruned: {reason}"
+                    log_dist(f"autotuning: {exp}", ranks=[0])
+                    continue
+                trials += 1
+                self._run_experiment(exp)
+                log_dist(f"autotuning: {exp}", ranks=[0])
+                if exp.metric is None:
+                    continue
+                # best is the strict max; min_gain only gates the early-stop
+                # counter (a <2% win still wins, it just doesn't reset patience)
+                improved_enough = (best is None
+                                   or exp.metric > best.metric * (1 + min_gain))
+                if best is None or exp.metric > best.metric:
+                    best = exp
+                since_improvement = 0 if improved_enough else since_improvement + 1
+                if self.metric == METRIC_THROUGHPUT and group_best is not None \
+                        and exp.metric < group_best * (1 - min_gain):
+                    break  # past MXU saturation: bigger mbs only costs memory
+                group_best = max(group_best or 0.0, exp.metric)
+            if trials >= self.max_trials or since_improvement >= early_stopping:
+                break
         if best is None:
-            raise RuntimeError("autotuning: every experiment failed")
+            raise RuntimeError("autotuning: every experiment failed or was pruned")
         cfg = self._build_config(best.overrides["zero_stage"],
                                  best.overrides["micro_batch_size"],
                                  best.overrides["remat_policy"])

@@ -1,0 +1,173 @@
+"""Elastic execution agent — relaunch-on-membership-change supervision.
+
+Reference ``elasticity/elastic_agent.py:32`` (``DSElasticAgent``) delegates to
+torch.distributed.elastic: workers are monitored, and on failure or
+membership change the whole gang is restarted with a recomputed environment.
+The TPU-native agent supervises the launcher's worker processes directly:
+
+- spawns one process per host (the launcher's env contract, plus
+  ``DS_ELASTIC_WORLD_SIZE`` so engines resolve the elastic micro-batch);
+- on any worker failure, kills the gang, re-reads the hostfile (membership
+  may have changed — preempted/healed hosts), validates the new world size
+  against the elastic-compatible set (``compute_elastic_config``), and
+  relaunches, up to ``max_restarts`` times;
+- the new gang resumes from the latest checkpoint (universal checkpoints make
+  the state topology-independent — checkpoint/universal.py).
+"""
+
+import collections
+import os
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.elasticity.elasticity import (ElasticityError,
+                                                 compute_elastic_config)
+from deepspeed_tpu.launcher.runner import (build_ssh_command, node_env,
+                                           parse_hostfile)
+from deepspeed_tpu.utils.logging import logger
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
+
+
+class DSElasticAgent:
+    """Supervise an elastic multi-host gang (reference elastic_agent.py:32)."""
+
+    def __init__(self, user_script, user_args=(), ds_config=None,
+                 hostfile=None, hosts=None, master_addr="127.0.0.1",
+                 master_port=29500, max_restarts=3, launcher="local",
+                 restart_backoff=1.0):
+        assert (hostfile is None) != (hosts is None), \
+            "pass exactly one of hostfile / hosts"
+        self.user_script = user_script
+        self.user_args = list(user_args)
+        self.ds_config = ds_config or {}
+        self.hostfile = hostfile
+        self.static_hosts = list(hosts) if hosts else None
+        self.master_addr = master_addr
+        self.master_port = master_port
+        self.max_restarts = max_restarts
+        self.launcher = launcher
+        self.restart_backoff = restart_backoff
+        self.restarts = 0
+        self.world_history = []
+
+    # -- membership ------------------------------------------------------
+    def current_hosts(self):
+        if self.static_hosts is not None:
+            return list(self.static_hosts)
+        pool = parse_hostfile(self.hostfile)
+        return list(pool)
+
+    def _validate_world(self, n_hosts):
+        ec = self.ds_config.get("elasticity", {})
+        if not ec.get("enabled", False):
+            return None  # non-elastic config: any world size goes
+        final_batch, valid, mbs = compute_elastic_config(
+            self.ds_config, world_size=n_hosts, return_microbatch=True)
+        return {"final_batch": final_batch, "micro_batch": mbs}
+
+    # -- gang lifecycle --------------------------------------------------
+    def _spawn(self, hosts, resolved):
+        program = [sys.executable, self.user_script] + self.user_args
+        procs = []
+        for rank, host in enumerate(hosts):
+            env = node_env(rank, len(hosts), self.master_addr,
+                           self.master_port)
+            env["DS_ELASTIC_WORLD_SIZE"] = str(len(hosts))
+            env["DS_ELASTIC_RESTART_COUNT"] = str(self.restarts)
+            if resolved:
+                env["DS_ELASTIC_MICRO_BATCH"] = str(resolved["micro_batch"])
+                env["DS_ELASTIC_FINAL_BATCH"] = str(resolved["final_batch"])
+            if self.launcher == "ssh" and host not in _LOCAL_HOSTS:
+                cmd = build_ssh_command(host, env, program)
+                # -tt: allocate a tty so killing the ssh client HUPs the
+                # remote worker — otherwise a relaunched gang collides with
+                # survivors of the old one (port/TPU lock already held)
+                cmd.insert(1, "-tt")
+                procs.append(subprocess.Popen(cmd))
+            else:
+                procs.append(subprocess.Popen(
+                    program, env=dict(os.environ, **env)))
+        return procs
+
+    @staticmethod
+    def _kill(procs):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def run(self):
+        """Supervise until the gang exits cleanly or restarts are exhausted.
+        Returns the final exit code."""
+        while True:
+            hosts = self.current_hosts()
+            try:
+                resolved = self._validate_world(len(hosts))
+            except ElasticityError as e:
+                logger.error(f"elastic agent: world size {len(hosts)} invalid: {e}")
+                return 1
+            self.world_history.append(len(hosts))
+            logger.info(f"elastic agent: launching gang of {len(hosts)} "
+                        f"(attempt {self.restarts + 1}, "
+                        f"resolved={resolved})")
+            procs = self._spawn(hosts, resolved)
+
+            failed = False
+            while True:
+                alive = [p for p in procs if p.poll() is None]
+                done = [p for p in procs if p.poll() is not None]
+                if any(p.returncode != 0 for p in done):
+                    failed = True
+                    break
+                if not alive:
+                    return 0  # clean gang exit
+                time.sleep(0.2)
+
+            self._kill(procs)
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                logger.error("elastic agent: restart budget exhausted")
+                return 1
+            logger.warning(
+                f"elastic agent: worker failure; re-reading membership and "
+                f"relaunching ({self.restarts}/{self.max_restarts})")
+            time.sleep(self.restart_backoff)
+
+
+def main(args=None):
+    """``ds_elastic``-style CLI (reference ``bin/ds_elastic``)."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="deepspeed_tpu elastic agent")
+    parser.add_argument("--hostfile", required=True)
+    parser.add_argument("--deepspeed_config", default=None)
+    parser.add_argument("--master_addr", default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--launcher", default="ssh", choices=["ssh", "local"])
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs="...")
+    args = parser.parse_args(args)
+    ds_config = {}
+    if args.deepspeed_config:
+        with open(args.deepspeed_config) as f:
+            ds_config = json.load(f)
+    agent = DSElasticAgent(args.user_script, args.user_args, ds_config,
+                           hostfile=args.hostfile,
+                           master_addr=args.master_addr,
+                           master_port=args.master_port,
+                           max_restarts=args.max_restarts,
+                           launcher=args.launcher)
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

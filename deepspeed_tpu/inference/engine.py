@@ -52,9 +52,9 @@ class InferenceEngine:
         model: flax module (must expose the KV-cache contract for ``generate``;
             ``param_specs(params)`` for TP sharding).
         config: ``DeepSpeedInferenceConfig`` or dict.
-        params: parameter pytree. If ``None``, ``config.checkpoint`` must point
-            at a checkpoint dir saved by the training engine, or the model is
-            freshly initialized on first use.
+        params: parameter pytree. If ``None``, ``config.checkpoint`` must
+            point at a checkpoint/HF dir, or ``set_params()`` must be called
+            before serving (forward/generate raise otherwise).
     """
 
     def __init__(self, model, config=None, params=None):
@@ -137,8 +137,16 @@ class InferenceEngine:
         return params, _DequantizingModule(self.module)
 
     # -- serving -----------------------------------------------------------
+    def _require_params(self):
+        if self.params is None:
+            raise RuntimeError(
+                "InferenceEngine has no parameters: pass params= to "
+                "init_inference, set config.checkpoint to a checkpoint/HF "
+                "dir, or call set_params()")
+
     def forward(self, batch, **kwargs):
         """Logits forward (reference ``engine.py:584``)."""
+        self._require_params()
         if self._forward_fn is None:
             mod = self._serve_module
             self._forward_fn = jax.jit(
@@ -153,6 +161,7 @@ class InferenceEngine:
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0, top_k=0,
                  top_p=1.0, rng=None, eos_token_id=None, **kwargs):
         """KV-cached autoregressive generation (reference ``engine.py:613``)."""
+        self._require_params()
         max_new_tokens = min(max_new_tokens, self._config.max_out_tokens)
         if rng is None and temperature > 0.0:
             self._rng, rng = jax.random.split(self._rng)

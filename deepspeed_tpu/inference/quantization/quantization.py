@@ -44,13 +44,22 @@ class QuantizedParameter:
 
     @classmethod
     def from_array(cls, w, num_bits=8, group_size=256):
-        if num_bits == 8:
+        if num_bits in (6, 12):
+            # FP6-LLM-style float quantization (ops/fp_quantizer.py)
+            from deepspeed_tpu.ops.fp_quantizer import quantize_fp
+            q, s = quantize_fp(w, bits=num_bits, group_size=group_size)
+        elif num_bits == 8:
             q, s = quantize_lastdim(w, group_size=group_size)
         else:
             q, s = quantize(w, num_bits=num_bits, group_size=group_size)
         return cls(q, s, w.shape, num_bits, group_size)
 
     def dequantized(self, dtype=jnp.bfloat16):
+        if self.num_bits in (6, 12):
+            from deepspeed_tpu.ops.fp_quantizer import dequantize_fp
+            return dequantize_fp(self.q, self.scale, self.shape,
+                                 bits=self.num_bits,
+                                 group_size=self.group_size, dtype=dtype)
         if self.num_bits == 8:
             return dequantize_lastdim(self.q, self.scale,
                                       group_size=self.group_size, dtype=dtype)

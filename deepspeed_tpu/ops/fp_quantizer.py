@@ -1,0 +1,135 @@
+"""FP6/FP12 floating-point quantization (reference ``csrc/fp_quantizer/``).
+
+The reference's FP6-LLM kernels quantize weights to 6-bit floats (sign + 3-bit
+exponent + 2-bit mantissa) with per-group fp scales — better tail behavior
+than int4 at the same width, enabling the FP6 serving capability. This module
+implements the same capability with XLA integer bit-math (fused elementwise on
+the VPU) instead of CUDA:
+
+- ``quantize_fp(x, bits=6|12)``: groupwise absmax scaling, round-to-nearest-
+  even mantissa truncation in fp32 bit-space, denormal flush, bit-packing
+  (four 6-bit codes per 3 bytes; two 12-bit codes per 3 bytes).
+- ``dequantize_fp``: exact inverse of the packing + bit expansion.
+
+Formats: fp6 = e3m2 (bias 3), fp12 = e5m6 (bias 15) — 12-bit is bf16's
+exponent range with 6 mantissa bits, matching the reference's q_bits choices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_GROUP = 2048
+
+_FORMATS = {6: (3, 2, 3), 12: (5, 6, 15)}  # bits -> (e_bits, m_bits, bias)
+
+
+def _max_representable(e_bits, m_bits, bias):
+    emax = (1 << e_bits) - 1 - bias  # top exponent (no inf/nan codes)
+    return float(2.0 ** emax * (2.0 - 2.0 ** -m_bits))
+
+
+def _encode(y, e_bits, m_bits, bias):
+    """fp32 values (pre-scaled) -> small-float codes [same shape, int32]."""
+    b = jax.lax.bitcast_convert_type(y.astype(jnp.float32), jnp.int32)
+    sign = (b >> 31) & 1
+    exp = ((b >> 23) & 0xFF) - 127           # unbiased fp32 exponent
+    man = b & 0x7FFFFF
+    shift = 23 - m_bits
+    # round-to-nearest-even on the dropped mantissa bits
+    lsb = (man >> shift) & 1
+    round_bias = (1 << (shift - 1)) - 1 + lsb
+    man_r = (man + round_bias) >> shift      # may carry into the exponent
+    carry = man_r >> m_bits
+    man_r = man_r & ((1 << m_bits) - 1)
+    exp = exp + carry
+    qexp = exp + bias
+    # clamp to the format: overflow -> max code; underflow/denormal -> zero
+    max_exp = (1 << e_bits) - 1
+    overflow = qexp > max_exp
+    underflow = qexp < 1                     # denormals flushed (reference too)
+    man_max = (1 << m_bits) - 1
+    code = (sign << (e_bits + m_bits)) | \
+           (jnp.clip(qexp, 1, max_exp) << m_bits) | man_r
+    code = jnp.where(overflow,
+                     (sign << (e_bits + m_bits)) | (max_exp << m_bits) | man_max,
+                     code)
+    code = jnp.where(underflow, sign << (e_bits + m_bits), code)
+    code = jnp.where(y == 0.0, 0, code)
+    return code.astype(jnp.int32)
+
+
+def _decode(code, e_bits, m_bits, bias):
+    sign = (code >> (e_bits + m_bits)) & 1
+    exp = (code >> m_bits) & ((1 << e_bits) - 1)
+    man = code & ((1 << m_bits) - 1)
+    zero = exp == 0
+    f32 = ((sign << 31) | ((exp - bias + 127) << 23) | (man << (23 - m_bits)))
+    val = jax.lax.bitcast_convert_type(f32.astype(jnp.int32), jnp.float32)
+    return jnp.where(zero, jnp.where(sign == 1, -0.0, 0.0), val)
+
+
+def _pack_codes(codes, bits):
+    """Flat int32 codes -> uint8 wire bytes (LSB-first bit stream). Pads with
+    zero codes to the packing unit (4 values/3B for fp6, 2 values/3B for
+    fp12); _unpack_codes slices back to the true length."""
+    per = 4 if bits == 6 else 2
+    n = codes.shape[0]
+    if n % per:
+        codes = jnp.pad(codes, (0, per - n % per))
+    n = codes.shape[0]
+    if bits == 6:
+        c = codes.reshape(-1, 4).astype(jnp.uint32)
+        word = c[:, 0] | (c[:, 1] << 6) | (c[:, 2] << 12) | (c[:, 3] << 18)
+        out = jnp.stack([word & 0xFF, (word >> 8) & 0xFF, (word >> 16) & 0xFF],
+                        axis=1)
+        return out.reshape(-1).astype(jnp.uint8)
+    c = codes.reshape(-1, 2).astype(jnp.uint32)
+    word = c[:, 0] | (c[:, 1] << 12)
+    out = jnp.stack([word & 0xFF, (word >> 8) & 0xFF, (word >> 16) & 0xFF], axis=1)
+    return out.reshape(-1).astype(jnp.uint8)
+
+
+def _unpack_codes(packed, n, bits):
+    by = packed.astype(jnp.uint32).reshape(-1, 3)
+    word = by[:, 0] | (by[:, 1] << 8) | (by[:, 2] << 16)
+    if bits == 6:
+        c = jnp.stack([word & 0x3F, (word >> 6) & 0x3F, (word >> 12) & 0x3F,
+                       (word >> 18) & 0x3F], axis=1)
+    else:
+        c = jnp.stack([word & 0xFFF, (word >> 12) & 0xFFF], axis=1)
+    return c.reshape(-1)[:n].astype(jnp.int32)
+
+
+def quantize_fp(x, bits=6, group_size=DEFAULT_GROUP):
+    """Groupwise FP quantization. Returns (packed uint8, fp32 group scales)."""
+    if bits not in _FORMATS:
+        raise ValueError(f"fp quantizer supports bits in {tuple(_FORMATS)}, got {bits}")
+    e_bits, m_bits, bias = _FORMATS[bits]
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    groups = max(1, -(-n // group_size))
+    pad = groups * group_size - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    g = flat.reshape(groups, -1)
+    amax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / _max_representable(e_bits, m_bits, bias),
+                      jnp.float32(1.0))
+    codes = _encode(g / scale, e_bits, m_bits, bias)
+    return _pack_codes(codes.reshape(-1), bits), scale[:, 0]
+
+
+def dequantize_fp(packed, scale, shape, bits=6, group_size=DEFAULT_GROUP,
+                  dtype=jnp.float32):
+    e_bits, m_bits, bias = _FORMATS[bits]
+    n = int(np.prod(shape))
+    groups = scale.shape[0]
+    codes = _unpack_codes(packed, groups * group_size, bits)
+    vals = _decode(codes, e_bits, m_bits, bias).reshape(groups, -1)
+    out = vals * scale[:, None]
+    return out.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+# the registry slot lives in ops/quantizer.py (FPQuantizerBuilder,
+# NAME="fp_quantizer") and points here.

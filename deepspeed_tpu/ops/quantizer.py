@@ -115,12 +115,10 @@ class QuantizerBuilder(OpBuilder):
 
 @register_op_builder
 class FPQuantizerBuilder(OpBuilder):
-    """FP6/FP12 quantization slot (reference csrc/fp_quantizer). The TPU path
-    uses int8/int4 groupwise quantization; FP6 packing is not implemented."""
+    """FP6/FP12 quantization (reference csrc/fp_quantizer — the FP6-LLM
+    capability): XLA bit-math pack/unpack in ``ops/fp_quantizer.py``."""
     NAME = "fp_quantizer"
 
-    def is_compatible(self, verbose=False):
-        return False
-
     def reference_impl(self):
-        return quantize
+        from deepspeed_tpu.ops.fp_quantizer import quantize_fp
+        return quantize_fp

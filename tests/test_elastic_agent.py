@@ -1,0 +1,102 @@
+"""Elastic agent: relaunch-on-failure with membership change
+(reference ``elasticity/elastic_agent.py:32`` capability)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+
+def write_worker(tmp_path, body):
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_clean_gang_exit(tmp_path):
+    w = write_worker(tmp_path, """
+        import os, sys
+        out = sys.argv[1]
+        rank = os.environ["RANK"]
+        open(os.path.join(out, f"ok{rank}_{os.environ['DS_ELASTIC_RESTART_COUNT']}"), "w").close()
+    """)
+    agent = DSElasticAgent(w, [str(tmp_path)], hosts=["localhost", "localhost"],
+                           max_restarts=2)
+    assert agent.run() == 0
+    assert agent.restarts == 0
+    assert (tmp_path / "ok0_0").exists() and (tmp_path / "ok1_0").exists()
+
+
+def test_restart_on_failure_then_succeed(tmp_path):
+    """First incarnation of rank 0 fails; the relaunched gang succeeds."""
+    w = write_worker(tmp_path, """
+        import os, sys
+        out = sys.argv[1]
+        flag = os.path.join(out, "failed_once")
+        if os.environ["RANK"] == "0" and not os.path.exists(flag):
+            open(flag, "w").close()
+            sys.exit(3)
+        open(os.path.join(out, f"done{os.environ['RANK']}"), "w").close()
+    """)
+    agent = DSElasticAgent(w, [str(tmp_path)], hosts=["localhost", "localhost"],
+                           max_restarts=2, restart_backoff=0.1)
+    assert agent.run() == 0
+    assert agent.restarts == 1
+    assert (tmp_path / "done0").exists() and (tmp_path / "done1").exists()
+
+
+def test_membership_change_recomputes_batch(tmp_path):
+    """Hostfile shrinks between incarnations: the agent revalidates the world
+    and exports the recomputed elastic micro-batch."""
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("localhost slots=1\nlocalhost2 slots=1\n")
+    w = write_worker(tmp_path, """
+        import os, sys
+        out, hostfile = sys.argv[1], sys.argv[2]
+        ws = os.environ["DS_ELASTIC_WORLD_SIZE"]
+        mb = os.environ["DS_ELASTIC_MICRO_BATCH"]
+        rank = os.environ["RANK"]
+        open(os.path.join(out, f"run_ws{ws}_mb{mb}_r{rank}"), "w").close()
+        if ws == "2" and rank == "0":
+            # simulate a preempted host: shrink membership, then die
+            open(hostfile, "w").write("localhost slots=1\\n")
+            sys.exit(7)
+    """)
+    # Worker spawn is local regardless of hostname (launcher='local')
+    agent = DSElasticAgent(w, [str(tmp_path), str(hostfile)],
+                           ds_config={"elasticity": {
+                               "enabled": True, "max_train_batch_size": 64,
+                               "micro_batch_sizes": [2, 4, 8],
+                               "min_gpus": 1, "max_gpus": 4}},
+                           hostfile=str(hostfile), max_restarts=2,
+                           restart_backoff=0.1, launcher="local")
+    assert agent.run() == 0
+    assert agent.world_history == [2, 1]
+    runs = sorted(f for f in os.listdir(tmp_path) if f.startswith("run_"))
+    assert any(f.startswith("run_ws2_") for f in runs)
+    assert any(f.startswith("run_ws1_") for f in runs)
+
+
+def test_restart_budget_exhausted(tmp_path):
+    w = write_worker(tmp_path, """
+        import sys
+        sys.exit(1)
+    """)
+    agent = DSElasticAgent(w, [], hosts=["localhost"], max_restarts=1,
+                           restart_backoff=0.05)
+    assert agent.run() == 1
+    assert agent.restarts == 2  # initial + 1 restart, then budget blown
+
+
+def test_invalid_world_size_rejected(tmp_path):
+    w = write_worker(tmp_path, "pass")
+    agent = DSElasticAgent(w, [], hosts=["h1", "h2", "h3"],
+                           ds_config={"elasticity": {
+                               "enabled": True, "max_train_batch_size": 8,
+                               "micro_batch_sizes": [4], "min_gpus": 1,
+                               "max_gpus": 2}},
+                           max_restarts=0, launcher="local")
+    assert agent.run() == 1  # 3 hosts not in the compatible set

@@ -146,3 +146,68 @@ def test_autotuner_picks_feasible_config():
     # every experiment either produced a metric or a recorded error
     for overrides, m, err in tuner.summary():
         assert (m is not None) or (err is not None)
+
+
+def test_autotuner_memory_pruning(monkeypatch):
+    """Infeasible stages are pruned by the cost model without running."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from tests.simple_model import SimpleModel, random_batches
+    model = SimpleModel(hidden_dim=16)
+    batches = random_batches(1, 8)
+    import jax as _jax
+    params = model.init(_jax.random.PRNGKey(0), batches[0])["params"]
+    tuner = Autotuner(model, params,
+                      {"train_batch_size": 8,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+                      lambda mbs: random_batches(1, mbs)[0],
+                      tuning_space={"zero_stage": [0, 1],
+                                    "remat_policy": ["nothing"]})
+    # tiny fake budget: stage 0 (replicated state) must be pruned, stage 1
+    # (sharded over the 8-device world) must fit
+    tuner.profile_model_info()
+    n = tuner.model_info["num_params"]
+    # fp32 state: stage0 = 16n bytes (4n params + 8n opt + 4n grads), stage1
+    # shards opt over 8 devices = 9n; effective budget 20n*0.6 = 12n sits
+    # between them
+    monkeypatch.setattr(tuner, "device_hbm_budget", lambda: int(n * 20))
+    assert tuner.prune(0, 2, "nothing", dp_world=8) is not None
+    assert tuner.prune(1, 2, "nothing", dp_world=8) is None
+    cfg, metric = tuner.tune()
+    pruned = [e for e in tuner.experiments if e.error and "pruned" in e.error]
+    ran = [e for e in tuner.experiments if e.metric is not None]
+    assert pruned and ran
+    assert all(e.overrides["zero_stage"] == 0 for e in pruned)
+    assert cfg["zero_optimization"]["stage"] == 1
+
+
+def test_autotuner_early_stopping(monkeypatch):
+    """The search stops after `early_stopping` consecutive non-improvements."""
+    from deepspeed_tpu.autotuning import autotuner as at
+    from tests.simple_model import SimpleModel, random_batches
+    model = SimpleModel(hidden_dim=16)
+    batches = random_batches(1, 8)
+    import jax as _jax
+    params = model.init(_jax.random.PRNGKey(0), batches[0])["params"]
+    tuner = at.Autotuner(model, params,
+                         {"train_batch_size": 8,
+                          "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+                         lambda mbs: random_batches(1, mbs)[0],
+                         tuning_space={"zero_stage": [0, 1, 2, 3],
+                                       "micro_batch_size": [2],
+                                       "remat_policy": ["nothing", "dots",
+                                                        "everything"]})
+    calls = []
+
+    def fake_run(exp):
+        calls.append(exp.overrides)
+        exp.metric = 100.0  # identical -> never improves after the first
+        return exp
+
+    monkeypatch.setattr(tuner, "_run_experiment", fake_run)
+    monkeypatch.setattr(tuner, "profile_model_info",
+                        lambda: setattr(tuner, "model_info",
+                                        {"num_params": 100, "fwd_flops": 1,
+                                         "fwd_macs": 1}) or tuner.model_info)
+    tuner.tune(early_stopping=3)
+    # 1 improving + 3 non-improving = 4 runs, not the full 12-point grid
+    assert len(calls) == 4
