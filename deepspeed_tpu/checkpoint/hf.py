@@ -27,7 +27,7 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 LLAMA_FAMILY = ("llama", "mistral", "qwen2")
-SUPPORTED = LLAMA_FAMILY + ("gpt2", "opt", "mixtral")
+SUPPORTED = LLAMA_FAMILY + ("gpt2", "opt", "mixtral", "falcon", "phi")
 
 
 class UnsupportedModelError(ValueError):
@@ -93,10 +93,12 @@ def _rotary_perm(dh):
     return perm
 
 
-def _permute_qk_out(mat, n_heads, dh, inverse=False):
+def _permute_qk_out(mat, n_heads, dh, inverse=False, rotary_dim=None):
     """Permute the per-head output dim (last axis) of a q/k projection
-    (kernel [in, H*Dh] or bias [H*Dh]) between rotary conventions."""
-    perm = _rotary_perm(dh)
+    (kernel [in, H*Dh] or bias [H*Dh]) between rotary conventions.
+    ``rotary_dim`` < dh permutes only the rotated slice (phi partial rotary)."""
+    rd = dh if rotary_dim is None else rotary_dim
+    perm = np.concatenate([_rotary_perm(rd), np.arange(rd, dh)])
     if inverse:
         perm = np.argsort(perm)
     shaped = mat.reshape(mat.shape[:-1] + (n_heads, dh))
@@ -433,6 +435,113 @@ def mixtral_from_flax(params, cfg, dtype=np.float32):
 
 
 # ---------------------------------------------------------------------------
+# falcon / phi (parallel-residual families, models/parallel_block.py)
+# ---------------------------------------------------------------------------
+
+def _falcon_split_qkv(fused, H, KV, Dh, interleaved):
+    """Fused QKV wire layout -> (q, k, v) on the OUTPUT axis (last).
+
+    multi_query=True stores contiguous blocks [H q | KV k | KV v];
+    multi_query=False stores per-head interleaved [H, (q,k,v), Dh]."""
+    if not interleaved:
+        return (fused[..., : H * Dh],
+                fused[..., H * Dh: (H + KV) * Dh],
+                fused[..., (H + KV) * Dh:])
+    shaped = fused.reshape(fused.shape[:-1] + (H, 3, Dh))
+    q, k, v = (shaped[..., j, :].reshape(fused.shape[:-1] + (H * Dh,))
+               for j in range(3))
+    return q, k, v
+
+
+def falcon_to_flax(sd, cfg, dtype=np.float32):
+    """HF Falcon (7b lineage: parallel_attn, rotary) -> tree. Handles both
+    multi_query (block QKV) and per-head-interleaved layouts, with or
+    without linear biases."""
+    H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    interleaved = KV == H  # multi_query=False stores per-head interleaved
+    sd = {k.removeprefix("transformer."): v for k, v in sd.items()}
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def ln(p):
+        return {"scale": g(p + ".weight"), "bias": g(p + ".bias")}
+
+    def lin(p, transform=None):
+        out = {"kernel": g(p + ".weight").T}
+        if p + ".bias" in sd:
+            out["bias"] = g(p + ".bias")
+        if transform:
+            out = {k: transform(v) for k, v in out.items()}
+        return out
+
+    def qkv_transform(w):
+        # w: [..., (H+2KV)*Dh] wire layout -> our [q|k|v] block layout with
+        # the rotary columns permuted to the interleaved convention
+        q, k, v = _falcon_split_qkv(w, H, KV, Dh, interleaved)
+        q = _permute_qk_out(q, H, Dh)
+        k = _permute_qk_out(k, KV, Dh)
+        return np.concatenate([q, k, v], axis=-1)
+
+    embed = g("word_embeddings.weight")
+    tree = {"embed_tokens": embed,
+            "final_layernorm": ln("ln_f")}
+    if not cfg.tie_lm_head:
+        tree["lm_head"] = sd["lm_head.weight"].astype(dtype) \
+            if "lm_head.weight" in sd else embed
+    for i in range(cfg.num_hidden_layers):
+        p = f"h.{i}."
+        tree[f"layers_{i}"] = {
+            "input_layernorm": ln(p + "input_layernorm"),
+            "query_key_value": lin(p + "self_attention.query_key_value",
+                                   transform=qkv_transform),
+            "dense": lin(p + "self_attention.dense"),
+            "fc1": lin(p + "mlp.dense_h_to_4h"),
+            "fc2": lin(p + "mlp.dense_4h_to_h"),
+        }
+    return tree
+
+
+def phi_to_flax(sd, cfg, dtype=np.float32):
+    """HF Phi (phi-1.5/phi-2) -> tree (partial rotary, biases everywhere)."""
+    H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    rd = cfg.rotary_dim
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def lin(p, heads=None):
+        out = {"kernel": g(p + ".weight").T}
+        if p + ".bias" in sd:
+            out["bias"] = g(p + ".bias")
+        if heads is not None:
+            out = {k: _permute_qk_out(v, heads, Dh, rotary_dim=rd)
+                   for k, v in out.items()}
+        return out
+
+    def ln(p):
+        return {"scale": g(p + ".weight"), "bias": g(p + ".bias")}
+
+    tree = {"embed_tokens": g("model.embed_tokens.weight"),
+            "final_layernorm": ln("model.final_layernorm"),
+            "lm_head": g("lm_head.weight")}
+    if "lm_head.bias" in sd:
+        tree["lm_head_bias"] = g("lm_head.bias")
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        tree[f"layers_{i}"] = {
+            "input_layernorm": ln(p + "input_layernorm"),
+            "q_proj": lin(p + "self_attn.q_proj", heads=H),
+            "k_proj": lin(p + "self_attn.k_proj", heads=KV),
+            "v_proj": lin(p + "self_attn.v_proj"),
+            "dense": lin(p + "self_attn.dense"),
+            "fc1": lin(p + "mlp.fc1"),
+            "fc2": lin(p + "mlp.fc2"),
+        }
+    return tree
+
+
+# ---------------------------------------------------------------------------
 # top-level API
 # ---------------------------------------------------------------------------
 
@@ -484,6 +593,56 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
                             rms_norm_eps=hf_cfg.rms_norm_eps,
                             rope_theta=getattr(hf_cfg, "rope_theta", 1e6))
         return MixtralForCausalLM(cfg), mixtral_to_flax(sd, cfg, dtype=dtype)
+    if mt == "falcon":
+        from deepspeed_tpu.models.parallel_block import (ParallelBlockConfig,
+                                                         ParallelBlockForCausalLM)
+        if getattr(hf_cfg, "new_decoder_architecture", False):
+            raise UnsupportedModelError(
+                "falcon new_decoder_architecture (40b/180b grouped-qkv layout) "
+                "not supported yet; 7b-lineage (multi_query) is")
+        if getattr(hf_cfg, "alibi", False):
+            raise UnsupportedModelError("falcon alibi variant not supported")
+        if not getattr(hf_cfg, "parallel_attn", True):
+            raise UnsupportedModelError(
+                "falcon parallel_attn=False (sequential-residual falcon-rw "
+                "lineage) not supported — the parallel-block model cannot "
+                "represent it")
+        kv = 1 if getattr(hf_cfg, "multi_query", True) else hf_cfg.num_attention_heads
+        cfg = ParallelBlockConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            intermediate_size=getattr(hf_cfg, "ffn_hidden_size",
+                                      4 * hf_cfg.hidden_size),
+            num_hidden_layers=hf_cfg.num_hidden_layers,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_key_value_heads=kv,
+            max_position_embeddings=getattr(hf_cfg, "max_position_embeddings", 2048),
+            layer_norm_eps=hf_cfg.layer_norm_epsilon,
+            rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+            use_bias=bool(getattr(hf_cfg, "bias", False)),
+            fused_qkv=True,
+            tie_lm_head=bool(getattr(hf_cfg, "tie_word_embeddings", False)))
+        return (ParallelBlockForCausalLM(cfg),
+                falcon_to_flax(sd, cfg, dtype=dtype))
+    if mt == "phi":
+        from deepspeed_tpu.models.parallel_block import (ParallelBlockConfig,
+                                                         ParallelBlockForCausalLM)
+        kv = getattr(hf_cfg, "num_key_value_heads", None) or hf_cfg.num_attention_heads
+        cfg = ParallelBlockConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            intermediate_size=hf_cfg.intermediate_size,
+            num_hidden_layers=hf_cfg.num_hidden_layers,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_key_value_heads=kv,
+            max_position_embeddings=hf_cfg.max_position_embeddings,
+            layer_norm_eps=hf_cfg.layer_norm_eps,
+            rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+            rotary_pct=getattr(hf_cfg, "partial_rotary_factor", 1.0),
+            use_bias=True, fused_qkv=False,
+            # phi hidden_act is gelu_new (tanh); exact only if configured so
+            gelu_exact=getattr(hf_cfg, "hidden_act", "gelu_new")
+            not in ("gelu_new", "gelu_pytorch_tanh"),
+            lm_head_bias="lm_head.bias" in sd)
+        return ParallelBlockForCausalLM(cfg), phi_to_flax(sd, cfg, dtype=dtype)
     raise UnsupportedModelError(
         f"unsupported model_type {mt!r}; supported: {SUPPORTED}")
 

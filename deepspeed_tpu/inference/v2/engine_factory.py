@@ -13,7 +13,7 @@ import numpy as np
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.utils.logging import logger
 
-SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral")
+SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral", "falcon", "phi")
 
 
 def build_hf_engine(path, engine_config=None, dtype=None):
@@ -53,10 +53,14 @@ def resolve_forward_fn(model, family=None):
     """The ragged implementation for a model family (the reference's policy
     map, ``engine_factory.py:68-129``)."""
     if family is None:
-        family = ("mixtral" if type(model.config).__name__ == "MixtralConfig"
-                  else "llama")
+        name = type(model.config).__name__
+        family = {"MixtralConfig": "mixtral",
+                  "ParallelBlockConfig": "falcon"}.get(name, "llama")
     if family == "mixtral":
         from deepspeed_tpu.inference.v2.model_implementations.mixtral import (
+            ragged_forward)
+    elif family in ("falcon", "phi"):
+        from deepspeed_tpu.inference.v2.model_implementations.parallel_block import (
             ragged_forward)
     else:
         from deepspeed_tpu.inference.v2.model_implementations.llama import (

@@ -43,8 +43,10 @@ def _scatter_kv(k_pool, v_pool, k, v, block_tables, seen, q_len, block_size):
                      (nb - 1) * block_size)
     kf = k_pool.reshape(nb * block_size, *k_pool.shape[2:])
     vf = v_pool.reshape(nb * block_size, *v_pool.shape[2:])
-    kf = kf.at[flat.reshape(-1)].set(k.reshape(S * Q, *k.shape[2:]))
-    vf = vf.at[flat.reshape(-1)].set(v.reshape(S * Q, *v.shape[2:]))
+    kf = kf.at[flat.reshape(-1)].set(
+        k.reshape(S * Q, *k.shape[2:]).astype(k_pool.dtype))
+    vf = vf.at[flat.reshape(-1)].set(
+        v.reshape(S * Q, *v.shape[2:]).astype(v_pool.dtype))
     return kf.reshape(k_pool.shape), vf.reshape(v_pool.shape)
 
 

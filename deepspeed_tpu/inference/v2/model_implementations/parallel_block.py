@@ -1,0 +1,78 @@
+"""Ragged (paged-KV) forward for the parallel-residual families (Falcon/Phi).
+
+Reference v2 implementations ``inference/v2/model_implementations/{falcon,phi}``
+(two of the eight ``engine_factory.py:68-129`` families). Shares the paged
+attention pieces with the llama implementation; the block math follows
+``models/parallel_block.py`` (shared input layernorm, parallel attn+mlp
+residual, fused-MQA or split qkv, partial rotary).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.parallel_block import partial_rotary
+from deepspeed_tpu.inference.v2.model_implementations.llama import (
+    _paged_attention, _scatter_kv)
+
+
+def _layernorm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
+                   block_tables):
+    """One ragged Falcon/Phi forward step -> (last-token logits, new pools)."""
+    S, Q = tokens.shape
+    H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    bs = k_pool.shape[2]
+    positions = seen[:, None] + jnp.arange(Q)[None, :]
+
+    embed = params["embed_tokens"].astype(cfg.dtype)
+    x = embed[tokens]
+
+    def lin(p, h):
+        y = h @ p["kernel"].astype(cfg.dtype)
+        if "bias" in p:
+            y = y + p["bias"].astype(cfg.dtype)
+        return y
+
+    for i in range(cfg.num_hidden_layers):
+        lp = params[f"layers_{i}"]
+        ln = lp["input_layernorm"]
+        h = _layernorm(x, ln["scale"], ln["bias"], cfg.layer_norm_eps)
+        if cfg.fused_qkv:
+            qkv = lin(lp["query_key_value"], h)
+            q = qkv[..., : H * Dh].reshape(S, Q, H, Dh)
+            k = qkv[..., H * Dh: (H + KV) * Dh].reshape(S, Q, KV, Dh)
+            v = qkv[..., (H + KV) * Dh:].reshape(S, Q, KV, Dh)
+        else:
+            q = lin(lp["q_proj"], h).reshape(S, Q, H, Dh)
+            k = lin(lp["k_proj"], h).reshape(S, Q, KV, Dh)
+            v = lin(lp["v_proj"], h).reshape(S, Q, KV, Dh)
+        q = partial_rotary(q, positions, cfg.rope_theta, cfg.rotary_dim)
+        k = partial_rotary(k, positions, cfg.rope_theta, cfg.rotary_dim)
+        kp, vp = _scatter_kv(k_pool[i], v_pool[i], k, v, block_tables, seen,
+                             q_len, bs)
+        k_pool = k_pool.at[i].set(kp)
+        v_pool = v_pool.at[i].set(vp)
+        attn = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len)
+        attn_out = lin(lp["dense"], attn.reshape(S, Q, H * Dh))
+        mlp_out = lin(lp["fc2"], jax.nn.gelu(lin(lp["fc1"], h),
+                                             approximate=not cfg.gelu_exact))
+        x = x + attn_out + mlp_out
+
+    fl = params["final_layernorm"]
+    x = _layernorm(x, fl["scale"], fl["bias"], cfg.layer_norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(q_len - 1, 0)[:, None, None], axis=1)[:, 0]
+    head = embed if cfg.tie_lm_head else params["lm_head"].astype(cfg.dtype)
+    logits = last @ head.T
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), k_pool, v_pool

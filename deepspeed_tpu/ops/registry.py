@@ -97,3 +97,8 @@ def _populate():
         import deepspeed_tpu.ops.quantizer  # noqa: F401
     except Exception:
         pass
+    for mod in ("cpu_adagrad", "cpu_lion", "evoformer_attn"):
+        try:
+            __import__(f"deepspeed_tpu.ops.{mod}")
+        except Exception:
+            pass

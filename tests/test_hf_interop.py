@@ -268,3 +268,93 @@ def test_mistral_export_keeps_window(tmp_path):
         hf_cfg = _json.load(f)
     assert hf_cfg["model_type"] == "mistral"
     assert hf_cfg["sliding_window"] == cfg.sliding_window
+
+
+def test_falcon_logits(tmp_path):
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True,
+        new_decoder_architecture=False, parallel_attn=True, bias=False,
+        alibi=False, max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(12)
+    hf_model = transformers.FalconForCausalLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    assert model.config.num_key_value_heads == 1  # MQA
+    import dataclasses
+    fcfg = dataclasses.replace(model.config, dtype=jnp.float32, remat=False)
+    ids = np.random.default_rng(12).integers(0, 128, size=(2, 10)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
+
+
+def test_phi_logits(tmp_path):
+    cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=64,
+        tie_word_embeddings=False)
+    torch.manual_seed(13)
+    hf_model = transformers.PhiForCausalLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    assert model.config.rotary_dim == 8  # 0.5 * head_dim 16
+    import dataclasses
+    fcfg = dataclasses.replace(model.config, dtype=jnp.float32, remat=False)
+    ids = np.random.default_rng(13).integers(0, 128, size=(2, 10)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
+
+
+def test_falcon_phi_trainable():
+    """New families train through the engine (loss decreases)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.falcon import tiny_falcon_config
+    from deepspeed_tpu.models.phi import tiny_phi_config
+    from deepspeed_tpu.models.parallel_block import ParallelBlockForCausalLM
+    for cfg in (tiny_falcon_config(), tiny_phi_config()):
+        model = ParallelBlockForCausalLM(cfg)
+        ids = (np.arange(8 * 16) % cfg.vocab_size).astype(np.int32).reshape(8, 16)
+        batch = {"input_ids": ids, "labels": ids}
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_batch_size": 8, "bf16": {"enabled": True},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                    "zero_optimization": {"stage": 2}})
+        losses = []
+        for _ in range(5):
+            loss = engine(batch); engine.backward(loss); engine.step()
+            losses.append(float(jax.device_get(loss)))
+        assert losses[-1] < losses[0], (type(cfg).__name__, losses)
+
+
+def test_falcon_mha_interleaved_and_bias_logits(tmp_path):
+    """multi_query=False (per-head interleaved fused QKV) + bias=True."""
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False,
+        new_decoder_architecture=False, parallel_attn=True, bias=True,
+        alibi=False, max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(14)
+    hf_model = transformers.FalconForCausalLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    assert model.config.num_key_value_heads == 4 and model.config.use_bias
+    import dataclasses
+    fcfg = dataclasses.replace(model.config, dtype=jnp.float32, remat=False)
+    ids = np.random.default_rng(14).integers(0, 128, size=(2, 10)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
+
+
+def test_falcon_sequential_residual_rejected(tmp_path):
+    cfg = transformers.FalconConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, multi_query=True,
+        new_decoder_architecture=False, parallel_attn=False, alibi=False,
+        bias=True, max_position_embeddings=32)
+    torch.manual_seed(15)
+    m = transformers.FalconForCausalLM(cfg)
+    d = save_hf(m, cfg, tmp_path)
+    with pytest.raises(ValueError, match="parallel_attn"):
+        hf_interop.load_pretrained(d)

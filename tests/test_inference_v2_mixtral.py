@@ -151,3 +151,50 @@ def test_mistral_sliding_window_through_v2_engine(tmp_path):
     logits = eng.put([1], [prompt])
     ref = hf_next_logits(hf, prompt[None])
     np.testing.assert_allclose(logits[0], ref[0], atol=2e-2, rtol=2e-2)
+
+
+def test_falcon_through_v2_engine(tmp_path):
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True,
+        new_decoder_architecture=False, parallel_attn=True, bias=False,
+        alibi=False, max_position_embeddings=128, tie_word_embeddings=False)
+    torch.manual_seed(6)
+    hf = transformers.FalconForCausalLM(cfg).eval()
+    d = str(tmp_path / "falcon")
+    hf.save_pretrained(d, safe_serialization=True)
+    eng = build_hf_engine(d, {"state_manager": {"max_ragged_sequence_count": 2,
+                                                "max_ragged_batch_size": 64,
+                                                "max_context": 128}},
+                          dtype=np.float32)
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, 128, size=11).astype(np.int32)
+    logits = eng.put([1], [prompt])
+    ref = hf_next_logits(hf, prompt[None])
+    np.testing.assert_allclose(logits[0], ref[0], atol=2e-2, rtol=2e-2)
+    # decode continues greedily in agreement
+    nxt = int(np.argmax(logits[0]))
+    logits2 = eng.put([1], [np.asarray([nxt], np.int32)])
+    ref2 = hf_next_logits(hf, np.asarray(list(prompt) + [nxt], np.int64)[None])
+    np.testing.assert_allclose(logits2[0], ref2[0], atol=2e-2, rtol=2e-2)
+
+
+def test_phi_through_v2_engine(tmp_path):
+    cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=128,
+        tie_word_embeddings=False)
+    torch.manual_seed(7)
+    hf = transformers.PhiForCausalLM(cfg).eval()
+    d = str(tmp_path / "phi")
+    hf.save_pretrained(d, safe_serialization=True)
+    eng = build_hf_engine(d, {"state_manager": {"max_ragged_sequence_count": 2,
+                                                "max_ragged_batch_size": 64,
+                                                "max_context": 128}},
+                          dtype=np.float32)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 128, size=9).astype(np.int32)
+    logits = eng.put([1], [prompt])
+    ref = hf_next_logits(hf, prompt[None])
+    np.testing.assert_allclose(logits[0], ref[0], atol=2e-2, rtol=2e-2)

@@ -109,10 +109,13 @@ def test_explicit_missing_hostfile_errors(tmp_path):
 
 
 def test_remote_with_localhost_master_rejected(tmp_path):
+    """ssh mode: a coordinator the remote workers cannot reach must be
+    rejected before spawning. (local mode spawns every node on this machine,
+    so a loopback coordinator is correct there — see test_launcher_smoke.)"""
     hf = tmp_path / "hf"
     hf.write_text("localhost slots=4\nworker-1 slots=4\n")
     with pytest.raises(ValueError, match="master_addr"):
-        runner_main(["--hostfile", str(hf), "--launcher", "local", "x.py"])
+        runner_main(["--hostfile", str(hf), "--launcher", "ssh", "x.py"])
 
 
 def test_local_launch_runs_script(tmp_path):
