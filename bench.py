@@ -124,8 +124,19 @@ def run_bench():
     t0 = time.perf_counter()
     loss = step()
     jax.block_until_ready(loss)
-    print(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={float(loss):.3f}",
+    first_loss = float(jax.device_get(loss))
+    print(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={first_loss:.3f}",
           file=sys.stderr)
+    # sanity: random-init CE should be ~ln(vocab). An insane/NaN loss on the
+    # Pallas path means a kernel miscompile — rerun once on pure XLA.
+    import math
+    expected = math.log(cfg.vocab_size)
+    if on_tpu and not (abs(first_loss - expected) < 3.0) and \
+            not os.environ.get("DS_TPU_DISABLE_PALLAS"):
+        print(f"bench: first loss {first_loss:.2f} vs expected ~{expected:.1f}; "
+              f"retrying with DS_TPU_DISABLE_PALLAS=1", file=sys.stderr)
+        os.environ["DS_TPU_DISABLE_PALLAS"] = "1"
+        os.execv(sys.executable, [sys.executable] + sys.argv)
 
     n_steps = 10 if on_tpu else 3
     t0 = time.perf_counter()

@@ -570,6 +570,15 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
                                                   dtype=dtype)
     if mt == "opt":
         from deepspeed_tpu.models.opt import OPTConfig, OPTForCausalLM
+        if not getattr(hf_cfg, "do_layer_norm_before", True):
+            raise UnsupportedModelError(
+                "OPT do_layer_norm_before=False (opt-350m post-LN lineage) "
+                "not supported — the pre-LN model cannot represent it")
+        if getattr(hf_cfg, "word_embed_proj_dim",
+                   hf_cfg.hidden_size) != hf_cfg.hidden_size:
+            raise UnsupportedModelError(
+                "OPT word_embed_proj_dim != hidden_size (project_in/out "
+                "lineage, e.g. opt-350m) not supported")
         cfg = OPTConfig(vocab_size=hf_cfg.vocab_size,
                         hidden_size=hf_cfg.hidden_size,
                         ffn_dim=hf_cfg.ffn_dim,

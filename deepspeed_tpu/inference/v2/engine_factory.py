@@ -13,7 +13,8 @@ import numpy as np
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.utils.logging import logger
 
-SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral", "falcon", "phi")
+SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral", "falcon", "phi",
+                      "opt")
 
 
 def build_hf_engine(path, engine_config=None, dtype=None):
@@ -55,12 +56,16 @@ def resolve_forward_fn(model, family=None):
     if family is None:
         name = type(model.config).__name__
         family = {"MixtralConfig": "mixtral",
-                  "ParallelBlockConfig": "falcon"}.get(name, "llama")
+                  "ParallelBlockConfig": "falcon",
+                  "OPTConfig": "opt"}.get(name, "llama")
     if family == "mixtral":
         from deepspeed_tpu.inference.v2.model_implementations.mixtral import (
             ragged_forward)
     elif family in ("falcon", "phi"):
         from deepspeed_tpu.inference.v2.model_implementations.parallel_block import (
+            ragged_forward)
+    elif family == "opt":
+        from deepspeed_tpu.inference.v2.model_implementations.opt import (
             ragged_forward)
     else:
         from deepspeed_tpu.inference.v2.model_implementations.llama import (

@@ -52,8 +52,10 @@ class InferenceEngineV2:
         self._ragged_forward = forward_fn
         head_dim = getattr(cfg, "head_dim", None) or \
             cfg.hidden_size // cfg.num_attention_heads
+        kv_heads = getattr(cfg, "num_key_value_heads",
+                           cfg.num_attention_heads)  # OPT has no GQA field
         self._state = DSStateManager(config, cfg.num_hidden_layers,
-                                     cfg.num_key_value_heads, head_dim)
+                                     kv_heads, head_dim)
         sm = config.state_manager
         bs = self._state.kv_block_size
         self._max_blocks_per_seq = -(-sm.max_context // bs)

@@ -24,6 +24,11 @@ class OpBuilder:
     _warned_fallback = set()
 
     def is_compatible(self, verbose=False):
+        import os
+        if os.environ.get("DS_TPU_DISABLE_PALLAS"):
+            # operational kill-switch: force every op onto the pure-XLA path
+            # (e.g. to isolate a suspected kernel miscompile in production)
+            return False
         try:
             import jax
             plat = jax.devices()[0].platform
@@ -97,7 +102,8 @@ def _populate():
         import deepspeed_tpu.ops.quantizer  # noqa: F401
     except Exception:
         pass
-    for mod in ("cpu_adagrad", "cpu_lion", "evoformer_attn"):
+    for mod in ("cpu_adagrad", "cpu_lion", "evoformer_attn",
+                "sparse_attention.sparse_self_attention"):
         try:
             __import__(f"deepspeed_tpu.ops.{mod}")
         except Exception:

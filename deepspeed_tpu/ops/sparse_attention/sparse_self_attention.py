@@ -35,8 +35,20 @@ def sparse_attention(q, k, v, layout, block, causal=False, softmax_scale=None):
     """Masked multi-head attention under a block-sparsity layout.
 
     q/k/v: [B, H, S, D]; layout: [H, S/block, S/block] (np or jnp) from a
-    SparsityConfig.make_layout; returns [B, H, S, D]."""
+    SparsityConfig.make_layout; returns [B, H, S, D]. On TPU the Pallas
+    splash-style kernel (ops/pallas/block_sparse_attention.py) runs when the
+    shapes tile — O(enabled-blocks) fetch and compute, the Triton kernels'
+    property."""
     B, H, S, D = q.shape
+    from deepspeed_tpu.ops.registry import get_op_builder
+    builder_cls = get_op_builder("sparse_attn")
+    if builder_cls is not None and builder_cls().is_compatible():
+        # registry gate: TPU platform + DS_TPU_DISABLE_PALLAS kill-switch
+        from deepspeed_tpu.ops.pallas import block_sparse_attention as bsa
+        if bsa.is_supported(q.shape, block) and \
+                not isinstance(layout, jax.core.Tracer):
+            return bsa.sparse_mha(q, k, v, layout, block, causal=causal,
+                                  softmax_scale=softmax_scale)
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(D)
     mask = _token_mask_from_layout(layout, block)  # [H, S, S]
     if causal:
@@ -102,3 +114,23 @@ class SparseSelfAttention(nn.Module):
                                causal=self.causal)
         out = out.transpose(0, 2, 1, 3).reshape(B, S, E)
         return nn.Dense(E, name="out")(out)
+
+
+from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder  # noqa: E402
+
+
+@register_op_builder
+class SparseAttnBuilder(OpBuilder):
+    """Parity slot for op_builder/sparse_attn.py: the Pallas splash-style
+    kernel (ops/pallas/block_sparse_attention.py) is the fast path."""
+    NAME = "sparse_attn"
+
+    def pallas_impl(self):
+        try:
+            from deepspeed_tpu.ops.pallas.block_sparse_attention import sparse_mha
+            return sparse_mha
+        except Exception:
+            return None
+
+    def reference_impl(self):
+        return sparse_attention

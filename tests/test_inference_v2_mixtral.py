@@ -198,3 +198,29 @@ def test_phi_through_v2_engine(tmp_path):
     logits = eng.put([1], [prompt])
     ref = hf_next_logits(hf, prompt[None])
     np.testing.assert_allclose(logits[0], ref[0], atol=2e-2, rtol=2e-2)
+
+
+def test_opt_through_v2_engine(tmp_path):
+    """OPT completes the reference's v2 family set (engine_factory.py:99)."""
+    cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        do_layer_norm_before=True, word_embed_proj_dim=64)
+    torch.manual_seed(8)
+    hf = transformers.OPTForCausalLM(cfg).eval()
+    d = str(tmp_path / "opt")
+    hf.save_pretrained(d, safe_serialization=True)
+    eng = build_hf_engine(d, {"state_manager": {"max_ragged_sequence_count": 2,
+                                                "max_ragged_batch_size": 64,
+                                                "max_context": 128}},
+                          dtype=np.float32)
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 128, size=12).astype(np.int32)
+    logits = eng.put([1], [prompt])
+    ref = hf_next_logits(hf, prompt[None])
+    np.testing.assert_allclose(logits[0], ref[0], atol=2e-2, rtol=2e-2)
+    # decode leg (positions must keep the +2 OPT offset through the cache)
+    nxt = int(np.argmax(logits[0]))
+    logits2 = eng.put([1], [np.asarray([nxt], np.int32)])
+    ref2 = hf_next_logits(hf, np.asarray(list(prompt) + [nxt], np.int64)[None])
+    np.testing.assert_allclose(logits2[0], ref2[0], atol=2e-2, rtol=2e-2)
