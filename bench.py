@@ -91,42 +91,67 @@ def run_bench():
     on_tpu = devs[0].platform in ("tpu", "axon")
     print(f"bench: {n_chips}x {kind}", file=sys.stderr)
 
-    batch, seq = (16, 1024) if on_tpu else (2, 128)
+    seq = 1024 if on_tpu else 128
     cfg = GPT2Config.small() if on_tpu else GPT2Config.tiny()
     cfg = type(cfg)(**{**cfg.__dict__, "n_positions": max(cfg.n_positions, seq),
                        "scan_layers": True, "remat": True})
     model = GPT2LMHeadModel(cfg)
 
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, size=(batch * max(n_chips, 1), seq)).astype(np.int32)
-    batch_data = {"input_ids": ids, "labels": ids}
+    # flash attention + chunked CE freed the [B,H,T,T] and [B,T,V] buffers;
+    # try the larger per-chip batches first and fall back on OOM
+    if os.environ.get("DS_BENCH_BATCH"):
+        candidates = [int(os.environ["DS_BENCH_BATCH"])]
+    else:
+        candidates = [32, 16, 8] if on_tpu else [2]
 
-    params = model.init(jax.random.PRNGKey(0), batch_data)["params"]
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model,
-        model_parameters=params,
-        config={
-            "train_micro_batch_size_per_gpu": batch,
-            "gradient_accumulation_steps": 1,
-            "bf16": {"enabled": True},
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "zero_optimization": {"stage": 1},
-            "gradient_clipping": 1.0,
-        })
+    engine = batch_data = None
+    last_err = None
+    for batch in candidates:
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size,
+                           size=(batch * max(n_chips, 1), seq)).astype(np.int32)
+        batch_data = {"input_ids": ids, "labels": ids}
+        try:
+            from deepspeed_tpu.parallel import groups
+            groups.reset()
+            params = model.init(jax.random.PRNGKey(0), batch_data)["params"]
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model,
+                model_parameters=params,
+                config={
+                    "train_micro_batch_size_per_gpu": batch,
+                    "gradient_accumulation_steps": 1,
+                    "bf16": {"enabled": True},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                    "zero_optimization": {"stage": 1},
+                    "gradient_clipping": 1.0,
+                })
 
-    def step():
-        loss = engine(batch_data)
-        engine.backward(loss)
-        engine.step()
-        return loss
+            def step():
+                loss = engine(batch_data)
+                engine.backward(loss)
+                engine.step()
+                return loss
 
-    # warmup (compile)
-    t0 = time.perf_counter()
-    loss = step()
-    jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            loss = step()
+            jax.block_until_ready(loss)
+            break
+        except Exception as e:  # OOM at this batch -> try the next size down
+            # keep only the message: the traceback would pin the failed
+            # attempt's device buffers and params, OOMing the retry too
+            last_err = RuntimeError(f"{type(e).__name__}: {e}")
+            engine = params = None
+            import gc
+            gc.collect()
+            print(f"bench: batch {batch} failed ({type(e).__name__}); "
+                  f"falling back", file=sys.stderr)
+    if engine is None:
+        raise last_err
+
     first_loss = float(jax.device_get(loss))
-    print(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={first_loss:.3f}",
-          file=sys.stderr)
+    print(f"compile+first step: {time.perf_counter()-t0:.1f}s "
+          f"batch={batch} loss={first_loss:.3f}", file=sys.stderr)
     # sanity: random-init CE should be ~ln(vocab). An insane/NaN loss on the
     # Pallas path means a kernel miscompile — rerun once on pure XLA.
     import math

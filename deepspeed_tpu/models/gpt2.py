@@ -159,12 +159,13 @@ class GPT2LMHeadModel(nn.Module):
                 x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
-        logits = x @ wte.astype(cfg.dtype).T  # tied embeddings
 
         if labels is None:
-            return logits
-        from deepspeed_tpu.models.losses import next_token_loss
-        return next_token_loss(logits, labels)
+            return x @ wte.astype(cfg.dtype).T  # tied embeddings
+        # training: fused chunked linear+CE for large vocabs — never
+        # materializes the [B, T, V] logits (models/losses.py)
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, wte, labels)
 
     def param_specs(self, params):
         """Tensor-parallel PartitionSpecs (Megatron column/row pattern)."""

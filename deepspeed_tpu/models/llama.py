@@ -246,12 +246,13 @@ class LlamaForCausalLM(nn.Module):
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         lm_head = self.param("lm_head", nn.initializers.normal(0.02),
                              (cfg.vocab_size, cfg.hidden_size), jnp.float32)
-        logits = x @ lm_head.astype(cfg.dtype).T
 
         if labels is None:
-            return logits
-        from deepspeed_tpu.models.losses import next_token_loss
-        return next_token_loss(logits, labels)
+            return x @ lm_head.astype(cfg.dtype).T
+        # training: fused chunked linear+CE for large vocabs — never
+        # materializes the [B, T, V] logits (models/losses.py)
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, lm_head, labels)
 
     def param_specs(self, params):
         """Megatron-style TP specs: q/k/v/gate/up column-split, o/down row-split,

@@ -19,12 +19,133 @@ def next_token_loss(logits, labels, ignore_index=None):
     return cross_entropy(logits[:, :-1], labels[:, 1:], ignore_index=ignore_index)
 
 
+def _masked_mean(nll, targets, ignore_index):
+    if ignore_index is None:
+        return nll.mean()
+    mask = (targets != ignore_index).astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
 def cross_entropy(logits, targets, ignore_index=None):
     """Unshifted CE over the last axis (utility for non-causal tasks)."""
     lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = lse - tgt.astype(jnp.float32)
-    if ignore_index is not None:
-        mask = (targets != ignore_index).astype(jnp.float32)
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    return nll.mean()
+    return _masked_mean(nll, targets, ignore_index)
+
+
+# ---------------------------------------------------------------------------
+# fused linear + cross-entropy (chunked vocab)
+# ---------------------------------------------------------------------------
+#
+# The lm-head matmul of a 50k-vocab model materializes [B*T, V] logits (bf16
+# ~1.6GB at 16x1024) plus their gradient — often the single largest
+# activation. This computes loss and gradients by scanning vocab chunks with
+# an online logsumexp, so peak memory is O(B*T*chunk): the capability the
+# reference gets from fused-softmax kernels, done the XLA way (scan + fused
+# reductions; each chunk matmul still saturates the MXU).
+
+import functools
+
+
+def _pad_head(head, chunk):
+    V, D = head.shape
+    K = -(-V // chunk)
+    pad = K * chunk - V
+    if pad:
+        head = jnp.pad(head, ((0, pad), (0, 0)))
+    return head.reshape(K, chunk, D), V
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_cross_entropy(x, head, labels, chunk=8192):
+    """Per-token nll of softmax(x @ head.T) without materializing the logits.
+
+    x: [N, D]; head: [V, D]; labels: [N] int -> nll [N] fp32.
+    """
+    nll, _ = _flce_fwd_impl(x, head, labels, chunk)
+    return nll
+
+
+def _flce_fwd_impl(x, head, labels, chunk):
+    N, D = x.shape
+    Wc, V = _pad_head(head, chunk)
+    K = Wc.shape[0]
+
+    def step(carry, inputs):
+        m, l, tgt = carry
+        w, kidx = inputs
+        start = kidx * chunk
+        logits = (x @ w.T).astype(jnp.float32)             # [N, chunk]
+        col = start + jnp.arange(chunk)[None, :]
+        logits = jnp.where(col < V, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        rel = labels - start
+        in_chunk = (labels >= start) & (labels < start + chunk)
+        got = jnp.take_along_axis(logits, jnp.clip(rel, 0, chunk - 1)[:, None],
+                                  axis=-1)[:, 0]
+        tgt = tgt + jnp.where(in_chunk, got, 0.0)
+        return (m_new, l, tgt), None
+
+    init = (jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32), jnp.zeros((N,), jnp.float32))
+    (m, l, tgt), _ = jax.lax.scan(step, init, (Wc, jnp.arange(K)))
+    lse = m + jnp.log(l)
+    return lse - tgt, lse
+
+
+def _flce_fwd(x, head, labels, chunk):
+    nll, lse = _flce_fwd_impl(x, head, labels, chunk)
+    return nll, (x, head, labels, lse)
+
+
+def _flce_bwd(chunk, res, g):
+    x, head, labels, lse = res
+    N, D = x.shape
+    Wc, V = _pad_head(head, chunk)
+    K = Wc.shape[0]
+    g32 = g.astype(jnp.float32)
+
+    def step(dx, inputs):
+        w, kidx = inputs
+        start = kidx * chunk
+        logits = (x @ w.T).astype(jnp.float32)
+        col = start + jnp.arange(chunk)[None, :]
+        logits = jnp.where(col < V, logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])                 # softmax chunk
+        onehot = (labels[:, None] == col).astype(jnp.float32)
+        dl = (p - onehot) * g32[:, None]                   # [N, chunk]
+        dx = dx + dl @ w.astype(jnp.float32)               # fp32 carry
+        dw = dl.T @ x.astype(jnp.float32)                  # [chunk, D]
+        return dx, dw
+
+    # dx accumulates in fp32 across chunks (one cast at the end) — a bf16
+    # carry would round K times where the dense matmul rounds once
+    dx0 = jnp.zeros(x.shape, jnp.float32)
+    dx, dWc = jax.lax.scan(step, dx0, (Wc, jnp.arange(K)))
+    dW = dWc.reshape(K * chunk, D)[:V].astype(head.dtype)
+    return dx.astype(x.dtype), dW, None
+
+
+fused_linear_cross_entropy.defvjp(_flce_fwd, _flce_bwd)
+
+FUSED_CE_MIN_VOCAB = 16384
+
+
+def lm_head_next_token_loss(x, head, labels, ignore_index=None, chunk=8192):
+    """Causal-LM loss straight from hidden states + lm_head weights.
+
+    x: [B, T, D]; head: [V, D]; labels: [B, T]. Uses the fused chunked path
+    for large vocabularies (never materializes [B, T, V]), the plain matmul
+    below ``FUSED_CE_MIN_VOCAB``."""
+    B, T, D = x.shape
+    V = head.shape[0]
+    if V < FUSED_CE_MIN_VOCAB:
+        logits = x @ head.astype(x.dtype).T
+        return next_token_loss(logits, labels, ignore_index=ignore_index)
+    xs = x[:, :-1].reshape(-1, D)
+    ys = labels[:, 1:].reshape(-1)
+    nll = fused_linear_cross_entropy(xs, head.astype(x.dtype), ys, chunk)
+    return _masked_mean(nll, ys, ignore_index)

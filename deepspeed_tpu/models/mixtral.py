@@ -120,11 +120,10 @@ class MixtralForCausalLM(nn.Module):
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         lm_head = self.param("lm_head", nn.initializers.normal(0.02),
                              (cfg.vocab_size, cfg.hidden_size), jnp.float32)
-        logits = x @ lm_head.astype(cfg.dtype).T
         if labels is None:
-            return logits
-        from deepspeed_tpu.models.losses import next_token_loss
-        lm_loss = next_token_loss(logits, labels)
+            return x @ lm_head.astype(cfg.dtype).T
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        lm_loss = lm_head_next_token_loss(x, lm_head, labels)
         return lm_loss + cfg.router_aux_loss_coef * total_aux / cfg.num_hidden_layers
 
     def param_specs(self, params):

@@ -143,11 +143,10 @@ class OPTForCausalLM(nn.Module):
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          name="final_layer_norm")(x)
-        logits = x @ embed.astype(cfg.dtype).T  # tied embeddings
         if labels is None:
-            return logits
-        from deepspeed_tpu.models.losses import next_token_loss
-        return next_token_loss(logits, labels)
+            return x @ embed.astype(cfg.dtype).T  # tied embeddings
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, embed, labels)
 
     def param_specs(self, params):
         """Megatron column/row TP pattern over q/k/v/fc1 (column) and

@@ -158,20 +158,24 @@ class ParallelBlockForCausalLM(nn.Module):
         for i in range(cfg.num_hidden_layers):
             x = block_cls(cfg, use_cache, name=f"layers_{i}")(x, positions)
         x = _LN(cfg.layer_norm_eps, cfg.dtype, name="final_layernorm")(x)
-        if cfg.tie_lm_head:
-            logits = x @ embed.astype(cfg.dtype).T
-        else:
-            head = self.param("lm_head", nn.initializers.normal(0.02),
-                              (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        head = embed if cfg.tie_lm_head else self.param(
+            "lm_head", nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        hb = self.param("lm_head_bias", nn.initializers.zeros,
+                        (cfg.vocab_size,), jnp.float32) \
+            if (cfg.lm_head_bias and not cfg.tie_lm_head) else None
+        if labels is None or hb is not None:
+            # the biased head (phi) keeps the dense path — the fused CE has
+            # no bias slot; falcon-size vocabs without bias go fused
             logits = x @ head.astype(cfg.dtype).T
-            if cfg.lm_head_bias:
-                hb = self.param("lm_head_bias", nn.initializers.zeros,
-                                (cfg.vocab_size,), jnp.float32)
+            if hb is not None:
                 logits = logits + hb.astype(cfg.dtype)
-        if labels is None:
-            return logits
-        from deepspeed_tpu.models.losses import next_token_loss
-        return next_token_loss(logits, labels)
+            if labels is None:
+                return logits
+            from deepspeed_tpu.models.losses import next_token_loss
+            return next_token_loss(logits, labels)
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, head, labels)
 
     def param_specs(self, params):
         """Megatron TP: qkv/fc1 column-split, dense/fc2 row-split, vocab-split
