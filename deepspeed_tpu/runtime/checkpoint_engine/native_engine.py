@@ -105,3 +105,87 @@ class NativeCheckpointEngine(CheckpointEngine):
             f"checkpoint has {len(flat)} leaves but template has {treedef.num_leaves} — "
             f"model/optimizer structure changed since save")
         return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Asynchronous checkpointing (the Nebula-engine analog, reference
+    ``nebula_checkpoint_engine.py:107``): ``save`` fetches the (sharded)
+    arrays to host synchronously — cheap next to serialization — then a
+    background thread does the compress/serialize/write while training
+    proceeds; ``commit`` joins outstanding writes and atomically publishes
+    the tag. On TPU the device->host fetch is the only part that must be on
+    the training thread (it synchronizes the device stream); everything
+    after is pure host I/O the step loop need not wait for."""
+
+    def __init__(self, max_inflight=2):
+        import itertools
+        import threading
+        self._threads = []
+        self._errors = []
+        self._lock = threading.Lock()
+        self._max_inflight = max_inflight
+        self._inner = NativeCheckpointEngine()
+        self._seq = itertools.count()
+
+    def _drain(self, limit):
+        alive = []
+        for t in self._threads:
+            if t.is_alive():
+                alive.append(t)
+            else:
+                t.join()
+        self._threads = alive
+        while len(self._threads) >= max(limit, 1):
+            t = self._threads.pop(0)
+            t.join()
+
+    def save(self, state_dict, path, meta=None, extra_writer=None,
+             on_published=None):
+        """``extra_writer(tmp_path)`` runs in the worker before the atomic
+        publish (extra in-checkpoint files); ``on_published()`` runs after it
+        (e.g. updating the 'latest' tag — never before the data is durable)."""
+        import copy
+        import threading
+        self._drain(self._max_inflight)
+        # device->host fetch on the caller's thread: jax arrays are not
+        # guaranteed safe to device_get concurrently with donated updates
+        host_state = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x))
+            if isinstance(x, jax.Array) else x, state_dict)
+        # snapshot meta too: callers routinely mutate client_state post-save
+        meta = copy.deepcopy(meta) if meta is not None else None
+        tmp = f"{path}.tmp.{os.getpid()}.{next(self._seq)}"
+
+        def work():
+            try:
+                self._inner.save(host_state, tmp, meta=meta)
+                if extra_writer is not None:
+                    extra_writer(tmp)
+                if os.path.isdir(path):
+                    import shutil
+                    shutil.rmtree(path)
+                os.replace(tmp, path)
+                if on_published is not None:
+                    on_published()
+            except Exception as e:  # surfaced at commit()
+                with self._lock:
+                    self._errors.append(f"{type(e).__name__}: {e}")
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def load(self, path, template=None, map_location=None):
+        self.commit(None)  # never read a tag with writes still in flight
+        return self._inner.load(path, template=template,
+                                map_location=map_location)
+
+    def commit(self, tag):
+        for t in self._threads:
+            t.join()
+        self._threads = []
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            raise IOError(f"async checkpoint writes failed: {errors}")
+        return True

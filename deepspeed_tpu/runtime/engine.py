@@ -282,6 +282,7 @@ class DeepSpeedEngine:
         self.quantized_weights = False  # ZeRO++ qwZ (set in _init_state)
         self._qgz_plan = None  # ZeRO++ qgZ (set in _init_state, zero/qgz.py)
         self._pending_opt_state = None  # OptimizerShim.load_state_dict pre-init
+        self._async_ckpt_engine = None  # lazy (save_checkpoint(async_save=True))
         self.flops_profiler = None  # lazy (profiling/flops_profiler)
         self._param_transform = None  # compression hook (compression/compress.py)
         # legacy seqlen curriculum (reference engine.py:1826 curriculum hook)
@@ -1117,10 +1118,20 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:3056 save / :2712 load)
     # ------------------------------------------------------------------
-    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
-        from deepspeed_tpu.runtime.checkpoint_engine.native_engine import NativeCheckpointEngine
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        async_save=False):
+        """``async_save=True`` uses the background-writer engine (the Nebula
+        analog): training resumes after the device->host fetch; call
+        ``commit_checkpoints()`` (or the next save/load) to join writes."""
+        from deepspeed_tpu.runtime.checkpoint_engine.native_engine import (
+            AsyncCheckpointEngine, NativeCheckpointEngine)
         tag = tag or f"global_step{self.global_steps}"
-        engine = NativeCheckpointEngine()
+        if async_save:
+            if self._async_ckpt_engine is None:
+                self._async_ckpt_engine = AsyncCheckpointEngine()
+            engine = self._async_ckpt_engine
+        else:
+            engine = NativeCheckpointEngine()
         path = os.path.join(save_dir, str(tag))
         meta = {
             "counters": {
@@ -1133,6 +1144,31 @@ class DeepSpeedEngine:
             "client_state": client_state or {},
             "ds_config": self.config._param_dict,
         }
+        if async_save:
+            # host-tier snapshot and the in-dir/post-publish writes run in the
+            # worker: the tag dir only exists after the atomic publish, and
+            # 'latest' must not point at an unpublished checkpoint. Deep-copy
+            # the blobs — the host tier updates masters/moments in place while
+            # the write is in flight.
+            offload_blobs = None
+            if self._offload is not None:
+                offload_blobs = {k: np.array(v, copy=True)
+                                 for k, v in self._offload.state_dict().items()}
+
+            def in_dir(p):
+                if offload_blobs is not None:
+                    np.savez(os.path.join(p, "host_optimizer_states.npz"),
+                             **offload_blobs)
+
+            def after_publish():
+                if save_latest:
+                    with open(os.path.join(save_dir, "latest"), "w") as f:
+                        f.write(str(tag))
+
+            engine.save(self.state, path, meta=meta, extra_writer=in_dir,
+                        on_published=after_publish)
+            log_dist(f"async checkpoint {path} scheduled", ranks=[0])
+            return path
         engine.save(self.state, path, meta=meta)
         if self._offload is not None:
             self._offload.save(os.path.join(path, "host_optimizer_states.npz"))
@@ -1142,9 +1178,17 @@ class DeepSpeedEngine:
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return path
 
+    def commit_checkpoints(self):
+        """Join outstanding async checkpoint writes (reference Nebula commit);
+        raises if any background write failed."""
+        if self._async_ckpt_engine is not None:
+            return self._async_ckpt_engine.commit(None)
+        return True
+
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
         from deepspeed_tpu.runtime.checkpoint_engine.native_engine import NativeCheckpointEngine
+        self.commit_checkpoints()  # never read a tag with writes in flight
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):
