@@ -28,7 +28,8 @@ def _rmsnorm(x, scale, eps):
 
 
 def _scatter_kv(k_pool, v_pool, k, v, block_tables, seen, q_len, block_size):
-    """Write [S, Q, KV, Dh] new KVs into the flat pool via block tables.
+    """Write [S, Q, KV, Dh] new KVs into the [NB, KV, bs, Dh] pool via block
+    tables.
 
     Padded token slots are routed to the trash block (last block of the pool).
     Analog of the reference's linear_blocked_kv_copy kernel.
@@ -39,15 +40,15 @@ def _scatter_kv(k_pool, v_pool, k, v, block_tables, seen, q_len, block_size):
     valid = jnp.arange(Q)[None, :] < q_len[:, None]
     blk = jnp.take_along_axis(block_tables, pos // block_size, axis=1,
                               mode="clip")
-    flat = jnp.where(valid, blk * block_size + pos % block_size,
-                     (nb - 1) * block_size)
-    kf = k_pool.reshape(nb * block_size, *k_pool.shape[2:])
-    vf = v_pool.reshape(nb * block_size, *v_pool.shape[2:])
-    kf = kf.at[flat.reshape(-1)].set(
+    bi = jnp.where(valid, blk, nb - 1).reshape(-1)            # [S*Q]
+    si = jnp.where(valid, pos % block_size, 0).reshape(-1)
+    # advanced indices at dims (0, 2) straddle the head slice, so the token
+    # dim lands in front: values are [S*Q, KV, Dh]
+    k_pool = k_pool.at[bi, :, si].set(
         k.reshape(S * Q, *k.shape[2:]).astype(k_pool.dtype))
-    vf = vf.at[flat.reshape(-1)].set(
+    v_pool = v_pool.at[bi, :, si].set(
         v.reshape(S * Q, *v.shape[2:]).astype(v_pool.dtype))
-    return kf.reshape(k_pool.shape), vf.reshape(v_pool.shape)
+    return k_pool, v_pool
 
 
 def _paged_attention(q, k_pool, v_pool, block_tables, seen, block_size,
@@ -72,19 +73,17 @@ def _paged_attention_dense(q, k_pool, v_pool, block_tables, seen, block_size,
     """Pure-XLA reference path (gathers the full table; numerics twin of the
     Pallas kernel)."""
     S, Q, H, Dh = q.shape
-    KV = k_pool.shape[-2]
+    KV = k_pool.shape[1]
     rep = H // KV
-    nb = k_pool.shape[0]
-    kf = k_pool.reshape(nb * block_size, KV, Dh)
-    vf = v_pool.reshape(nb * block_size, KV, Dh)
     scale = 1.0 / (Dh ** 0.5)
     MB = block_tables.shape[1]
-    slot = jnp.arange(block_size)
 
     def one_seq(q_s, bt_s, seen_s):
-        idx = (bt_s[:, None] * block_size + slot[None, :]).reshape(-1)  # [MB*bs]
-        keys = kf[idx].astype(q_s.dtype)                                 # [L, KV, Dh]
-        vals = vf[idx].astype(q_s.dtype)
+        # [MB, KV, bs, Dh] -> token-major [MB*bs, KV, Dh]
+        keys = (k_pool[bt_s].transpose(0, 2, 1, 3)
+                .reshape(MB * block_size, KV, Dh).astype(q_s.dtype))
+        vals = (v_pool[bt_s].transpose(0, 2, 1, 3)
+                .reshape(MB * block_size, KV, Dh).astype(q_s.dtype))
         qg = q_s.reshape(Q, KV, rep, Dh)
         logits = jnp.einsum("qkrd,skd->krqs", qg, keys).astype(jnp.float32) * scale
         key_pos = jnp.arange(MB * block_size)[None, :]
@@ -108,7 +107,7 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     """
     S, Q = tokens.shape
     H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-    bs = k_pool.shape[2]
+    bs = k_pool.shape[3]          # [L, NB, KV, bs, Dh]
     positions = seen[:, None] + jnp.arange(Q)[None, :]
 
     x = params["embed_tokens"].astype(cfg.dtype)[tokens]

@@ -70,7 +70,7 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     S, Q = tokens.shape
     H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
     Dh = cfg.hidden_size // H
-    bs = k_pool.shape[2]
+    bs = k_pool.shape[3]          # [L, NB, KV, bs, Dh]
     positions = seen[:, None] + jnp.arange(Q)[None, :]
 
     x = params["embed_tokens"].astype(cfg.dtype)[tokens]

@@ -30,7 +30,7 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     """One ragged Falcon/Phi forward step -> (last-token logits, new pools)."""
     S, Q = tokens.shape
     H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-    bs = k_pool.shape[2]
+    bs = k_pool.shape[3]          # [L, NB, KV, bs, Dh]
     positions = seen[:, None] + jnp.arange(Q)[None, :]
 
     embed = params["embed_tokens"].astype(cfg.dtype)

@@ -2,7 +2,9 @@
 ``deepspeed/inference/v2/ragged/kv_cache.py:40``).
 
 Device layout: one K pool and one V pool per cache group, shaped
-``[num_layers, num_blocks, block_size, num_kv_heads, head_dim]``. Block ids are
+``[num_layers, num_blocks, num_kv_heads, block_size, head_dim]`` — (block_size,
+head_dim) minor so the Pallas paged kernel's per-block DMA is a legal Mosaic
+tile. Block ids are
 handed out by the host-side ``BlockedAllocator``; the model's paged-attention
 path scatters new KVs into the pool and gathers per-sequence views through
 block tables. One extra *trash block* (index ``num_blocks``) absorbs writes
@@ -25,7 +27,7 @@ class BlockedKVCache:
         self.block_size = block_size
         self.dtype = _DTYPES.get(dtype, dtype)
         # +1 trash block for masked writes
-        shape = (num_layers, num_blocks + 1, block_size, num_kv_heads, head_dim)
+        shape = (num_layers, num_blocks + 1, num_kv_heads, block_size, head_dim)
         self.k_pool = jnp.zeros(shape, self.dtype)
         self.v_pool = jnp.zeros(shape, self.dtype)
         self._allocator = BlockedAllocator(num_blocks)

@@ -122,7 +122,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30)))
+        # LSE rows are replicated across the LANES minor dim: Mosaic requires
+        # the last two block dims be (8k, 128m)-aligned, so a [bq] vector
+        # output is stored as [bq, LANES] (same layout as jax's own kernel).
+        lse = m_scr[:, :1] + jnp.log(jnp.maximum(l_scr[:, :1], 1e-30))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _bias_spec(bias, bq, bk, H):
@@ -169,11 +173,11 @@ def _fwd(q, k, v, bias, causal, scale, interpret):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, iq, ik: (b, h, iq)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, iq, ik: (b, h, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, tq, dh), q.dtype),
-            jax.ShapeDtypeStruct((B, H, tq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, tq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, LANES), jnp.float32),
@@ -182,7 +186,9 @@ def _fwd(q, k, v, bias, causal, scale, interpret):
         ],
         interpret=interpret,
     )(*args)
-    return out.transpose(0, 2, 1, 3), lse
+    # keep only column 0 as the residual: holding the lane-replicated copy
+    # from forward to backward would be a 128x memory blow-up
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -211,13 +217,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qpos + off >= kpos, s, NEG_INF)
-        lse = lse_ref[0, 0][:, None]                      # [bq, 1]
+        lse = lse_ref[0, 0][:, :1]                        # [bq, 1] (lane-replicated)
         p = jnp.exp(s - lse)                              # [bq, bk]
         do = do_ref[0, 0].astype(jnp.float32)             # [bq, dh]
         dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        delta = delta_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, :1]
         ds = p * (dp - delta) * scale                     # [bq, bk]
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -251,7 +257,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
             qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qpos + off >= kpos, s, NEG_INF)
-        lse = lse_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]
         p = jnp.exp(s - lse)                              # [bq, bk]
         do = do_ref[0, 0].astype(jnp.float32)
         # dV += P^T @ dO
@@ -260,7 +266,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
         dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        delta = delta_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, :1]
         ds = p * (dp - delta) * scale
         # dK += dS^T @ Q
         dk_scr[...] += jax.lax.dot_general(ds, q.astype(jnp.float32),
@@ -287,13 +293,17 @@ def _bwd(causal, scale, interpret, res, g):
     dot = g.transpose(0, 2, 1, 3)
     ot = out.transpose(0, 2, 1, 3)
 
-    # delta_i = rowsum(dO_i * O_i) — cheap in XLA, feeds both bwd kernels
+    # delta_i = rowsum(dO_i * O_i) — cheap in XLA, feeds both bwd kernels.
+    # Broadcast delta and the saved LSE across LANES: the kernels read both
+    # through lane-replicated [.., LANES] blocks (transient, backward-only).
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, LANES))
 
     qspec = pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0))
     kspec = pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0))
     dospec = qspec
-    lspec = pl.BlockSpec((1, 1, bq), lambda b, h, iq, ik: (b, h, iq))
+    lspec = pl.BlockSpec((1, 1, bq, LANES), lambda b, h, iq, ik: (b, h, iq, 0))
     common = [qt, kt, vt, dot, lse, delta]
 
     def specs_with_bias(base, order):
@@ -334,7 +344,7 @@ def _bwd(causal, scale, interpret, res, g):
     # groups summed afterwards in XLA (rep is 1 for MHA so this is free there)
     kspec2 = pl.BlockSpec((1, 1, bk, dh), lambda b, h, ik, iq: (b, h // rep, ik, 0))
     qspec2 = pl.BlockSpec((1, 1, bq, dh), lambda b, h, ik, iq: (b, h, iq, 0))
-    lspec2 = pl.BlockSpec((1, 1, bq), lambda b, h, ik, iq: (b, h, iq))
+    lspec2 = pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ik, iq: (b, h, iq, 0))
     dkv_specs, dkv_args = specs_with_bias(
         [qspec2, kspec2, kspec2, qspec2, lspec2, lspec2], "kq")
     dkv_body = functools.partial(

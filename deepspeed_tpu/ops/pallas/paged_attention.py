@@ -16,7 +16,8 @@ the TPU pipeline model rather than translated:
   scratch across the block iterations (decode flash attention).
 
 Layouts: q [S, Q, H, Dh] (Q = new-token budget, 1 for pure decode);
-k/v pools [NB, bs, KV, Dh]; block_tables [S, MB]; seen [S]. Output matches q.
+k/v pools [NB, KV, bs, Dh] — (bs, Dh) are the minor dims so each grid step's
+block is a legal Mosaic tile; block_tables [S, MB]; seen [S]. Output matches q.
 GQA runs natively: grid is over KV heads, each step attends the whole
 ``rep = H // KV`` query-head group against one KV block.
 """
@@ -54,8 +55,8 @@ def _kernel(bt_ref, seen_ref, qlen_ref, jcap_ref, q_ref, k_ref, v_ref, o_ref,
     def _body():
         # q rows: the rep query heads of this kv head, all q tokens: [rep*Q, Dh]
         q = q_ref[0, 0]                           # [rep*Q, Dh]
-        k = k_ref[0, :, 0]                        # [bs, Dh]
-        v = v_ref[0, :, 0]
+        k = k_ref[0, 0]                           # [bs, Dh]
+        v = v_ref[0, 0]
         sij = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32) * scale
         # causal over the ragged sequence: key pos <= seen + qi
@@ -89,7 +90,7 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
               softmax_scale=None, window=None, interpret=False):
     """Blocked-flash attention over paged KV. See module docstring for shapes."""
     S, Q, H, Dh = q.shape
-    NB, bs, KV, _ = k_pool.shape
+    NB, KV, bs, _ = k_pool.shape
     MB = block_tables.shape[1]
     rep = H // KV
     scale = softmax_scale if softmax_scale is not None else Dh ** -0.5
@@ -105,7 +106,7 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
 
     def kv_index(s, h, j, bt, seen_ref, qlen_ref, jcap_ref):
         jc = jnp.minimum(j, jcap_ref[s])
-        return (bt[s, jc], 0, h, 0)
+        return (bt[s, jc], h, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
@@ -114,8 +115,8 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
             pl.BlockSpec((1, 1, rep * Q, Dh),
                          lambda s, h, j, bt, sn, ql, jc: (s, h, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bs, 1, Dh), kv_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bs, 1, Dh), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bs, Dh), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bs, Dh), kv_index, memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, rep * Q, Dh),
                                lambda s, h, j, bt, sn, ql, jc: (s, h, 0, 0),
@@ -143,5 +144,5 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
 
 def is_supported(q_shape, pool_shape):
     S, Q, H, Dh = q_shape
-    NB, bs, KV, _ = pool_shape
+    NB, KV, bs, _ = pool_shape
     return H % KV == 0 and Dh <= 256 and bs % 8 == 0

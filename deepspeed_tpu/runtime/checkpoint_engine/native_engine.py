@@ -126,6 +126,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
         self._max_inflight = max_inflight
         self._inner = NativeCheckpointEngine()
         self._seq = itertools.count()
+        self._published_seq = {}  # publish_key -> highest seq whose on_published ran
 
     def _drain(self, limit):
         alive = []
@@ -140,10 +141,14 @@ class AsyncCheckpointEngine(CheckpointEngine):
             t.join()
 
     def save(self, state_dict, path, meta=None, extra_writer=None,
-             on_published=None):
+             on_published=None, publish_key=None):
         """``extra_writer(tmp_path)`` runs in the worker before the atomic
         publish (extra in-checkpoint files); ``on_published()`` runs after it
-        (e.g. updating the 'latest' tag — never before the data is durable)."""
+        (e.g. updating the 'latest' tag — never before the data is durable).
+        ``publish_key`` scopes the out-of-order-completion guard: among saves
+        sharing a key (e.g. the same save_dir), only the newest one's
+        ``on_published`` runs; saves to unrelated targets don't suppress each
+        other. Defaults to ``path``'s parent directory."""
         import copy
         import threading
         self._drain(self._max_inflight)
@@ -154,20 +159,42 @@ class AsyncCheckpointEngine(CheckpointEngine):
             if isinstance(x, jax.Array) else x, state_dict)
         # snapshot meta too: callers routinely mutate client_state post-save
         meta = copy.deepcopy(meta) if meta is not None else None
-        tmp = f"{path}.tmp.{os.getpid()}.{next(self._seq)}"
+        seq = next(self._seq)
+        key = publish_key if publish_key is not None else os.path.dirname(path)
+        tmp = f"{path}.tmp.{os.getpid()}.{seq}"
 
         def work():
+            import shutil
+            old = None
             try:
                 self._inner.save(host_state, tmp, meta=meta)
                 if extra_writer is not None:
                     extra_writer(tmp)
+                # never destroy the existing durable checkpoint before the new
+                # one is in place: move it aside (atomic rename), swap in the
+                # new dir, then reap the old one; restore on failure
                 if os.path.isdir(path):
-                    import shutil
-                    shutil.rmtree(path)
-                os.replace(tmp, path)
-                if on_published is not None:
+                    old = f"{path}.old.{os.getpid()}.{seq}"
+                    os.replace(path, old)
+                try:
+                    os.replace(tmp, path)
+                except Exception:
+                    if old is not None:
+                        os.replace(old, path)
+                        old = None
+                    raise
+                if old is not None:
+                    shutil.rmtree(old, ignore_errors=True)
+                # workers with max_inflight > 1 can finish out of order; the
+                # 'latest'-tag callback must never move backwards within a key
+                with self._lock:
+                    publish = seq > self._published_seq.get(key, -1)
+                    if publish:
+                        self._published_seq[key] = seq
+                if publish and on_published is not None:
                     on_published()
             except Exception as e:  # surfaced at commit()
+                shutil.rmtree(tmp, ignore_errors=True)
                 with self._lock:
                     self._errors.append(f"{type(e).__name__}: {e}")
 

@@ -1131,6 +1131,9 @@ class DeepSpeedEngine:
                 self._async_ckpt_engine = AsyncCheckpointEngine()
             engine = self._async_ckpt_engine
         else:
+            # a sync save must order after any in-flight async publishes, or a
+            # late async worker could move 'latest' back to an older tag
+            self.commit_checkpoints()
             engine = NativeCheckpointEngine()
         path = os.path.join(save_dir, str(tag))
         meta = {

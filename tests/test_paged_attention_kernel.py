@@ -20,8 +20,8 @@ from deepspeed_tpu.ops.pallas.paged_attention import is_supported, paged_mha
 def make_case(S=3, Q=1, H=4, KV=2, Dh=64, NB=10, bs=16, MB=4, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
     q = jax.random.normal(ks[0], (S, Q, H, Dh), jnp.float32)
-    k_pool = jax.random.normal(ks[1], (NB, bs, KV, Dh), jnp.float32)
-    v_pool = jax.random.normal(ks[2], (NB, bs, KV, Dh), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (NB, KV, bs, Dh), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (NB, KV, bs, Dh), jnp.float32)
     rng = np.random.default_rng(seed)
     # distinct blocks per sequence (last pool block is the trash block)
     bt = rng.permutation((NB - 1) * MB)[: S * MB].reshape(S, MB) % (NB - 1)
@@ -33,7 +33,7 @@ def make_case(S=3, Q=1, H=4, KV=2, Dh=64, NB=10, bs=16, MB=4, seed=0):
 
 def run_both(case):
     q, kp, vp, bt, seen, q_len = case
-    bs = kp.shape[1]
+    bs = kp.shape[2]
     out_k = paged_mha(q, kp, vp, bt, seen, q_len, interpret=True)
     out_d = _paged_attention_dense(q, kp, vp, bt, seen, bs)
     return out_k, out_d
@@ -65,7 +65,7 @@ def test_zero_seen_decode_first_token():
     q, kp, vp, bt, seen, q_len = make_case(S=2, Q=1)
     seen = jnp.zeros_like(seen)
     out_k = paged_mha(q, kp, vp, bt, seen, q_len, interpret=True)
-    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[1])
+    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[2])
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d),
                                atol=2e-4, rtol=1e-3)
 
@@ -74,7 +74,7 @@ def test_bf16():
     q, kp, vp, bt, seen, q_len = make_case(Dh=128)
     q, kp, vp = (x.astype(jnp.bfloat16) for x in (q, kp, vp))
     out_k = paged_mha(q, kp, vp, bt, seen, q_len, interpret=True)
-    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[1])
+    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[2])
     assert out_k.dtype == jnp.bfloat16
     np.testing.assert_allclose(
         valid_rows(out_k, q_len).astype(np.float32),
@@ -82,10 +82,10 @@ def test_bf16():
 
 
 def test_is_supported():
-    assert is_supported((2, 1, 8, 64), (8, 16, 2, 64))
-    assert not is_supported((2, 1, 8, 64), (8, 16, 3, 64))   # H % KV
-    assert not is_supported((2, 1, 8, 512), (8, 16, 2, 512))  # Dh
-    assert not is_supported((2, 1, 8, 64), (8, 12, 2, 64))   # bs % 8
+    assert is_supported((2, 1, 8, 64), (8, 2, 16, 64))
+    assert not is_supported((2, 1, 8, 64), (8, 3, 16, 64))   # H % KV
+    assert not is_supported((2, 1, 8, 512), (8, 2, 16, 512))  # Dh
+    assert not is_supported((2, 1, 8, 64), (8, 2, 12, 64))   # bs % 8
 
 
 @pytest.mark.parametrize("window", [8, 24])
@@ -96,7 +96,7 @@ def test_sliding_window_matches_dense(window):
         _paged_attention_dense)
     q, kp, vp, bt, seen, q_len = make_case(S=3, Q=2, seed=7)
     out_k = paged_mha(q, kp, vp, bt, seen, q_len, window=window, interpret=True)
-    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[1],
+    out_d = _paged_attention_dense(q, kp, vp, bt, seen, kp.shape[2],
                                    window=window)
     np.testing.assert_allclose(valid_rows(out_k, q_len),
                                valid_rows(out_d, q_len), atol=2e-4, rtol=1e-3)
