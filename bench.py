@@ -125,6 +125,11 @@ def run_bench():
                     "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
                     "zero_optimization": {"stage": 1},
                     "gradient_clipping": 1.0,
+                    # "dots" saves projections + flash outputs; backward then
+                    # skips recomputing the blocks (OOM falls back to a
+                    # smaller batch, where the saved activations fit)
+                    "activation_checkpointing": {
+                        "policy": os.environ.get("DS_BENCH_REMAT", "dots")},
                 })
 
             def step():

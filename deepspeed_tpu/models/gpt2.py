@@ -20,6 +20,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    current_policy as remat_policy)
 from jax.sharding import PartitionSpec as P
 
 
@@ -146,6 +148,7 @@ class GPT2LMHeadModel(nn.Module):
             block = ScanBlock
             if cfg.remat:
                 block = nn.remat(ScanBlock, prevent_cse=False,
+                                 policy=remat_policy(),
                                  static_argnums=())
             ScannedBlocks = nn.scan(block,
                                     variable_axes={"params": 0},
@@ -154,7 +157,8 @@ class GPT2LMHeadModel(nn.Module):
                                     metadata_params={nn.meta.PARTITION_NAME: "layers"})
             (x, _), _ = ScannedBlocks(cfg, name="h")((x, deterministic), None)
         else:
-            block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+            block_cls = nn.remat(Block, prevent_cse=False,
+                                 policy=remat_policy()) if cfg.remat else Block
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
 

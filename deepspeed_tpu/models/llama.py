@@ -14,6 +14,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    current_policy as remat_policy)
 from jax.sharding import PartitionSpec as P
 
 
@@ -230,7 +232,8 @@ class LlamaForCausalLM(nn.Module):
         if cfg.scan_layers:
             block = ScanLlamaBlock
             if cfg.remat and not use_cache:
-                block = nn.remat(ScanLlamaBlock, prevent_cse=False)
+                block = nn.remat(ScanLlamaBlock, prevent_cse=False,
+                                 policy=remat_policy())
             Scanned = nn.scan(block,
                               variable_axes={"params": 0, "cache": 0},
                               split_rngs={"params": True, "dropout": True},
@@ -238,7 +241,9 @@ class LlamaForCausalLM(nn.Module):
                               metadata_params={nn.meta.PARTITION_NAME: "layers"})
             (x, _), _ = Scanned(cfg, use_cache, name="layers")((x, positions), None)
         else:
-            block_cls = nn.remat(LlamaBlock, prevent_cse=False) if (cfg.remat and not use_cache) else LlamaBlock
+            block_cls = nn.remat(LlamaBlock, prevent_cse=False,
+                                 policy=remat_policy()) \
+                if (cfg.remat and not use_cache) else LlamaBlock
             for i in range(cfg.num_hidden_layers):
                 x = block_cls(cfg, name=f"layers_{i}")(x, positions, deterministic,
                                                        use_cache=use_cache)

@@ -14,6 +14,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    current_policy as remat_policy)
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.llama import LlamaAttention, LlamaConfig, RMSNorm
@@ -111,6 +113,7 @@ class MixtralForCausalLM(nn.Module):
 
         total_aux = 0.0
         block_cls = nn.remat(MixtralBlock, prevent_cse=False,
+                             policy=remat_policy(),
                              static_argnums=(3,)) if cfg.remat else MixtralBlock
         for i in range(cfg.num_hidden_layers):
             x, l_aux = block_cls(cfg, name=f"layers_{i}")(x, positions,

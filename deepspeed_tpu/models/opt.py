@@ -14,6 +14,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    current_policy as remat_policy)
 from jax.sharding import PartitionSpec as P
 
 
@@ -130,14 +132,16 @@ class OPTForCausalLM(nn.Module):
         if cfg.scan_layers:
             block = ScanOPTBlock
             if cfg.remat:
-                block = nn.remat(ScanOPTBlock, prevent_cse=False)
+                block = nn.remat(ScanOPTBlock, prevent_cse=False,
+                                 policy=remat_policy())
             Scanned = nn.scan(block, variable_axes={"params": 0},
                               split_rngs={"params": True, "dropout": True},
                               length=cfg.num_hidden_layers,
                               metadata_params={nn.meta.PARTITION_NAME: "layers"})
             (x, _), _ = Scanned(cfg, name="layers")((x, deterministic), None)
         else:
-            blk = nn.remat(OPTBlock, prevent_cse=False) if cfg.remat else OPTBlock
+            blk = nn.remat(OPTBlock, prevent_cse=False,
+                           policy=remat_policy()) if cfg.remat else OPTBlock
             for i in range(cfg.num_hidden_layers):
                 x = blk(cfg, name=f"layers_{i}")(x, deterministic)
 

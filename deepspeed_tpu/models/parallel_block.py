@@ -22,6 +22,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    current_policy as remat_policy)
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.llama import rotary_embed
@@ -153,7 +155,8 @@ class ParallelBlockForCausalLM(nn.Module):
         x = embed.astype(cfg.dtype)[input_ids]
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-        block_cls = nn.remat(ParallelBlock, prevent_cse=False) \
+        block_cls = nn.remat(ParallelBlock, prevent_cse=False,
+                             policy=remat_policy()) \
             if (cfg.remat and not use_cache) else ParallelBlock
         for i in range(cfg.num_hidden_layers):
             x = block_cls(cfg, use_cache, name=f"layers_{i}")(x, positions)

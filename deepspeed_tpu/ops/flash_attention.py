@@ -52,8 +52,13 @@ def mha(q, k, v, bias=None, causal=True, softmax_scale=None):
         reason = fa.unsupported_reason(q.shape, k.shape,
                                        None if bias is None else bias.shape)
         if reason is None:
-            return fa.flash_mha(q, k, v, bias=bias, causal=causal,
-                                softmax_scale=softmax_scale)
+            out = fa.flash_mha(q, k, v, bias=bias, causal=causal,
+                               softmax_scale=softmax_scale)
+            # named so remat policies can choose to save attention outputs
+            # (see activation_checkpointing "dots" policy) — recomputing the
+            # flash kernel in backward doubles its cost for no memory win
+            # beyond the [B,T,H,Dh] output itself
+            return jax.ad_checkpoint.checkpoint_name(out, "flash_attn_out")
         key = (q.shape, k.shape, None if bias is None else bias.shape)
         if key not in _warned_shapes:
             _warned_shapes.add(key)

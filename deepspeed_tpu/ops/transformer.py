@@ -1,0 +1,149 @@
+"""Legacy fused transformer (encoder) layer.
+
+Capability analog of the reference's ``DeepSpeedTransformerLayer``
+(``deepspeed/ops/transformer/transformer.py:296`` backed by the CUDA kernels
+in ``csrc/transformer/*.cu``): a BERT-style encoder layer with pre- or
+post-LayerNorm, exposed with the same config surface
+(``DeepSpeedTransformerConfig``, ``transformer.py:34`` incl. ``from_dict`` /
+``from_json_file``).
+
+TPU design: one flax module whose whole body sits inside the caller's jit —
+XLA fuses the bias/gelu/dropout/residual chains that the reference hand-fuses
+in CUDA, attention routes through the framework-wide ``ops.flash_attention.mha``
+entry (Pallas on TPU), and the memory-saving knobs (``gelu_checkpoint``,
+``attn_dropout_checkpoint``, ``normalize_invertible``) map to ``jax.checkpoint``
+remat of the corresponding sub-computations rather than manual buffer drops.
+``stochastic_mode`` has no TPU meaning (no nondeterministic fast path) and is
+accepted as a no-op.
+"""
+
+import dataclasses
+import json
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.ops.flash_attention import mha
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """reference ``transformer.py:34`` config surface (TPU: ``fp16`` selects
+    bf16 compute — fp16 matmuls have no TPU advantage)."""
+    batch_size: int = -1
+    hidden_size: int = -1
+    intermediate_size: int = -1
+    heads: int = -1
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    num_hidden_layers: int = -1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    return_tuple: bool = False
+    training: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size <= 0 < self.hidden_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @classmethod
+    def from_dict(cls, json_object):
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in json_object.items() if k in fields})
+
+    @classmethod
+    def from_json_file(cls, json_file):
+        with open(json_file) as f:
+            return cls.from_dict(json.load(f))
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.fp16 else jnp.float32
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """reference ``transformer.py:296``. Parameter names mirror the reference's
+    attribute names (attn_qkvw/attn_qkvb/attn_ow/... ) so checkpoints can be
+    mapped mechanically."""
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None,
+                 deterministic: Optional[bool] = None):
+        cfg = self.config
+        det = (not cfg.training) if deterministic is None else deterministic
+        B, T, Hs = hidden_states.shape
+        nh = cfg.heads
+        dh = Hs // nh
+        dt = cfg.dtype
+        std = cfg.initializer_range
+        out_std = std
+        if cfg.adjust_init_range and cfg.num_hidden_layers > 0:
+            out_std = std / (2.0 * cfg.num_hidden_layers) ** 0.5
+
+        def dense(x, n_out, name, init_std):
+            w = self.param(f"{name}w", nn.initializers.normal(init_std),
+                           (x.shape[-1], n_out), jnp.float32)
+            b = self.param(f"{name}b", nn.initializers.zeros, (n_out,),
+                           jnp.float32)
+            return x @ w.astype(dt) + b.astype(dt)
+
+        def ln(x, name):
+            return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt,
+                                name=name)(x)
+
+        x = hidden_states.astype(dt)
+
+        def attention(h):
+            qkv = dense(h, 3 * Hs, "attn_qkv", std)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, T, nh, dh)
+            k = k.reshape(B, T, nh, dh)
+            v = v.reshape(B, T, nh, dh)
+            bias = None
+            if attention_mask is not None:
+                # HF-style additive mask broadcast over heads/queries
+                bias = attention_mask.reshape(B, 1, 1, T).astype(jnp.float32) \
+                    if attention_mask.ndim == 2 else attention_mask
+                bias = jnp.broadcast_to(bias, (B, 1, T, T))
+            a = mha(q, k, v, bias=bias, causal=False)
+            a = a.reshape(B, T, Hs)
+            a = nn.Dropout(cfg.attn_dropout_ratio)(a, deterministic=det)
+            return dense(a, Hs, "attn_o", out_std)
+
+        def mlp(h):
+            g = jax.nn.gelu(dense(h, cfg.intermediate_size, "inter_", std),
+                            approximate=True)
+            return dense(g, Hs, "output_", out_std)
+
+        if cfg.attn_dropout_checkpoint or cfg.normalize_invertible:
+            attention = jax.checkpoint(attention, prevent_cse=False)
+        if cfg.gelu_checkpoint:
+            mlp = jax.checkpoint(mlp, prevent_cse=False)
+
+        if cfg.pre_layer_norm:
+            a = attention(ln(x, "attn_nn"))
+            x = x + nn.Dropout(cfg.hidden_dropout_ratio)(a, deterministic=det)
+            m = mlp(ln(x, "norm_"))
+            out = x + nn.Dropout(cfg.hidden_dropout_ratio)(m, deterministic=det)
+        else:
+            a = attention(x)
+            x = ln(x + nn.Dropout(cfg.hidden_dropout_ratio)(a,
+                                                            deterministic=det),
+                   "attn_nn")
+            m = mlp(x)
+            out = ln(x + nn.Dropout(cfg.hidden_dropout_ratio)(m,
+                                                              deterministic=det),
+                     "norm_")
+        return (out,) if cfg.return_tuple else out

@@ -57,13 +57,22 @@ def policy_by_name(name, checkpoint_in_cpu=False):
     ``checkpoint_in_cpu`` lifts saved dots to pinned host memory (the
     reference's CPU checkpointing). ``policy="nothing"`` (no remat) takes
     precedence — there is nothing to offload if everything is saved."""
+    cp = jax.checkpoint_policies
     if checkpoint_in_cpu and name != "nothing":
-        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
-            "device", "pinned_host")
+        # dots offload to pinned host; the flash output (not a dot_general)
+        # is saved on device — still skipping the backward recompute
+        return cp.save_from_both_policies(
+            cp.offload_dot_with_no_batch_dims("device", "pinned_host"),
+            cp.save_only_these_names("flash_attn_out"))
     return {
-        "everything": jax.checkpoint_policies.nothing_saveable,
-        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        "nothing": jax.checkpoint_policies.everything_saveable,
+        "everything": cp.nothing_saveable,
+        # projections saved via the dots rule; the Pallas flash kernel is not
+        # a dot_general, so its named output is saved explicitly — otherwise
+        # backward re-runs the whole attention kernel
+        "dots": cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable,
+            cp.save_only_these_names("flash_attn_out")),
+        "nothing": cp.everything_saveable,
     }[name]
 
 

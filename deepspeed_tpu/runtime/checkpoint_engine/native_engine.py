@@ -127,6 +127,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
         self._inner = NativeCheckpointEngine()
         self._seq = itertools.count()
         self._published_seq = {}  # publish_key -> highest seq whose on_published ran
+        self._path_seq = {}       # path -> newest seq scheduled for that path
 
     def _drain(self, limit):
         alive = []
@@ -161,6 +162,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
         meta = copy.deepcopy(meta) if meta is not None else None
         seq = next(self._seq)
         key = publish_key if publish_key is not None else os.path.dirname(path)
+        self._path_seq[path] = seq  # caller thread: newest intent for path
         tmp = f"{path}.tmp.{os.getpid()}.{seq}"
 
         def work():
@@ -170,27 +172,32 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 self._inner.save(host_state, tmp, meta=meta)
                 if extra_writer is not None:
                     extra_writer(tmp)
-                # never destroy the existing durable checkpoint before the new
-                # one is in place: move it aside (atomic rename), swap in the
-                # new dir, then reap the old one; restore on failure
-                if os.path.isdir(path):
-                    old = f"{path}.old.{os.getpid()}.{seq}"
-                    os.replace(path, old)
-                try:
-                    os.replace(tmp, path)
-                except Exception:
-                    if old is not None:
-                        os.replace(old, path)
-                        old = None
-                    raise
-                if old is not None:
-                    shutil.rmtree(old, ignore_errors=True)
-                # workers with max_inflight > 1 can finish out of order; the
-                # 'latest'-tag callback must never move backwards within a key
+                # the swap runs under the lock: (a) workers finishing out of
+                # order must not let an OLDER save clobber a newer one's data
+                # at the same path; (b) concurrent renames of the same path
+                # would interleave. Never destroy the existing durable
+                # checkpoint before the new one is in place: move aside
+                # (atomic rename), swap in, reap; restore on failure.
                 with self._lock:
+                    if self._path_seq.get(path, seq) > seq:
+                        shutil.rmtree(tmp, ignore_errors=True)
+                        return  # superseded by a newer save to this path
+                    if os.path.isdir(path):
+                        old = f"{path}.old.{os.getpid()}.{seq}"
+                        os.replace(path, old)
+                    try:
+                        os.replace(tmp, path)
+                    except Exception:
+                        if old is not None:
+                            os.replace(old, path)
+                            old = None
+                        raise
+                    # 'latest'-tag callback must never move backwards either
                     publish = seq > self._published_seq.get(key, -1)
                     if publish:
                         self._published_seq[key] = seq
+                if old is not None:
+                    shutil.rmtree(old, ignore_errors=True)
                 if publish and on_published is not None:
                     on_published()
             except Exception as e:  # surfaced at commit()

@@ -1,0 +1,73 @@
+"""Sparse (factored) gradient collectives — device-side, static-shape.
+
+Reference: the engine's sparse embedding-gradient allreduce
+(``deepspeed/runtime/engine.py:2470-2539``): embedding grads touch at most
+``batch x seq`` of the ``vocab`` rows, so ranks exchange (indices, values)
+pairs instead of the dense [V, D] table.
+
+TPU design: the nonzero rows of an embedding gradient are exactly the batch's
+token ids, whose COUNT is static — so the whole factored exchange stays
+inside jit with fixed shapes:
+
+1. :func:`dedupe_rows` — sort ids, segment-sum duplicate rows (a local
+   gradient already sums duplicates; dedupe prevents double-counting when
+   gathering rows *from* the dense local grad);
+2. gather the deduped rows from the local dense grad;
+3. ``all_gather`` (ids, rows) over the data axis — traffic
+   ``world x N x (D+1)`` vs ``V x D`` for a dense psum;
+4. scatter-add everything back into a dense table (out-of-range pad ids are
+   dropped).
+
+Use inside ``jax.shard_map`` bodies (the engine's manual-mode grad paths);
+for host-side numpy SparseTensors see ``runtime/sparse_tensor.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dedupe_rows(ids, rows, pad_id):
+    """Sum rows of duplicate ids into one slot each, padding the rest.
+
+    ids [N] int, rows [N, D]. Returns (uids [N], vals [N, D]) where the
+    first k slots (k = unique count) hold the unique ids and their summed
+    rows; remaining slots hold ``pad_id`` and zero rows. Pure static shapes.
+    """
+    order = jnp.argsort(ids)
+    sid = ids[order]
+    srow = rows[order]
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    seg = jnp.cumsum(is_new) - 1                       # [N] segment number
+    uids = jnp.full(ids.shape, pad_id, ids.dtype).at[seg].set(sid)
+    vals = jax.ops.segment_sum(srow, seg, num_segments=ids.shape[0])
+    return uids, vals
+
+
+def sparse_all_reduce(dense_grad, ids, axis_name):
+    """Factored allreduce of an embedding gradient inside shard_map.
+
+    ``dense_grad`` [V, D]: this device's LOCAL (unreduced) gradient whose
+    nonzero rows are a subset of ``ids`` [N] (the device's token ids, possibly
+    with duplicates). Returns the dense [V, D] sum over ``axis_name`` — equal
+    to ``lax.psum(dense_grad, axis_name)`` whenever the nonzero-row invariant
+    holds, at ``N x (D+1)`` per-device traffic instead of ``V x D``.
+    """
+    V = dense_grad.shape[0]
+    uids, _ = dedupe_rows(ids, jnp.zeros((ids.shape[0], 1),
+                                         dense_grad.dtype), V)
+    rows = jnp.take(dense_grad, uids, axis=0, mode="fill", fill_value=0)
+    all_ids = lax.all_gather(uids, axis_name, tiled=True)      # [W*N]
+    all_rows = lax.all_gather(rows, axis_name, tiled=True)     # [W*N, D]
+    return jnp.zeros_like(dense_grad).at[all_ids].add(
+        all_rows, mode="drop")
+
+
+def sparse_exchange(ids, rows, axis_name, pad_id):
+    """All-gather the factored form itself: (ids [N], rows [N, D]) ->
+    (all_ids [W*N], all_rows [W*N, D]), deduped locally first. The caller
+    scatters into whatever layout it wants (e.g. only its optimizer shard)."""
+    uids, vals = dedupe_rows(ids, rows, pad_id)
+    return (lax.all_gather(uids, axis_name, tiled=True),
+            lax.all_gather(vals, axis_name, tiled=True))

@@ -262,6 +262,10 @@ class DeepSpeedEngine:
         import deepspeed_tpu.comm as dist
         dist.configure(comms_config=self.config.comms_config)
 
+        # remat policy for model blocks (models read it at trace time)
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+        checkpointing.configure(deepspeed_config=self.config)
+
         # --- counters (reference engine bookkeeping) ---
         self.global_steps = 0
         self.global_samples = 0
@@ -976,6 +980,47 @@ class DeepSpeedEngine:
     def is_gradient_accumulation_boundary(self):
         """reference engine.py:2153 semantics."""
         return (self.micro_steps + 1) % self.gradient_accumulation_steps_value == 0
+
+    # --- sparse (embedding) gradient reduction -------------------------
+    # reference engine.py:2470-2539: embedding grads travel as (indices,
+    # values) pairs. On TPU the in-step reduction is GSPMD-emitted, so the
+    # factored exchange is exposed two ways: host-side over SparseTensors
+    # (this API, the reference's surface) and in-jit for shard_map grad paths
+    # (runtime/comm/sparse_collectives.py).
+    def sparse_allreduce_bucket(self, sparse_tensors):
+        """Reduce a bucket of per-rank SparseTensors to their summed, deduped
+        form (reference ``sparse_allreduce_bucket``)."""
+        from deepspeed_tpu.runtime.sparse_tensor import sparse_all_reduce
+        return sparse_all_reduce(sparse_tensors)
+
+    def sparse_allreduce(self, sparse_tensor, ids=None, axis_name="dp"):
+        """Factored allreduce of one embedding gradient.
+
+        Host path (``SparseTensor``): dedupe via the rendezvous math.
+        Device path: ``sparse_tensor`` = stacked per-device local grads
+        [world, V, D] (sharded over ``axis_name``), ``ids`` = their token ids
+        [world, N]; runs the static-shape factored exchange over the engine
+        mesh — ``N x (D+1)`` traffic instead of ``V x D``
+        (comm/sparse_collectives). Returns the dense [V, D] sum.
+        """
+        from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+        if isinstance(sparse_tensor, SparseTensor):
+            return sparse_tensor.deduplicate()
+        assert ids is not None, "device-path sparse_allreduce needs token ids"
+        cache = getattr(self, "_sparse_ar_fns", None)
+        if cache is None:
+            cache = self._sparse_ar_fns = {}
+        fn = cache.get(axis_name)
+        if fn is None:
+            # built once per axis: jit caches by function identity
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.runtime.comm.sparse_collectives import (
+                sparse_all_reduce)
+            fn = cache[axis_name] = jax.jit(jax.shard_map(
+                lambda g, i: sparse_all_reduce(g[0], i[0], axis_name),
+                mesh=self.topology.mesh, in_specs=(P(axis_name), P(axis_name)),
+                out_specs=P(), check_vma=False))
+        return fn(sparse_tensor, ids)
 
     def step(self):
         """Optimizer step at the gradient-accumulation boundary (engine.py:2132)."""

@@ -10,9 +10,10 @@ against its pure-XLA twin. Run it after touching any kernel:
 One TPU job at a time — the chip is exclusive.
 """
 
+import os
 import sys
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

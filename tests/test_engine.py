@@ -312,3 +312,33 @@ def test_async_checkpoint_with_offload(tmp_path):
     for k in e2._offload.masters:
         np.testing.assert_allclose(e2._offload.masters[k],
                                    e3._offload.masters[k], atol=1e-7)
+
+
+def test_remat_policy_config_reaches_models():
+    """activation_checkpointing.policy selects the jax.checkpoint policy the
+    model blocks trace with (reference ``checkpointing.configure`` analog) and
+    training still converges under the "dots" policy."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2LMHeadModel(cfg)
+    batches = tiny_gpt2_batches(3, 8, seq_len=16, vocab=cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": batches[0]["input_ids"].shape[0],
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "activation_checkpointing": {"policy": "dots"}})
+    assert checkpointing._CONFIG["policy"] == "dots"
+    # the policy objects must actually differ (wiring, not just parsing)
+    assert checkpointing.policy_by_name("dots") is not \
+        checkpointing.policy_by_name("everything")
+    losses = []
+    for b in batches * 3:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,119 @@
+"""Sparse (factored) embedding-gradient reduction: device-side static-shape
+collectives vs dense psum, the host SparseTensor rendezvous, and the engine
+API (reference ``tests/unit/runtime/sparse_tensor`` +
+``engine.sparse_allreduce_*`` analogs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.comm.sparse_collectives import (
+    dedupe_rows, sparse_all_reduce, sparse_exchange)
+from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+
+
+def test_dedupe_rows():
+    ids = jnp.asarray([5, 2, 5, 9, 2, 2], jnp.int32)
+    rows = jnp.arange(12, dtype=jnp.float32).reshape(6, 2)
+    uids, vals = dedupe_rows(ids, rows, pad_id=100)
+    u = np.asarray(uids)
+    v = np.asarray(vals)
+    # unique ids first (sorted), pads after
+    assert list(u[:3]) == [2, 5, 9]
+    assert all(u[3:] == 100)
+    np.testing.assert_allclose(v[0], rows[1] + rows[4] + rows[5])  # id 2
+    np.testing.assert_allclose(v[1], rows[0] + rows[2])            # id 5
+    np.testing.assert_allclose(v[2], rows[3])                      # id 9
+    np.testing.assert_allclose(v[3:], 0.0)
+
+
+def _mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+
+def _local_grads(V=32, D=4, N=6, W=8, seed=0):
+    """Per-device dense grads whose nonzero rows are the device's ids."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, V, size=(W, N)).astype(np.int32)
+    dense = np.zeros((W, V, D), np.float32)
+    for w in range(W):
+        for n in range(N):
+            dense[w, ids[w, n]] += rng.normal(size=D)
+    return jnp.asarray(dense), jnp.asarray(ids)
+
+
+def test_sparse_all_reduce_matches_psum():
+    mesh = _mesh()
+    dense, ids = _local_grads()
+
+    def body(g, i):
+        return sparse_all_reduce(g[0], i[0], "dp")
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                               in_specs=(P("dp"), P("dp")),
+                               out_specs=P(), check_vma=False))
+    out = np.asarray(fn(dense, ids))
+    ref = np.asarray(dense).sum(axis=0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_exchange_factored_form():
+    mesh = _mesh()
+    dense, ids = _local_grads(seed=3)
+    V = dense.shape[1]
+
+    def body(g, i):
+        rows = jnp.take(g[0], i[0], axis=0)  # ids unique per slot? may repeat
+        # feed raw (possibly duplicated) rows: exchange dedupes locally
+        all_ids, all_rows = sparse_exchange(i[0], rows, "dp", pad_id=V)
+        return jnp.zeros_like(g[0]).at[all_ids].add(all_rows, mode="drop")
+
+    # NOTE: taking dense rows at duplicate ids would double-count; restrict
+    # the fixture to unique per-device ids for this path
+    rng = np.random.default_rng(7)
+    W, N, D = 8, 6, 4
+    ids = np.stack([rng.choice(V, size=N, replace=False) for _ in range(W)]
+                   ).astype(np.int32)
+    dense = np.zeros((W, V, D), np.float32)
+    for w in range(W):
+        dense[w, ids[w]] = rng.normal(size=(N, D))
+    fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                               in_specs=(P("dp"), P("dp")),
+                               out_specs=P(), check_vma=False))
+    out = np.asarray(fn(jnp.asarray(dense), jnp.asarray(ids)))
+    np.testing.assert_allclose(out, dense.sum(axis=0), rtol=1e-5, atol=1e-5)
+
+
+def test_engine_sparse_allreduce_api():
+    from tests.simple_model import SimpleModel, random_batches
+    from deepspeed_tpu.parallel import groups
+    groups.reset()
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8, "sparse_gradients": True,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    assert engine.config.sparse_gradients_enabled
+
+    # host path: reference rendezvous over SparseTensors
+    sts = [SparseTensor([1, 3], np.ones((2, 4), np.float32), (8, 4)),
+           SparseTensor([3, 5], np.ones((2, 4), np.float32), (8, 4))]
+    out = engine.sparse_allreduce_bucket(sts)
+    dense = out.to_dense()
+    np.testing.assert_allclose(dense[3], 2.0)
+    np.testing.assert_allclose(dense[1], 1.0)
+    np.testing.assert_allclose(dense[0], 0.0)
+
+    # device path: stacked per-device local grads + ids over the engine mesh
+    W = engine.topology.data_parallel_size
+    dense_l, ids = _local_grads(W=W, seed=5)
+    summed = engine.sparse_allreduce(dense_l, ids=ids)
+    np.testing.assert_allclose(np.asarray(summed),
+                               np.asarray(dense_l).sum(axis=0),
+                               rtol=1e-5, atol=1e-5)
