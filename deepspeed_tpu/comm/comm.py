@@ -25,6 +25,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from deepspeed_tpu.utils.logging import logger
@@ -196,6 +197,101 @@ def send_prev(tensor, axis_name="pp"):
 
 def axis_rank(axis_name):
     return lax.axis_index(axis_name)
+
+
+@timed_op
+def gather(tensor, dst=0, axis_name="dp", axis=0, **kwargs):
+    """reference ``comm/comm.py:380`` gather — SPMD materializes the gathered
+    result on every device (XLA keeps it live only where used; ``dst`` kept
+    for API parity)."""
+    return lax.all_gather(tensor, axis_name, axis=axis, tiled=False)
+
+
+@timed_op
+def scatter(tensor, src=0, axis_name="dp", axis=0, **kwargs):
+    """reference ``comm/comm.py:391`` scatter — each rank takes its slice of
+    src's tensor (broadcast + static slice; XLA DCEs the unused shards)."""
+    full = broadcast.__wrapped__(tensor, src=src, axis_name=axis_name) \
+        if hasattr(broadcast, "__wrapped__") else broadcast(tensor, src=src,
+                                                           axis_name=axis_name)
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    if full.shape[axis] % n != 0:
+        raise ValueError(f"scatter: dim {axis} of size {full.shape[axis]} "
+                         f"is not divisible by axis '{axis_name}' size {n}")
+    size = full.shape[axis] // n
+    return lax.dynamic_slice_in_dim(full, idx * size, size, axis=axis)
+
+
+def monitored_barrier(group=None, timeout=None, **kwargs):
+    """reference ``comm/comm.py:412`` — rank-failure detection is the
+    launcher/elastic-agent's job on TPU; behaves as ``barrier``."""
+    return barrier(group=group)
+
+
+def _coalesce_by_dtype(tensors, exchange):
+    """One fused exchange per dtype group (mixed buckets must come back in
+    their own dtypes — concatenating across dtypes would silently promote).
+    ``exchange(flat) -> exchanged flat`` may add leading dims."""
+    groups = {}
+    for i, t in enumerate(tensors):
+        groups.setdefault(jnp.asarray(t).dtype, []).append(i)
+    out = [None] * len(tensors)
+    for dtype, idxs in groups.items():
+        flat = jnp.concatenate([jnp.ravel(tensors[i]) for i in idxs])
+        ex = exchange(flat)
+        off = 0
+        for i in idxs:
+            shape = tensors[i].shape
+            n = int(np.prod(shape)) if shape else 1
+            out[i] = ex[..., off:off + n].reshape(ex.shape[:-1] + tuple(shape))
+            off += n
+    return out
+
+
+@timed_op
+def all_reduce_coalesced(tensors, op=ReduceOp.SUM, axis_name="dp", **kwargs):
+    """reference ``comm/comm.py:512`` — fused exchange for a list of tensors
+    (flatten-concat per dtype, one psum each, split)."""
+    return _coalesce_by_dtype(
+        tensors, lambda flat: all_reduce(flat, op=op, axis_name=axis_name))
+
+
+@timed_op
+def all_gather_coalesced(tensors, axis_name="dp", **kwargs):
+    """reference ``comm/comm.py:475`` — gather a list of tensors in one
+    exchange per dtype; returns per-tensor [world, ...] stacks."""
+    return _coalesce_by_dtype(
+        tensors, lambda flat: lax.all_gather(flat, axis_name, axis=0,
+                                             tiled=False))
+
+
+class _ImmediateHandle:
+    """Async-handle parity (reference isend/irecv return works): XLA programs
+    are scheduled asynchronously by dispatch, so wait() is a no-op."""
+
+    def __init__(self, value=None):
+        self.value = value
+
+    def wait(self):
+        return self.value
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst, src=0, axis_name="pp", **kwargs):
+    """reference ``comm/comm.py:362``. SPMD point-to-point is a (src, dst)
+    permute traced on every device — callers name both endpoints. The permute
+    is issued into the XLA program immediately; the handle satisfies
+    ``.wait()`` callers. Ranks other than ``dst`` receive zeros."""
+    return _ImmediateHandle(send_recv(tensor, [(src, dst)], axis_name))
+
+
+def irecv(tensor, src, dst=0, axis_name="pp", **kwargs):
+    """reference ``comm/comm.py:370`` — same permute viewed from the
+    receiver."""
+    return _ImmediateHandle(send_recv(tensor, [(src, dst)], axis_name))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,129 @@
+"""Memory-mapped token dataset (.bin/.idx pair).
+
+Capability analog of the reference's MMap indexed dataset
+(``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py:627``,
+the Megatron-format pretraining corpus reader the data analyzer and
+curriculum sampler run over): random access to billions of tokens without
+loading them, O(1) per-sample slicing through ``np.memmap``.
+
+Own format (documented, not byte-compatible): ``<path>.bin`` holds the
+concatenated sample token arrays; ``<path>.idx`` holds a small header
+(magic, version, dtype code, sample count) followed by int64 sizes and byte
+offsets. TPU relevance: the host-side input pipeline feeds
+``jax.device_put`` from memmap slices — no Python-object dataset in RAM.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+           9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix):
+    return prefix + ".bin"
+
+
+def index_file_path(prefix):
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer: ``add_item`` token arrays, then ``finalize``."""
+
+    def __init__(self, path_prefix, dtype=np.int32):
+        self._prefix = path_prefix
+        self._dtype = np.dtype(dtype)
+        if self._dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._bin = open(data_file_path(path_prefix), "wb")
+        self._sizes = []
+
+    def add_item(self, tokens):
+        arr = np.ascontiguousarray(np.asarray(tokens, dtype=self._dtype))
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def merge_file(self, other_prefix):
+        """Append another dataset with the same dtype (reference
+        ``MMapIndexedDatasetBuilder.merge_file_``: distributed analyzer
+        shards merge into one corpus)."""
+        other = MMapIndexedDataset(other_prefix)
+        if other.dtype != self._dtype:
+            raise ValueError(f"dtype mismatch: {other.dtype} vs {self._dtype}")
+        with open(data_file_path(other_prefix), "rb") as f:
+            while True:
+                chunk = f.read(1 << 24)
+                if not chunk:
+                    break
+                self._bin.write(chunk)
+        self._sizes.extend(other.sizes.tolist())
+
+    def finalize(self):
+        self._bin.close()
+        sizes = np.asarray(self._sizes, dtype=np.int64)
+        pointers = np.zeros_like(sizes)
+        if sizes.size:
+            np.cumsum(sizes[:-1] * self._dtype.itemsize, out=pointers[1:])
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<QQQ", _VERSION,
+                                _DTYPE_CODES[self._dtype], sizes.size))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader. ``ds[i]`` -> np array view of sample i;
+    ``ds.get(i, offset, length)`` slices within a sample (curriculum
+    truncation); iteration and ``len`` as usual."""
+
+    def __init__(self, path_prefix):
+        self._prefix = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{index_file_path(path_prefix)}: bad magic")
+            version, code, count = struct.unpack("<QQQ", f.read(24))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self.dtype = np.dtype(_DTYPES[int(code)])
+            self.sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
+            self._pointers = np.frombuffer(f.read(8 * count), dtype=np.int64)
+        self._data = np.memmap(data_file_path(path_prefix), dtype=self.dtype,
+                               mode="r")
+
+    def __len__(self):
+        return self.sizes.size
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        ptr = self._pointers[i] // self.dtype.itemsize
+        return self._data[ptr:ptr + self.sizes[i]]
+
+    def get(self, i, offset=0, length=None):
+        size = int(self.sizes[i])
+        length = size - offset if length is None else min(length, size - offset)
+        ptr = self._pointers[i] // self.dtype.itemsize + offset
+        return self._data[ptr:ptr + length]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    @property
+    def num_tokens(self):
+        return int(self.sizes.sum())
+
+    def describe(self):
+        return json.dumps({"samples": len(self), "tokens": self.num_tokens,
+                           "dtype": self.dtype.name})

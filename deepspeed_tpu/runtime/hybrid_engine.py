@@ -51,12 +51,46 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def is_in_training_mode(self):
         return self._training_mode
 
+    # --- LoRA (reference hybrid_engine fuse/unfuse_lora_weight) ---
+    def configure_lora(self, lora):
+        """Attach an adapter pytree (``runtime/lora.py``); generation reads
+        the merged view, training params stay untouched."""
+        from deepspeed_tpu.runtime.lora import merged_view
+        self._lora = lora
+        self._lora_fused = False
+        self._lora_merge_fn = jax.jit(merged_view)  # built once: jit caches
+
+    def fuse_lora_weight(self):
+        """Explicit merge into the training params (reference semantics —
+        e.g. before exporting rollout weights). While fused, generation skips
+        the in-trace merge — the delta must never apply twice."""
+        from deepspeed_tpu.runtime.lora import fuse_lora
+        assert getattr(self, "_lora", None) is not None
+        assert not self._lora_fused, "LoRA already fused"
+        self.state = self.state._replace(
+            params=fuse_lora(self.state.params, self._lora))
+        self._lora_fused = True
+
+    def unfuse_lora_weight(self):
+        from deepspeed_tpu.runtime.lora import unfuse_lora
+        assert getattr(self, "_lora", None) is not None
+        assert self._lora_fused, "LoRA is not fused"
+        self.state = self.state._replace(
+            params=unfuse_lora(self.state.params, self._lora))
+        self._lora_fused = False
+
     def _inference_params(self):
         """The weights generation reads: the live working copy, dequantized
-        when qwZ stores it as int8 (the reference's gather+dequant flip)."""
+        when qwZ stores it as int8 (the reference's gather+dequant flip),
+        with LoRA adapters merged in-trace when configured (and not already
+        fused into the params)."""
         p = self.state.params
         if self.quantized_weights:
-            p = jax.jit(self._dequantize_working)(p)
+            if not hasattr(self, "_dequant_fn"):
+                self._dequant_fn = jax.jit(self._dequantize_working)
+            p = self._dequant_fn(p)
+        if getattr(self, "_lora", None) is not None and not self._lora_fused:
+            p = self._lora_merge_fn(p, self._lora)
         return p
 
     def generate(self, input_ids, max_new_tokens=None, temperature=0.0,

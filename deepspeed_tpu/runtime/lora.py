@@ -1,0 +1,95 @@
+"""LoRA — low-rank adapters with fuse/unfuse for the hybrid (RLHF) engine.
+
+Reference: the hybrid engine's LoRA handling
+(``runtime/hybrid_engine.py:126-173``: ``fuse_lora_weight`` /
+``unfuse_lora_weight`` around each generate, so rollout reads merged weights
+while training updates only the adapters).
+
+TPU design: adapters are a separate pytree mirroring the selected kernel
+leaves. "Fusing" is a jitted functional merge ``W + (alpha/r) * A @ B``
+producing the generation-time view — no in-place mutation, no unfuse
+needed for correctness (the training params are never touched); explicit
+``fuse``/``unfuse`` are still provided for checkpoint-export parity with the
+reference.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+DEFAULT_TARGETS = r"(q_proj|k_proj|v_proj|o_proj|gate_proj|up_proj|down_proj|c_attn|c_proj|c_fc)$"
+
+
+def _iter_kernels(params, targets):
+    """Yield (path tuple, leaf) for 2D kernels whose parent module matches."""
+    pat = re.compile(targets)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
+        if keys and keys[-1] == "kernel" and hasattr(leaf, "ndim") \
+                and leaf.ndim == 2 and len(keys) >= 2 \
+                and pat.search(str(keys[-2])):
+            yield keys, leaf
+
+
+def init_lora(params, rank=8, alpha=16.0, targets=DEFAULT_TARGETS, rng=None,
+              dtype=jnp.float32):
+    """Build the adapter pytree: {"/".join(path): {"a": [in, r], "b": [r, out]}}.
+
+    ``a`` is gaussian, ``b`` zeros (standard LoRA init: the merged delta
+    starts at exactly zero). ``alpha/rank`` is the merge scaling."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    adapters = {}
+    for keys, leaf in _iter_kernels(params, targets):
+        d_in, d_out = leaf.shape
+        rng, sub = jax.random.split(rng)
+        adapters["/".join(map(str, keys))] = {
+            "a": jax.random.normal(sub, (d_in, rank), dtype) / np.sqrt(d_in),
+            "b": jnp.zeros((rank, d_out), dtype),
+        }
+    return {"adapters": adapters, "scaling": float(alpha) / float(rank)}
+
+
+def _merge_one(leaf, ab, scaling, sign=1.0):
+    delta = (ab["a"].astype(jnp.float32) @ ab["b"].astype(jnp.float32))
+    return (leaf.astype(jnp.float32) + sign * scaling * delta).astype(leaf.dtype)
+
+
+def _map_targets(params, lora, fn):
+    adapters, scaling = lora["adapters"], lora["scaling"]
+
+    def walk(tree, prefix):
+        if isinstance(tree, dict):
+            return {k: walk(v, prefix + (str(k),)) for k, v in tree.items()}
+        key = "/".join(prefix)
+        return fn(tree, adapters[key], scaling) if key in adapters else tree
+
+    return walk(params, ())
+
+
+def fuse_lora(params, lora):
+    """W <- W + (alpha/r) A@B on every adapted leaf (reference
+    ``fuse_lora_weight``); returns a new pytree."""
+    return _map_targets(params, lora, lambda w, ab, s: _merge_one(w, ab, s, 1.0))
+
+
+def unfuse_lora(params, lora):
+    """Inverse of :func:`fuse_lora` (reference ``unfuse_lora_weight``)."""
+    return _map_targets(params, lora,
+                        lambda w, ab, s: _merge_one(w, ab, s, -1.0))
+
+
+def merged_view(params, lora):
+    """Jit-friendly merged view for generation — same math as fuse_lora but
+    intended to be traced inside the decode program (XLA fuses the low-rank
+    delta into the weight load; training params remain untouched)."""
+    return fuse_lora(params, lora)
+
+
+def trainable_filter(lora):
+    """Set of adapted leaf paths — used to freeze base weights when doing
+    adapter-only training (optax.masked-style masks)."""
+    return set(lora["adapters"].keys())

@@ -5,6 +5,7 @@ Mirrors reference coverage: ``tests/unit/checkpoint/test_universal_checkpoint.py
 (safe get/set across stages), ``runtime/activation_checkpointing``.
 """
 
+import os
 import numpy as np
 import pytest
 
@@ -269,3 +270,38 @@ def test_rng_tracker():
     assert not np.array_equal(np.asarray(k1), np.asarray(k2))
     with pytest.raises(Exception):
         tr.add("model-parallel-rng", 1)
+
+
+def test_zero_to_fp32_cli(tmp_path):
+    """bin/ds_tpu_zero_to_fp32 consolidates universal fragments offline
+    (reference utils/zero_to_fp32.py analog)."""
+    import subprocess
+    import sys
+
+    from tests.simple_model import SimpleModel, random_batches
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.checkpoint.universal import save_universal_checkpoint
+
+    groups.reset()
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    loss = engine(batch); engine.backward(loss); engine.step()
+    udir = save_universal_checkpoint(engine, str(tmp_path / "uni"))
+
+    out = tmp_path / "consolidated.npz"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, os.path.join(repo, "bin", "ds_tpu_zero_to_fp32"),
+                        udir, str(out)], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    data = np.load(out)
+    ref = engine.get_model_parameters(dtype=np.float32)
+    import jax as _jax
+    n_leaves = len(_jax.tree_util.tree_leaves(ref))
+    assert len(data.files) == n_leaves
+    total = sum(data[k].size for k in data.files)
+    assert total == sum(l.size for l in _jax.tree_util.tree_leaves(ref))

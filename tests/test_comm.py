@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.parallel.topology import MeshTopology
@@ -86,3 +86,60 @@ def test_comms_logger_records():
         "all_reduce", 1024, 0.001, n=8)
     assert busbw == pytest.approx(tput * 2 * 7 / 8)
     dist.configure(enabled=False)
+
+
+def test_coalesced_and_scatter_gather_verbs():
+    """gather/scatter/all_reduce_coalesced/all_gather_coalesced/isend parity
+    (reference comm/comm.py:380,391,475,512,362)."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    a = jnp.arange(8.0).reshape(4, 2)   # sharded -> per-device [1, 2]
+    b = jnp.arange(4.0)                 # sharded -> per-device [1]
+
+    def body(x, y):
+        g = dist.gather(x, axis_name="dp")            # [4, 1, 2]
+        summed = dist.all_reduce_coalesced([x, y], axis_name="dp")
+        st = dist.scatter(jnp.ravel(g) * 0 + jnp.arange(8.0), axis_name="dp")
+        ag = dist.all_gather_coalesced([x, y], axis_name="dp")
+        h = dist.isend(x, dst=1, src=0, axis_name="dp")
+        return g, summed[0], summed[1], st, ag[0], ag[1], h.wait()
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                               in_specs=(P("dp"), P("dp")),
+                               out_specs=(P(), P("dp"), P("dp"), P("dp"),
+                                          P(), P(), P("dp")),
+                               check_vma=False))
+    g, s0, s1, st, ag0, ag1, snt = fn(a, b)
+    np.testing.assert_allclose(np.asarray(g).reshape(4, 2), np.asarray(a))
+    # all_reduce_coalesced: every shard receives the sum over shards
+    np.testing.assert_allclose(np.asarray(s0)[0], np.asarray(a).sum(axis=0))
+    np.testing.assert_allclose(np.asarray(s1)[0], np.asarray(b).sum())
+    # scatter: rank i takes slice i of the source tensor
+    np.testing.assert_allclose(np.asarray(st), np.arange(8.0))
+    np.testing.assert_allclose(np.asarray(ag0).reshape(4, 1, 2)[2],
+                               np.asarray(a)[2:3])
+    np.testing.assert_allclose(np.asarray(ag1).reshape(4, 1)[1],
+                               np.asarray(b)[1:2])
+    # isend (0 -> 1): rank 1 holds rank 0's value, others zero
+    snt = np.asarray(snt)
+    np.testing.assert_allclose(snt[1], np.asarray(a)[0])
+    np.testing.assert_allclose(snt[0], 0.0)
+
+
+def test_coalesced_mixed_dtypes_preserved():
+    """Mixed-dtype buckets come back in their own dtypes (no silent
+    promotion through the flat concat)."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    a = jnp.ones((4, 2), jnp.bfloat16)
+    b = jnp.ones((4, 3), jnp.float32)
+
+    def body(x, y):
+        r = dist.all_reduce_coalesced([x, y], axis_name="dp")
+        g = dist.all_gather_coalesced([x, y], axis_name="dp")
+        return r[0], r[1], g[0], g[1]
+
+    r0, r1, g0, g1 = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp"), P(), P()), check_vma=False))(a, b)
+    assert r0.dtype == jnp.bfloat16 and g0.dtype == jnp.bfloat16
+    assert r1.dtype == jnp.float32 and g1.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(r1), 4.0)

@@ -1,0 +1,62 @@
+"""MMap indexed dataset round-trip (reference
+``tests/unit/runtime/data_pipeline`` indexed-dataset analog)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+
+def build(tmp_path, name, samples, dtype=np.int32):
+    b = MMapIndexedDatasetBuilder(str(tmp_path / name), dtype=dtype)
+    for s in samples:
+        b.add_item(s)
+    b.finalize()
+    return MMapIndexedDataset(str(tmp_path / name))
+
+
+def test_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    samples = [rng.integers(0, 50000, size=n).astype(np.int32)
+               for n in (5, 1, 128, 17)]
+    ds = build(tmp_path, "corpus", samples)
+    assert len(ds) == 4
+    assert ds.num_tokens == sum(s.size for s in samples)
+    for got, want in zip(ds, samples):
+        np.testing.assert_array_equal(got, want)
+    # in-sample slicing (curriculum truncation)
+    np.testing.assert_array_equal(ds.get(2, offset=10, length=20),
+                                  samples[2][10:30])
+    np.testing.assert_array_equal(ds.get(2, offset=120), samples[2][120:])
+
+
+def test_dtypes_and_merge(tmp_path):
+    s1 = [np.array([1, 2, 3], np.uint16), np.array([9], np.uint16)]
+    s2 = [np.array([7, 8], np.uint16)]
+    build(tmp_path, "a", s1, dtype=np.uint16)
+    build(tmp_path, "b", s2, dtype=np.uint16)
+    m = MMapIndexedDatasetBuilder(str(tmp_path / "merged"), dtype=np.uint16)
+    m.merge_file(str(tmp_path / "a"))
+    m.merge_file(str(tmp_path / "b"))
+    m.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "merged"))
+    assert len(ds) == 3
+    np.testing.assert_array_equal(ds[0], s1[0])
+    np.testing.assert_array_equal(ds[2], s2[0])
+    assert ds.dtype == np.uint16
+
+
+def test_bad_magic(tmp_path):
+    (tmp_path / "x.idx").write_bytes(b"NOTMAGIC" + b"\0" * 24)
+    (tmp_path / "x.bin").write_bytes(b"")
+    with pytest.raises(ValueError, match="bad magic"):
+        MMapIndexedDataset(str(tmp_path / "x"))
+
+
+def test_dtype_mismatch_merge(tmp_path):
+    build(tmp_path, "a32", [np.array([1], np.int32)])
+    m = MMapIndexedDatasetBuilder(str(tmp_path / "m16"), dtype=np.uint16)
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        m.merge_file(str(tmp_path / "a32"))
+    m.finalize()

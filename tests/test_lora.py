@@ -1,0 +1,122 @@
+"""LoRA adapters + hybrid-engine fuse/unfuse (reference
+``runtime/hybrid_engine.py:126-173`` LoRA flow)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.lora import (fuse_lora, init_lora, merged_view,
+                                        trainable_filter, unfuse_lora)
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig.tiny(scan_layers=False, remat=False)
+    model = LlamaForCausalLM(cfg)
+    ids = np.arange(8, dtype=np.int32)[None, :]
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    return cfg, model, params, ids
+
+
+def test_init_targets_projections(llama_setup):
+    _, _, params, _ = llama_setup
+    lora = init_lora(params, rank=4)
+    assert lora["adapters"], "no adapted leaves found"
+    assert all(k.endswith("kernel") for k in lora["adapters"])
+    assert any("q_proj" in k for k in lora["adapters"])
+    for ab in lora["adapters"].values():
+        assert ab["a"].shape[1] == 4 and ab["b"].shape[0] == 4
+
+
+def test_fresh_adapters_are_identity(llama_setup):
+    _, model, params, ids = llama_setup
+    lora = init_lora(params, rank=4)  # b=0 => merged == base
+    merged = merged_view(params, lora)
+    out_a = model.apply({"params": params}, {"input_ids": ids})
+    out_b = model.apply({"params": merged}, {"input_ids": ids})
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b))
+
+
+def _randomize_b(lora, seed=1):
+    rng = jax.random.PRNGKey(seed)
+    ad = {}
+    for k, ab in lora["adapters"].items():
+        rng, sub = jax.random.split(rng)
+        ad[k] = {"a": ab["a"],
+                 "b": 0.3 * jax.random.normal(sub, ab["b"].shape, ab["b"].dtype)}
+    return {"adapters": ad, "scaling": lora["scaling"]}
+
+
+def test_fuse_unfuse_roundtrip(llama_setup):
+    _, _, params, _ = llama_setup
+    lora = _randomize_b(init_lora(params, rank=4))
+    fused = fuse_lora(params, lora)
+    # fused differs on adapted leaves
+    tf = trainable_filter(lora)
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_f = jax.tree_util.tree_flatten_with_path(fused)[0]
+    changed = 0
+    for (pa, la), (_, lb) in zip(flat_p, flat_f):
+        key = "/".join(str(getattr(p, "key", "")) for p in pa)
+        if key in tf:
+            assert float(jnp.max(jnp.abs(la - lb))) > 0
+            changed += 1
+        else:
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert changed == len(tf)
+    back = unfuse_lora(fused, lora)
+    for (_, la), (_, lb) in zip(flat_p,
+                                jax.tree_util.tree_flatten_with_path(back)[0]):
+        np.testing.assert_allclose(np.asarray(la, np.float32),
+                                   np.asarray(lb, np.float32), atol=2e-6)
+
+
+def test_hybrid_engine_lora_generation(llama_setup):
+    cfg, model, params, ids = llama_setup
+    from deepspeed_tpu.parallel import groups
+    groups.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "hybrid_engine": {"enabled": True, "max_out_tokens": 4}})
+    base = np.asarray(engine.generate(jnp.asarray(ids), max_new_tokens=4))
+    lora = _randomize_b(init_lora(engine.state.params, rank=4), seed=9)
+    engine.configure_lora(lora)
+    adapted = np.asarray(engine.generate(jnp.asarray(ids), max_new_tokens=4))
+    assert base.shape == adapted.shape
+    fused_before = np.asarray(
+        jax.tree_util.tree_leaves(engine.state.params)[0])
+    engine.fuse_lora_weight()
+    engine.unfuse_lora_weight()
+    fused_after = np.asarray(
+        jax.tree_util.tree_leaves(engine.state.params)[0])
+    np.testing.assert_allclose(fused_before, fused_after, atol=2e-6)
+
+
+def test_no_double_merge_after_fuse(llama_setup):
+    """generate() after fuse_lora_weight must not apply the delta twice
+    (the fused flag gates the in-trace merge)."""
+    cfg, model, params, ids = llama_setup
+    from deepspeed_tpu.runtime.lora import merged_view
+    from deepspeed_tpu.parallel import groups
+    groups.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "hybrid_engine": {"enabled": True, "max_out_tokens": 4}})
+    lora = _randomize_b(init_lora(engine.state.params, rank=4), seed=11)
+    engine.configure_lora(lora)
+    want = np.asarray(jax.tree_util.tree_leaves(
+        merged_view(engine.state.params, lora))[0])
+    engine.fuse_lora_weight()
+    got = np.asarray(jax.tree_util.tree_leaves(engine._inference_params())[0])
+    np.testing.assert_allclose(got, want, atol=2e-6)
+    with pytest.raises(AssertionError):
+        engine.fuse_lora_weight()  # double fuse is refused
+    engine.unfuse_lora_weight()

@@ -63,8 +63,7 @@ def test_sparse_all_reduce_matches_psum():
 
 def test_sparse_exchange_factored_form():
     mesh = _mesh()
-    dense, ids = _local_grads(seed=3)
-    V = dense.shape[1]
+    V = 32
 
     def body(g, i):
         rows = jnp.take(g[0], i[0], axis=0)  # ids unique per slot? may repeat
