@@ -98,15 +98,25 @@ def run_bench():
     model = GPT2LMHeadModel(cfg)
 
     # flash attention + chunked CE freed the [B,H,T,T] and [B,T,V] buffers;
-    # try the larger per-chip batches first and fall back on OOM
+    # try the larger per-chip batches first and fall back on OOM. The remat
+    # policy trades memory for step time: "dots" (save projections + flash
+    # outputs) is fastest when it fits, "everything" (recompute-all) is the
+    # memory floor — prefer a big batch with dots, degrade policy before
+    # batch.
     if os.environ.get("DS_BENCH_BATCH"):
-        candidates = [int(os.environ["DS_BENCH_BATCH"])]
+        pol = os.environ.get("DS_BENCH_REMAT", "dots")
+        candidates = [(int(os.environ["DS_BENCH_BATCH"]), pol)]
+    elif os.environ.get("DS_BENCH_REMAT"):
+        pol = os.environ["DS_BENCH_REMAT"]
+        candidates = [(32, pol), (16, pol), (8, pol)] if on_tpu else [(2, pol)]
     else:
-        candidates = [32, 16, 8] if on_tpu else [2]
+        candidates = ([(32, "dots"), (32, "everything"), (16, "dots"),
+                       (16, "everything"), (8, "everything")]
+                      if on_tpu else [(2, "dots")])
 
     engine = batch_data = None
     last_err = None
-    for batch in candidates:
+    for batch, remat_policy in candidates:
         rng = np.random.default_rng(0)
         ids = rng.integers(0, cfg.vocab_size,
                            size=(batch * max(n_chips, 1), seq)).astype(np.int32)
@@ -125,11 +135,7 @@ def run_bench():
                     "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
                     "zero_optimization": {"stage": 1},
                     "gradient_clipping": 1.0,
-                    # "dots" saves projections + flash outputs; backward then
-                    # skips recomputing the blocks (OOM falls back to a
-                    # smaller batch, where the saved activations fit)
-                    "activation_checkpointing": {
-                        "policy": os.environ.get("DS_BENCH_REMAT", "dots")},
+                    "activation_checkpointing": {"policy": remat_policy},
                 })
 
             def step():
@@ -149,14 +155,15 @@ def run_bench():
             engine = params = None
             import gc
             gc.collect()
-            print(f"bench: batch {batch} failed ({type(e).__name__}); "
-                  f"falling back", file=sys.stderr)
+            print(f"bench: batch {batch}/{remat_policy} failed "
+                  f"({type(e).__name__}); falling back", file=sys.stderr)
     if engine is None:
         raise last_err
 
     first_loss = float(jax.device_get(loss))
     print(f"compile+first step: {time.perf_counter()-t0:.1f}s "
-          f"batch={batch} loss={first_loss:.3f}", file=sys.stderr)
+          f"batch={batch} remat={remat_policy} loss={first_loss:.3f}",
+          file=sys.stderr)
     # sanity: random-init CE should be ~ln(vocab). An insane/NaN loss on the
     # Pallas path means a kernel miscompile — rerun once on pure XLA.
     import math
@@ -187,6 +194,7 @@ def run_bench():
         "vs_baseline": round(mfu / 0.45, 4),
         "extra": {"mfu": round(mfu, 4), "chips": n_chips, "device": kind,
                   "batch_per_chip": batch, "seq": seq, "steps": n_steps,
+                  "remat_policy": remat_policy,
                   "loss": float(jax.device_get(loss))},
     })
 

@@ -1,0 +1,157 @@
+"""SplitFuse continuous-batching scheduler over ``InferenceEngineV2``.
+
+The reference keeps this role in DeepSpeed-MII (``engine_v2.py`` exposes
+``query``/``can_schedule`` for it; the SplitFuse policy is described in the
+FastGen blog): every forward carries a near-constant token budget by
+splitting long prompts into chunks and fusing them with the single-token
+decodes of running sequences — prefill never stalls decode latency and the
+MXU always sees a full batch.
+
+Pure host-side policy: composes ragged batches, calls ``engine.put``, samples
+greedily, retires finished sequences. The engine's admission control
+(``can_schedule``) stays the source of truth; the scheduler only proposes.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class _Request:
+    uid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    prefill_pos: int = 0
+    generated: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def prefilling(self):
+        return self.prefill_pos < len(self.prompt)
+
+
+class SplitFuseScheduler:
+    """Greedy continuous batching with chunked (split) prefill.
+
+    Args:
+        engine: an ``InferenceEngineV2``.
+        token_budget: max tokens per forward (defaults to the engine's
+            ``max_ragged_batch_size``).
+    """
+
+    def __init__(self, engine, token_budget=None):
+        self._engine = engine
+        sm = engine._config.state_manager
+        self._budget = min(token_budget or sm.max_ragged_batch_size,
+                           sm.max_ragged_batch_size)
+        self._max_seqs = sm.max_ragged_sequence_count
+        self._requests: Dict[int, _Request] = {}
+        self._starved = 0  # consecutive rounds with nothing schedulable
+
+    def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None):
+        if uid in self._requests:
+            raise ValueError(f"uid {uid} already submitted")
+        prompt = np.asarray(prompt, np.int32)
+        max_ctx = self._engine._config.state_manager.max_context
+        if len(prompt) >= max_ctx:
+            raise ValueError(f"prompt of {len(prompt)} tokens cannot fit "
+                             f"max_context {max_ctx}")
+        self._requests[uid] = _Request(uid, prompt, int(max_new_tokens),
+                                       eos_token_id)
+
+    @property
+    def has_work(self):
+        return any(not r.done for r in self._requests.values())
+
+    def _compose(self):
+        """Pick (uids, token-chunks) for one forward under the budget.
+
+        Decodes (1 token) first — they bound tail latency; leftover budget
+        is split across pending prefills (the SplitFuse chunking)."""
+        max_ctx = self._engine._config.state_manager.max_context
+        uids, chunks, budget = [], [], self._budget
+        for r in list(self._requests.values()):
+            if r.done or r.prefilling or len(uids) >= self._max_seqs:
+                continue
+            pos = len(r.prompt) + len(r.generated)
+            if pos >= max_ctx:
+                # context capacity reached: retire with what it has — the
+                # request can never schedule again and must not wedge others
+                r.done = True
+                self._engine.flush(r.uid)
+                continue
+            if budget < 1:
+                break
+            nxt = r.generated[-1]
+            uids.append(r.uid)
+            chunks.append(np.asarray([nxt], np.int32))
+            budget -= 1
+        for r in self._requests.values():
+            if r.done or not r.prefilling or r.uid in uids:
+                continue
+            if len(uids) >= self._max_seqs or budget < 1:
+                break
+            room, _ = self._engine.query(r.uid, budget,
+                                         self._engine.free_blocks)
+            take = min(budget, room, len(r.prompt) - r.prefill_pos)
+            if take < 1:
+                continue
+            uids.append(r.uid)
+            chunks.append(r.prompt[r.prefill_pos:r.prefill_pos + take])
+            budget -= take
+        return uids, chunks
+
+    def step(self):
+        """One scheduling round + forward. Returns uids finished this round."""
+        uids, chunks = self._compose()
+        if not uids:
+            return []
+        # shrink the proposal until the engine admits it (KV pressure):
+        # drop the largest chunk each time and RE-validate — put() would
+        # raise on an oversubscribed batch
+        while uids:
+            verdict = self._engine.can_schedule(uids, [len(c) for c in chunks])
+            if verdict.success:
+                break
+            biggest = int(np.argmax([len(c) for c in chunks]))
+            uids.pop(biggest)
+            chunks.pop(biggest)
+        if not uids:
+            self._starved += 1
+            if self._starved > 3:
+                raise RuntimeError(
+                    f"no schedulable work for {self._starved} rounds: "
+                    f"{verdict.reason} (KV cache too small for any request?)")
+            return []
+        self._starved = 0
+        logits = self._engine.put(uids, chunks)
+        finished = []
+        for row, uid in enumerate(uids):
+            r = self._requests[uid]
+            if r.prefilling:
+                r.prefill_pos += len(chunks[row])
+                if r.prefilling:
+                    continue  # mid-prompt logits are not a next token
+            else:
+                pass
+            tok = int(np.argmax(logits[row]))
+            r.generated.append(tok)
+            if (r.eos_token_id is not None and tok == r.eos_token_id) or \
+                    len(r.generated) >= r.max_new_tokens:
+                r.done = True
+                self._engine.flush(uid)
+                finished.append(uid)
+        return finished
+
+    def run_to_completion(self, max_rounds=10000):
+        for _ in range(max_rounds):
+            if not self.has_work:
+                break
+            self.step()
+        else:
+            raise RuntimeError("scheduler did not converge")
+        return {uid: np.asarray(r.generated, np.int32)
+                for uid, r in self._requests.items()}

@@ -98,8 +98,14 @@ class MMapIndexedDataset:
             self.dtype = np.dtype(_DTYPES[int(code)])
             self.sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
             self._pointers = np.frombuffer(f.read(8 * count), dtype=np.int64)
-        self._data = np.memmap(data_file_path(path_prefix), dtype=self.dtype,
-                               mode="r")
+        # np.memmap refuses 0-byte files; an analyzer shard that received no
+        # samples is a valid (empty) dataset
+        if self.sizes.size == 0 or \
+                os.path.getsize(data_file_path(path_prefix)) == 0:
+            self._data = np.empty((0,), dtype=self.dtype)
+        else:
+            self._data = np.memmap(data_file_path(path_prefix),
+                                   dtype=self.dtype, mode="r")
 
     def __len__(self):
         return self.sizes.size

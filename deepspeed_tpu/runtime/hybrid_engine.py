@@ -56,6 +56,9 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         """Attach an adapter pytree (``runtime/lora.py``); generation reads
         the merged view, training params stay untouched."""
         from deepspeed_tpu.runtime.lora import merged_view
+        assert not getattr(self, "_lora_fused", False), \
+            "unfuse_lora_weight() before configuring a new adapter — the " \
+            "previous delta is baked into the params"
         self._lora = lora
         self._lora_fused = False
         self._lora_merge_fn = jax.jit(merged_view)  # built once: jit caches

@@ -60,3 +60,13 @@ def test_dtype_mismatch_merge(tmp_path):
     with pytest.raises(ValueError, match="dtype mismatch"):
         m.merge_file(str(tmp_path / "a32"))
     m.finalize()
+
+
+def test_empty_dataset(tmp_path):
+    ds = build(tmp_path, "empty", [])
+    assert len(ds) == 0 and ds.num_tokens == 0
+    m = MMapIndexedDatasetBuilder(str(tmp_path / "m"), dtype=np.int32)
+    m.merge_file(str(tmp_path / "empty"))  # merging an empty shard is fine
+    m.add_item(np.array([5], np.int32))
+    m.finalize()
+    assert len(MMapIndexedDataset(str(tmp_path / "m"))) == 1
