@@ -27,7 +27,8 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 LLAMA_FAMILY = ("llama", "mistral", "qwen2")
-SUPPORTED = LLAMA_FAMILY + ("gpt2", "opt", "mixtral", "falcon", "phi")
+SUPPORTED = LLAMA_FAMILY + ("gpt2", "opt", "mixtral", "falcon", "phi", "bloom",
+                            "gpt_neox", "gptj")
 
 
 class UnsupportedModelError(ValueError):
@@ -541,6 +542,179 @@ def phi_to_flax(sd, cfg, dtype=np.float32):
     return tree
 
 
+def gptneox_to_flax(sd, cfg, dtype=np.float32):
+    """HF GPT-NeoX -> parallel-block tree (dual LN, fused interleaved QKV,
+    partial rotary permuted to our interleaved convention)."""
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    rd = cfg.rotary_dim
+    sd = {k.removeprefix("gpt_neox."): v for k, v in sd.items()}
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def ln(p):
+        return {"scale": g(p + ".weight"), "bias": g(p + ".bias")}
+
+    def lin(p, transform=None):
+        out = {"kernel": g(p + ".weight").T, "bias": g(p + ".bias")}
+        if transform:
+            out = {k: transform(v) for k, v in out.items()}
+        return out
+
+    def qkv_transform(w):
+        q, k, v = _falcon_split_qkv(w, H, H, Dh, interleaved=True)
+        q = _permute_qk_out(q, H, Dh, rotary_dim=rd)
+        k = _permute_qk_out(k, H, Dh, rotary_dim=rd)
+        return np.concatenate([q, k, v], axis=-1)
+
+    tree = {"embed_tokens": g("embed_in.weight"),
+            "final_layernorm": ln("final_layer_norm"),
+            "lm_head": sd["embed_out.weight"].astype(dtype)}
+    for i in range(cfg.num_hidden_layers):
+        p = f"layers.{i}."
+        tree[f"layers_{i}"] = {
+            "input_layernorm": ln(p + "input_layernorm"),
+            "post_attention_layernorm": ln(p + "post_attention_layernorm"),
+            "query_key_value": lin(p + "attention.query_key_value",
+                                   transform=qkv_transform),
+            "dense": lin(p + "attention.dense"),
+            "fc1": lin(p + "mlp.dense_h_to_4h"),
+            "fc2": lin(p + "mlp.dense_4h_to_h"),
+        }
+    return tree
+
+
+def gptj_to_flax(sd, cfg, dtype=np.float32):
+    """HF GPT-J -> parallel-block tree. GPT-J's interleaved partial rotary is
+    OUR native convention — no q/k permutation."""
+    sd = {k.removeprefix("transformer."): v for k, v in sd.items()}
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def lin(p):
+        out = {"kernel": g(p + ".weight").T}
+        if p + ".bias" in sd:
+            out["bias"] = g(p + ".bias")
+        return out
+
+    def ln(p):
+        return {"scale": g(p + ".weight"), "bias": g(p + ".bias")}
+
+    tree = {"embed_tokens": g("wte.weight"),
+            "final_layernorm": ln("ln_f"),
+            "lm_head": g("lm_head.weight")}
+    if "lm_head.bias" in sd:
+        tree["lm_head_bias"] = g("lm_head.bias")
+    for i in range(cfg.num_hidden_layers):
+        p = f"h.{i}."
+        tree[f"layers_{i}"] = {
+            "input_layernorm": ln(p + "ln_1"),
+            "q_proj": lin(p + "attn.q_proj"),
+            "k_proj": lin(p + "attn.k_proj"),
+            "v_proj": lin(p + "attn.v_proj"),
+            "dense": lin(p + "attn.out_proj"),
+            "fc1": lin(p + "mlp.fc_in"),
+            "fc2": lin(p + "mlp.fc_out"),
+        }
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# bloom
+# ---------------------------------------------------------------------------
+
+def bloom_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
+    """HF BLOOM -> models/bloom.py tree. The fused QKV is stored per-head
+    interleaved ([H, 3, Dh] on the out axis); converted to our q|k|v concat."""
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    L = cfg.num_hidden_layers
+    sd = {k.removeprefix("transformer."): v for k, v in sd.items()}
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def qkv(p):
+        w = g(p + "query_key_value.weight").T           # [D, 3D] interleaved
+        b = g(p + "query_key_value.bias")               # [3D]
+        qw, kw, vw = _falcon_split_qkv(w, H, H, Dh, interleaved=True)
+        qb, kb, vb = _falcon_split_qkv(b, H, H, Dh, interleaved=True)
+        return {"kernel": np.concatenate([qw, kw, vw], axis=-1),
+                "bias": np.concatenate([qb, kb, vb], axis=-1)}
+
+    def lin(name):
+        return {"kernel": g(name + ".weight").T, "bias": g(name + ".bias")}
+
+    def ln(name):
+        return {"scale": g(name + ".weight"), "bias": g(name + ".bias")}
+
+    def layer(i):
+        p = f"h.{i}."
+        return {
+            "input_layernorm": ln(p + "input_layernorm"),
+            "post_attention_layernorm": ln(p + "post_attention_layernorm"),
+            "query_key_value": qkv(p + "self_attention."),
+            "dense": lin(p + "self_attention.dense"),
+            "dense_h_to_4h": lin(p + "mlp.dense_h_to_4h"),
+            "dense_4h_to_h": lin(p + "mlp.dense_4h_to_h"),
+        }
+
+    tree = {"word_embeddings": g("word_embeddings.weight"),
+            "word_embeddings_layernorm": ln("word_embeddings_layernorm"),
+            "ln_f": ln("ln_f")}
+    layers = [layer(i) for i in range(L)]
+    if scan_layers:
+        import jax
+        tree["h"] = {"block": jax.tree.map(lambda *xs: _stack(xs), *layers)}
+    else:
+        for i, l in enumerate(layers):
+            tree[f"h_{i}"] = l
+    return tree
+
+
+def bloom_from_flax(params, cfg, dtype=np.float32):
+    import jax
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+
+    def interleave_qkv(kernel, bias):
+        """our q|k|v concat (out axis) -> HF per-head [H, 3, Dh] layout."""
+        def to_hf(a):
+            q, k, v = np.split(a, 3, axis=-1)
+            parts = np.stack([x.reshape(x.shape[:-1] + (H, Dh)) for x in (q, k, v)],
+                             axis=-2)                    # [..., H, 3, Dh]
+            return parts.reshape(a.shape)
+        return to_hf(kernel), to_hf(bias)
+
+    sd = {"word_embeddings.weight": params["word_embeddings"],
+          "word_embeddings_layernorm.weight":
+              params["word_embeddings_layernorm"]["scale"],
+          "word_embeddings_layernorm.bias":
+              params["word_embeddings_layernorm"]["bias"],
+          "ln_f.weight": params["ln_f"]["scale"],
+          "ln_f.bias": params["ln_f"]["bias"]}
+    for i in range(cfg.num_hidden_layers):
+        l = (jax.tree.map(lambda x: x[i], params["h"]["block"])
+             if "h" in params else params[f"h_{i}"])
+        p = f"h.{i}."
+        for lname in ("input_layernorm", "post_attention_layernorm"):
+            sd[p + lname + ".weight"] = l[lname]["scale"]
+            sd[p + lname + ".bias"] = l[lname]["bias"]
+        kw, kb = interleave_qkv(l["query_key_value"]["kernel"],
+                                l["query_key_value"]["bias"])
+        sd[p + "self_attention.query_key_value.weight"] = kw.T
+        sd[p + "self_attention.query_key_value.bias"] = kb
+        sd[p + "self_attention.dense.weight"] = l["dense"]["kernel"].T
+        sd[p + "self_attention.dense.bias"] = l["dense"]["bias"]
+        sd[p + "mlp.dense_h_to_4h.weight"] = l["dense_h_to_4h"]["kernel"].T
+        sd[p + "mlp.dense_h_to_4h.bias"] = l["dense_h_to_4h"]["bias"]
+        sd[p + "mlp.dense_4h_to_h.weight"] = l["dense_4h_to_h"]["kernel"].T
+        sd[p + "mlp.dense_4h_to_h.bias"] = l["dense_4h_to_h"]["bias"]
+    sd = {"transformer." + k: v for k, v in sd.items()}
+    sd["lm_head.weight"] = params["word_embeddings"]  # tied
+    return sd
+
+
 # ---------------------------------------------------------------------------
 # top-level API
 # ---------------------------------------------------------------------------
@@ -652,6 +826,56 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
             not in ("gelu_new", "gelu_pytorch_tanh"),
             lm_head_bias="lm_head.bias" in sd)
         return ParallelBlockForCausalLM(cfg), phi_to_flax(sd, cfg, dtype=dtype)
+    if mt == "bloom":
+        from deepspeed_tpu.models.bloom import BloomConfig, BloomForCausalLM
+        cfg = BloomConfig(vocab_size=hf_cfg.vocab_size,
+                          hidden_size=hf_cfg.hidden_size,
+                          num_hidden_layers=hf_cfg.n_layer,
+                          num_attention_heads=hf_cfg.n_head,
+                          layer_norm_epsilon=hf_cfg.layer_norm_epsilon,
+                          scan_layers=scan_layers)
+        return BloomForCausalLM(cfg), bloom_to_flax(sd, cfg,
+                                                    scan_layers=scan_layers,
+                                                    dtype=dtype)
+    if mt == "gpt_neox":
+        from deepspeed_tpu.models.parallel_block import (ParallelBlockConfig,
+                                                         ParallelBlockForCausalLM)
+        if not getattr(hf_cfg, "use_parallel_residual", True):
+            raise UnsupportedModelError(
+                "gpt_neox use_parallel_residual=False (pythia-70m-v0 lineage) "
+                "not supported — the parallel-block model cannot represent it")
+        cfg = ParallelBlockConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            intermediate_size=hf_cfg.intermediate_size,
+            num_hidden_layers=hf_cfg.num_hidden_layers,
+            num_attention_heads=hf_cfg.num_attention_heads,
+            num_key_value_heads=hf_cfg.num_attention_heads,
+            max_position_embeddings=hf_cfg.max_position_embeddings,
+            layer_norm_eps=hf_cfg.layer_norm_eps,
+            rope_theta=getattr(hf_cfg, "rotary_emb_base", 10000.0),
+            rotary_pct=getattr(hf_cfg, "rotary_pct", 0.25),
+            use_bias=True, fused_qkv=True, dual_layernorm=True,
+            gelu_exact=getattr(hf_cfg, "hidden_act", "gelu") == "gelu",
+            tie_lm_head=bool(getattr(hf_cfg, "tie_word_embeddings", False)))
+        return (ParallelBlockForCausalLM(cfg),
+                gptneox_to_flax(sd, cfg, dtype=dtype))
+    if mt == "gptj":
+        from deepspeed_tpu.models.parallel_block import (ParallelBlockConfig,
+                                                         ParallelBlockForCausalLM)
+        cfg = ParallelBlockConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.n_embd,
+            intermediate_size=getattr(hf_cfg, "n_inner", None) or
+            4 * hf_cfg.n_embd,
+            num_hidden_layers=hf_cfg.n_layer,
+            num_attention_heads=hf_cfg.n_head,
+            num_key_value_heads=hf_cfg.n_head,
+            max_position_embeddings=hf_cfg.n_positions,
+            layer_norm_eps=hf_cfg.layer_norm_epsilon,
+            rotary_pct=hf_cfg.rotary_dim / (hf_cfg.n_embd // hf_cfg.n_head),
+            use_bias=True, qkv_bias=False, dense_bias=False,
+            fused_qkv=False, gelu_exact=False,
+            lm_head_bias="lm_head.bias" in sd)
+        return ParallelBlockForCausalLM(cfg), gptj_to_flax(sd, cfg, dtype=dtype)
     raise UnsupportedModelError(
         f"unsupported model_type {mt!r}; supported: {SUPPORTED}")
 
@@ -716,6 +940,14 @@ def export_pretrained(params, cfg, save_dir, dtype=np.float32):
               "num_experts_per_tok": cfg.num_experts_per_tok,
               "max_position_embeddings": cfg.max_position_embeddings,
               "tie_word_embeddings": False}
+    elif name == "BloomConfig":
+        sd = bloom_from_flax(params, cfg, dtype=dtype)
+        hf = {"model_type": "bloom", "architectures": ["BloomForCausalLM"],
+              "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+              "n_layer": cfg.num_hidden_layers,
+              "n_head": cfg.num_attention_heads,
+              "layer_norm_epsilon": cfg.layer_norm_epsilon,
+              "tie_word_embeddings": True}
     else:
         raise UnsupportedModelError(f"unsupported model config {name}")
 

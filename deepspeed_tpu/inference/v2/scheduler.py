@@ -55,6 +55,8 @@ class SplitFuseScheduler:
         if uid in self._requests:
             raise ValueError(f"uid {uid} already submitted")
         prompt = np.asarray(prompt, np.int32)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
         max_ctx = self._engine._config.state_manager.max_context
         if len(prompt) >= max_ctx:
             raise ValueError(f"prompt of {len(prompt)} tokens cannot fit "

@@ -1,0 +1,207 @@
+"""BLOOM model family (TPU-native flax).
+
+Reference support surface: BLOOM is a v1 kernel-injection family
+(``module_inject/containers/bloom.py``, policy in
+``module_inject/replace_policy.py``) with its fused-softmax ALiBi path in
+``csrc/transformer/inference/csrc/softmax.cu`` (the ``alibi`` argument).
+TPU design: ALiBi is an additive attention bias — exactly the bias slot the
+Pallas flash kernel already carries — so one [1, H, Tq, Tk] bias array gives
+BLOOM the same fused fast path as every other family, no dedicated kernel.
+
+Architecture (HF ``BloomForCausalLM``): sequential GPT-style blocks, fused
+interleaved QKV ([H, 3, Dh] on the output dim — converted to our q/k/v concat
+layout at load, ``checkpoint/hf.py``), LayerNorm on the embedding output,
+biases everywhere, tied lm_head, no position embeddings (ALiBi only).
+"""
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    current_policy as remat_policy)
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 4096
+    num_hidden_layers: int = 30
+    num_attention_heads: int = 32
+    layer_norm_epsilon: float = 1e-5
+    max_position_embeddings: int = 2048   # KV-cache length for decode
+    scan_layers: bool = True
+    remat: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw):
+        return BloomConfig(vocab_size=512, hidden_size=64,
+                           num_hidden_layers=2, num_attention_heads=4, **kw)
+
+
+def alibi_slopes(n_heads):
+    """Per-head ALiBi slopes (Press et al.; matches HF ``build_alibi_tensor``):
+    powers of 2^(-8/n) for the largest power-of-two head count, interleaved
+    extras at half step for the remainder."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return jnp.asarray(pow2_slopes(n_heads), jnp.float32)
+    base = 2 ** math.floor(math.log2(n_heads))
+    slopes = pow2_slopes(base)
+    extra = pow2_slopes(2 * base)[0::2][: n_heads - base]
+    return jnp.asarray(slopes + extra, jnp.float32)
+
+
+def alibi_bias(n_heads, q_pos, k_len):
+    """[1, H, Tq, Tk] additive bias: slope_h * key_position. Shift-invariant
+    per softmax row, so the absolute-key form matches HF's."""
+    slopes = alibi_slopes(n_heads)                       # [H]
+    keys = jnp.arange(k_len, dtype=jnp.float32)          # [Tk]
+    bias = slopes[:, None, None] * keys[None, None, :]   # [H, 1, Tk]
+    return jnp.broadcast_to(bias, (n_heads, q_pos.shape[-1], k_len))[None]
+
+
+class BloomBlock(nn.Module):
+    config: BloomConfig
+    use_cache: bool = False
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, T, D = x.shape
+        H, Dh = cfg.num_attention_heads, cfg.head_dim
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                       dtype=cfg.dtype, name=name)
+        h = ln("input_layernorm")(x)
+        qkv = nn.Dense(3 * D, dtype=cfg.dtype, name="query_key_value")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, Dh)
+        k = k.reshape(B, T, H, Dh)
+        v = v.reshape(B, T, H, Dh)
+
+        from deepspeed_tpu.ops.flash_attention import NEG_INF, mha
+        if self.use_cache:
+            L = cfg.max_position_embeddings
+            ck = self.variable("cache", "cached_key", jnp.zeros, (B, L, H, Dh), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, (B, L, H, Dh), cfg.dtype)
+            ci = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            ci.value = idx + T
+            key_pos = jnp.arange(L)[None, :]
+            qry_pos = idx + jnp.arange(T)[:, None]
+            mask = jnp.where(key_pos <= qry_pos, 0.0, NEG_INF)       # [T, L]
+            ab = alibi_bias(H, qry_pos[:, 0], L)[0]                  # [H, T, L]
+            bias = (ab + mask[None])[None]                           # [1, H, T, L]
+            scale = 1.0 / (Dh ** 0.5)
+            logits = jnp.einsum("bthd,bshd->bhts", q, ck.value).astype(jnp.float32) * scale
+            logits = logits + bias
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, cv.value).reshape(B, T, D)
+        else:
+            qry = jnp.arange(T)
+            bias = alibi_bias(H, qry, T)                 # [1, H, T, T]
+            attn = mha(q, k, v, bias=bias, causal=True).reshape(B, T, D)
+        x = x + nn.Dense(D, dtype=cfg.dtype, name="dense")(attn)
+
+        h = ln("post_attention_layernorm")(x)
+        m = nn.gelu(nn.Dense(4 * D, dtype=cfg.dtype, name="dense_h_to_4h")(h),
+                    approximate=True)
+        x = x + nn.Dense(D, dtype=cfg.dtype, name="dense_4h_to_h")(m)
+        return x
+
+
+class ScanBloomBlock(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, deterministic = carry
+        x = BloomBlock(self.config, name="block")(x, deterministic)
+        return (x, deterministic), None
+
+
+class BloomForCausalLM(nn.Module):
+    """Returns the LM loss when the batch carries labels (engine convention),
+    else logits. ``use_cache=True`` enables the KV-cache decode path for the
+    v1 inference engine / hybrid-engine generation."""
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic=True, use_cache=False,
+                 positions=None):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        embed = self.param("word_embeddings", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        x = embed.astype(cfg.dtype)[input_ids]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="word_embeddings_layernorm")(x)
+
+        if cfg.scan_layers and not use_cache:
+            block = ScanBloomBlock
+            if cfg.remat:
+                block = nn.remat(ScanBloomBlock, prevent_cse=False,
+                                 policy=remat_policy())
+            Scanned = nn.scan(block, variable_axes={"params": 0},
+                              split_rngs={"params": True, "dropout": True},
+                              length=cfg.num_hidden_layers,
+                              metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            (x, _), _ = Scanned(cfg, name="h")((x, deterministic), None)
+        else:
+            block_cls = nn.remat(BloomBlock, prevent_cse=False,
+                                 policy=remat_policy()) \
+                if (cfg.remat and not use_cache) else BloomBlock
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, use_cache, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_f")(x)
+        if labels is None:
+            return x @ embed.astype(cfg.dtype).T        # tied head
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, embed, labels)
+
+    def param_specs(self, params):
+        """Megatron TP: qkv/h_to_4h column-split, dense/4h_to_h row-split,
+        vocab-split embedding (same pattern as models/llama.py)."""
+        cfg = self.config
+
+        def spec_for(path, leaf):
+            names = "/".join(str(getattr(p, "key", getattr(p, "name", "")))
+                             for p in path)
+            # scanned block params carry a leading [L] axis
+            scan_prefix = (None,) if (cfg.scan_layers and "h/block" in names) \
+                else ()
+            if leaf.ndim == 1 + len(scan_prefix):
+                return None
+            if "word_embeddings" in names and "layernorm" not in names:
+                return P("tp", None)
+            if "query_key_value" in names or "dense_h_to_4h" in names:
+                return P(*scan_prefix, None, "tp")
+            if "dense_4h_to_h" in names or "dense/kernel" in names:
+                return P(*scan_prefix, "tp", None)
+            return None
+
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        specs = [spec_for(p, l) for p, l in flat]
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params), specs)

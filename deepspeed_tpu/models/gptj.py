@@ -1,0 +1,34 @@
+"""GPT-J family configs (reference v1 injection container
+``module_inject/containers/gptj.py`` + replace policy). See
+models/parallel_block.py — GPT-J is the parallel-residual block with one
+shared layernorm, separate un-biased q/k/v and biased MLP, partial
+INTERLEAVED rotary (our native convention — loaded without any q/k
+permutation, unlike the half-split NeoX/llama checkpoints), and a biased
+lm_head."""
+
+from deepspeed_tpu.models.parallel_block import (ParallelBlockConfig,
+                                                 ParallelBlockForCausalLM)
+
+GPTJForCausalLM = ParallelBlockForCausalLM
+
+
+def gptj_6b_config(**kw):
+    defaults = dict(vocab_size=50400, hidden_size=4096, intermediate_size=16384,
+                    num_hidden_layers=28, num_attention_heads=16,
+                    num_key_value_heads=16, max_position_embeddings=2048,
+                    rotary_pct=64 / 256, use_bias=True, qkv_bias=False,
+                    dense_bias=False, fused_qkv=False, dual_layernorm=False,
+                    gelu_exact=False, lm_head_bias=True)
+    defaults.update(kw)
+    return ParallelBlockConfig(**defaults)
+
+
+def tiny_gptj_config(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=4, max_position_embeddings=128,
+                    rotary_pct=0.5, use_bias=True, qkv_bias=False,
+                    dense_bias=False, fused_qkv=False, gelu_exact=False,
+                    lm_head_bias=True)
+    defaults.update(kw)
+    return ParallelBlockConfig(**defaults)

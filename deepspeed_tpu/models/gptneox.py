@@ -1,0 +1,31 @@
+"""GPT-NeoX family configs (reference v1 injection container
+``module_inject/containers/gptneox.py`` + replace policy). See
+models/parallel_block.py — NeoX is the parallel-residual block with its own
+MLP layernorm (``use_parallel_residual=True``), fused interleaved QKV
+(normalized to the concat layout at HF load, ``checkpoint/hf.py``), partial
+rotary (``rotary_pct``, default 0.25) and biases everywhere."""
+
+from deepspeed_tpu.models.parallel_block import (ParallelBlockConfig,
+                                                 ParallelBlockForCausalLM)
+
+GPTNeoXForCausalLM = ParallelBlockForCausalLM
+
+
+def gpt_neox_20b_config(**kw):
+    defaults = dict(vocab_size=50432, hidden_size=6144, intermediate_size=24576,
+                    num_hidden_layers=44, num_attention_heads=64,
+                    num_key_value_heads=64, max_position_embeddings=2048,
+                    rotary_pct=0.25, use_bias=True, fused_qkv=True,
+                    dual_layernorm=True, gelu_exact=True)
+    defaults.update(kw)
+    return ParallelBlockConfig(**defaults)
+
+
+def tiny_gptneox_config(**kw):
+    defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=4, max_position_embeddings=128,
+                    rotary_pct=0.25, use_bias=True, fused_qkv=True,
+                    dual_layernorm=True, gelu_exact=True)
+    defaults.update(kw)
+    return ParallelBlockConfig(**defaults)

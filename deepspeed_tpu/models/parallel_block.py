@@ -40,14 +40,22 @@ class ParallelBlockConfig:
     max_position_embeddings: int = 2048
     layer_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
-    rotary_pct: float = 1.0               # phi: partial rotary fraction
-    use_bias: bool = False                # phi: True
-    fused_qkv: bool = True                # falcon layout; phi: False
-    gelu_exact: bool = True               # falcon: erf GELU; phi gelu_new: tanh
-    lm_head_bias: bool = False            # phi: True (falcon: never)
+    rotary_pct: float = 1.0               # phi/neox/gptj: partial rotary fraction
+    use_bias: bool = False                # phi/neox: True
+    qkv_bias: Any = None                  # gptj: False while mlp has biases
+    dense_bias: Any = None                # (None -> use_bias)
+    mlp_bias: Any = None
+    fused_qkv: bool = True                # falcon/neox layout; phi/gptj: False
+    dual_layernorm: bool = False          # neox: mlp reads its own LN of x
+    gelu_exact: bool = True               # falcon/neox: erf; phi/gptj tanh
+    lm_head_bias: bool = False            # phi/gptj: True (falcon: never)
     tie_lm_head: bool = False
     remat: bool = True
     dtype: Any = jnp.bfloat16
+
+    def _bias(self, which):
+        v = getattr(self, which)
+        return self.use_bias if v is None else bool(v)
 
     @property
     def head_dim(self):
@@ -92,18 +100,23 @@ class ParallelBlock(nn.Module):
         B, T, D = x.shape
         H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         h = _LN(cfg.layer_norm_eps, cfg.dtype, name="input_layernorm")(x)
+        # neox-style dual LN: the MLP branch normalizes x independently
+        hm = _LN(cfg.layer_norm_eps, cfg.dtype,
+                 name="post_attention_layernorm")(x) \
+            if cfg.dual_layernorm else h
 
-        dense = lambda feats, name: nn.Dense(feats, use_bias=cfg.use_bias,
-                                             dtype=cfg.dtype, name=name)
+        dense = lambda feats, name, bias: nn.Dense(feats, use_bias=bias,
+                                                   dtype=cfg.dtype, name=name)
+        qb = cfg._bias("qkv_bias")
         if cfg.fused_qkv:
-            qkv = dense((H + 2 * KV) * Dh, "query_key_value")(h)
+            qkv = dense((H + 2 * KV) * Dh, "query_key_value", qb)(h)
             q = qkv[..., : H * Dh].reshape(B, T, H, Dh)
             k = qkv[..., H * Dh: (H + KV) * Dh].reshape(B, T, KV, Dh)
             v = qkv[..., (H + KV) * Dh:].reshape(B, T, KV, Dh)
         else:
-            q = dense(H * Dh, "q_proj")(h).reshape(B, T, H, Dh)
-            k = dense(KV * Dh, "k_proj")(h).reshape(B, T, KV, Dh)
-            v = dense(KV * Dh, "v_proj")(h).reshape(B, T, KV, Dh)
+            q = dense(H * Dh, "q_proj", qb)(h).reshape(B, T, H, Dh)
+            k = dense(KV * Dh, "k_proj", qb)(h).reshape(B, T, KV, Dh)
+            v = dense(KV * Dh, "v_proj", qb)(h).reshape(B, T, KV, Dh)
         q = partial_rotary(q, positions, cfg.rope_theta, cfg.rotary_dim)
         k = partial_rotary(k, positions, cfg.rope_theta, cfg.rotary_dim)
 
@@ -129,11 +142,12 @@ class ParallelBlock(nn.Module):
             attn = jnp.einsum("bkrts,bskd->btkrd", probs, cv.value).reshape(B, T, H * Dh)
         else:
             attn = mha(q, k, v, causal=True).reshape(B, T, H * Dh)
-        attn_out = dense(D, "dense")(attn)
+        attn_out = dense(D, "dense", cfg._bias("dense_bias"))(attn)
 
-        act = nn.gelu(dense(cfg.intermediate_size, "fc1")(h),
+        mb = cfg._bias("mlp_bias")
+        act = nn.gelu(dense(cfg.intermediate_size, "fc1", mb)(hm),
                       approximate=not cfg.gelu_exact)
-        mlp = dense(cfg.hidden_size, "fc2")(act)
+        mlp = dense(cfg.hidden_size, "fc2", mb)(act)
         return x + attn_out + mlp
 
 

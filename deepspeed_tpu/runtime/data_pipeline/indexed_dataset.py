@@ -99,11 +99,17 @@ class MMapIndexedDataset:
             self.sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
             self._pointers = np.frombuffer(f.read(8 * count), dtype=np.int64)
         # np.memmap refuses 0-byte files; an analyzer shard that received no
-        # samples is a valid (empty) dataset
-        if self.sizes.size == 0 or \
-                os.path.getsize(data_file_path(path_prefix)) == 0:
+        # samples is a valid (empty) dataset — but a 0-byte .bin whose index
+        # claims tokens is a truncated copy, not an empty corpus
+        if int(self.sizes.sum()) == 0:
             self._data = np.empty((0,), dtype=self.dtype)
         else:
+            nbytes = os.path.getsize(data_file_path(path_prefix))
+            want = int(self.sizes.sum()) * self.dtype.itemsize
+            if nbytes < want:
+                raise ValueError(
+                    f"{data_file_path(path_prefix)}: {nbytes} bytes but the "
+                    f"index expects {want} — truncated/corrupt data file")
             self._data = np.memmap(data_file_path(path_prefix),
                                    dtype=self.dtype, mode="r")
 

@@ -99,13 +99,53 @@ def smoke_block_sparse():
     check("sparse_mha fwd", out, ref, atol=0.05)
 
 
+SMOKES = {"flash": smoke_flash, "paged": smoke_paged,
+          "block_sparse": smoke_block_sparse}
+
+
 def main():
-    print("devices:", jax.devices(), flush=True)
-    smoke_flash()
-    smoke_paged()
-    smoke_block_sparse()
-    if FAILED:
-        print("FAILED:", FAILED, flush=True)
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", choices=sorted(SMOKES),
+                    help="run a single kernel smoke in-process")
+    ap.add_argument("--timeout", type=float, default=420,
+                    help="per-kernel subprocess deadline (seconds)")
+    args = ap.parse_args()
+
+    if args.only:
+        print("devices:", jax.devices(), flush=True)
+        SMOKES[args.only]()
+        sys.exit(1 if FAILED else 0)
+
+    # parent mode: one subprocess per kernel so a hang (e.g. a Mosaic compile
+    # that never returns) identifies the kernel and doesn't take out the
+    # whole run; output is unbuffered into per-kernel logs
+    import subprocess
+    failed = []
+    for name in SMOKES:
+        log = f"/tmp/tpu_smoke_{name}.log"
+        print(f"== {name} (log: {log})", flush=True)
+        with open(log, "w") as lf:
+            try:
+                rc = subprocess.run(
+                    [sys.executable, "-u", os.path.abspath(__file__),
+                     "--only", name],
+                    stdout=lf, stderr=subprocess.STDOUT,
+                    timeout=args.timeout).returncode
+            except subprocess.TimeoutExpired:
+                rc = -1
+                lf.write(f"\nTIMEOUT after {args.timeout}s\n")
+        print(open(log).read(), end="", flush=True)
+        if rc != 0:
+            failed.append(name)
+            print(f"== {name}: {'TIMEOUT/hang' if rc == -1 else 'FAILED'} — "
+                  f"skipping remaining output", flush=True)
+            if rc == -1:
+                # a killed TPU process can wedge the chip; don't pile on
+                print("== stopping: chip may be held after the hang", flush=True)
+                break
+    if failed:
+        print("FAILED:", failed, flush=True)
         sys.exit(1)
     print("all kernels lower and match on TPU", flush=True)
 

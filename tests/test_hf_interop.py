@@ -358,3 +358,68 @@ def test_falcon_sequential_residual_rejected(tmp_path):
     d = save_hf(m, cfg, tmp_path)
     with pytest.raises(ValueError, match="parallel_attn"):
         hf_interop.load_pretrained(d)
+
+
+def test_bloom_logits(tmp_path):
+    """BLOOM: ALiBi bias + interleaved fused QKV + embedding layernorm —
+    logits parity vs transformers (v1-injection family in the reference)."""
+    cfg = transformers.BloomConfig(vocab_size=128, hidden_size=32, n_layer=2,
+                                   n_head=4)
+    torch.manual_seed(11)
+    hf_model = transformers.BloomForCausalLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    fcfg = type(model.config)(**{**model.config.__dict__, "dtype": jnp.float32,
+                                 "remat": False})
+    ids = np.random.default_rng(11).integers(0, 128, size=(2, 9)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
+
+
+def test_bloom_export_roundtrip(tmp_path):
+    """flax -> HF safetensors -> transformers loads it and logits agree."""
+    from deepspeed_tpu.models.bloom import BloomConfig, BloomForCausalLM
+    cfg = BloomConfig.tiny(dtype=jnp.float32, remat=False)
+    model = BloomForCausalLM(cfg)
+    ids = np.random.default_rng(12).integers(0, cfg.vocab_size,
+                                             size=(1, 8)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(12), {"input_ids": ids})["params"]
+    out_dir = str(tmp_path / "export")
+    hf_interop.export_pretrained(params, cfg, out_dir)
+    hf_model = transformers.AutoModelForCausalLM.from_pretrained(out_dir).eval()
+    assert_logits_close(our_logits(model, params, ids), hf_logits(hf_model, ids))
+
+
+def test_gptneox_logits(tmp_path):
+    """GPT-NeoX: dual-LN parallel residual, fused interleaved QKV, partial
+    half-split rotary permuted to our convention."""
+    cfg = transformers.GPTNeoXConfig(vocab_size=128, hidden_size=32,
+                                     num_hidden_layers=2,
+                                     num_attention_heads=4,
+                                     intermediate_size=64, rotary_pct=0.25,
+                                     max_position_embeddings=64)
+    torch.manual_seed(13)
+    hf_model = transformers.GPTNeoXForCausalLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    fcfg = type(model.config)(**{**model.config.__dict__, "dtype": jnp.float32,
+                                 "remat": False})
+    ids = np.random.default_rng(13).integers(0, 128, size=(2, 9)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
+
+
+def test_gptj_logits(tmp_path):
+    """GPT-J: shared-LN parallel residual, un-biased attn + biased MLP,
+    interleaved partial rotary (our native convention — no permutation)."""
+    cfg = transformers.GPTJConfig(vocab_size=128, n_embd=32, n_layer=2,
+                                  n_head=4, rotary_dim=4, n_positions=64)
+    torch.manual_seed(14)
+    hf_model = transformers.GPTJForCausalLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    fcfg = type(model.config)(**{**model.config.__dict__, "dtype": jnp.float32,
+                                 "remat": False})
+    ids = np.random.default_rng(14).integers(0, 128, size=(2, 9)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
