@@ -454,6 +454,14 @@ def _falcon_split_qkv(fused, H, KV, Dh, interleaved):
     return q, k, v
 
 
+def _fuse_qkv_interleaved(q, k, v, H, Dh):
+    """Inverse of ``_falcon_split_qkv(..., interleaved=True)``: our q|k|v
+    concat (last axis) -> per-head [H, 3, Dh] wire layout. Works for kernels
+    ([in, H*Dh] each) and biases ([H*Dh] each)."""
+    shaped = [a.reshape(a.shape[:-1] + (H, Dh)) for a in (q, k, v)]
+    return np.stack(shaped, axis=-2).reshape(q.shape[:-1] + (3 * H * Dh,))
+
+
 def falcon_to_flax(sd, cfg, dtype=np.float32):
     """HF Falcon (7b lineage: parallel_attn, rotary) -> tree. Handles both
     multi_query (block QKV) and per-head-interleaved layouts, with or
@@ -568,8 +576,12 @@ def gptneox_to_flax(sd, cfg, dtype=np.float32):
         return np.concatenate([q, k, v], axis=-1)
 
     tree = {"embed_tokens": g("embed_in.weight"),
-            "final_layernorm": ln("final_layer_norm"),
-            "lm_head": sd["embed_out.weight"].astype(dtype)}
+            "final_layernorm": ln("final_layer_norm")}
+    if not cfg.tie_lm_head:
+        # tied checkpoints drop embed_out from safetensors entirely
+        tree["lm_head"] = (sd["embed_out.weight"].astype(dtype)
+                           if "embed_out.weight" in sd
+                           else tree["embed_tokens"])
     for i in range(cfg.num_hidden_layers):
         p = f"layers.{i}."
         tree[f"layers_{i}"] = {
@@ -618,6 +630,185 @@ def gptj_to_flax(sd, cfg, dtype=np.float32):
             "fc2": lin(p + "mlp.fc_out"),
         }
     return tree
+
+
+def _parallel_block_family(cfg):
+    """Which HF family a ParallelBlockConfig describes — derivable from the
+    architectural flags (used by export: the config carries no family tag)."""
+    if cfg.dual_layernorm:
+        return "gpt_neox"
+    if cfg.fused_qkv:
+        return "falcon"
+    if not cfg._bias("qkv_bias") and cfg._bias("mlp_bias"):
+        return "gptj"
+    return "phi"
+
+
+def parallel_block_from_flax(params, cfg, dtype=np.float32):
+    """Inverse converters for the parallel-residual families
+    (falcon/phi/gpt_neox/gptj). Returns (state_dict, hf_config_dict)."""
+    import jax
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+    H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    fam = _parallel_block_family(cfg)
+    rd = cfg.rotary_dim
+
+    def unperm(mat, heads, rdim):
+        return _permute_qk_out(mat, heads, Dh, inverse=True, rotary_dim=rdim)
+
+    def fuse_interleaved(q, k, v):
+        return _fuse_qkv_interleaved(q, k, v, H, Dh)
+
+    sd = {}
+
+    def put_lin(name, leaf, transpose=True):
+        sd[name + ".weight"] = leaf["kernel"].T if transpose else leaf["kernel"]
+        if "bias" in leaf:
+            sd[name + ".bias"] = leaf["bias"]
+
+    for i in range(cfg.num_hidden_layers):
+        l = params[f"layers_{i}"]
+        if fam == "gpt_neox":
+            p = f"gpt_neox.layers.{i}."
+            for ours, theirs in (("input_layernorm", "input_layernorm"),
+                                 ("post_attention_layernorm",
+                                  "post_attention_layernorm")):
+                sd[p + theirs + ".weight"] = l[ours]["scale"]
+                sd[p + theirs + ".bias"] = l[ours]["bias"]
+            qkv = l["query_key_value"]
+            q, k, v = np.split(qkv["kernel"], [H * Dh, 2 * H * Dh], axis=-1)
+            qb, kb, vb = np.split(qkv["bias"], [H * Dh, 2 * H * Dh], axis=-1)
+            w = fuse_interleaved(unperm(q, H, rd), unperm(k, H, rd), v)
+            b = fuse_interleaved(unperm(qb, H, rd), unperm(kb, H, rd), vb)
+            sd[p + "attention.query_key_value.weight"] = w.T
+            sd[p + "attention.query_key_value.bias"] = b
+            put_lin(p + "attention.dense", l["dense"])
+            put_lin(p + "mlp.dense_h_to_4h", l["fc1"])
+            put_lin(p + "mlp.dense_4h_to_h", l["fc2"])
+        elif fam == "falcon":
+            p = f"transformer.h.{i}."
+            sd[p + "input_layernorm.weight"] = l["input_layernorm"]["scale"]
+            sd[p + "input_layernorm.bias"] = l["input_layernorm"]["bias"]
+            qkv = l["query_key_value"]
+
+            def falcon_wire(a):
+                # mirror the loader: multi_query (KV==1) is block concat,
+                # KV==H is per-head interleaved (transformers' _split_heads)
+                q, k, v = np.split(a, [H * Dh, (H + KV) * Dh], axis=-1)
+                q, k = unperm(q, H, rd), unperm(k, KV, rd)
+                if KV == H:
+                    return _fuse_qkv_interleaved(q, k, v, H, Dh)
+                return np.concatenate([q, k, v], axis=-1)
+
+            sd[p + "self_attention.query_key_value.weight"] = \
+                falcon_wire(qkv["kernel"]).T
+            if "bias" in qkv:
+                sd[p + "self_attention.query_key_value.bias"] = \
+                    falcon_wire(qkv["bias"])
+            put_lin(p + "self_attention.dense", l["dense"])
+            put_lin(p + "mlp.dense_h_to_4h", l["fc1"])
+            put_lin(p + "mlp.dense_4h_to_h", l["fc2"])
+        elif fam == "gptj":
+            p = f"transformer.h.{i}."
+            sd[p + "ln_1.weight"] = l["input_layernorm"]["scale"]
+            sd[p + "ln_1.bias"] = l["input_layernorm"]["bias"]
+            for ours, theirs in (("q_proj", "attn.q_proj"),
+                                 ("k_proj", "attn.k_proj"),
+                                 ("v_proj", "attn.v_proj"),
+                                 ("dense", "attn.out_proj"),
+                                 ("fc1", "mlp.fc_in"), ("fc2", "mlp.fc_out")):
+                put_lin(p + theirs, l[ours])     # native rotary: no unperm
+        else:  # phi
+            p = f"model.layers.{i}."
+            sd[p + "input_layernorm.weight"] = l["input_layernorm"]["scale"]
+            sd[p + "input_layernorm.bias"] = l["input_layernorm"]["bias"]
+            for ours, theirs, heads in (("q_proj", "self_attn.q_proj", H),
+                                        ("k_proj", "self_attn.k_proj", KV),
+                                        ("v_proj", "self_attn.v_proj", None),
+                                        ("dense", "self_attn.dense", None),
+                                        ("fc1", "mlp.fc1", None),
+                                        ("fc2", "mlp.fc2", None)):
+                leaf = dict(l[ours])
+                if heads is not None:
+                    leaf = {k2: unperm(v2, heads, rd)
+                            for k2, v2 in leaf.items()}
+                put_lin(p + theirs, leaf)
+
+    embed = params["embed_tokens"]
+    head = embed if cfg.tie_lm_head else params["lm_head"]
+    fl = params["final_layernorm"]
+    if fam == "gpt_neox":
+        sd["gpt_neox.embed_in.weight"] = embed
+        sd["gpt_neox.final_layer_norm.weight"] = fl["scale"]
+        sd["gpt_neox.final_layer_norm.bias"] = fl["bias"]
+        sd["embed_out.weight"] = head
+        hf = {"model_type": "gpt_neox", "architectures": ["GPTNeoXForCausalLM"],
+              "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+              "intermediate_size": cfg.intermediate_size,
+              "num_hidden_layers": cfg.num_hidden_layers,
+              "num_attention_heads": cfg.num_attention_heads,
+              "max_position_embeddings": cfg.max_position_embeddings,
+              "layer_norm_eps": cfg.layer_norm_eps,
+              "rotary_pct": cfg.rotary_pct,
+              "rotary_emb_base": cfg.rope_theta,
+              "use_parallel_residual": True,
+              "hidden_act": "gelu" if cfg.gelu_exact else "gelu_new",
+              "tie_word_embeddings": False}
+    elif fam == "falcon":
+        sd["transformer.word_embeddings.weight"] = embed
+        sd["transformer.ln_f.weight"] = fl["scale"]
+        sd["transformer.ln_f.bias"] = fl["bias"]
+        if not cfg.tie_lm_head:
+            sd["lm_head.weight"] = head
+        hf = {"model_type": "falcon", "architectures": ["FalconForCausalLM"],
+              "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+              "ffn_hidden_size": cfg.intermediate_size,
+              "num_hidden_layers": cfg.num_hidden_layers,
+              "num_attention_heads": cfg.num_attention_heads,
+              "num_kv_heads": cfg.num_key_value_heads,
+              "multi_query": cfg.num_key_value_heads == 1,
+              "parallel_attn": True, "bias": cfg.use_bias, "alibi": False,
+              "new_decoder_architecture": False,
+              "rope_theta": cfg.rope_theta,
+              "layer_norm_epsilon": cfg.layer_norm_eps,
+              "max_position_embeddings": cfg.max_position_embeddings,
+              "tie_word_embeddings": bool(cfg.tie_lm_head)}
+    elif fam == "gptj":
+        sd["transformer.wte.weight"] = embed
+        sd["transformer.ln_f.weight"] = fl["scale"]
+        sd["transformer.ln_f.bias"] = fl["bias"]
+        sd["lm_head.weight"] = head
+        if "lm_head_bias" in params:
+            sd["lm_head.bias"] = params["lm_head_bias"]
+        hf = {"model_type": "gptj", "architectures": ["GPTJForCausalLM"],
+              "vocab_size": cfg.vocab_size, "n_embd": cfg.hidden_size,
+              "n_inner": cfg.intermediate_size,
+              "n_layer": cfg.num_hidden_layers, "n_head": cfg.num_attention_heads,
+              "n_positions": cfg.max_position_embeddings,
+              "rotary_dim": cfg.rotary_dim,
+              "layer_norm_epsilon": cfg.layer_norm_eps,
+              "activation_function": "gelu_new",
+              "tie_word_embeddings": False}
+    else:  # phi
+        sd["model.embed_tokens.weight"] = embed
+        sd["model.final_layernorm.weight"] = fl["scale"]
+        sd["model.final_layernorm.bias"] = fl["bias"]
+        sd["lm_head.weight"] = head
+        if "lm_head_bias" in params:
+            sd["lm_head.bias"] = params["lm_head_bias"]
+        hf = {"model_type": "phi", "architectures": ["PhiForCausalLM"],
+              "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+              "intermediate_size": cfg.intermediate_size,
+              "num_hidden_layers": cfg.num_hidden_layers,
+              "num_attention_heads": cfg.num_attention_heads,
+              "num_key_value_heads": cfg.num_key_value_heads,
+              "max_position_embeddings": cfg.max_position_embeddings,
+              "layer_norm_eps": cfg.layer_norm_eps,
+              "rope_theta": cfg.rope_theta,
+              "partial_rotary_factor": cfg.rotary_pct,
+              "hidden_act": "gelu" if cfg.gelu_exact else "gelu_new",
+              "tie_word_embeddings": False}
+    return sd, hf
 
 
 # ---------------------------------------------------------------------------
@@ -681,9 +872,7 @@ def bloom_from_flax(params, cfg, dtype=np.float32):
         """our q|k|v concat (out axis) -> HF per-head [H, 3, Dh] layout."""
         def to_hf(a):
             q, k, v = np.split(a, 3, axis=-1)
-            parts = np.stack([x.reshape(x.shape[:-1] + (H, Dh)) for x in (q, k, v)],
-                             axis=-2)                    # [..., H, 3, Dh]
-            return parts.reshape(a.shape)
+            return _fuse_qkv_interleaved(q, k, v, H, Dh)
         return to_hf(kernel), to_hf(bias)
 
     sd = {"word_embeddings.weight": params["word_embeddings"],
@@ -828,6 +1017,10 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
         return ParallelBlockForCausalLM(cfg), phi_to_flax(sd, cfg, dtype=dtype)
     if mt == "bloom":
         from deepspeed_tpu.models.bloom import BloomConfig, BloomForCausalLM
+        if getattr(hf_cfg, "apply_residual_connection_post_layernorm", False):
+            raise UnsupportedModelError(
+                "bloom apply_residual_connection_post_layernorm=True not "
+                "supported — the pre-LN-residual model cannot represent it")
         cfg = BloomConfig(vocab_size=hf_cfg.vocab_size,
                           hidden_size=hf_cfg.hidden_size,
                           num_hidden_layers=hf_cfg.n_layer,
@@ -948,6 +1141,8 @@ def export_pretrained(params, cfg, save_dir, dtype=np.float32):
               "n_head": cfg.num_attention_heads,
               "layer_norm_epsilon": cfg.layer_norm_epsilon,
               "tie_word_embeddings": True}
+    elif name == "ParallelBlockConfig":
+        sd, hf = parallel_block_from_flax(params, cfg, dtype=dtype)
     else:
         raise UnsupportedModelError(f"unsupported model config {name}")
 

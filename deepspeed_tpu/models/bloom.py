@@ -126,11 +126,13 @@ class BloomBlock(nn.Module):
 
 class ScanBloomBlock(nn.Module):
     config: BloomConfig
+    use_cache: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         x, deterministic = carry
-        x = BloomBlock(self.config, name="block")(x, deterministic)
+        x = BloomBlock(self.config, self.use_cache, name="block")(
+            x, deterministic)
         return (x, deterministic), None
 
 
@@ -156,16 +158,20 @@ class BloomForCausalLM(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          name="word_embeddings_layernorm")(x)
 
-        if cfg.scan_layers and not use_cache:
+        if cfg.scan_layers:
+            # scan stays active under use_cache (cache vars get a layer axis)
+            # so scan-layout params serve decode without conversion — same
+            # approach as models/llama.py
             block = ScanBloomBlock
-            if cfg.remat:
+            if cfg.remat and not use_cache:
                 block = nn.remat(ScanBloomBlock, prevent_cse=False,
                                  policy=remat_policy())
-            Scanned = nn.scan(block, variable_axes={"params": 0},
+            Scanned = nn.scan(block, variable_axes={"params": 0, "cache": 0},
                               split_rngs={"params": True, "dropout": True},
                               length=cfg.num_hidden_layers,
                               metadata_params={nn.meta.PARTITION_NAME: "layers"})
-            (x, _), _ = Scanned(cfg, name="h")((x, deterministic), None)
+            (x, _), _ = Scanned(cfg, use_cache, name="h")((x, deterministic),
+                                                          None)
         else:
             block_cls = nn.remat(BloomBlock, prevent_cse=False,
                                  policy=remat_policy()) \

@@ -138,11 +138,12 @@ def main():
         print(open(log).read(), end="", flush=True)
         if rc != 0:
             failed.append(name)
-            print(f"== {name}: {'TIMEOUT/hang' if rc == -1 else 'FAILED'} — "
-                  f"skipping remaining output", flush=True)
+            print(f"== {name}: {'TIMEOUT/hang' if rc == -1 else 'FAILED'}",
+                  flush=True)
             if rc == -1:
                 # a killed TPU process can wedge the chip; don't pile on
-                print("== stopping: chip may be held after the hang", flush=True)
+                print("== stopping: chip may be held after the hang — "
+                      "remaining kernels skipped", flush=True)
                 break
     if failed:
         print("FAILED:", failed, flush=True)

@@ -423,3 +423,35 @@ def test_gptj_logits(tmp_path):
     ids = np.random.default_rng(14).integers(0, 128, size=(2, 9)).astype(np.int32)
     assert_logits_close(our_logits(type(model)(fcfg), params, ids),
                         hf_logits(hf_model, ids))
+
+
+@pytest.mark.parametrize("family,make_cfg", [
+    ("falcon", lambda: __import__("deepspeed_tpu.models.falcon",
+                                  fromlist=["tiny_falcon_config"]
+                                  ).tiny_falcon_config(remat=False)),
+    ("phi", lambda: __import__("deepspeed_tpu.models.phi",
+                               fromlist=["tiny_phi_config"]
+                               ).tiny_phi_config(remat=False)),
+    ("gpt_neox", lambda: __import__("deepspeed_tpu.models.gptneox",
+                                    fromlist=["tiny_gptneox_config"]
+                                    ).tiny_gptneox_config(remat=False)),
+    ("gptj", lambda: __import__("deepspeed_tpu.models.gptj",
+                                fromlist=["tiny_gptj_config"]
+                                ).tiny_gptj_config(remat=False)),
+])
+def test_parallel_block_export_roundtrip(tmp_path, family, make_cfg):
+    """flax -> HF safetensors for every parallel-residual family; transformers
+    loads the export and the logits agree (the 'both directions' guarantee)."""
+    from deepspeed_tpu.models.parallel_block import ParallelBlockForCausalLM
+    cfg = type(make_cfg())(**{**make_cfg().__dict__, "dtype": jnp.float32})
+    model = ParallelBlockForCausalLM(cfg)
+    ids = np.random.default_rng(21).integers(0, cfg.vocab_size,
+                                             size=(1, 8)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(21), {"input_ids": ids})["params"]
+    out_dir = str(tmp_path / family)
+    hf_interop.export_pretrained(params, cfg, out_dir)
+    import json as _json
+    with open(out_dir + "/config.json") as f:
+        assert _json.load(f)["model_type"] == family
+    hf_model = transformers.AutoModelForCausalLM.from_pretrained(out_dir).eval()
+    assert_logits_close(our_logits(model, params, ids), hf_logits(hf_model, ids))

@@ -139,3 +139,26 @@ def test_bloom_tp_specs():
     row = [s for k, s in by_name.items()
            if "dense_4h_to_h" in k and "kernel" in k][0]
     assert "tp" in tuple(row)[:-1]
+
+
+def test_bloom_kv_cache_decode_matches_full_forward():
+    """BLOOM greedy decode over the KV cache (scan-layout params, the
+    load_pretrained default) agrees with full-recompute argmax."""
+    from deepspeed_tpu.inference import generate
+    from deepspeed_tpu.models.bloom import BloomConfig, BloomForCausalLM
+    cfg = BloomConfig.tiny(dtype=jnp.float32, remat=False, scan_layers=True,
+                           max_position_embeddings=64)
+    model = BloomForCausalLM(cfg)
+    ids = np.random.default_rng(7).integers(0, cfg.vocab_size,
+                                            size=(1, 6)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(7), {"input_ids": ids})["params"]
+    out = np.asarray(generate(model, params, ids, max_new_tokens=4,
+                              temperature=0.0))
+    cur = ids
+    want = []
+    for _ in range(4):
+        logits = model.apply({"params": params}, {"input_ids": jnp.asarray(cur)})
+        tok = int(np.argmax(np.asarray(logits[0, -1], np.float32)))
+        want.append(tok)
+        cur = np.concatenate([cur, [[tok]]], axis=1)
+    np.testing.assert_array_equal(out[0], want)
