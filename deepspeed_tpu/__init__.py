@@ -37,6 +37,7 @@ def initialize(args=None,
                lr_scheduler=None,
                distributed_port=29500,
                mesh=None,
+               mpu=None,
                dist_init_required=None,
                collate_fn=None,
                config=None,
@@ -65,6 +66,12 @@ def initialize(args=None,
         lr_scheduler: optional schedule name/callable overriding config.
         mesh: optional ``jax.sharding.Mesh``; by default one is built from the
             config's parallel sizes over all visible devices.
+        mpu: optional model-parallel-unit object (reference
+            ``deepspeed/__init__.py:69`` Megatron interop): its
+            ``get_model_parallel_world_size()`` seeds the mesh's ``tp`` axis
+            when the config carries no ``tensor_parallel`` section. On TPU
+            the mesh IS the process-group topology, so only the size is
+            consumed — group handles are compiler-managed.
         config: dict or path to a DeepSpeed-style JSON config.
         config_params: legacy alias for ``config`` (reference
             ``deepspeed/__init__.py:125``).
@@ -79,6 +86,17 @@ def initialize(args=None,
         config = config_params
     if config is None and args is not None and hasattr(args, "deepspeed_config"):
         config = args.deepspeed_config
+
+    if mpu is not None and not isinstance(config, DeepSpeedConfig):
+        import copy
+        import json as _json
+        if isinstance(config, str):     # JSON config file path
+            with open(config) as f:
+                config = _json.load(f)
+        # deep-copy: never mutate the caller's (possibly reused) dict
+        config = copy.deepcopy(config or {})
+        tp = int(mpu.get_model_parallel_world_size())
+        config.setdefault("tensor_parallel", {}).setdefault("tp_size", tp)
 
     # engine selection (reference deepspeed/__init__.py:166-206): hybrid
     # engine for RLHF configs, else the standard engine (PipelineEngine is

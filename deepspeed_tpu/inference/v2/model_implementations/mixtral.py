@@ -24,7 +24,7 @@ from deepspeed_tpu.inference.v2.model_implementations.llama import (
     _paged_attention, _rmsnorm, _scatter_kv)
 
 
-def _moe_ffn(x, gate_wg, w1, w2, w3, *, k, dtype):
+def _moe_ffn(x, gate_wg, w1, w2, w3, *, k, dtype, force_einsum=False):
     """Grouped-expert FFN over a flat token batch.
 
     x: [T, D]; gate_wg: [D, E]; w1/w3: [E, D, F]; w2: [E, F, D].
@@ -38,6 +38,13 @@ def _moe_ffn(x, gate_wg, w1, w2, w3, *, k, dtype):
     T, D = x.shape
     E = gate_wg.shape[1]
     C = T
+
+    if not force_einsum:
+        from deepspeed_tpu.inference.v2.modules.heuristics import (
+            instantiate_moe)
+        impl, fn = instantiate_moe(D, w1.shape[-1])
+        if impl == "megablox":
+            return fn(x, gate_wg, w1, w2, w3, k=k, dtype=dtype)
 
     logits = (x @ gate_wg).astype(jnp.float32)          # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)

@@ -34,9 +34,22 @@ def instantiate_attention(q_shape, pool_shape):
     return "dense", None
 
 
-def instantiate_moe():
-    """-> name of the MoE dispatch implementation. The TPU grouped-GEMM
-    (dense dispatch-combine einsum over stacked expert weights — the
-    cutlass_multi_gemm analog) is used everywhere: XLA lowers the batched
-    einsum to grouped MXU GEMMs."""
-    return "grouped_gemm"
+def instantiate_moe(d_model=None, d_ff=None):
+    """-> ('megablox' | 'einsum', callable|None) for the expert-FFN dispatch.
+
+    'megablox': ragged grouped GEMM (ops/pallas/grouped_gemm.py) — tokens
+    sorted by expert, no capacity dimension (cutlass moe_gemm +
+    moe_scatter/gather analog). 'einsum': GShard dense dispatch-combine over
+    stacked expert weights (lossless capacity) — the oracle and CPU path.
+    """
+    import os
+    from deepspeed_tpu.ops.pallas import grouped_gemm as gg
+    killed = bool(os.environ.get("DS_TPU_DISABLE_PALLAS"))
+    if _on_tpu() and not killed and gg.is_supported(d_model, d_ff):
+        return "megablox", gg.moe_ffn_gmm
+    if _on_tpu() and not killed and d_model is not None \
+            and "moe" not in _warned:
+        _warned.add("moe")
+        logger.warning(f"moe: dims ({d_model}, {d_ff}) not gmm-tileable; "
+                       f"einsum dispatch fallback")
+    return "einsum", None

@@ -1,0 +1,72 @@
+"""Ragged grouped-GEMM MoE FFN over the Pallas ``megablox`` kernel.
+
+Capability analog of the reference's CUTLASS grouped expert GEMMs +
+moe_scatter/moe_gather (``inference/v2/kernels/cutlass_ops/moe_gemm``,
+``kernels/ragged_ops/{moe_scatter,moe_gather}``): tokens are sorted by
+assigned expert (moe_scatter), each expert's contiguous row-group hits the
+MXU through ``jax.experimental.pallas.ops.tpu.megablox.gmm`` — no capacity
+dimension, no [T, E, C] dispatch tensors — and the weighted results unsort
+back (moe_gather).
+
+vs the GShard einsum path (`inference/v2/model_implementations/mixtral.py`):
+that one is O(T^2 E) in dispatch memory/FLOPs at lossless capacity; this one
+is O(T k) rows regardless of routing skew. The einsum path remains the
+numerics oracle and CPU fallback.
+"""
+
+import jax
+import jax.numpy as jnp
+
+ROW_ALIGN = 128  # gmm's m-dimension tile
+
+
+def is_supported(d_model, d_ff):
+    # gmm tiles k/n at 128; ragged m is handled by padding below
+    return (d_model is not None and d_ff is not None
+            and d_model % ROW_ALIGN == 0 and d_ff % ROW_ALIGN == 0)
+
+
+def moe_ffn_gmm(x, gate_wg, w1, w2, w3, *, k, dtype, interpret=False):
+    """Mixtral-style top-k expert FFN: silu(x@w1) * (x@w3) @ w2 per expert.
+
+    x [T, D]; gate_wg [D, E]; w1/w3 [E, D, F]; w2 [E, F, D] -> [T, D].
+    """
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+    T, D = x.shape
+    E = gate_wg.shape[1]
+
+    logits = (x @ gate_wg).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, k)          # [T, k]
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+
+    # moe_scatter: stable sort of the T*k (token, expert) rows by expert
+    flat_e = top_idx.reshape(-1)                         # [T*k]
+    order = jnp.argsort(flat_e, stable=True)
+    token_of = jnp.arange(T * k, dtype=jnp.int32) // k
+    xs = jnp.take(x, token_of[order], axis=0)            # [T*k, D] grouped
+
+    rows = T * k
+    pad = (-rows) % ROW_ALIGN
+    group_sizes = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
+    if pad:
+        # pad rows ride in the LAST expert's group; outputs are dropped
+        xs = jnp.concatenate(
+            [xs, jnp.zeros((pad, D), xs.dtype)], axis=0)
+        group_sizes = group_sizes.at[E - 1].add(pad)
+
+    def grouped(lhs, rhs):
+        return gmm(lhs, rhs, group_sizes,
+                   preferred_element_type=jnp.float32,
+                   interpret=interpret).astype(dtype)
+
+    h = jax.nn.silu(grouped(xs, w1)) * grouped(xs, w3)   # [rows+pad, F]
+    y = grouped(h, w2)                                   # [rows+pad, D]
+    y = y[:rows]
+
+    # moe_gather: unsort, weight by gate, combine the k slots
+    inv = jnp.argsort(order, stable=True)
+    y = jnp.take(y, inv, axis=0).reshape(T, k, D)
+    return jnp.sum(y.astype(jnp.float32) * top_vals[..., None],
+                   axis=1).astype(dtype)

@@ -1,0 +1,97 @@
+"""Long-context training bench: Llama-class model at 4k/8k sequence length.
+
+The flash kernel's headline regime — the XLA einsum path materializes
+[B, H, T, T] logits (4 GB per layer-pass at 8k) while flash streams blocks.
+Prints one JSON line per (seq, impl) leg. One TPU job at a time.
+
+    python scripts/bench_long_context.py [--seqs 4096,8192] [--layers 8]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="4096,8192")
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--xla_too", action="store_true",
+                    help="also time the pure-XLA attention path")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                            llama_flops_per_token)
+    from deepspeed_tpu.parallel import groups
+
+    print("devices:", jax.devices(), file=sys.stderr, flush=True)
+
+    def run(seq, disable_pallas):
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=args.hidden,
+            intermediate_size=args.hidden * 4 // 2 * 2,
+            num_hidden_layers=args.layers, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=seq,
+            scan_layers=True, remat=True)
+        model = LlamaForCausalLM(cfg)
+        batch = 1
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+        data = {"input_ids": ids, "labels": ids}
+        if disable_pallas:
+            os.environ["DS_TPU_DISABLE_PALLAS"] = "1"
+        else:
+            os.environ.pop("DS_TPU_DISABLE_PALLAS", None)
+        groups.reset()
+        params = model.init(jax.random.PRNGKey(0), data)["params"]
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": batch,
+                    "gradient_accumulation_steps": 1,
+                    "bf16": {"enabled": True},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                    "zero_optimization": {"stage": 1},
+                    "activation_checkpointing": {"policy": "dots"}})
+
+        def step():
+            loss = engine(data)
+            engine.backward(loss)
+            engine.step()
+            return loss
+
+        jax.block_until_ready(step())
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            loss = step()
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / args.steps
+        toks = batch * seq / dt
+        fpt = llama_flops_per_token(cfg, seq)
+        kind = jax.devices()[0].device_kind
+        peak = 197e12 if "lite" in kind else 459e12
+        print(json.dumps({
+            "metric": f"llama_{args.hidden}h{args.layers}L_seq{seq}"
+                      f"_{'xla' if disable_pallas else 'flash'}",
+            "value": round(toks, 1), "unit": "tokens/s/chip",
+            "vs_baseline": round(toks * fpt / peak / 0.45, 4),
+            "extra": {"ms_per_step": round(dt * 1000, 1),
+                      "mfu": round(toks * fpt / peak, 4)}}), flush=True)
+
+    for seq in [int(s) for s in args.seqs.split(",")]:
+        run(seq, disable_pallas=False)
+        if args.xla_too:
+            run(seq, disable_pallas=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,8 +99,28 @@ def smoke_block_sparse():
     check("sparse_mha fwd", out, ref, atol=0.05)
 
 
+def smoke_grouped_gemm():
+    from deepspeed_tpu.inference.v2.model_implementations.mixtral import (
+        _moe_ffn)
+    from deepspeed_tpu.ops.pallas.grouped_gemm import moe_ffn_gmm
+
+    ks = jax.random.split(jax.random.PRNGKey(4), 5)
+    T, D, F, E, k = 40, 128, 256, 4, 2
+    x = jax.random.normal(ks[0], (T, D), jnp.bfloat16)
+    gate = jax.random.normal(ks[1], (D, E), jnp.float32) * 0.3
+    w1 = jax.random.normal(ks[2], (E, D, F), jnp.bfloat16) * 0.05
+    w2 = jax.random.normal(ks[3], (E, F, D), jnp.bfloat16) * 0.05
+    w3 = jax.random.normal(ks[4], (E, D, F), jnp.bfloat16) * 0.05
+    out = jax.jit(lambda *a: moe_ffn_gmm(*a, k=k, dtype=jnp.bfloat16))(
+        x, gate, w1, w2, w3)
+    ref = _moe_ffn(x, gate, w1, w2, w3, k=k, dtype=jnp.bfloat16,
+                   force_einsum=True)
+    check("moe_ffn_gmm", out, ref, atol=0.05)
+
+
 SMOKES = {"flash": smoke_flash, "paged": smoke_paged,
-          "block_sparse": smoke_block_sparse}
+          "block_sparse": smoke_block_sparse,
+          "grouped_gemm": smoke_grouped_gemm}
 
 
 def main():

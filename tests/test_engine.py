@@ -342,3 +342,24 @@ def test_remat_policy_config_reaches_models():
         engine.step()
         losses.append(float(jax.device_get(loss)))
     assert losses[-1] < losses[0]
+
+
+def test_initialize_accepts_mpu():
+    """reference deepspeed.initialize(mpu=...) Megatron interop: the mpu's
+    model-parallel world size seeds the mesh's tp axis."""
+    from deepspeed_tpu.parallel import groups
+
+    class FakeMPU:
+        def get_model_parallel_world_size(self):
+            return 2
+
+    groups.reset()
+    model = SimpleModel(hidden_dim=16)
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, mpu=FakeMPU(),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    assert engine.topology.get_dim("tp") == 2
+    loss = engine(batch); engine.backward(loss); engine.step()
