@@ -39,17 +39,17 @@ def _moe_ffn(x, gate_wg, w1, w2, w3, *, k, dtype, force_einsum=False):
     E = gate_wg.shape[1]
     C = T
 
+    # single routing implementation for both dispatch backends
+    from deepspeed_tpu.ops.pallas.grouped_gemm import topk_router
+    top_vals, top_idx = topk_router(x, gate_wg, k)       # [T, k]
+
     if not force_einsum:
         from deepspeed_tpu.inference.v2.modules.heuristics import (
             instantiate_moe)
         impl, fn = instantiate_moe(D, w1.shape[-1])
         if impl == "megablox":
-            return fn(x, gate_wg, w1, w2, w3, k=k, dtype=dtype)
-
-    logits = (x @ gate_wg).astype(jnp.float32)          # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    top_vals, top_idx = jax.lax.top_k(probs, k)          # [T, k]
-    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+            return fn(x, top_vals, top_idx, w1, w2, w3, n_experts=E,
+                      dtype=dtype)
 
     # top_k_gating: position of each (token, slot) inside its expert's bucket
     onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)       # [T, k, E]

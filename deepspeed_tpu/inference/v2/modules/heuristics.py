@@ -42,14 +42,13 @@ def instantiate_moe(d_model=None, d_ff=None):
     moe_scatter/gather analog). 'einsum': GShard dense dispatch-combine over
     stacked expert weights (lossless capacity) — the oracle and CPU path.
     """
-    import os
     from deepspeed_tpu.ops.pallas import grouped_gemm as gg
-    killed = bool(os.environ.get("DS_TPU_DISABLE_PALLAS"))
-    if _on_tpu() and not killed and gg.is_supported(d_model, d_ff):
-        return "megablox", gg.moe_ffn_gmm
-    if _on_tpu() and not killed and d_model is not None \
-            and "moe" not in _warned:
-        _warned.add("moe")
-        logger.warning(f"moe: dims ({d_model}, {d_ff}) not gmm-tileable; "
-                       f"einsum dispatch fallback")
+    from deepspeed_tpu.ops.registry import pallas_enabled
+    if pallas_enabled():
+        if gg.is_supported(d_model, d_ff):
+            return "megablox", gg.moe_ffn_gmm
+        if d_model is not None and "moe" not in _warned:
+            _warned.add("moe")
+            logger.warning(f"moe: dims ({d_model}, {d_ff}) not gmm-tileable; "
+                           f"einsum dispatch fallback")
     return "einsum", None

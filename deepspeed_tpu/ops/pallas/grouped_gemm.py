@@ -26,20 +26,30 @@ def is_supported(d_model, d_ff):
             and d_model % ROW_ALIGN == 0 and d_ff % ROW_ALIGN == 0)
 
 
-def moe_ffn_gmm(x, gate_wg, w1, w2, w3, *, k, dtype, interpret=False):
-    """Mixtral-style top-k expert FFN: silu(x@w1) * (x@w3) @ w2 per expert.
+def topk_router(x, gate_wg, k):
+    """Mixtral top-k softmax router with renormalized gate weights.
 
-    x [T, D]; gate_wg [D, E]; w1/w3 [E, D, F]; w2 [E, F, D] -> [T, D].
+    THE routing implementation — both the megablox and the einsum dispatch
+    paths consume its (top_vals [T, k], top_idx [T, k]) so gating numerics
+    can never diverge between backends."""
+    logits = (x @ gate_wg).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, k)
+    return top_vals / jnp.sum(top_vals, axis=-1, keepdims=True), top_idx
+
+
+def moe_ffn_gmm(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
+                interpret=False):
+    """Mixtral-style expert FFN: silu(x@w1) * (x@w3) @ w2 per expert, routed
+    by precomputed (top_vals, top_idx) from :func:`topk_router`.
+
+    x [T, D]; w1/w3 [E, D, F]; w2 [E, F, D] -> [T, D].
     """
     from jax.experimental.pallas.ops.tpu.megablox import gmm
 
     T, D = x.shape
-    E = gate_wg.shape[1]
-
-    logits = (x @ gate_wg).astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    top_vals, top_idx = jax.lax.top_k(probs, k)          # [T, k]
-    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    E = n_experts
+    k = top_idx.shape[-1]
 
     # moe_scatter: stable sort of the T*k (token, expert) rows by expert
     flat_e = top_idx.reshape(-1)                         # [T*k]

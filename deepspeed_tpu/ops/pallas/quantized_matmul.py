@@ -1,0 +1,79 @@
+"""Fused dequantize-matmul Pallas kernel (W8A16-style).
+
+Capability analog of the reference's quantized GEMMs
+(``inference/v2/kernels/core_ops/cuda_linear`` FP6 GEMM and
+``cutlass_ops/mixed_gemm`` W4/W8A16): the XLA path dequantizes the whole
+weight to bf16 in HBM before the matmul, doubling weight traffic; this
+kernel DMAs the int8 blocks and their group scales into VMEM and
+dequantizes right before the MXU dot — HBM reads stay int8-sized.
+
+Layout matches ``inference/quantization``'s ``quantize_lastdim``: weight
+q [K, N] int8 with per-(row, N-group) scales [K, N // group_size] f32.
+Activations x [M, K] (bf16/f32). Grid (M/bm, N/bn, K/bk), k innermost with
+an f32 VMEM accumulator.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BM, BN, BK = 256, 256, 512
+
+
+def is_supported(m, k, n, group_size, num_bits):
+    """Shapes the kernel tiles cleanly; callers fall back to XLA dequant."""
+    return (num_bits == 8 and m % 8 == 0 and (m <= BM or m % BM == 0)
+            and k % BK == 0 and n % BN == 0
+            and BN % group_size == 0 and group_size <= BN)
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc, *, nk, bn, group_size):
+    kstep = pl.program_id(2)
+
+    @pl.when(kstep == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    x = x_ref[...]                                    # [bm, bk]
+    w8 = q_ref[...].astype(jnp.float32)               # [bk, bn]
+    j = pl.program_id(1)
+    g0 = j * (bn // group_size)
+    s = s_ref[:, pl.ds(g0, bn // group_size)]         # [bk, bn/G]
+    w = (w8.reshape(w8.shape[0], bn // group_size, group_size)
+         * s[:, :, None]).reshape(w8.shape[0], bn).astype(x.dtype)
+    acc[...] += jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    @pl.when(kstep == nk - 1)
+    def _done():
+        o_ref[...] = acc[...].astype(o_ref.dtype)
+
+
+def quantized_matmul(x, q, scale, group_size, out_dtype=None,
+                     interpret=False):
+    """x [M, K] @ dequant(q [K, N] int8, scale [K, N//G]) -> [M, N]."""
+    M, K = x.shape
+    _, N = q.shape
+    out_dtype = out_dtype or x.dtype
+    bm = min(BM, M)
+    nm, nn, nk = M // bm, N // BN, K // BK
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=nk, bn=BN, group_size=group_size),
+        grid=(nm, nn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, BK), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((BK, BN), lambda i, j, kk: (kk, j)),
+            # full scale rows for this k-block: [bk, N//G] is narrow (N/G
+            # lanes) — the n-slice happens in-kernel
+            pl.BlockSpec((BK, N // group_size), lambda i, j, kk: (kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, BN), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, BN), jnp.float32)],
+        interpret=interpret,
+    )(x, q, scale.astype(jnp.float32))
+    return out

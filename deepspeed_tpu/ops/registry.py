@@ -67,6 +67,20 @@ class OpBuilder:
         return self._loaded
 
 
+def pallas_enabled():
+    """True when Pallas fast paths may be used: a TPU backend is live and the
+    DS_TPU_DISABLE_PALLAS kill-switch is off. THE shared gate — heuristics
+    and op wrappers must not re-implement platform probing."""
+    import os
+    if os.environ.get("DS_TPU_DISABLE_PALLAS"):
+        return False
+    try:
+        import jax
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 def register_op_builder(cls):
     assert cls.NAME is not None
     _REGISTRY[cls.NAME] = cls

@@ -78,7 +78,8 @@ def main():
         toks = batch * seq / dt
         fpt = llama_flops_per_token(cfg, seq)
         kind = jax.devices()[0].device_kind
-        peak = 197e12 if "lite" in kind else 459e12
+        from bench import peak_flops   # repo-root bench.py: one peak table
+        peak = peak_flops(kind)
         print(json.dumps({
             "metric": f"llama_{args.hidden}h{args.layers}L_seq{seq}"
                       f"_{'xla' if disable_pallas else 'flash'}",

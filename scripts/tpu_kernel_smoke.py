@@ -102,7 +102,7 @@ def smoke_block_sparse():
 def smoke_grouped_gemm():
     from deepspeed_tpu.inference.v2.model_implementations.mixtral import (
         _moe_ffn)
-    from deepspeed_tpu.ops.pallas.grouped_gemm import moe_ffn_gmm
+    from deepspeed_tpu.ops.pallas.grouped_gemm import moe_ffn_gmm, topk_router
 
     ks = jax.random.split(jax.random.PRNGKey(4), 5)
     T, D, F, E, k = 40, 128, 256, 4, 2
@@ -111,16 +111,33 @@ def smoke_grouped_gemm():
     w1 = jax.random.normal(ks[2], (E, D, F), jnp.bfloat16) * 0.05
     w2 = jax.random.normal(ks[3], (E, F, D), jnp.bfloat16) * 0.05
     w3 = jax.random.normal(ks[4], (E, D, F), jnp.bfloat16) * 0.05
-    out = jax.jit(lambda *a: moe_ffn_gmm(*a, k=k, dtype=jnp.bfloat16))(
-        x, gate, w1, w2, w3)
+    tv, ti = topk_router(x, gate, k)
+    out = jax.jit(lambda *a: moe_ffn_gmm(*a, n_experts=E, dtype=jnp.bfloat16))(
+        x, tv, ti, w1, w2, w3)
     ref = _moe_ffn(x, gate, w1, w2, w3, k=k, dtype=jnp.bfloat16,
                    force_einsum=True)
     check("moe_ffn_gmm", out, ref, atol=0.05)
 
 
+def smoke_quantized_matmul():
+    from deepspeed_tpu.inference.quantization.quantization import (
+        QuantizedParameter)
+    from deepspeed_tpu.ops.pallas.quantized_matmul import quantized_matmul
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 2)
+    x = jax.random.normal(ks[0], (16, 512), jnp.bfloat16)
+    w = np.asarray(jax.random.normal(ks[1], (512, 256), jnp.float32)) * 0.1
+    qp = QuantizedParameter.from_array(w, num_bits=8, group_size=128)
+    out = jax.jit(lambda a, q, s: quantized_matmul(a, q, s, 128))(
+        x, qp.q, qp.scale)
+    ref = x @ qp.dequantized(jnp.bfloat16)
+    check("quantized_matmul", out, ref, atol=0.1)
+
+
 SMOKES = {"flash": smoke_flash, "paged": smoke_paged,
           "block_sparse": smoke_block_sparse,
-          "grouped_gemm": smoke_grouped_gemm}
+          "grouped_gemm": smoke_grouped_gemm,
+          "quantized_matmul": smoke_quantized_matmul}
 
 
 def main():

@@ -10,7 +10,8 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.v2.model_implementations.mixtral import _moe_ffn
-from deepspeed_tpu.ops.pallas.grouped_gemm import is_supported, moe_ffn_gmm
+from deepspeed_tpu.ops.pallas.grouped_gemm import (is_supported, moe_ffn_gmm,
+                                                   topk_router)
 
 
 def make_case(T=16, D=128, F=256, E=4, k=2, seed=0):
@@ -26,8 +27,9 @@ def make_case(T=16, D=128, F=256, E=4, k=2, seed=0):
 @pytest.mark.parametrize("T", [16, 40])
 def test_matches_einsum_oracle(T):
     x, gate, w1, w2, w3, k = make_case(T=T)
-    got = moe_ffn_gmm(x, gate, w1, w2, w3, k=k, dtype=jnp.float32,
-                      interpret=True)
+    tv, ti = topk_router(x, gate, k)
+    got = moe_ffn_gmm(x, tv, ti, w1, w2, w3, n_experts=gate.shape[1],
+                      dtype=jnp.float32, interpret=True)
     want = _moe_ffn(x, gate, w1, w2, w3, k=k, dtype=jnp.float32,
                     force_einsum=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -44,8 +46,9 @@ def test_skewed_routing():
     logits = (x @ gate).astype(jnp.float32)
     top_idx = jnp.argmax(logits, axis=-1)
     assert int((top_idx == 0).sum()) >= 22  # fixture sanity: real skew
-    got = moe_ffn_gmm(x, gate, w1, w2, w3, k=1, dtype=jnp.float32,
-                      interpret=True)
+    tv, ti = topk_router(x, gate, 1)
+    got = moe_ffn_gmm(x, tv, ti, w1, w2, w3, n_experts=gate.shape[1],
+                      dtype=jnp.float32, interpret=True)
     want = _moe_ffn(x, gate, w1, w2, w3, k=1, dtype=jnp.float32,
                     force_einsum=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),

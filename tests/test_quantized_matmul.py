@@ -1,0 +1,60 @@
+"""Fused dequant-matmul kernel vs XLA dequant + matmul (reference
+``tests/unit/ops/quantizer`` / cuda_linear analogs). Interpret mode; real-TPU
+lowering covered by scripts/tpu_kernel_smoke.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.quantization.quantization import (
+    QuantizedParameter)
+from deepspeed_tpu.ops.pallas.quantized_matmul import (is_supported,
+                                                       quantized_matmul)
+
+
+def make_case(M=16, K=512, N=256, G=128, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 2)
+    x = jax.random.normal(ks[0], (M, K), jnp.float32)
+    w = jax.random.normal(ks[1], (K, N), jnp.float32) * 0.1
+    qp = QuantizedParameter.from_array(np.asarray(w), num_bits=8, group_size=G)
+    return x, w, qp
+
+
+@pytest.mark.parametrize("M", [8, 16])
+def test_matches_xla_dequant(M):
+    x, w, qp = make_case(M=M)
+    got = quantized_matmul(x, qp.q, qp.scale, qp.group_size, interpret=True)
+    want = x @ qp.dequantized(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+    # and the quantization error itself is small vs the fp weight
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x @ w),
+                               rtol=1e-1, atol=1e-1)
+
+
+def test_multi_kblock_accumulation():
+    x, w, qp = make_case(K=1024, seed=2)   # nk = 2: accumulator correctness
+    got = quantized_matmul(x, qp.q, qp.scale, qp.group_size, interpret=True)
+    want = x @ qp.dequantized(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_is_supported_gate():
+    assert is_supported(16, 512, 256, 128, 8)
+    assert not is_supported(16, 512, 256, 128, 4)   # int4 -> fallback
+    assert not is_supported(15, 512, 256, 128, 8)   # M % 8
+    assert not is_supported(16, 500, 256, 128, 8)   # K % BK
+    assert not is_supported(16, 512, 200, 128, 8)   # N % BN
+    assert not is_supported(16, 512, 256, 512, 8)   # G > BN
+
+
+def test_param_matmul_fallback_on_cpu():
+    """On CPU the .matmul helper must silently use the XLA path."""
+    x, w, qp = make_case(M=4)  # M=4 unsupported anyway
+    out = qp.matmul(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(x @ qp.dequantized(jnp.float32)),
+                               rtol=1e-5, atol=1e-5)
