@@ -17,15 +17,41 @@ from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu import comm as dist
 
 
+# reference deepspeed/__init__.py:25-48 export surface, resolved lazily so
+# `import deepspeed_tpu` stays cheap (no jax/flax import until first use)
+_LAZY_EXPORTS = {
+    "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+    "DeepSpeedHybridEngine": ("deepspeed_tpu.runtime.hybrid_engine",
+                              "DeepSpeedHybridEngine"),
+    "PipelineEngine": ("deepspeed_tpu.runtime.pipe.engine", "PipelineEngine"),
+    "PipelineModule": ("deepspeed_tpu.runtime.pipe.module", "PipelineModule"),
+    "InferenceEngine": ("deepspeed_tpu.inference.engine", "InferenceEngine"),
+    "DeepSpeedInferenceConfig": ("deepspeed_tpu.inference.config",
+                                 "DeepSpeedInferenceConfig"),
+    "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer",
+                                  "DeepSpeedTransformerLayer"),
+    "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer",
+                                   "DeepSpeedTransformerConfig"),
+    "init_distributed": ("deepspeed_tpu.comm.comm", "init_distributed"),
+    "get_accelerator": ("deepspeed_tpu.accelerator.real_accelerator",
+                        "get_accelerator"),
+    "log_dist": ("deepspeed_tpu.utils.logging", "log_dist"),
+    "logger": ("deepspeed_tpu.utils.logging", "logger"),
+    "zero": ("deepspeed_tpu.runtime.zero", None),
+    "OnDevice": ("deepspeed_tpu.utils", "OnDevice"),
+    "add_tuning_arguments": ("deepspeed_tpu.runtime.lr_schedules",
+                             "add_tuning_arguments"),
+    "checkpointing": ("deepspeed_tpu.runtime.activation_checkpointing."
+                      "checkpointing", None),
+}
+
+
 def __getattr__(name):
-    # engine import is deferred so `import deepspeed_tpu` stays cheap
-    if name == "DeepSpeedEngine":
-        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
-        return DeepSpeedEngine
-    if name == "zero":
-        # deepspeed.zero namespace parity (zero.Init lives here)
-        from deepspeed_tpu.runtime import zero
-        return zero
+    entry = _LAZY_EXPORTS.get(name)
+    if entry is not None:
+        import importlib
+        module = importlib.import_module(entry[0])
+        return module if entry[1] is None else getattr(module, entry[1])
     raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")
 
 
@@ -129,3 +155,19 @@ def init_inference(model=None, config=None, params=None, **kwargs):
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
     cfg = DeepSpeedInferenceConfig.from_dict(config or {}, **kwargs)
     return InferenceEngine(model, cfg, params=params)
+
+
+def add_config_arguments(parser):
+    """Add the DeepSpeed CLI flags to an argparse parser (reference
+    ``deepspeed/__init__.py:250``): ``--deepspeed`` enable flag and
+    ``--deepspeed_config <json>`` consumed by :func:`initialize` via
+    ``args.deepspeed_config``."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user scripts)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    import argparse
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse.SUPPRESS)
+    return parser

@@ -8,29 +8,23 @@ the Pallas TPU kernels and the pure-XLA twins. Centralizing the choice keeps
 model implementations free of backend probing.
 """
 
-import jax
-
+from deepspeed_tpu.ops.registry import pallas_enabled
 from deepspeed_tpu.utils.logging import logger
 
 _warned = set()
 
 
-def _on_tpu():
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
-
-
 def instantiate_attention(q_shape, pool_shape):
     """-> ('pallas_paged' | 'dense', callable) for ragged paged attention."""
     from deepspeed_tpu.ops.pallas import paged_attention as pa
-    if _on_tpu() and pa.is_supported(q_shape, pool_shape):
-        return "pallas_paged", pa.paged_mha
-    if _on_tpu() and "attention" not in _warned:
-        _warned.add("attention")
-        logger.warning(f"paged attention: shapes q={q_shape} pool={pool_shape} "
-                       f"not kernel-compatible; dense fallback (O(max_context))")
+    if pallas_enabled():
+        if pa.is_supported(q_shape, pool_shape):
+            return "pallas_paged", pa.paged_mha
+        if "attention" not in _warned:
+            _warned.add("attention")
+            logger.warning(
+                f"paged attention: shapes q={q_shape} pool={pool_shape} "
+                f"not kernel-compatible; dense fallback (O(max_context))")
     return "dense", None
 
 
@@ -43,7 +37,6 @@ def instantiate_moe(d_model=None, d_ff=None):
     stacked expert weights (lossless capacity) — the oracle and CPU path.
     """
     from deepspeed_tpu.ops.pallas import grouped_gemm as gg
-    from deepspeed_tpu.ops.registry import pallas_enabled
     if pallas_enabled():
         if gg.is_supported(d_model, d_ff):
             return "megablox", gg.moe_ffn_gmm

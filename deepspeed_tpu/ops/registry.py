@@ -24,10 +24,7 @@ class OpBuilder:
     _warned_fallback = set()
 
     def is_compatible(self, verbose=False):
-        import os
-        if os.environ.get("DS_TPU_DISABLE_PALLAS"):
-            # operational kill-switch: force every op onto the pure-XLA path
-            # (e.g. to isolate a suspected kernel miscompile in production)
+        if not pallas_enabled():   # platform probe + operational kill-switch
             return False
         try:
             import jax

@@ -1,0 +1,22 @@
+
+
+class OnDevice:
+    """reference ``deepspeed.OnDevice`` (meta-device model construction).
+
+    In torch this context routes tensor allocation to the meta device so
+    huge models can be DESCRIBED without materializing weights. flax modules
+    are already lazy — construction allocates nothing until ``init`` runs —
+    and sharded materialization is ``deepspeed_tpu.zero.Init`` /
+    ``runtime/zero/sharded_init.py``. Kept as a no-op context for scripts
+    ported from the reference."""
+
+    def __init__(self, dtype=None, device="meta", enabled=True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
