@@ -79,15 +79,15 @@ def _probe_backend_subprocess():
     A wedged chip makes backend init HANG (not raise) — in-process there is
     no way to recover, and the driver's kill would end the run with no JSON
     emitted. The child takes the hang; the parent keeps control and can still
-    emit the structured error line."""
-    import subprocess
-    r = subprocess.run(
-        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-        capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
-    if r.returncode != 0:
-        tail = (r.stderr or "").strip().splitlines()
-        raise RuntimeError("backend probe failed: " +
-                           (tail[-1] if tail else f"rc={r.returncode}"))
+    emit the structured error line. (Shared impl:
+    deepspeed_tpu/utils/backend_probe.py — also used by ds_tpu_report.)"""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from deepspeed_tpu.utils.backend_probe import probe_backend
+    ok, detail = probe_backend(timeout_s=PROBE_TIMEOUT_S)
+    if not ok:
+        if "hung" in detail:
+            raise RuntimeError(f"backend init UNAVAILABLE: {detail}")
+        raise RuntimeError(f"backend {detail}")
 
 
 def init_backend_with_retry():
@@ -98,7 +98,6 @@ def init_backend_with_retry():
     both are detected by the subprocess probe. Retrying with backoff gives
     the holder time to exit. Returns the device list, or raises after all
     attempts (the caller still emits structured JSON)."""
-    import subprocess
     last = None
     for attempt in range(1, INIT_ATTEMPTS + 1):
         try:
@@ -107,12 +106,6 @@ def init_backend_with_retry():
             devs = jax.devices()
             if devs:
                 return devs
-        except subprocess.TimeoutExpired:
-            last = RuntimeError(
-                f"backend init UNAVAILABLE: probe hung >{PROBE_TIMEOUT_S:.0f}s "
-                f"— chip held/wedged")
-            print(f"bench: probe attempt {attempt}/{INIT_ATTEMPTS} hung",
-                  file=sys.stderr)
         except Exception as e:
             last = e
             print(f"bench: backend init attempt {attempt}/{INIT_ATTEMPTS} failed: "

@@ -43,8 +43,15 @@ def software_report():
     return rows
 
 
-def hardware_report():
+def hardware_report(backend_ok=None, backend_detail=""):
+    from deepspeed_tpu.utils.backend_probe import probe_backend
     rows = []
+    if backend_ok is None:
+        backend_ok, backend_detail = probe_backend()
+    if not backend_ok:
+        rows.append(("jax devices", backend_detail or "backend unavailable",
+                     FAIL))
+        return rows
     try:
         import jax
         devs = jax.devices()
@@ -99,11 +106,26 @@ def main(hide_operator_status=False, hide_errors_and_warnings=False):
         return [r for r in rows if FAIL not in r[2]] \
             if hide_errors_and_warnings else rows
 
+    from deepspeed_tpu.utils.backend_probe import probe_backend
+    backend_ok, backend_detail = probe_backend()
+    if not backend_ok:
+        # a wedged accelerator would hang every in-process jax.devices()
+        # below (ops compatibility probes included) — degrade to the CPU
+        # platform so the report still prints, with a loud banner
+        print(f"WARNING: accelerator {backend_detail}; reporting against "
+              f"the CPU platform")
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except ImportError:
+            pass  # no jax at all: the software table shows the NO row
+
     print("DeepSpeed-TPU C++/Pallas op report")
     if not hide_operator_status:
         _print_table("op compatibility", clean(ops_report()))
     _print_table("software", clean(software_report()))
-    _print_table("hardware", clean(hardware_report()))
+    _print_table("hardware", clean(hardware_report(
+        backend_ok=backend_ok, backend_detail=backend_detail)))
     return 0
 
 

@@ -114,7 +114,8 @@ def _populate():
     except Exception:
         pass
     for mod in ("cpu_adagrad", "cpu_lion", "evoformer_attn",
-                "sparse_attention.sparse_self_attention"):
+                "sparse_attention.sparse_self_attention", "spatial",
+                "inference_builders"):
         try:
             __import__(f"deepspeed_tpu.ops.{mod}")
         except Exception:

@@ -1,0 +1,28 @@
+"""Shared accelerator-backend probe.
+
+``jax.devices()`` HANGS (not raises) when the chip is held by another
+process — any in-process probe can wedge the caller. This helper takes the
+hang in a CHILD process with a deadline and reports what actually happened.
+Used by bench.py and ds_tpu_report; keep it the only copy.
+"""
+
+import subprocess
+import sys
+
+
+def probe_backend(timeout_s=30.0):
+    """-> (ok, detail). ``ok`` False means hung (detail explains) or the
+    child failed (detail carries its stderr tail, e.g. a libtpu mismatch —
+    NOT necessarily a held chip)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, (f"probe hung >{timeout_s:.0f}s — accelerator held by "
+                       f"another process")
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return False, "probe failed: " + (tail[-1] if tail
+                                          else f"rc={r.returncode}")
+    return True, (r.stdout or "").strip()

@@ -1,0 +1,49 @@
+"""Serve an HF checkpoint with continuous batching (FastGen-style v2 engine +
+SplitFuse scheduler). Works with any supported family directory
+(llama/mistral/qwen2/gpt2/opt/mixtral/falcon/phi/bloom/gpt_neox/gptj).
+
+    python examples/serve_hf_model.py <hf_model_dir> "prompt one" "prompt two"
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as np
+
+    from deepspeed_tpu.checkpoint.hf import load_pretrained
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
+
+    model_dir = sys.argv[1]
+    prompts = sys.argv[2:] or ["Hello"]
+    try:
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(model_dir)
+        encode = lambda s: np.asarray(tok(s)["input_ids"], np.int32)
+        decode = tok.decode
+    except Exception:   # tokenizer-less checkpoints: bytes fallback
+        encode = lambda s: np.frombuffer(s.encode(), np.uint8).astype(np.int32)
+        decode = lambda ids: str(list(ids))
+
+    model, params = load_pretrained(model_dir)
+    engine = InferenceEngineV2(model, params, config={
+        "state_manager": {"max_ragged_sequence_count": 8,
+                          "max_ragged_batch_size": 512,
+                          "max_context": 2048, "num_kv_blocks": 512},
+        "kv_cache": {"block_size": 64}})
+    sched = SplitFuseScheduler(engine)
+    for uid, p in enumerate(prompts):
+        sched.submit(uid, encode(p), max_new_tokens=32,
+                     eos_token_id=getattr(tok, "eos_token_id", None)
+                     if "tok" in dir() else None)
+    outputs = sched.run_to_completion()
+    for uid, p in enumerate(prompts):
+        print(f"[{uid}] {p!r} -> {decode(outputs[uid])!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Minimal DeepSpeed-style training script (the reference's
+DeepSpeedExamples cifar/gpt training pattern, TPU-native).
+
+    python examples/train_gpt2.py --deepspeed_config examples/ds_config.json
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import deepspeed_tpu
+
+
+def get_batches(vocab, batch, seq, steps, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        start = rng.integers(0, vocab, size=(batch, 1))
+        ids = ((start + np.arange(seq)[None, :]) % vocab).astype(np.int32)
+        yield {"input_ids": ids, "labels": ids}
+
+
+def main():
+    import jax
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    parser = argparse.ArgumentParser()
+    deepspeed_tpu.add_config_arguments(parser)
+    parser.add_argument("--steps", type=int, default=20)
+    args = parser.parse_args()
+
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    first = next(get_batches(cfg.vocab_size, 8, 64, 1))
+    params = model.init(jax.random.PRNGKey(0), first)["params"]
+
+    config = args.deepspeed_config or {
+        "train_micro_batch_size_per_gpu": 1,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+        "zero_optimization": {"stage": 2},
+        "activation_checkpointing": {"policy": "dots"},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=config)
+
+    for step, batch in enumerate(get_batches(cfg.vocab_size,
+                                             engine.train_batch_size(), 64,
+                                             args.steps)):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(jax.device_get(loss)):.4f}")
+
+    engine.save_checkpoint("/tmp/ds_tpu_example_ckpt")
+    print("saved checkpoint to /tmp/ds_tpu_example_ckpt")
+
+
+if __name__ == "__main__":
+    main()

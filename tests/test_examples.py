@@ -1,0 +1,29 @@
+"""The shipped example scripts run end to end on the CPU mesh (reference
+DeepSpeedExamples smoke coverage)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_train_gpt2_example(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               XLA_FLAGS=os.environ.get("XLA_FLAGS", "") +
+               " --xla_force_host_platform_device_count=8")
+    # force CPU from inside the child (sitecustomize ignores JAX_PLATFORMS)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import runpy, sys; sys.argv = ['train_gpt2.py', '--steps', '6'];"
+        f"runpy.run_path(r'{os.path.join(repo, 'examples', 'train_gpt2.py')}',"
+        "run_name='__main__')")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "saved checkpoint" in r.stdout
+    losses = [float(l.rsplit(" ", 1)[1]) for l in r.stdout.splitlines()
+              if l.startswith("step ")]
+    assert losses and losses[-1] < losses[0]

@@ -111,9 +111,7 @@ def test_long_context_zero3_sp_training_step():
     distributed are first-class' claim, end to end)."""
     import deepspeed_tpu
     from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    from deepspeed_tpu.parallel import groups
 
-    groups.reset()
     cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
                       num_hidden_layers=2, num_attention_heads=4,
                       num_key_value_heads=4, max_position_embeddings=2048,
@@ -140,6 +138,5 @@ def test_long_context_zero3_sp_training_step():
         losses.append(float(jax.device_get(loss)))
     assert np.isfinite(losses).all()
     # params stayed ZeRO-3 sharded through the sp step
-    import jax as _jax
-    leaf = _jax.tree_util.tree_leaves(engine.state.params)[0]
+    leaf = jax.tree_util.tree_leaves(engine.state.params)[0]
     assert len(leaf.sharding.device_set) == 8
