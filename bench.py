@@ -32,6 +32,8 @@ PEAK_BF16_FLOPS = {
 INIT_ATTEMPTS = int(os.environ.get("DS_BENCH_INIT_ATTEMPTS", "4"))
 INIT_BACKOFF_S = float(os.environ.get("DS_BENCH_INIT_BACKOFF", "15"))
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 
 def peak_flops(device_kind):
     for k, v in PEAK_BF16_FLOPS.items():
@@ -81,12 +83,11 @@ def _probe_backend_subprocess():
     emitted. The child takes the hang; the parent keeps control and can still
     emit the structured error line. (Shared impl:
     deepspeed_tpu/utils/backend_probe.py — also used by ds_tpu_report.)"""
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from deepspeed_tpu.utils.backend_probe import probe_backend
-    ok, detail = probe_backend(timeout_s=PROBE_TIMEOUT_S)
-    if not ok:
-        if "hung" in detail:
-            raise RuntimeError(f"backend init UNAVAILABLE: {detail}")
+    kind, detail = probe_backend(timeout_s=PROBE_TIMEOUT_S)
+    if kind == "hang":
+        raise RuntimeError(f"backend init UNAVAILABLE: {detail}")
+    if kind != "ok":
         raise RuntimeError(f"backend {detail}")
 
 

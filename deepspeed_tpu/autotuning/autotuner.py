@@ -125,6 +125,31 @@ class Autotuner:
                     f"{budget/1e9:.2f}GB budget at stage {stage}")
         return None
 
+    # ---- cost model (reference model-based search, autotuner.py:42) ----
+    def predicted_step_cost(self, stage, mbs, remat, dp_world,
+                            peak_flops=197e12, hbm_gbps=800e9):
+        """Relative predicted step time — compute plus HBM roofline terms.
+
+        Compute: fwd+bwd FLOPs (3x fwd), +1 extra fwd under recompute-all
+        remat; "dots" recomputes roughly the elementwise half. HBM: training
+        state bytes (stage-sharded) + activation traffic scaled by mbs.
+        Absolute accuracy is irrelevant — only the ORDERING matters: the
+        search runs candidates most-promising-first so early stopping keeps
+        the cheap winners (reference model-based search role)."""
+        flops = 3.0 * self.model_info["fwd_flops"] * mbs
+        # unknown policies cost like recompute-all; they still fail cleanly
+        # inside _run_experiment rather than crashing the sort
+        flops *= {"everything": 4 / 3, "dots": 7 / 6,
+                  "nothing": 1.0}.get(remat, 4 / 3)
+        compute_t = flops / peak_flops
+        state = self.estimate_state_bytes(stage, dp_world)
+        act = 2.0 * self.model_info["fwd_flops"] * mbs / max(
+            self.model_info["num_params"], 1) * 8
+        mem_t = (state + act) / hbm_gbps
+        # sum, not max: assumes no compute/DMA overlap — pessimistic but
+        # monotone in both terms, which is all the ORDERING needs
+        return (compute_t + mem_t) / max(mbs, 1)     # per-sample time
+
     def _build_config(self, stage, mbs, remat):
         cfg = dict(self.base_config)
         zero = dict(cfg.get("zero_optimization", {}))
@@ -175,7 +200,7 @@ class Autotuner:
             logger.info(f"autotuning experiment failed: {exp}")
         return exp
 
-    def tune(self, early_stopping=5, min_gain=0.02):
+    def tune(self, early_stopping=5, min_gain=0.02, search="cost"):
         """Run the (pruned) experiment schedule; return (best_config, metric).
 
         Mirrors the reference tuning loop (:523) + scheduler (:433) behavior
@@ -184,7 +209,12 @@ class Autotuner:
         run ascending and stop growing once throughput regresses (larger mbs
         past the MXU saturation point only adds memory); and the whole search
         stops after ``early_stopping`` consecutive non-improving experiments
-        (reference ``tuner_early_stopping``)."""
+        (reference ``tuner_early_stopping``).
+
+        ``search``: "cost" orders (stage, remat) groups by the predicted
+        per-sample step cost (reference model-based search — promising
+        configs run before patience runs out); "grid" keeps enumeration
+        order (reference grid search)."""
         self.profile_model_info()
         log_dist(f"autotuning: model_info={self.model_info}", ranks=[0])
         try:
@@ -197,10 +227,18 @@ class Autotuner:
         remats = self.space.get("remat_policy") or ["everything"]
         mbs_list = sorted(self._micro_batch_candidates())
 
+        groups_order = list(itertools.product(stages, remats))
+        if search == "cost":
+            mid = mbs_list[len(mbs_list) // 2]
+            groups_order.sort(key=lambda sr: self.predicted_step_cost(
+                sr[0], mid, sr[1], dp_world))
+            log_dist(f"autotuning: cost-ordered groups {groups_order}",
+                     ranks=[0])
+
         best = None
         since_improvement = 0
         trials = 0
-        for stage, remat in itertools.product(stages, remats):
+        for stage, remat in groups_order:
             group_best = None
             for mbs in mbs_list:
                 if trials >= self.max_trials or \

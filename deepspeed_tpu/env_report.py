@@ -47,7 +47,8 @@ def hardware_report(backend_ok=None, backend_detail=""):
     from deepspeed_tpu.utils.backend_probe import probe_backend
     rows = []
     if backend_ok is None:
-        backend_ok, backend_detail = probe_backend()
+        kind, backend_detail = probe_backend()
+        backend_ok = kind == "ok"
     if not backend_ok:
         rows.append(("jax devices", backend_detail or "backend unavailable",
                      FAIL))
@@ -107,7 +108,8 @@ def main(hide_operator_status=False, hide_errors_and_warnings=False):
             if hide_errors_and_warnings else rows
 
     from deepspeed_tpu.utils.backend_probe import probe_backend
-    backend_ok, backend_detail = probe_backend()
+    kind, backend_detail = probe_backend()
+    backend_ok = kind == "ok"
     if not backend_ok:
         # a wedged accelerator would hang every in-process jax.devices()
         # below (ops compatibility probes included) — degrade to the CPU

@@ -10,19 +10,31 @@ import subprocess
 import sys
 
 
-def probe_backend(timeout_s=30.0):
-    """-> (ok, detail). ``ok`` False means hung (detail explains) or the
-    child failed (detail carries its stderr tail, e.g. a libtpu mismatch —
-    NOT necessarily a held chip)."""
+import os
+
+DEFAULT_TIMEOUT_S = float(os.environ.get("DS_BACKEND_PROBE_TIMEOUT", "90"))
+
+
+def probe_backend(timeout_s=None):
+    """-> (kind, detail) where kind is "ok" | "hang" | "error".
+
+    "hang": the child never returned within the deadline — consistent with
+    (but not proof of) the accelerator being held by another process, or a
+    genuinely slow cold init; raise the timeout to distinguish.
+    "error": the child exited nonzero; detail carries its stderr tail
+    (e.g. a libtpu/jaxlib mismatch — NOT a held chip)."""
+    if timeout_s is None:
+        timeout_s = DEFAULT_TIMEOUT_S
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
             capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return False, (f"probe hung >{timeout_s:.0f}s — accelerator held by "
-                       f"another process")
+        return "hang", (f"backend probe returned nothing within "
+                        f"{timeout_s:.0f}s (accelerator held by another "
+                        f"process, or a very slow init)")
     if r.returncode != 0:
         tail = (r.stderr or "").strip().splitlines()
-        return False, "probe failed: " + (tail[-1] if tail
-                                          else f"rc={r.returncode}")
-    return True, (r.stdout or "").strip()
+        return "error", "probe failed: " + (tail[-1] if tail
+                                            else f"rc={r.returncode}")
+    return "ok", (r.stdout or "").strip()

@@ -25,9 +25,11 @@ def main():
         tok = AutoTokenizer.from_pretrained(model_dir)
         encode = lambda s: np.asarray(tok(s)["input_ids"], np.int32)
         decode = tok.decode
+        eos = tok.eos_token_id
     except Exception:   # tokenizer-less checkpoints: bytes fallback
         encode = lambda s: np.frombuffer(s.encode(), np.uint8).astype(np.int32)
         decode = lambda ids: str(list(ids))
+        eos = None
 
     model, params = load_pretrained(model_dir)
     engine = InferenceEngineV2(model, params, config={
@@ -37,9 +39,7 @@ def main():
         "kv_cache": {"block_size": 64}})
     sched = SplitFuseScheduler(engine)
     for uid, p in enumerate(prompts):
-        sched.submit(uid, encode(p), max_new_tokens=32,
-                     eos_token_id=getattr(tok, "eos_token_id", None)
-                     if "tok" in dir() else None)
+        sched.submit(uid, encode(p), max_new_tokens=32, eos_token_id=eos)
     outputs = sched.run_to_completion()
     for uid, p in enumerate(prompts):
         print(f"[{uid}] {p!r} -> {decode(outputs[uid])!r}")

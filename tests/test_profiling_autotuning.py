@@ -211,3 +211,29 @@ def test_autotuner_early_stopping(monkeypatch):
     tuner.tune(early_stopping=3)
     # 1 improving + 3 non-improving = 4 runs, not the full 12-point grid
     assert len(calls) == 4
+
+
+def test_autotuner_cost_model_ordering():
+    """The cost model orders no-remat before recompute-all at equal batch
+    (less recompute -> lower predicted per-sample cost) and the cost-guided
+    search still returns a valid winner."""
+    from tests.simple_model import SimpleModel, random_batches
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+    model = SimpleModel()
+    tuner = Autotuner(
+        model, model_parameters=None,
+        base_config={"train_batch_size": 8,
+                     "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        batch_fn=lambda b: random_batches(1, max(b, 1))[0],
+        tuning_space={"zero_stage": [0], "micro_batch_size": [1],
+                      "remat_policy": ["everything", "nothing"]},
+        warmup_steps=1, measure_steps=1)
+    tuner.profile_model_info()
+    c_all = tuner.predicted_step_cost(0, 4, "everything", 8)
+    c_none = tuner.predicted_step_cost(0, 4, "nothing", 8)
+    assert c_none < c_all
+    params = model.init(jax.random.PRNGKey(0), random_batches(1, 8)[0])["params"]
+    tuner.model_parameters = params
+    cfg, metric = tuner.tune(search="cost")
+    assert metric > 0 and cfg["zero_optimization"]["stage"] == 0
