@@ -72,7 +72,7 @@ class Autotuner:
         flops, macs, n_params = get_model_profile(self.model, batch,
                                                   print_profile=False)
         self.model_info = {"num_params": n_params, "fwd_flops": flops,
-                           "fwd_macs": macs}
+                           "fwd_macs": macs, "profile_mbs": max(mbs, 1)}
         return self.model_info
 
     def _micro_batch_candidates(self):
@@ -136,14 +136,18 @@ class Autotuner:
         Absolute accuracy is irrelevant — only the ORDERING matters: the
         search runs candidates most-promising-first so early stopping keeps
         the cheap winners (reference model-based search role)."""
-        flops = 3.0 * self.model_info["fwd_flops"] * mbs
+        # fwd_flops was measured over a profile_mbs-sized batch: normalize
+        # to per-sample before scaling by this candidate's mbs
+        per_sample = self.model_info["fwd_flops"] / \
+            self.model_info.get("profile_mbs", 1)
+        flops = 3.0 * per_sample * mbs
         # unknown policies cost like recompute-all; they still fail cleanly
         # inside _run_experiment rather than crashing the sort
         flops *= {"everything": 4 / 3, "dots": 7 / 6,
                   "nothing": 1.0}.get(remat, 4 / 3)
         compute_t = flops / peak_flops
         state = self.estimate_state_bytes(stage, dp_world)
-        act = 2.0 * self.model_info["fwd_flops"] * mbs / max(
+        act = 2.0 * per_sample * mbs / max(
             self.model_info["num_params"], 1) * 8
         mem_t = (state + act) / hbm_gbps
         # sum, not max: assumes no compute/DMA overlap — pessimistic but

@@ -12,8 +12,6 @@ import sys
 
 import os
 
-DEFAULT_TIMEOUT_S = float(os.environ.get("DS_BACKEND_PROBE_TIMEOUT", "90"))
-
 
 def probe_backend(timeout_s=None):
     """-> (kind, detail) where kind is "ok" | "hang" | "error".
@@ -23,8 +21,8 @@ def probe_backend(timeout_s=None):
     genuinely slow cold init; raise the timeout to distinguish.
     "error": the child exited nonzero; detail carries its stderr tail
     (e.g. a libtpu/jaxlib mismatch — NOT a held chip)."""
-    if timeout_s is None:
-        timeout_s = DEFAULT_TIMEOUT_S
+    if timeout_s is None:   # read at call time: callers set the env late
+        timeout_s = float(os.environ.get("DS_BACKEND_PROBE_TIMEOUT", "90"))
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; print(len(jax.devices()))"],

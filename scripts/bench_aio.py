@@ -1,0 +1,66 @@
+"""AIO (NVMe tier) microbench: the C++ O_DIRECT thread pool vs plain
+buffered numpy I/O (reference ``csrc/aio`` perf sweep analog). Host-only.
+
+    python scripts/bench_aio.py [--mb 512] [--dir /tmp]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=512)
+    ap.add_argument("--dir", default="/tmp")
+    ap.add_argument("--queue_depth", type=int, default=8)
+    ap.add_argument("--threads", type=int, default=4)
+    args = ap.parse_args()
+
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    from deepspeed_tpu.ops.native import load_native
+
+    native = load_native("ds_aio") is not None
+    label = "aio(C++)" if native else "aio(py-fallback)"
+
+    n = args.mb * (1 << 20) // 4
+    data = np.random.default_rng(0).random(n, dtype=np.float32)
+    buf = np.empty_like(data)
+    path = os.path.join(args.dir, "ds_aio_bench.bin")
+    h = AsyncIOHandle(queue_depth=args.queue_depth,
+                      num_threads=args.threads)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    wt = timed(lambda: (h.async_pwrite(data, path), h.wait()))
+    rt = timed(lambda: (h.async_pread(buf, path), h.wait()))
+    assert np.array_equal(buf, data)
+
+    npath = path + ".np"
+    nwt = timed(lambda: data.tofile(npath))
+    nbuf = np.empty_like(data)
+    nrt = timed(lambda: nbuf.__setitem__(slice(None),
+                                         np.fromfile(npath, np.float32)))
+
+    gb = args.mb / 1024
+    print(f"{label:>16} write {gb/wt:6.2f} GB/s   read {gb/rt:6.2f} GB/s "
+          f"(queue_depth={args.queue_depth}, threads={args.threads})")
+    print(f"{'numpy':>16} write {gb/nwt:6.2f} GB/s   read {gb/nrt:6.2f} GB/s "
+          f"(buffered, page-cache assisted)")
+    for p in (path, npath):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Host CPU-Adam microbench: AVX-512 native step vs numpy fallback
+(reference ``tests/perf/adam_test.py`` analog). Host-only — no accelerator.
+
+    python scripts/bench_cpu_adam.py [--n 50000000] [--iters 5]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def bench(update_fn, params, grads, iters):
+    update_fn(params, grads)      # warm the code path / page in state
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        update_fn(params, grads)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=50_000_000,
+                    help="elements in the flat shard (50M fp32 = 200MB)")
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    from deepspeed_tpu.ops import cpu_adam as ca
+    from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+
+    rng = np.random.default_rng(0)
+    params = rng.normal(size=args.n).astype(np.float32)
+    grads = rng.normal(size=args.n).astype(np.float32)
+    out16 = np.zeros(args.n, dtype=np.uint16)
+
+    native = ca._native() is not None
+    opt = DeepSpeedCPUAdam(lr=1e-4)
+
+    def step(p, g):
+        opt.begin_step()
+        opt.update("k", p, g)
+
+    def step_bf16(p, g):
+        opt.begin_step()
+        opt.update("k", p, g, out_bf16=out16)
+
+    results = {}
+    if native:
+        results["native"] = bench(step, params, grads, args.iters)
+        results["native+bf16copy"] = bench(step_bf16, params, grads,
+                                           args.iters)
+    # force the numpy path by hiding the native lib
+    saved = ca._native
+    ca._native = lambda: None
+    try:
+        opt_np = DeepSpeedCPUAdam(lr=1e-4)
+
+        def step_np(p, g):
+            opt_np.begin_step()
+            opt_np.update("k", p, g)
+
+        results["numpy"] = bench(step_np, params, grads, args.iters)
+    finally:
+        ca._native = saved
+
+    gb = args.n * 4 * 4 / 1e9   # p+g+m+v read (+p/m/v write ~ same order)
+    for name, dt in results.items():
+        print(f"{name:>16}: {dt*1000:8.1f} ms/step  "
+              f"{args.n/dt/1e9:6.2f} Gelem/s  (~{gb/dt:5.1f} GB/s read)")
+    if native and "numpy" in results:
+        print(f"speedup native vs numpy: "
+              f"{results['numpy']/results['native']:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
