@@ -1,0 +1,15 @@
+"""Automatic tensor-parallel policy inference (reference ``module_inject``).
+
+The reference rewrites torch modules in place (``replace_module.py:182``) and
+its AutoTP walks module graphs to decide which Linears split column- vs
+row-wise (``auto_tp.py``). The TPU analog needs no module surgery — a TP
+"policy" here is a PartitionSpec pytree consumed by the engine's partitioner —
+so this package provides the same capability as pure functions:
+
+- :func:`infer_tp_specs`: name-heuristic column/row/vocab classification for
+  ANY flax param tree (models without a hand-written ``param_specs``);
+- in-tree models still ship exact ``param_specs`` methods; this is the
+  generic fallback the reference's AutoTP plays for unseen architectures.
+"""
+
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, infer_tp_specs  # noqa: F401

@@ -1,0 +1,94 @@
+"""AutoTP — infer Megatron-style TP PartitionSpecs for any flax param tree.
+
+Reference ``module_inject/auto_tp.py:491`` walks the torch module graph and
+classifies Linears as all-reduce (row) or split (column) layers by tracing
+which ones feed residual sums. Weight NAMES carry the same signal in every
+transformer implementation, so the TPU version classifies by name:
+
+- column-parallel (output dim split, no collective on the way in):
+  q/k/v/gate/up projections, fused qkv, first MLP matmuls;
+- row-parallel (input dim split, psum on the way out — the reference's
+  LinearAllreduce): attention output and second MLP matmuls;
+- vocab-split: embeddings and lm heads;
+- everything else (norms, biases, scalars): replicated.
+
+The column/row pairing keeps each transformer block collective-count
+identical to Megatron: one psum after attention, one after the MLP.
+"""
+
+import re
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+# ordered: the ROW patterns must win over generic matches
+ROW_PATTERNS = re.compile(
+    r"(o_proj|out_proj|down_proj|dense_4h_to_h|dense/kernel"
+    r"|fc2|fc_out|c_proj|wo)\b")
+COLUMN_PATTERNS = re.compile(
+    r"(q_proj|k_proj|v_proj|query_key_value|c_attn|qkv"
+    r"|gate_proj|up_proj|dense_h_to_4h|fc1|fc_in|c_fc|wi)\b")
+VOCAB_PATTERNS = re.compile(
+    r"(embed_tokens|word_embeddings$|wte|embed_in|lm_head|embed_out|shared)\b")
+
+
+def _classify(name):
+    if VOCAB_PATTERNS.search(name):
+        return "vocab"
+    if ROW_PATTERNS.search(name):
+        return "row"
+    if COLUMN_PATTERNS.search(name):
+        return "column"
+    return None
+
+
+# scan-stacked containers: "layers/block" (in-tree lax.scan trees), but NOT
+# "layers/0" — HF-Flax nests per-layer dicts under digit keys
+_SCAN_RE = re.compile(r"(layers/(?!\d)|h/block|/block/)")
+
+
+def infer_tp_specs(params, axis="tp"):
+    """PartitionSpec pytree for ``params`` by weight-name heuristics.
+
+    Scanned ([L, ...]-stacked) leaves — recognized by scan-container path
+    fragments or structurally (a 3D classified kernel is a stacked 2D one) —
+    get a leading None axis. 1D leaves (biases, norm scales) and
+    unrecognized kernels stay replicated (None spec).
+    """
+    def spec_for(path, leaf):
+        name = "/".join(str(getattr(p, "key", getattr(p, "name", "")))
+                        for p in path)
+        stacked = bool(_SCAN_RE.search(name)) or \
+            (leaf.ndim == 3 and _classify(name) in ("column", "row"))
+        base_ndim = leaf.ndim - (1 if stacked else 0)
+        kind = _classify(name)
+        if kind == "vocab":
+            if base_ndim != 2:
+                return None
+            spec = (axis, None)
+        elif base_ndim != 2:
+            return None
+        elif kind == "column":
+            spec = (None, axis)
+        elif kind == "row":
+            spec = (axis, None)
+        else:
+            return None
+        return P(*(((None,) if stacked else ()) + spec))
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    specs = [spec_for(p, l) for p, l in flat]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), specs)
+
+
+class AutoTP:
+    """reference ``AutoTP`` surface: policy discovery for a model/params."""
+
+    @staticmethod
+    def get_policy(model, params):
+        """Prefer the model's exact ``param_specs``; fall back to name
+        inference (the reference's graph-walk role)."""
+        if hasattr(model, "param_specs"):
+            return model.param_specs(params)
+        return infer_tp_specs(params)

@@ -47,8 +47,13 @@ def main():
     npath = path + ".np"
     nwt = timed(lambda: data.tofile(npath))
     nbuf = np.empty_like(data)
-    nrt = timed(lambda: nbuf.__setitem__(slice(None),
-                                         np.fromfile(npath, np.float32)))
+
+    def np_read():   # apples-to-apples: read INTO the preallocated buffer
+        with open(npath, "rb") as f:
+            f.readinto(memoryview(nbuf).cast("B"))
+
+    nrt = timed(np_read)
+    assert np.array_equal(nbuf, data)
 
     gb = args.mb / 1024
     print(f"{label:>16} write {gb/wt:6.2f} GB/s   read {gb/rt:6.2f} GB/s "

@@ -1,0 +1,88 @@
+"""AutoTP name-heuristic TP inference vs the models' hand-written specs
+(reference ``tests/unit/module_inject`` auto-TP analogs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.module_inject import AutoTP, infer_tp_specs
+
+
+def flat_named(tree):
+    return {jax.tree_util.keystr(p): s for p, s in
+            jax.tree_util.tree_flatten_with_path(
+                tree, is_leaf=lambda x: x is None or isinstance(x, P))[0]}
+
+
+def test_matches_llama_handwritten_specs():
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    inferred = flat_named(infer_tp_specs(params))
+    exact = flat_named(model.param_specs(params))
+    for k, want in exact.items():
+        assert inferred[k] == want, f"{k}: inferred {inferred[k]} != {want}"
+
+
+def test_matches_bloom_handwritten_specs():
+    from deepspeed_tpu.models.bloom import BloomConfig, BloomForCausalLM
+    cfg = BloomConfig.tiny(dtype=jnp.float32)
+    model = BloomForCausalLM(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    inferred = flat_named(infer_tp_specs(params))
+    exact = flat_named(model.param_specs(params))
+    for k, want in exact.items():
+        assert inferred[k] == want, f"{k}: inferred {inferred[k]} != {want}"
+
+
+def test_unknown_model_gets_sane_policy():
+    """An arbitrary tree with conventional names: paired column/row splits
+    and replicated norms (the AutoTP graph-walk role for unseen archs)."""
+    params = {
+        "encoder": {"layers_0": {
+            "attn": {"qkv": {"kernel": np.zeros((64, 192)),
+                             "bias": np.zeros(192)},
+                     "wo": {"kernel": np.zeros((64, 64))}},
+            "mlp": {"wi": {"kernel": np.zeros((64, 256))},
+                    "wo": {"kernel": np.zeros((256, 64))}},
+            "ln": {"scale": np.zeros(64)}}},
+        "shared": np.zeros((1000, 64)),
+    }
+    specs = flat_named(infer_tp_specs(params))
+    assert specs["['encoder']['layers_0']['attn']['qkv']['kernel']"] == P(None, "tp")
+    assert specs["['encoder']['layers_0']['attn']['wo']['kernel']"] == P("tp", None)
+    assert specs["['encoder']['layers_0']['mlp']['wi']['kernel']"] == P(None, "tp")
+    assert specs["['encoder']['layers_0']['mlp']['wo']['kernel']"] == P("tp", None)
+    assert specs["['encoder']['layers_0']['attn']['qkv']['bias']"] is None
+    assert specs["['encoder']['layers_0']['ln']['scale']"] is None
+    assert specs["['shared']"] == P("tp", None)
+
+
+def test_autotp_prefers_exact_specs():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2LMHeadModel(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    via_autotp = flat_named(AutoTP.get_policy(model, params))
+    exact = flat_named(model.param_specs(params))
+    assert via_autotp == exact
+
+
+def test_hf_flax_digit_nesting_not_mistaken_for_scan():
+    """HF-Flax trees nest per-layer dicts under digit keys (layers/0/...) —
+    those are NOT scan-stacked; and a genuinely stacked 3D kernel is."""
+    params = {"model": {"layers": {"0": {"self_attn": {
+        "q_proj": {"kernel": np.zeros((64, 64))}}}}}}
+    specs = flat_named(infer_tp_specs(params))
+    key = "['model']['layers']['0']['self_attn']['q_proj']['kernel']"
+    assert specs[key] == P(None, "tp")
+    stacked = {"blocks": {"q_proj": {"kernel": np.zeros((4, 64, 64))}}}
+    s2 = flat_named(infer_tp_specs(stacked))
+    assert s2["['blocks']['q_proj']['kernel']"] == P(None, None, "tp")
