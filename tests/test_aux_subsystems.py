@@ -1,0 +1,81 @@
+"""Aux subsystems without dedicated coverage: monitor writers, eigenvalue,
+progressive layer drop, synchronized timers (reference tests/unit/monitor,
+runtime eigenvalue/PLD/timer tests)."""
+
+import csv
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def test_csv_monitor_writes_events(tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "job"}})
+    mon = MonitorMaster(cfg)
+    assert mon.enabled
+    mon.write_events([("Train/loss", 1.25, 1), ("Train/loss", 1.10, 2)])
+    files = [f for root, _, fs in os.walk(tmp_path) for f in fs
+             if f.endswith(".csv")]
+    assert files, "no csv written"
+    path = next(os.path.join(root, f) for root, _, fs in os.walk(tmp_path)
+                for f in fs if f.endswith(".csv"))
+    rows = list(csv.reader(open(path)))
+    assert any("1.25" in " ".join(r) for r in rows)
+
+
+def test_eigenvalue_power_iteration_quadratic():
+    """For loss = 0.5 * x^T diag(d) x the top Hessian eigenvalue is max(d)."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+    d = jnp.asarray([1.0, 5.0, 2.0, 0.5])
+
+    def loss_fn(params):
+        x = params["x"]
+        return 0.5 * jnp.sum(d * x * x)
+
+    ev = Eigenvalue(max_iter=50, tol=1e-4)
+    eig = ev.compute_eigenvalue(loss_fn, {"x": jnp.ones(4)},
+                                rng=jax.random.PRNGKey(0))
+    assert abs(float(eig) - 5.0) < 0.2
+
+
+def test_progressive_layer_drop_schedule():
+    from deepspeed_tpu.runtime.progressive_layer_drop import (
+        ProgressiveLayerDrop, should_keep_layer)
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+    pld.update_state(0)
+    t0 = pld.get_theta()
+    pld.update_state(10_000)
+    t1 = pld.get_theta()
+    # keep-probability anneals DOWN from 1.0 toward theta (more drops later)
+    assert t0 == 1.0 and 0.5 <= t1 < t0
+    # keep decision is deterministic per (rng, layer)
+    k1 = should_keep_layer(jax.random.PRNGKey(0), 3, 0.99)
+    k2 = should_keep_layer(jax.random.PRNGKey(0), 3, 0.99)
+    assert bool(k1) == bool(k2)
+
+
+def test_synchronized_timer_and_throughput():
+    from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer,
+                                           ThroughputTimer)
+    timers = SynchronizedWallClockTimer()
+    timers("unit").start()
+    time.sleep(0.01)
+    timers("unit").stop()
+    sec = timers("unit").elapsed(reset=False)
+    assert sec >= 0.005
+    tput = ThroughputTimer(batch_size=4, steps_per_output=1000)
+    tput.start()
+    time.sleep(0.005)
+    tput.stop(global_step=True)
+    assert tput.global_step_count == 1
