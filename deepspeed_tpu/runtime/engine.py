@@ -963,6 +963,13 @@ class DeepSpeedEngine:
         batch = self._shard_batch(batch)
         self.state, loss = self._micro_step_fn(self.state, batch)
         self._staged_loss = loss
+        # device-side running mean across the GAS window (reference averages
+        # micro-step losses before the train_loss event; no host sync here)
+        if getattr(self, "_loss_accum", None) is None:
+            self._loss_accum, self._loss_accum_n = loss, 1
+        else:
+            self._loss_accum = self._loss_accum + loss
+            self._loss_accum_n += 1
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).stop(token=loss)
         return loss
@@ -1040,10 +1047,19 @@ class DeepSpeedEngine:
             # lives in device state and is read lazily (skipped_steps property)
             self.lr_scheduler.step()
             if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
-                self.monitor.write_events([
+                events = [
                     ("Train/Samples/lr", float(stats.lr), self.global_samples),
                     ("Train/Samples/loss_scale", float(stats.loss_scale), self.global_samples),
-                ])
+                ]
+                if getattr(self, "_loss_accum", None) is not None:
+                    # reference engine.py:1961 Train/Samples/train_loss —
+                    # the GAS-window mean; float() sync only at monitor cadence
+                    mean = float(jax.device_get(self._loss_accum)) / \
+                        self._loss_accum_n
+                    events.insert(0, ("Train/Samples/train_loss", mean,
+                                      self.global_samples))
+                self.monitor.write_events(events)
+            self._loss_accum, self._loss_accum_n = None, 0
         self.micro_steps += 1
         self.global_samples += self.micro_batch_size * self.topology.data_parallel_size
         if self.wall_clock_breakdown:

@@ -79,3 +79,27 @@ def test_synchronized_timer_and_throughput():
     time.sleep(0.005)
     tput.stop(global_step=True)
     assert tput.global_step_count == 1
+
+
+def test_engine_writes_train_loss_event(tmp_path):
+    """The engine emits Train/Samples/train_loss at monitor cadence
+    (reference engine.py:1961)."""
+    import deepspeed_tpu
+    from tests.simple_model import SimpleModel, random_batches
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8, "steps_per_print": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                                "job_name": "j"}})
+    for _ in range(2):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    paths = [os.path.join(root, f)
+             for root, _, fs in os.walk(tmp_path) for f in fs]
+    assert any("train_loss" in p or "train_loss" in open(p).read()
+               for p in paths), paths
