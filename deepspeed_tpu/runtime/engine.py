@@ -965,11 +965,12 @@ class DeepSpeedEngine:
         self._staged_loss = loss
         # device-side running mean across the GAS window (reference averages
         # micro-step losses before the train_loss event; no host sync here)
-        if getattr(self, "_loss_accum", None) is None:
-            self._loss_accum, self._loss_accum_n = loss, 1
-        else:
-            self._loss_accum = self._loss_accum + loss
-            self._loss_accum_n += 1
+        if self.monitor.enabled:
+            if getattr(self, "_loss_accum", None) is None:
+                self._loss_accum, self._loss_accum_n = loss, 1
+            else:
+                self._loss_accum = self._loss_accum + loss
+                self._loss_accum_n += 1
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).stop(token=loss)
         return loss

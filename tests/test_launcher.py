@@ -160,3 +160,28 @@ def test_ds_report_runs(capsys):
     assert "fused_adam" in out
     assert "native/ds_aio" in out
     assert "platform" in out
+
+
+def test_ds_tpu_ssh_fanout(tmp_path):
+    """bin/ds_tpu_ssh fans the command out per hostfile host (reference
+    bin/ds_ssh) — exercised with a stub ssh on PATH."""
+    import os
+    import stat
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("hostA slots=1\nhostB slots=2\n")
+    fake_ssh = tmp_path / "ssh"
+    fake_ssh.write_text("#!/bin/sh\nshift 2   # drop -o opt\n"
+                        "host=$1; shift\necho \"$host ran: $*\"\n")
+    fake_ssh.chmod(fake_ssh.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ, PATH=f"{tmp_path}:{os.environ['PATH']}")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bin", "ds_tpu_ssh"),
+         "-f", str(hostfile), "--", "uptime"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[hostA]" in r.stdout and "[hostB]" in r.stdout
+    assert "uptime" in r.stdout
