@@ -23,16 +23,29 @@ def probe_backend(timeout_s=None):
     (e.g. a libtpu/jaxlib mismatch — NOT a held chip)."""
     if timeout_s is None:   # read at call time: callers set the env late
         timeout_s = float(os.environ.get("DS_BACKEND_PROBE_TIMEOUT", "90"))
+    # manual Popen dance: subprocess.run's TimeoutExpired path kills the
+    # child then WAITS for it — a child stuck in an uninterruptible tunnel
+    # syscall never dies and the "bounded" probe blocks forever. Here the
+    # final wait is itself bounded; an unkillable child gets ABANDONED.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-            capture_output=True, text=True, timeout=timeout_s)
+        out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass  # unkillable (D-state): abandon it
         return "hang", (f"backend probe returned nothing within "
                         f"{timeout_s:.0f}s (accelerator held by another "
                         f"process, or a very slow init)")
-    if r.returncode != 0:
-        tail = (r.stderr or "").strip().splitlines()
+    except BaseException:   # KeyboardInterrupt etc: never leak a live child
+        proc.kill()
+        raise
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()
         return "error", "probe failed: " + (tail[-1] if tail
-                                            else f"rc={r.returncode}")
-    return "ok", (r.stdout or "").strip()
+                                            else f"rc={proc.returncode}")
+    return "ok", (out or "").strip()
