@@ -13,7 +13,11 @@ import sys
 import os
 
 
-def probe_backend(timeout_s=None):
+# the child's probe body — module-level so tests can substitute a fake
+PROBE_CODE = "import jax; print(len(jax.devices()))"
+
+
+def probe_backend(timeout_s=None, _code=None):
     """-> (kind, detail) where kind is "ok" | "hang" | "error".
 
     "hang": the child never returned within the deadline — consistent with
@@ -28,7 +32,7 @@ def probe_backend(timeout_s=None):
     # syscall never dies and the "bounded" probe blocks forever. Here the
     # final wait is itself bounded; an unkillable child gets ABANDONED.
     proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        [sys.executable, "-c", _code or PROBE_CODE],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
         out, err = proc.communicate(timeout=timeout_s)

@@ -1,0 +1,27 @@
+"""Backend probe contract: ok/hang/error kinds with bounded waits (the
+wedged-chip diagnosis path every operator tool depends on)."""
+
+import time
+
+from deepspeed_tpu.utils.backend_probe import probe_backend
+
+
+def test_ok_kind():
+    kind, detail = probe_backend(timeout_s=30, _code="print(8)")
+    assert kind == "ok" and detail == "8"
+
+
+def test_error_kind_carries_stderr_tail():
+    kind, detail = probe_backend(
+        timeout_s=30, _code="raise RuntimeError('libtpu mismatch xyz')")
+    assert kind == "error"
+    assert "libtpu mismatch xyz" in detail
+
+
+def test_hang_kind_is_bounded():
+    t0 = time.time()
+    kind, detail = probe_backend(timeout_s=2,
+                                 _code="import time; time.sleep(60)")
+    assert kind == "hang"
+    assert time.time() - t0 < 12  # timeout + kill grace, never the sleep
+    assert "2s" in detail or "2" in detail
