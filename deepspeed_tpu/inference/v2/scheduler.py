@@ -24,6 +24,10 @@ class _Request:
     prompt: np.ndarray
     max_new_tokens: int
     eos_token_id: Optional[int]
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
     prefill_pos: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
@@ -51,18 +55,34 @@ class SplitFuseScheduler:
         self._requests: Dict[int, _Request] = {}
         self._starved = 0  # consecutive rounds with nothing schedulable
 
-    def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None):
+    def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None,
+               temperature=0.0, top_k=0, top_p=1.0, seed=None):
+        """Queue a request. ``temperature`` 0.0 = greedy; otherwise
+        per-request top-k/top-p sampling. ``seed=None`` draws a fresh random
+        stream per request; pass an int for reproducible completions."""
         if uid in self._requests:
             raise ValueError(f"uid {uid} already submitted")
         prompt = np.asarray(prompt, np.int32)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         max_ctx = self._engine._config.state_manager.max_context
         if len(prompt) >= max_ctx:
             raise ValueError(f"prompt of {len(prompt)} tokens cannot fit "
                              f"max_context {max_ctx}")
+        if seed is None:
+            import secrets
+            seed = secrets.randbits(31)
         self._requests[uid] = _Request(uid, prompt, int(max_new_tokens),
-                                       eos_token_id)
+                                       eos_token_id,
+                                       temperature=float(temperature),
+                                       top_k=int(top_k), top_p=float(top_p),
+                                       seed=int(seed))
 
     @property
     def has_work(self):
@@ -137,9 +157,7 @@ class SplitFuseScheduler:
                 r.prefill_pos += len(chunks[row])
                 if r.prefilling:
                     continue  # mid-prompt logits are not a next token
-            else:
-                pass
-            tok = int(np.argmax(logits[row]))
+            tok = self._sample(r, logits[row])
             r.generated.append(tok)
             if (r.eos_token_id is not None and tok == r.eos_token_id) or \
                     len(r.generated) >= r.max_new_tokens:
@@ -147,6 +165,29 @@ class SplitFuseScheduler:
                 self._engine.flush(uid)
                 finished.append(uid)
         return finished
+
+    def _sample(self, r, row_logits):
+        """Per-request sampling, host-side: logits already live on the host
+        (engine.put returns numpy), so numpy sampling avoids per-token eager
+        device dispatches. Deterministic per (seed, position)."""
+        if r.temperature == 0.0:
+            return int(np.argmax(row_logits))
+        logits = np.asarray(row_logits, np.float64) / r.temperature
+        if r.top_k and r.top_k > 0:
+            kth = np.sort(logits)[-r.top_k]
+            logits = np.where(logits < kth, -1e9, logits)
+        if r.top_p < 1.0:
+            order = np.argsort(logits)[::-1]
+            probs = np.exp(logits[order] - logits[order][0])
+            probs /= probs.sum()
+            cum = np.cumsum(probs)
+            cutoff_idx = int(np.sum(cum < r.top_p))  # always keep the top token
+            cutoff = logits[order][cutoff_idx]
+            logits = np.where(logits < cutoff, -1e9, logits)
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        rng = np.random.default_rng((r.seed << 20) + len(r.generated))
+        return int(rng.choice(len(p), p=p))
 
     def run_to_completion(self, max_rounds=10000):
         for _ in range(max_rounds):

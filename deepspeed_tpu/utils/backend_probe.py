@@ -32,7 +32,7 @@ def probe_backend(timeout_s=None, _code=None):
     # syscall never dies and the "bounded" probe blocks forever. Here the
     # final wait is itself bounded; an unkillable child gets ABANDONED.
     proc = subprocess.Popen(
-        [sys.executable, "-c", _code or PROBE_CODE],
+        [sys.executable, "-c", PROBE_CODE if _code is None else _code],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
         out, err = proc.communicate(timeout=timeout_s)

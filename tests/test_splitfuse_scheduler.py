@@ -142,3 +142,36 @@ def test_context_capacity_retires_request(served):
     got = sched.run_to_completion()[0]
     # 12 prompt + 4 generated fills the 16-token context; retired early
     assert 1 <= len(got) <= 4
+
+
+def test_sampled_decode_reproducible_and_valid(served):
+    """Per-request temperature sampling: deterministic per seed, tokens in
+    vocab, different seeds may diverge."""
+    cfg, model, params = served
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, cfg.vocab_size, 7).astype(np.int32)
+
+    def run(seed):
+        engine = make_engine(cfg, model, params)
+        sched = SplitFuseScheduler(engine)
+        sched.submit(0, prompt, max_new_tokens=6, temperature=0.8,
+                     top_k=20, seed=seed)
+        return sched.run_to_completion()[0].tolist()
+
+    a1, a2, b = run(1), run(1), run(2)
+    assert a1 == a2, "same seed must reproduce"
+    assert all(0 <= t < cfg.vocab_size for t in a1 + b)
+    assert len(a1) == 6 and len(b) == 6
+
+
+def test_sampling_param_validation(served):
+    cfg, model, params = served
+    engine = make_engine(cfg, model, params)
+    sched = SplitFuseScheduler(engine)
+    p = np.arange(5, dtype=np.int32) + 1
+    with pytest.raises(ValueError, match="temperature"):
+        sched.submit(0, p, temperature=-0.5)
+    with pytest.raises(ValueError, match="top_p"):
+        sched.submit(1, p, temperature=0.5, top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        sched.submit(2, p, temperature=0.5, top_k=-1)
