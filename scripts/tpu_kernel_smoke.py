@@ -145,8 +145,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", choices=sorted(SMOKES),
                     help="run a single kernel smoke in-process")
-    ap.add_argument("--timeout", type=float, default=420,
-                    help="per-kernel subprocess deadline (seconds)")
+    ap.add_argument("--timeout", type=float, default=600,
+                    help="per-kernel subprocess deadline (seconds) — first "
+                         "Mosaic compiles over the axon tunnel can take "
+                         "60-120s EACH, and a kernel smoke compiles several")
     args = ap.parse_args()
 
     if args.only:
