@@ -907,6 +907,10 @@ class DeepSpeedEngine:
             else:
                 self._apply_step_fn = self._build_apply_step()
             self._eval_step_fn = self._build_eval_step()
+        elif self._apply_step_fn is None and self._offload is None:
+            # invalidated (e.g. set_train_batch_size changed the baked-in
+            # GAS denominator) — rebuild just the apply step
+            self._apply_step_fn = self._build_apply_step()
 
     # ------------------------------------------------------------------
     # public API (reference engine.py:1794/1933/2132)
@@ -986,8 +990,10 @@ class DeepSpeedEngine:
         return staged_loss
 
     def is_gradient_accumulation_boundary(self):
-        """reference engine.py:2153 semantics."""
-        return (self.micro_steps + 1) % self.gradient_accumulation_steps_value == 0
+        """reference engine.py:2153 semantics. ``_gas_offset`` rebases the
+        window after an elastic ``set_train_batch_size`` resize."""
+        rel = self.micro_steps - getattr(self, "_gas_offset", 0)
+        return (rel + 1) % self.gradient_accumulation_steps_value == 0
 
     # --- sparse (embedding) gradient reduction -------------------------
     # reference engine.py:2470-2539: embedding grads travel as (indices,
@@ -1107,6 +1113,57 @@ class DeepSpeedEngine:
 
     def get_global_grad_norm(self):
         return float(self._last_stats.grad_norm) if self._last_stats is not None else 0.0
+
+    def set_lr(self, lr):
+        """Override the learning rate from here on (reference engine
+        ``set_lr``): pins the schedule to a constant until changed again."""
+        value = float(lr[0] if isinstance(lr, (list, tuple)) else lr)
+        self._schedule_fn = lambda step: value
+        # keep the scheduler shim's surface consistent with what is applied
+        if hasattr(self.lr_scheduler, "schedule_fn"):
+            self.lr_scheduler.schedule_fn = self._schedule_fn
+
+    def get_mom(self):
+        """reference ``get_mom``: first momentum coefficient (Adam beta1 /
+        SGD momentum) from the optimizer config."""
+        params = dict(getattr(self.config.optimizer, "params", {}) or {})
+        opt_type = str(getattr(self.config.optimizer, "type", "")).lower()
+        if "sgd" in opt_type:
+            # matches the builder default (ops/adam.py): sgd momentum 0.0
+            return [params.get("momentum", 0.0)]
+        betas = params.get("betas", (0.9, 0.999))  # adam-family default
+        return [list(betas)]
+
+    def set_train_batch_size(self, train_batch_size):
+        """Adjust the global batch size by changing gradient-accumulation
+        steps; the micro-batch size is untouched (reference engine.py:411 —
+        the elasticity resize hook). Only legal at an accumulation boundary
+        (a mid-window resize would mis-scale the partial window)."""
+        if getattr(self, "_grad_scale_multiplier", 1.0) != 1.0:
+            raise NotImplementedError(
+                "set_train_batch_size on PipelineEngine: the pipeline "
+                "micro-batch count is baked into the compiled schedule")
+        rel = self.micro_steps - getattr(self, "_gas_offset", 0)
+        if rel % self.gradient_accumulation_steps_value != 0:
+            raise RuntimeError(
+                "set_train_batch_size mid-accumulation-window: call it only "
+                "right after step() completed a window")
+        mbs = self.train_micro_batch_size_per_gpu()
+        dp = self.topology.data_parallel_size
+        if train_batch_size % (mbs * dp) != 0:
+            raise ValueError(
+                f"train_batch_size {train_batch_size} not divisible by "
+                f"micro_batch ({mbs}) x dp ({dp})")
+        self.gradient_accumulation_steps_value = train_batch_size // (mbs * dp)
+        self.train_batch_size_value = train_batch_size
+        self.config.train_batch_size = train_batch_size
+        self.config.gradient_accumulation_steps = \
+            self.gradient_accumulation_steps_value
+        self._gas_offset = self.micro_steps  # rebase the window
+        # the fused apply-step bakes the GAS denominator in: invalidate and
+        # let _compiled() rebuild lazily (offload keeps its own path; an
+        # uninitialized engine has no shardings to build against yet)
+        self._apply_step_fn = None
 
     @property
     def skipped_steps(self):

@@ -363,3 +363,36 @@ def test_initialize_accepts_mpu():
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
     assert engine.topology.get_dim("tp") == 2
     loss = engine(batch); engine.backward(loss); engine.step()
+
+
+def test_engine_accessors_set_lr_mom_batch():
+    """reference accessor parity: set_lr pins the schedule, get_mom reads
+    optimizer betas, set_train_batch_size resizes GAS (elasticity hook)."""
+    from tests.simple_model import SimpleModel, random_batches
+    from deepspeed_tpu.parallel import groups
+    groups.reset()
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam",
+                              "params": {"lr": 1e-3, "betas": [0.8, 0.95]}}})
+    assert engine.get_mom() == [[0.8, 0.95]]
+    engine.set_lr(5e-4)
+    loss = engine(batch); engine.backward(loss); engine.step()
+    assert abs(engine.get_lr()[0] - 5e-4) < 1e-9
+    dp = engine.topology.data_parallel_size
+    engine.set_train_batch_size(2 * dp)   # mbs=1 -> gas=2, at a boundary
+    assert engine.gradient_accumulation_steps() == 2
+    with pytest.raises(ValueError):
+        engine.set_train_batch_size(2 * dp + 1)
+    steps_before = engine.global_steps
+    loss = engine(batch); engine.backward(loss); engine.step()
+    assert engine.global_steps == steps_before          # mid-window: no apply
+    with pytest.raises(RuntimeError, match="mid-accumulation"):
+        engine.set_train_batch_size(4 * dp)
+    loss = engine(batch); engine.backward(loss); engine.step()
+    assert engine.global_steps == steps_before + 1      # window of 2 closed
