@@ -102,3 +102,24 @@ def test_qgz_grad_values_match_unquantized(eight_devices):
     mr = jax.device_get(engine_r.state.master)
     for a, b in zip(jax.tree.leaves(mq), jax.tree.leaves(mr)):
         np.testing.assert_allclose(a, b, atol=5e-4)
+
+
+def test_qgz_with_fp16_loss_scaling(eight_devices):
+    """qgZ under fp16 dynamic loss scaling: the manual-mode grad path must
+    unscale at the boundary like the auto path (loss-scale factor folded
+    into the denom), and training stays finite and converging."""
+    model = SimpleModel(hidden_dim=32)
+    batches = random_batches(8, 8, seed=0)
+    params = model.init(jax.random.PRNGKey(7), batches[0])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 16,
+                "gradient_accumulation_steps": 2,
+                "fp16": {"enabled": True, "initial_scale_power": 8},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 2,
+                                      "zero_quantized_gradients": True}})
+    losses = train(engine, batches, steps=8)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    assert engine.skipped_steps == 0
