@@ -1261,6 +1261,9 @@ class DeepSpeedEngine:
                 "global_samples": self.global_samples,
                 "micro_steps": self.micro_steps,
                 "skipped_steps": self.skipped_steps,
+                # accumulation-window rebase after set_train_batch_size —
+                # without it a resumed resized engine misaligns boundaries
+                "gas_offset": getattr(self, "_gas_offset", 0),
             },
             "lr_scheduler": self.lr_scheduler.state_dict(),
             "client_state": client_state or {},
@@ -1341,6 +1344,7 @@ class DeepSpeedEngine:
         self.global_steps = int(c["global_steps"])
         self.global_samples = int(c["global_samples"])
         self.micro_steps = int(c["micro_steps"])
+        self._gas_offset = int(c.get("gas_offset", 0))
         # skipped count travels inside the device state (TrainState.skipped)
         if load_lr_scheduler_states and "lr_scheduler" in meta:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])

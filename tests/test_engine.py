@@ -396,3 +396,37 @@ def test_engine_accessors_set_lr_mom_batch():
         engine.set_train_batch_size(4 * dp)
     loss = engine(batch); engine.backward(loss); engine.step()
     assert engine.global_steps == steps_before + 1      # window of 2 closed
+
+
+def test_gas_offset_survives_checkpoint(tmp_path):
+    """A resized accumulation window stays aligned across save/load."""
+    from tests.simple_model import SimpleModel, random_batches
+    from deepspeed_tpu.parallel import groups
+
+    def build():
+        groups.reset()
+        model = SimpleModel()
+        batch = random_batches(1, 8)[0]
+        params = model.init(jax.random.PRNGKey(0), batch)["params"]
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+        return eng, batch
+
+    eng, batch = build()
+    for _ in range(3):   # 3 windows of gas=1
+        loss = eng(batch); eng.backward(loss); eng.step()
+    dp = eng.topology.data_parallel_size
+    eng.set_train_batch_size(2 * dp)          # rebase at micro_steps=3
+    loss = eng(batch); eng.backward(loss); eng.step()   # half-window
+    eng.save_checkpoint(str(tmp_path), tag="resized")
+
+    eng2, batch = build()
+    dp = eng2.topology.data_parallel_size
+    eng2.set_train_batch_size(2 * dp)         # same GAS as at save time
+    eng2.load_checkpoint(str(tmp_path), tag="resized")
+    assert eng2.micro_steps == 4 and eng2._gas_offset == 3
+    # next micro-step closes the 2-window that began before the save
+    assert eng2.is_gradient_accumulation_boundary()
