@@ -161,13 +161,10 @@ class LlamaAttention(nn.Module):
             # GQA k/v pass through un-repeated — both mha implementations
             # handle head grouping internally (flash kernel maps q head h to
             # kv head h // rep in its index maps; no rep× HBM traffic).
-            bias = None
-            if cfg.sliding_window:
-                # Mistral-style local window (sliding_window keys back)
-                pos = jnp.arange(T)
-                near = pos[:, None] - pos[None, :] < cfg.sliding_window
-                bias = jnp.where(near, 0.0, NEG_INF)[None, None]
-            out = mha(q, k, v, bias=bias, causal=True)
+            # Mistral-style sliding window goes through the kernel's window
+            # parameter (whole-block skipping, O(T·W)) — never a [T,T] bias.
+            out = mha(q, k, v, causal=True,
+                      window=cfg.sliding_window or None)
         out = out.reshape(B, T, H * Dh)
         return dense(D, "o_proj")(out)
 

@@ -25,8 +25,14 @@ NEG_INF = -1e9  # large finite; -inf breaks softmax rows that are fully masked
 _warned_shapes = set()
 
 
-def mha_reference(q, k, v, bias=None, causal=True, softmax_scale=None):
-    """Plain XLA attention. q [B,Tq,H,Dh]; k/v [B,Tk,KV,Dh] -> [B,Tq,H,Dh]."""
+def mha_reference(q, k, v, bias=None, causal=True, softmax_scale=None,
+                  window=None, segment_ids=None):
+    """Plain XLA attention. q [B,Tq,H,Dh]; k/v [B,Tk,KV,Dh] -> [B,Tq,H,Dh].
+
+    ``window``: Mistral-style sliding window — query i sees keys in
+    ``(i + off - window, i + off]`` where ``off = Tk - Tq``.
+    ``segment_ids``: ``(q_ids [B,Tq], kv_ids [B,Tk])`` or single [B,T] array;
+    cross-segment attention is masked (packed sequences)."""
     *_, H, Dh = q.shape
     KV = k.shape[2]
     if KV != H:
@@ -37,34 +43,60 @@ def mha_reference(q, k, v, bias=None, causal=True, softmax_scale=None):
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if bias is not None:
         logits = logits + bias
-    if causal:
-        Tq, Tk = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), Tk - Tq)
+    Tq, Tk = logits.shape[-2], logits.shape[-1]
+    off = Tk - Tq
+    if causal or window is not None:
+        qpos = jnp.arange(Tq)[:, None]
+        kpos = jnp.arange(Tk)[None, :]
+        mask = jnp.ones((Tq, Tk), dtype=bool)
+        if causal:
+            mask &= qpos + off >= kpos
+        if window is not None:
+            mask &= kpos > qpos + off - window
         logits = jnp.where(mask, logits, NEG_INF)
+    if segment_ids is not None:
+        if not isinstance(segment_ids, (tuple, list)):
+            segment_ids = (segment_ids, segment_ids)
+        q_seg, kv_seg = segment_ids
+        same = q_seg[:, None, :, None] == kv_seg[:, None, None, :]  # [B,1,Tq,Tk]
+        logits = jnp.where(same, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def mha(q, k, v, bias=None, causal=True, softmax_scale=None):
+def mha(q, k, v, bias=None, causal=True, softmax_scale=None, window=None,
+        segment_ids=None):
+    if window is not None and int(window) <= 0:
+        # invalid everywhere, not a kernel limitation — never "fall back"
+        raise ValueError(f"mha: sliding window must be positive or None, "
+                         f"got {window}")
     builder = FlashAttnBuilder()
     if builder.is_compatible():
         from deepspeed_tpu.ops.pallas import flash_attention as fa
+        if segment_ids is not None and not isinstance(segment_ids, (tuple, list)):
+            segment_ids = (segment_ids, segment_ids)
+        seg_shape = None if segment_ids is None else (segment_ids[0].shape,
+                                                      segment_ids[1].shape)
         reason = fa.unsupported_reason(q.shape, k.shape,
-                                       None if bias is None else bias.shape)
+                                       None if bias is None else bias.shape,
+                                       window, seg_shape)
         if reason is None:
             out = fa.flash_mha(q, k, v, bias=bias, causal=causal,
-                               softmax_scale=softmax_scale)
+                               softmax_scale=softmax_scale, window=window,
+                               segment_ids=segment_ids)
             # named so remat policies can choose to save attention outputs
             # (see activation_checkpointing "dots" policy) — recomputing the
             # flash kernel in backward doubles its cost for no memory win
             # beyond the [B,T,H,Dh] output itself
             return jax.ad_checkpoint.checkpoint_name(out, "flash_attn_out")
-        key = (q.shape, k.shape, None if bias is None else bias.shape)
+        key = (q.shape, k.shape, None if bias is None else bias.shape,
+               window, seg_shape)
         if key not in _warned_shapes:
             _warned_shapes.add(key)
             logger.warning(f"flash_attn: {reason}; using XLA fallback")
     return mha_reference(q, k, v, bias=bias, causal=causal,
-                         softmax_scale=softmax_scale)
+                         softmax_scale=softmax_scale, window=window,
+                         segment_ids=segment_ids)
 
 
 @register_op_builder

@@ -11,10 +11,15 @@ skipped entirely.
 
 Features: causal masking, additive bias (broadcast over batch/head dims),
 grouped-query attention (q heads share k/v heads in-kernel — no HBM-side
-``jnp.repeat``), softmax scale, custom VJP with flash backward kernels.
+``jnp.repeat``), softmax scale, sliding-window masking (Mistral-style local
+attention — blocks left of the window are skipped, mirroring the causal
+block-skip, so cost is O(T·W) not O(T²)), packed-sequence segment-id masking
+(cross-segment logits masked in-kernel — no [Tq,Tk] bias materialization),
+custom VJP with flash backward kernels.
 
 Layout: q [B, Tq, H, Dh], k/v [B, Tk, KV, Dh] with H % KV == 0; output
-[B, Tq, H, Dh] (same as ``ops.flash_attention.mha_reference``).
+[B, Tq, H, Dh] (same as ``ops.flash_attention.mha_reference``). Segment ids
+are int32 [B, Tq] / [B, Tk]; attention is masked where they differ.
 """
 
 import functools
@@ -26,7 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e9  # finite: -inf poisons fully-masked softmax rows
 
-LANES = 128  # TPU lane width; m/l scratch rows are broadcast across lanes
+LANES = 128     # TPU lane width; m/l scratch rows are broadcast across lanes
+SUBLANES = 8    # TPU sublane count; kv segment-id rows are sublane-replicated
 
 
 def _largest_divisor(n, candidates):
@@ -42,7 +48,8 @@ def _pick_blocks(tq, tk):
     return bq, bk
 
 
-def unsupported_reason(q_shape, k_shape, bias_shape=None):
+def unsupported_reason(q_shape, k_shape, bias_shape=None, window=None,
+                       segment_ids_shape=None):
     """None if the kernel can handle these shapes, else a human reason."""
     if len(q_shape) != 4 or len(k_shape) != 4:
         return f"expected 4D [B,T,H,Dh] tensors, got q={q_shape} k={k_shape}"
@@ -55,6 +62,8 @@ def unsupported_reason(q_shape, k_shape, bias_shape=None):
     bq, bk = _pick_blocks(tq, tk)
     if bq is None or bk is None:
         return f"seq lens (q={tq}, k={tk}) not multiples of 128"
+    if window is not None and int(window) <= 0:
+        return f"sliding window must be positive, got {window}"
     if bias_shape is not None:
         if len(bias_shape) != 4:
             return f"bias must be 4D [B|1, H|1, Tq, Tk], got {bias_shape}"
@@ -62,22 +71,106 @@ def unsupported_reason(q_shape, k_shape, bias_shape=None):
         if (btq, btk) != (tq, tk) or bb not in (1, B) or bh not in (1, H):
             return (f"bias {bias_shape} not broadcastable to "
                     f"[{B}|1, {H}|1, {tq}, {tk}]")
+    if segment_ids_shape is not None:
+        qs, ks = segment_ids_shape
+        if tuple(qs) != (B, tq) or tuple(ks) != (B, tk):
+            return (f"segment ids {qs}/{ks} must be [B={B}, Tq={tq}] and "
+                    f"[B={B}, Tk={tk}]")
     return None
 
 
-def is_supported(q_shape, k_shape, bias_shape=None):
+def is_supported(q_shape, k_shape, bias_shape=None, window=None,
+                 segment_ids_shape=None):
     """Whether the kernel can handle these shapes (else callers fall back)."""
-    return unsupported_reason(q_shape, k_shape, bias_shape) is None
+    return unsupported_reason(q_shape, k_shape, bias_shape, window,
+                              segment_ids_shape) is None
+
+
+# ---------------------------------------------------------------------------
+# shared masking helpers
+# ---------------------------------------------------------------------------
+
+def _block_visible(iq, ik, *, causal, window, bq, bk, off):
+    """Whether block (iq, ik) can contain any visible (query, key) pair.
+
+    Causal skips blocks fully above the diagonal; a sliding window also skips
+    blocks fully LEFT of the window (key j visible iff j > i + off - window),
+    making cost O(Tq·window/bk) blocks per row instead of O(Tk/bk)."""
+    run = (iq * bq + bq - 1 + off >= ik * bk) if causal else (ik >= 0)
+    if window is not None:
+        run = run & (ik * bk + bk - 1 + window > iq * bq + off)
+    return run
+
+
+def _mask_logits(s, iq, ik, qseg_ref, kseg_ref, *, causal, window, bq, bk, off):
+    """Apply causal / sliding-window / segment masking to a [bq, bk] logit
+    block. Position masks are built from iotas (no HBM mask tensors); segment
+    ids arrive lane-replicated (q: [bq, LANES]) and sublane-replicated
+    (kv: [SUBLANES, bk]) so the comparison lowers to cheap VPU broadcasts."""
+    mask = None
+    if qseg_ref is not None:
+        qs = jnp.tile(qseg_ref[0], (1, bk // LANES))       # [bq, bk]
+        ks = kseg_ref[0][:1, :]                            # [1, bk]
+        mask = qs == ks
+    if causal or window is not None:
+        qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        pm = None
+        if causal:
+            pm = qpos + off >= kpos
+        if window is not None:
+            wm = kpos > qpos + off - window
+            pm = wm if pm is None else pm & wm
+        mask = pm if mask is None else mask & pm
+    return s if mask is None else jnp.where(mask, s, NEG_INF)
+
+
+def _unpack_refs(refs, n_fixed, has_bias, has_seg):
+    """Split a kernel's positional refs into (fixed..., bias, qseg, kseg,
+    rest...) honoring the optional-input layout used by every kernel here."""
+    fixed = refs[:n_fixed]
+    i = n_fixed
+    bias_ref = refs[i] if has_bias else None
+    i += 1 if has_bias else 0
+    qseg_ref = refs[i] if has_seg else None
+    kseg_ref = refs[i + 1] if has_seg else None
+    i += 2 if has_seg else 0
+    return fixed, bias_ref, qseg_ref, kseg_ref, refs[i:]
+
+
+def _seg_inputs(segment_ids, B, tq, tk):
+    """Replicate [B,T] segment ids into Mosaic-friendly layouts: q ids across
+    LANES (minor), kv ids across SUBLANES (second minor)."""
+    q_seg, kv_seg = segment_ids
+    q_rep = jnp.broadcast_to(q_seg.astype(jnp.int32)[:, :, None],
+                             (B, tq, LANES))
+    kv_rep = jnp.broadcast_to(kv_seg.astype(jnp.int32)[:, None, :],
+                              (B, SUBLANES, tk))
+    return q_rep, kv_rep
+
+
+def _seg_specs(bq, bk, order="qk"):
+    def qindex(b, h, i, j):
+        iq = i if order == "qk" else j
+        return (b, iq, 0)
+
+    def kindex(b, h, i, j):
+        ik = j if order == "qk" else i
+        return (b, 0, ik)
+
+    return (pl.BlockSpec((1, bq, LANES), qindex),
+            pl.BlockSpec((1, SUBLANES, bk), kindex))
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, causal, scale, bq, bk, nk, off):
-    # ``off = Tk - Tq``: causal masking is bottom-right aligned (query i sees
-    # keys j <= i + off), matching mha_reference's tril offset for Tq != Tk.
+def _fwd_kernel(*refs, causal, scale, window, bq, bk, nk, off,
+                has_bias, has_seg):
+    (q_ref, k_ref, v_ref), bias_ref, qseg_ref, kseg_ref, rest = _unpack_refs(
+        refs, 3, has_bias, has_seg)
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -86,8 +179,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # whole block above the causal diagonal -> nothing visible, skip
-    should_run = (iq * bq + bq - 1 + off >= ik * bk) if causal else (ik >= 0)
+    should_run = _block_visible(iq, ik, causal=causal, window=window,
+                                bq=bq, bk=bk, off=off)
 
     @pl.when(should_run)
     def _body():
@@ -98,10 +191,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         s = s * scale                                     # [bq, bk]
         if bias_ref is not None:
             s = s + bias_ref[0, 0].astype(jnp.float32)
-        if causal:
-            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
+        s = _mask_logits(s, iq, ik, qseg_ref, kseg_ref, causal=causal,
+                         window=window, bq=bq, bk=bk, off=off)
 
         m_prev = m_scr[:, :1]                             # [bq, 1]
         l_prev = l_scr[:, :1]
@@ -129,17 +220,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _bias_spec(bias, bq, bk, H):
+def _bias_spec(bias, bq, bk, order="qk"):
     """BlockSpec for a [1|B, 1|H, Tq, Tk] additive bias."""
     bb, bh = bias.shape[0], bias.shape[1]
 
-    def index(b, h, iq, ik):
+    def index(b, h, i, j):
+        iq, ik = (i, j) if order == "qk" else (j, i)
         return (b if bb > 1 else 0, h if bh > 1 else 0, iq, ik)
 
     return pl.BlockSpec((1, 1, bq, bk), index)
 
 
-def _fwd(q, k, v, bias, causal, scale, interpret):
+def _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret):
     B, tq, H, dh = q.shape
     _, tk, KV, _ = k.shape
     rep = H // KV
@@ -151,8 +243,10 @@ def _fwd(q, k, v, bias, causal, scale, interpret):
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
 
-    body = functools.partial(_fwd_kernel, causal=causal, scale=scale,
-                             bq=bq, bk=bk, nk=nk, off=tk - tq)
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               window=window, bq=bq, bk=bk, nk=nk, off=tk - tq,
+                               has_bias=bias is not None,
+                               has_seg=segment_ids is not None)
     in_specs = [
         pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0)),
         pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0)),
@@ -160,12 +254,12 @@ def _fwd(q, k, v, bias, causal, scale, interpret):
     ]
     args = [qt, kt, vt]
     if bias is not None:
-        in_specs.append(_bias_spec(bias, bq, bk, H))
+        in_specs.append(_bias_spec(bias, bq, bk))
         args.append(bias)
-        kernel = body
-    else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m, l, acc):
-            body(q_ref, k_ref, v_ref, None, o_ref, lse_ref, m, l, acc)
+    if segment_ids is not None:
+        qs, ks = _seg_specs(bq, bk)
+        in_specs += [qs, ks]
+        args += list(_seg_inputs(segment_ids, B, tq, tk))
 
     out, lse = pl.pallas_call(
         kernel,
@@ -195,15 +289,19 @@ def _fwd(q, k, v, bias, causal, scale, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-                   dq_ref, dq_scr, *, causal, scale, bq, bk, nk, off):
+def _bwd_dq_kernel(*refs, causal, scale, window, bq, bk, nk, off,
+                   has_bias, has_seg):
+    ((q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), bias_ref, qseg_ref,
+     kseg_ref, rest) = _unpack_refs(refs, 6, has_bias, has_seg)
+    dq_ref, dq_scr = rest
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    should_run = (iq * bq + bq - 1 + off >= ik * bk) if causal else (ik >= 0)
+    should_run = _block_visible(iq, ik, causal=causal, window=window,
+                                bq=bq, bk=bk, off=off)
 
     @pl.when(should_run)
     def _body():
@@ -213,10 +311,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
                                 preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
             s = s + bias_ref[0, 0].astype(jnp.float32)
-        if causal:
-            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
+        s = _mask_logits(s, iq, ik, qseg_ref, kseg_ref, causal=causal,
+                         window=window, bq=bq, bk=bk, off=off)
         lse = lse_ref[0, 0][:, :1]                        # [bq, 1] (lane-replicated)
         p = jnp.exp(s - lse)                              # [bq, bk]
         do = do_ref[0, 0].astype(jnp.float32)             # [bq, dh]
@@ -234,8 +330,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale, bq, bk, nq, off):
+def _bwd_dkv_kernel(*refs, causal, scale, window, bq, bk, nq, off,
+                    has_bias, has_seg):
+    ((q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), bias_ref, qseg_ref,
+     kseg_ref, rest) = _unpack_refs(refs, 6, has_bias, has_seg)
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
     ik, iq = pl.program_id(2), pl.program_id(3)
 
     @pl.when(iq == 0)
@@ -243,7 +342,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    should_run = (iq * bq + bq - 1 + off >= ik * bk) if causal else (iq >= 0)
+    should_run = _block_visible(iq, ik, causal=causal, window=window,
+                                bq=bq, bk=bk, off=off)
 
     @pl.when(should_run)
     def _body():
@@ -253,10 +353,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
                                 preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
             s = s + bias_ref[0, 0].astype(jnp.float32)
-        if causal:
-            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
+        s = _mask_logits(s, iq, ik, qseg_ref, kseg_ref, causal=causal,
+                         window=window, bq=bq, bk=bk, off=off)
         lse = lse_ref[0, 0][:, :1]
         p = jnp.exp(s - lse)                              # [bq, bk]
         do = do_ref[0, 0].astype(jnp.float32)
@@ -279,8 +377,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, interpret, res, g):
-    q, k, v, bias, out, lse = res
+def _bwd(causal, scale, window, interpret, res, g):
+    q, k, v, bias, segment_ids, out, lse = res
     B, tq, H, dh = q.shape
     _, tk, KV, _ = k.shape
     rep = H // KV
@@ -300,36 +398,33 @@ def _bwd(causal, scale, interpret, res, g):
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
     lse = jnp.broadcast_to(lse[..., None], (*lse.shape, LANES))
 
+    seg_args = None if segment_ids is None else _seg_inputs(segment_ids, B, tq, tk)
+
     qspec = pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0))
     kspec = pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0))
     dospec = qspec
     lspec = pl.BlockSpec((1, 1, bq, LANES), lambda b, h, iq, ik: (b, h, iq, 0))
     common = [qt, kt, vt, dot, lse, delta]
 
-    def specs_with_bias(base, order):
+    def specs_with_extras(base, order):
         sp = list(base)
         args = list(common)
         if bias is not None:
-            bb, bh = bias.shape[0], bias.shape[1]
-
-            def index(b, h, i, j):
-                iq, ik = (i, j) if order == "qk" else (j, i)
-                return (b if bb > 1 else 0, h if bh > 1 else 0, iq, ik)
-
-            sp.append(pl.BlockSpec((1, 1, bq, bk), index))
+            sp.append(_bias_spec(bias, bq, bk, order))
             args.append(bias)
+        if seg_args is not None:
+            qs, ks = _seg_specs(bq, bk, order)
+            sp += [qs, ks]
+            args += list(seg_args)
         return sp, args
 
     # dQ: grid (B, H, nq, nk), k innermost
-    dq_specs, dq_args = specs_with_bias([qspec, kspec, kspec, dospec, lspec, lspec], "qk")
-    dq_body = functools.partial(
-        _bwd_dq_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk,
-        off=tk - tq)
-    if bias is None:
-        def dq_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, dq_r, scr):
-            dq_body(q_r, k_r, v_r, do_r, lse_r, dl_r, None, dq_r, scr)
-    else:
-        dq_kernel = dq_body
+    dq_specs, dq_args = specs_with_extras(
+        [qspec, kspec, kspec, dospec, lspec, lspec], "qk")
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, causal=causal, scale=scale, window=window,
+        bq=bq, bk=bk, nk=nk, off=tk - tq,
+        has_bias=bias is not None, has_seg=seg_args is not None)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B, H, nq, nk),
@@ -345,16 +440,12 @@ def _bwd(causal, scale, interpret, res, g):
     kspec2 = pl.BlockSpec((1, 1, bk, dh), lambda b, h, ik, iq: (b, h // rep, ik, 0))
     qspec2 = pl.BlockSpec((1, 1, bq, dh), lambda b, h, ik, iq: (b, h, iq, 0))
     lspec2 = pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ik, iq: (b, h, iq, 0))
-    dkv_specs, dkv_args = specs_with_bias(
+    dkv_specs, dkv_args = specs_with_extras(
         [qspec2, kspec2, kspec2, qspec2, lspec2, lspec2], "kq")
-    dkv_body = functools.partial(
-        _bwd_dkv_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nq=nq,
-        off=tk - tq)
-    if bias is None:
-        def dkv_kernel(q_r, k_r, v_r, do_r, lse_r, dl_r, dk_r, dv_r, dks, dvs):
-            dkv_body(q_r, k_r, v_r, do_r, lse_r, dl_r, None, dk_r, dv_r, dks, dvs)
-    else:
-        dkv_kernel = dkv_body
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, causal=causal, scale=scale, window=window,
+        bq=bq, bk=bk, nq=nq, off=tk - tq,
+        has_bias=bias is not None, has_seg=seg_args is not None)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B, H, nk, nq),
@@ -382,39 +473,53 @@ def _bwd(causal, scale, interpret, res, g):
     dk = dk.transpose(0, 2, 1, 3)
     dv = dv.transpose(0, 2, 1, 3)
     dbias = None if bias is None else jnp.zeros_like(bias)
-    return dq, dk, dv, dbias
+    return dq, dk, dv, dbias, None
 
 
 # ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, bias, causal, scale, interpret):
-    out, _ = _fwd(q, k, v, bias, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, bias, segment_ids, causal, scale, window, interpret):
+    out, _ = _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, bias, causal, scale, interpret):
-    out, lse = _fwd(q, k, v, bias, causal, scale, interpret)
-    return out, (q, k, v, bias, out, lse)
+def _flash_fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret):
+    out, lse = _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret)
+    return out, (q, k, v, bias, segment_ids, out, lse)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_mha(q, k, v, bias=None, causal=True, softmax_scale=None,
-              interpret=False):
+              window=None, segment_ids=None, interpret=False):
     """Flash attention. q [B,Tq,H,Dh]; k/v [B,Tk,KV,Dh], H % KV == 0.
+
+    ``window``: sliding-window size (query i sees keys in
+    ``(i + off - window, i + off]``, matching Mistral's local attention) —
+    enforced in-kernel with whole-block skipping, never via a [Tq,Tk] bias.
+    ``segment_ids``: int32 ``(q_ids [B,Tq], kv_ids [B,Tk])`` tuple or a single
+    [B,T] array when Tq == Tk; positions in different segments do not attend
+    (packed-sequence pretraining).
 
     Raises ValueError on unsupported shapes — callers (the op registry) are
     expected to gate on :func:`is_supported` and fall back to the XLA path.
     The additive ``bias`` is treated as a constant (zero cotangent): every
     in-tree caller passes masks built from positions, never learned tensors.
     """
-    if not is_supported(q.shape, k.shape, None if bias is None else bias.shape):
-        raise ValueError(
-            f"flash_mha: unsupported shapes q={q.shape} k={k.shape} "
-            f"bias={None if bias is None else bias.shape}")
+    if segment_ids is not None and not isinstance(segment_ids, (tuple, list)):
+        segment_ids = (segment_ids, segment_ids)
+    seg_shape = None if segment_ids is None else (segment_ids[0].shape,
+                                                  segment_ids[1].shape)
+    reason = unsupported_reason(q.shape, k.shape,
+                                None if bias is None else bias.shape,
+                                window, seg_shape)
+    if reason is not None:
+        raise ValueError(f"flash_mha: {reason}")
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    return _flash(q, k, v, bias, causal, float(scale), interpret)
+    window = None if window is None else int(window)
+    seg = None if segment_ids is None else tuple(segment_ids)
+    return _flash(q, k, v, bias, seg, causal, float(scale), window, interpret)

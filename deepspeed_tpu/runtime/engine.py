@@ -427,7 +427,9 @@ class DeepSpeedEngine:
             grad_acc = tree_zeros_like(params_f32, self.grad_accum_dtype)
             grad_acc = jax.tree.map(jax.device_put, grad_acc, grad_sh)
 
-        self._shardings = dict(params=param_sh, master=master_sh, grad=grad_sh, opt=opt_sh)
+        self._shardings = dict(params=param_sh, master=master_sh, grad=grad_sh,
+                               opt=opt_sh,
+                               use=self.partitioner.use_sharding(params_f32))
         rep = self.topology.replicated()
         scale = init_loss_scale_state(self.config.fp16) if self.fp16_enabled \
             else LossScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(0))
@@ -517,7 +519,8 @@ class DeepSpeedEngine:
         grad_acc = tree_zeros_like(params_f32, self.grad_accum_dtype)
         grad_acc = jax.tree.map(jax.device_put, grad_acc, grad_sh)
         self._shardings = dict(params=param_sh, master=self._master_sh_d,
-                               grad=grad_sh, opt=opt_sh)
+                               grad=grad_sh, opt=opt_sh,
+                               use=self.partitioner.use_sharding(params_f32))
 
         scale = init_loss_scale_state(self.config.fp16) if self.fp16_enabled \
             else LossScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(0))
@@ -626,9 +629,17 @@ class DeepSpeedEngine:
         dq = self._dequantize_working if getattr(self, "quantized_weights", False) \
             else (lambda p: p)
         ptx = self._param_transform
+        # ZeRO-3: params are STORED sharded over the zero axes but USED
+        # gathered (model-parallel specs only) — the constraint makes GSPMD
+        # emit the per-use all-gather and keeps the storage sharding out of
+        # the activation sharding inference (partition.py use_sharding).
+        use_sh = self._shardings.get("use") \
+            if self.zero_optimization_stage() >= 3 else None
 
         def make_loss_fn(batch, sub, loss_scale, global_step):
             def loss_fn(p):
+                if use_sh is not None:
+                    p = constrain_tree(p, use_sh)
                 if ptx is not None:
                     # compression transform inside the grad: QAT quant uses
                     # STE, pruning masks the gradient (compression/compress.py)
@@ -784,8 +795,13 @@ class DeepSpeedEngine:
             else (lambda p: p)
         ptx = self._param_transform
 
+        use_sh = self._shardings.get("use") \
+            if self.zero_optimization_stage() >= 3 else None
+
         def eval_step(state: TrainState, batch):
             p = dq(state.params)
+            if use_sh is not None:
+                p = constrain_tree(p, use_sh)
             if ptx is not None:
                 p = ptx(p, state.global_step)
             out = model_fn(p, batch, None, False)

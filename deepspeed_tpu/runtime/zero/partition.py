@@ -113,6 +113,17 @@ class ZeroPartitioner:
             spec = self._base_specs(params)
         return self._to_sharding(spec)
 
+    def use_sharding(self, params):
+        """Sharding at *use* sites inside the jitted step: model-parallel specs
+        only, ZeRO axes gathered. Constraining params to this tree before
+        ``model.apply`` is the GSPMD form of stage 3's per-use parameter
+        all-gather (reference ``zero/partitioned_param_coordinator.py`` fetch):
+        XLA inserts the all-gather at the use and — crucially — stops the
+        *storage* sharding (hidden dim split over dp/sp) from propagating into
+        activation shardings, which otherwise forces involuntary full
+        rematerialization at sharding transitions."""
+        return self._to_sharding(self._base_specs(params))
+
     def master_sharding(self, params):
         """fp32 master + optimizer moments: sharded from stage 1 up. Persistence
         threshold does NOT apply (the reference shards all optimizer state)."""

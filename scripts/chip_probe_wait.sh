@@ -1,0 +1,23 @@
+#!/bin/bash
+# Probe the TPU backend until it answers, then exit 0 (caller reacts).
+# Logs every probe to onchip_results/watcher.log. Exits 1 at deadline.
+# Usage: chip_probe_wait.sh [interval_seconds] [max_seconds]
+INTERVAL=${1:-240}
+MAXSEC=${2:-39600}
+LOG=/root/repo/onchip_results/watcher.log
+mkdir -p /root/repo/onchip_results
+START=$(date +%s)
+echo "probe-wait start $(date) interval=${INTERVAL}s max=${MAXSEC}s" >> "$LOG"
+while :; do
+  if timeout 90 python -c "import jax; d=jax.devices(); print(d)" >/dev/null 2>&1; then
+    echo "CHIP BACK $(date)" >> "$LOG"
+    exit 0
+  fi
+  echo "probe: still wedged $(date)" >> "$LOG"
+  NOW=$(date +%s)
+  if [ $((NOW - START)) -ge "$MAXSEC" ]; then
+    echo "probe-wait deadline $(date)" >> "$LOG"
+    exit 1
+  fi
+  sleep "$INTERVAL"
+done
