@@ -27,12 +27,42 @@ def _one_hot(idx, num):
     return jax.nn.one_hot(idx, num, dtype=jnp.float32)
 
 
-def top1gating(logits, capacity_factor=1.0, min_capacity=4, noisy_gate_policy=None,
-               rng=None, used_token_mask=None, drop_tokens=True):
-    """Top-1 gating (reference ``sharded_moe.py:181``).
+def _token_sharding():
+    """NamedSharding for a [tokens, features] matrix over the flattened data
+    axes, or None outside an initialized process-group topology."""
+    from deepspeed_tpu.parallel import groups
+    topo = groups._TOPOLOGY
+    if topo is None:
+        return None
+    return topo.sharding(("dpr", "dp", "ep", "sp"), None)
 
-    logits: [S, E]. Returns (l_aux, combine [S,E,C], dispatch [S,E,C], exp_counts [E]).
+
+@dataclasses.dataclass
+class RoutingPlan:
+    """Index-form routing decision — the single source of gating truth.
+
+    The dense [S, E, C] combine/dispatch tensors of the GShard formulation and
+    the routed gather/scatter dispatch (reference CUTLASS
+    ``moe_scatter``/``moe_gather`` + grouped GEMM,
+    ``inference/v2/kernels/ragged_ops/moe_scatter``) are both derived from
+    this, so the two MOELayer dispatch modes can never diverge numerically.
+
+    experts/pos/gates: [S, k] — choice j of token s goes to slot
+    ``(experts[s,j], pos[s,j])`` weighted ``gates[s,j]`` (0 when dropped).
     """
+    l_aux: Any
+    experts: Any      # [S, k] int32
+    pos: Any          # [S, k] int32 (position in the expert's capacity queue)
+    gates: Any        # [S, k] float32, 0 for dropped choices
+    exp_counts: Any   # [E] pre-drop routing counts
+    capacity: int
+    num_experts: int
+
+
+def top1_routing(logits, capacity_factor=1.0, min_capacity=4,
+                 noisy_gate_policy=None, rng=None, used_token_mask=None,
+                 drop_tokens=True):
+    """Top-1 routing (reference ``sharded_moe.py:181``) in index form."""
     S, E = logits.shape
     capacity = _capacity(S, E, 1, capacity_factor, min_capacity, drop_tokens)
 
@@ -56,34 +86,31 @@ def top1gating(logits, capacity_factor=1.0, min_capacity=4, noisy_gate_policy=No
     ce = jnp.mean(mask1, axis=0)
     l_aux = jnp.sum(me * ce) * E
 
-    gate_val = jnp.sum(gates * mask1_kept, axis=-1, keepdims=True)  # [S,1]
-    pos = jnp.sum((pos_in_expert - 1) * mask1_kept, axis=-1).astype(jnp.int32)  # [S]
-    pos_oh = _one_hot(pos, capacity) * jnp.sum(mask1_kept, axis=-1, keepdims=True)
-    combine = gate_val[:, :, None] * mask1_kept[:, :, None] * pos_oh[:, None, :]
-    dispatch = combine > 0
+    gate_val = jnp.sum(gates * mask1_kept, axis=-1)  # [S], 0 when dropped
+    pos = jnp.sum((pos_in_expert - 1) * mask1_kept, axis=-1).astype(jnp.int32)
     # reference returns PRE-drop routing counts (sharded_moe.py:209) so router
     # imbalance/overflow stays observable
     exp_counts = jnp.sum(mask1, axis=0)
-    return l_aux, combine, dispatch, exp_counts
+    return RoutingPlan(l_aux, idx[:, None], pos[:, None], gate_val[:, None],
+                       exp_counts, capacity, E)
 
 
-def topkgating(logits, k=2, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
-               normalize_gates=True):
-    """Top-k gating (reference top2gating ``sharded_moe.py:288`` generalized to k).
-
-    logits: [S, E]. Returns (l_aux, combine [S,E,C], dispatch [S,E,C], exp_counts).
-    """
+def topk_routing(logits, k=2, capacity_factor=1.0, min_capacity=4,
+                 drop_tokens=True, normalize_gates=True):
+    """Top-k routing (reference top2gating ``sharded_moe.py:288`` generalized
+    to k) in index form."""
     S, E = logits.shape
     capacity = _capacity(S, E, k, capacity_factor, min_capacity, drop_tokens)
     gates = jax.nn.softmax(logits, axis=-1)
 
     # iterative top-k with masking (static k)
-    masks = []
+    masks, idxs = [], []
     g = gates
     for _ in range(k):
         idx = jnp.argmax(g, axis=-1)
         m = _one_hot(idx, E)
         masks.append(m)
+        idxs.append(idx)
         g = g * (1 - m)
     # aux loss on first choice (reference top2gating)
     me = jnp.mean(gates, axis=0)
@@ -92,27 +119,57 @@ def topkgating(logits, k=2, capacity_factor=1.0, min_capacity=4, drop_tokens=Tru
 
     # queue positions: ranks within each expert across all k choices, first
     # choices first (matches reference ordering: locations2 += sum(mask1))
-    combined = jnp.zeros((S, E, capacity), jnp.float32)
     offset = jnp.zeros((E,), jnp.float32)
-    total_mask = jnp.zeros((S, E), jnp.float32)
+    pos_cols, gate_cols = [], []
     for m in masks:
         pos = (jnp.cumsum(m, axis=0) - 1) * m + offset[None, :] * m  # 0-based
         keep = (pos < capacity) & (m > 0)
         mk = m * keep.astype(m.dtype)
-        gate_val = jnp.sum(gates * mk, axis=-1, keepdims=True)  # [S,1]
-        pos_idx = jnp.sum(pos * mk, axis=-1).astype(jnp.int32)
-        pos_oh = _one_hot(pos_idx, capacity) * jnp.sum(mk, axis=-1, keepdims=True)
-        combined = combined + gate_val[:, :, None] * mk[:, :, None] * pos_oh[:, None, :]
+        gate_cols.append(jnp.sum(gates * mk, axis=-1))           # [S]
+        pos_cols.append(jnp.sum(pos * mk, axis=-1).astype(jnp.int32))
         offset = offset + jnp.sum(m, axis=0)
-        total_mask = total_mask + mk
+    gates_sk = jnp.stack(gate_cols, axis=1)                      # [S, k]
     if normalize_gates:
-        denom = jnp.sum(combined, axis=(1, 2), keepdims=True)
-        combined = combined / jnp.maximum(denom, 1e-9)
-        # restore absolute gate mass (reference normalizes by sum of selected gates)
-    dispatch = combined > 0
-    # pre-drop routing counts (see top1gating note)
-    exp_counts = jnp.sum(sum(masks), axis=0)
-    return l_aux, combined, dispatch, exp_counts
+        # reference normalizes by the sum of the SELECTED (kept) gate mass
+        denom = jnp.sum(gates_sk, axis=1, keepdims=True)
+        gates_sk = gates_sk / jnp.maximum(denom, 1e-9)
+    exp_counts = jnp.sum(sum(masks), axis=0)  # pre-drop (see top1 note)
+    return RoutingPlan(l_aux, jnp.stack(idxs, axis=1),
+                       jnp.stack(pos_cols, axis=1), gates_sk,
+                       exp_counts, capacity, E)
+
+
+def _densify(plan: RoutingPlan, S):
+    """[S,E,C] combine/dispatch from a RoutingPlan (GShard einsum form)."""
+    C, E = plan.capacity, plan.num_experts
+    s_idx = jnp.broadcast_to(jnp.arange(S)[:, None], plan.experts.shape)
+    combine = jnp.zeros((S, E, C), jnp.float32).at[
+        s_idx, plan.experts, jnp.minimum(plan.pos, C - 1)].add(plan.gates)
+    return combine, combine > 0
+
+
+def top1gating(logits, capacity_factor=1.0, min_capacity=4, noisy_gate_policy=None,
+               rng=None, used_token_mask=None, drop_tokens=True):
+    """Top-1 gating (reference ``sharded_moe.py:181``).
+
+    logits: [S, E]. Returns (l_aux, combine [S,E,C], dispatch [S,E,C], exp_counts [E]).
+    """
+    plan = top1_routing(logits, capacity_factor, min_capacity, noisy_gate_policy,
+                        rng, used_token_mask, drop_tokens)
+    combine, dispatch = _densify(plan, logits.shape[0])
+    return plan.l_aux, combine, dispatch, plan.exp_counts
+
+
+def topkgating(logits, k=2, capacity_factor=1.0, min_capacity=4, drop_tokens=True,
+               normalize_gates=True):
+    """Top-k gating (reference top2gating ``sharded_moe.py:288`` generalized to k).
+
+    logits: [S, E]. Returns (l_aux, combine [S,E,C], dispatch [S,E,C], exp_counts).
+    """
+    plan = topk_routing(logits, k, capacity_factor, min_capacity, drop_tokens,
+                        normalize_gates)
+    combine, dispatch = _densify(plan, logits.shape[0])
+    return plan.l_aux, combine, dispatch, plan.exp_counts
 
 
 def _capacity(S, E, k, capacity_factor, min_capacity, drop_tokens):
@@ -136,19 +193,31 @@ class TopKGate(nn.Module):
     drop_tokens: bool = True
 
     @nn.compact
-    def __call__(self, x, train=True):
+    def __call__(self, x, train=True, as_plan=False):
         # router in fp32 (reference casts gate input to fp32)
         wg = self.param("wg", nn.initializers.normal(0.02),
                         (x.shape[-1], self.num_experts), jnp.float32)
         logits = x.astype(jnp.float32) @ wg
+        # pin logits to the token layout: without it, ZeRO's wg-grad sharding
+        # back-propagates through d(wg) = x^T @ d(logits) into the token
+        # matrix and GSPMD full-replicates it (spmd_partitioner b/433785288)
+        token_sh = _token_sharding()
+        if token_sh is not None:
+            logits = jax.lax.with_sharding_constraint(logits, token_sh)
         cf = self.capacity_factor if train else self.eval_capacity_factor
         rng = self.make_rng("gating") if (train and self.noisy_gate_policy == "RSample"
                                           and self.has_rng("gating")) else None
         if self.k == 1:
-            return top1gating(logits, cf, self.min_capacity, self.noisy_gate_policy,
-                              rng=rng, drop_tokens=self.drop_tokens)
-        return topkgating(logits, self.k, cf, self.min_capacity,
-                          drop_tokens=self.drop_tokens)
+            plan = top1_routing(logits, cf, self.min_capacity,
+                                self.noisy_gate_policy, rng=rng,
+                                drop_tokens=self.drop_tokens)
+        else:
+            plan = topk_routing(logits, self.k, cf, self.min_capacity,
+                                drop_tokens=self.drop_tokens)
+        if as_plan:
+            return plan
+        combine, dispatch = _densify(plan, logits.shape[0])
+        return plan.l_aux, combine, dispatch, plan.exp_counts
 
 
 class Experts(nn.Module):
@@ -172,7 +241,18 @@ class Experts(nn.Module):
 
 class MOELayer(nn.Module):
     """reference ``sharded_moe.py:455`` MOELayer: gate → dispatch(all-to-all) →
-    experts → combine(all-to-all). Returns (output, l_aux, exp_counts)."""
+    experts → combine(all-to-all). Returns (output, l_aux, exp_counts).
+
+    ``dispatch_mode``:
+      "indices" (default) — routed dispatch: tokens are scattered into each
+        expert's [C, D] bin by routing indices and gathered back weighted by
+        their gates (the reference's moe_scatter / grouped GEMM / moe_gather
+        pipeline, ``inference/v2/kernels/ragged_ops``, as a *training* path).
+        O(E·C·D + S·k·D) memory traffic.
+      "einsum" — the GShard [S,E,C] one-hot einsum formulation; O(S·E·C·D)
+        MXU/HBM work. Kept as the numerics oracle; both modes consume the same
+        RoutingPlan so they agree to float tolerance.
+    """
     expert_factory: Callable[[], nn.Module]
     num_experts: int
     k: int = 1
@@ -181,19 +261,90 @@ class MOELayer(nn.Module):
     min_capacity: int = 4
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
+    dispatch_mode: str = "indices"
 
     @nn.compact
     def __call__(self, x, train=True):
+        if self.dispatch_mode not in ("indices", "einsum"):
+            raise ValueError(f"MOELayer dispatch_mode must be 'indices' or "
+                             f"'einsum', got {self.dispatch_mode!r}")
         orig_shape = x.shape
         D = x.shape[-1]
         xf = x.reshape(-1, D)  # [S, D] tokens sharded over data axes
-        l_aux, combine, dispatch, exp_counts = TopKGate(
+        S = xf.shape[0]
+        plan = TopKGate(
             self.num_experts, self.k, self.capacity_factor, self.eval_capacity_factor,
             self.min_capacity, self.noisy_gate_policy, self.drop_tokens,
-            name="gate")(xf, train)
-        # dispatch einsum == all-to-all when E is ep-sharded and S is dp-sharded
-        expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(xf.dtype), xf)
+            name="gate")(xf, train, as_plan=True)
+        E, C = plan.num_experts, plan.capacity
+
+        if self.dispatch_mode == "einsum":
+            combine, dispatch = _densify(plan, S)
+            # dispatch einsum == all-to-all when E is ep-sharded, S dp-sharded
+            expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(xf.dtype), xf)
+            expert_out = Experts(self.expert_factory, self.num_experts,
+                                 name="experts")(expert_in)
+            out = jnp.einsum("sec,ecd->sd", combine.astype(expert_out.dtype),
+                             expert_out)
+            return out.reshape(orig_shape), plan.l_aux, plan.exp_counts
+
+        # routed dispatch (moe_scatter): slot (e, c) <- token index, built by
+        # scatter over the kept choices; empty slots read token 0 and are
+        # zeroed by the validity mask (the einsum path's implicit zeros)
+        kept = plan.gates > 0                                    # [S, k]
+        pos_c = jnp.minimum(plan.pos, C - 1)
+        flat_slot = plan.experts * C + pos_c                     # [S, k]
+        token_of = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None],
+                                    flat_slot.shape)
+        slot_token = jnp.zeros((E * C,), jnp.int32).at[
+            jnp.where(kept, flat_slot, E * C)].set(token_of, mode="drop")
+        slot_valid = jnp.zeros((E * C,), jnp.bool_).at[
+            jnp.where(kept, flat_slot, E * C)].set(True, mode="drop")
+        expert_in = jnp.take(xf, slot_token, axis=0).reshape(E, C, D)
+        expert_in = expert_in * slot_valid.reshape(E, C, 1).astype(xf.dtype)
+        # Pin the dispatch boundary: the gather output lives on the expert
+        # (ep) layout, tokens on the data layout. Without the pin, the expert
+        # weights' tp spec back-propagates THROUGH the gather into the token
+        # matrix and GSPMD falls back to full replication (the same
+        # involuntary-rematerialization failure as the ZeRO-3 use-sharding
+        # case, engine.py _build_micro_step). The token->expert transition
+        # then lowers to the dispatch all-to-all, as in the reference
+        # (deepspeed/moe/sharded_moe.py _AllToAll).
+        token_sh, expert_sh = self._dispatch_shardings()
+        if expert_sh is not None:
+            expert_in = jax.lax.with_sharding_constraint(expert_in, expert_sh)
+
         expert_out = Experts(self.expert_factory, self.num_experts,
                              name="experts")(expert_in)
-        out = jnp.einsum("sec,ecd->sd", combine.astype(expert_out.dtype), expert_out)
-        return out.reshape(orig_shape), l_aux, exp_counts
+        if expert_sh is not None:
+            expert_out = jax.lax.with_sharding_constraint(expert_out, expert_sh)
+
+        # combine (moe_gather): each token reads its k slots, gate-weighted.
+        # One [S, D] gather per choice (k is tiny and static) — keeping every
+        # intermediate in the token layout lets GSPMD propagate the batch
+        # sharding cleanly (a fused [S*k, D] gather+reshape made the partitioner
+        # fall back to full replication at the reshape).
+        flat_out = expert_out.reshape(E * C, -1)
+        out = None
+        for j in range(self.k):
+            yj = jnp.take(flat_out, flat_slot[:, j], axis=0)  # [S, Dout]
+            if token_sh is not None:
+                yj = jax.lax.with_sharding_constraint(yj, token_sh)
+            term = yj.astype(jnp.float32) * plan.gates[:, j, None]
+            out = term if out is None else out + term
+        return (out.astype(x.dtype).reshape(orig_shape), plan.l_aux,
+                plan.exp_counts)
+
+    def _dispatch_shardings(self):
+        """(token [S,D], expert [E,C,D]) NamedShardings from the process-group
+        topology, or (None, None) outside an initialized mesh. Tokens ride the
+        flattened data axes; expert bins ride 'ep' (reference expert-parallel
+        group, ``deepspeed/utils/groups.py _get_expert_parallel_group``)."""
+        from deepspeed_tpu.parallel import groups
+        topo = groups._TOPOLOGY
+        token = _token_sharding()
+        if topo is None:
+            return None, None
+        expert = topo.sharding("ep" if self.num_experts % topo.ep_size == 0
+                               and topo.ep_size > 1 else None, None, None)
+        return token, expert

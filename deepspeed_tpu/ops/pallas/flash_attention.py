@@ -12,8 +12,10 @@ skipped entirely.
 Features: causal masking, additive bias (broadcast over batch/head dims),
 grouped-query attention (q heads share k/v heads in-kernel — no HBM-side
 ``jnp.repeat``), softmax scale, sliding-window masking (Mistral-style local
-attention — blocks left of the window are skipped, mirroring the causal
-block-skip, so cost is O(T·W) not O(T²)), packed-sequence segment-id masking
+attention — blocks left of the window are skipped like the causal block-skip,
+with their K/V block indices clamped onto the visible range so Mosaic elides
+the DMAs too: both MXU time and HBM traffic are O(T·W), not O(T²)),
+packed-sequence segment-id masking
 (cross-segment logits masked in-kernel — no [Tq,Tk] bias materialization),
 custom VJP with flash backward kernels.
 
@@ -95,11 +97,48 @@ def _block_visible(iq, ik, *, causal, window, bq, bk, off):
 
     Causal skips blocks fully above the diagonal; a sliding window also skips
     blocks fully LEFT of the window (key j visible iff j > i + off - window),
-    making cost O(Tq·window/bk) blocks per row instead of O(Tk/bk)."""
+    making MXU cost O(Tq·window/bk) blocks per row instead of O(Tk/bk)."""
     run = (iq * bq + bq - 1 + off >= ik * bk) if causal else (ik >= 0)
     if window is not None:
         run = run & (ik * bk + bk - 1 + window > iq * bq + off)
     return run
+
+
+def _k_bounds(iq, *, causal, window, bq, bk, nk, off):
+    """[lo, hi] k-block range visible from q-block iq (inclusive)."""
+    lo = jnp.int32(0)
+    hi = jnp.int32(nk - 1)
+    if window is not None:
+        lo = jnp.maximum(lo, (iq * bq + off - window + 1) // bk)
+    if causal:
+        hi = jnp.clip((iq * bq + bq - 1 + off) // bk, 0, nk - 1)
+    return lo, jnp.maximum(hi, lo)
+
+
+def _q_bounds(ik, *, causal, window, bq, bk, nq, off):
+    """[lo, hi] q-block range that can see k-block ik (inclusive)."""
+    lo = jnp.int32(0)
+    hi = jnp.int32(nq - 1)
+    if causal:
+        lo = jnp.maximum(lo, (ik * bk - off) // bq)
+    if window is not None:
+        hi = jnp.clip((ik * bk + bk - 2 + window - off) // bq, 0, nq - 1)
+    return jnp.minimum(lo, hi), hi
+
+
+def _clamp_k(ik, iq, **kw):
+    """Clamp a skipped k-block index onto the visible range so Mosaic sees the
+    same block index as the previous grid step and elides the K/V DMA —
+    ``pl.when`` alone only gates MXU compute, the pipeline would still fetch
+    every block and HBM traffic would stay O(Tq·Tk)."""
+    lo, hi = _k_bounds(iq, **kw)
+    return jnp.clip(ik, lo, hi)
+
+
+def _clamp_q(iq, ik, **kw):
+    """Same as :func:`_clamp_k` for the dkv grid (q innermost)."""
+    lo, hi = _q_bounds(ik, **kw)
+    return jnp.clip(iq, lo, hi)
 
 
 def _mask_logits(s, iq, ik, qseg_ref, kseg_ref, *, causal, window, bq, bk, off):
@@ -149,13 +188,17 @@ def _seg_inputs(segment_ids, B, tq, tk):
     return q_rep, kv_rep
 
 
-def _seg_specs(bq, bk, order="qk"):
+def _seg_specs(bq, bk, order="qk", clamp=None):
     def qindex(b, h, i, j):
-        iq = i if order == "qk" else j
+        iq, ik = (i, j) if order == "qk" else (j, i)
+        if clamp is not None and order == "kq":
+            iq = clamp(iq, ik)
         return (b, iq, 0)
 
     def kindex(b, h, i, j):
-        ik = j if order == "qk" else i
+        iq, ik = (i, j) if order == "qk" else (j, i)
+        if clamp is not None and order == "qk":
+            ik = clamp(ik, iq)
         return (b, 0, ik)
 
     return (pl.BlockSpec((1, bq, LANES), qindex),
@@ -220,12 +263,18 @@ def _fwd_kernel(*refs, causal, scale, window, bq, bk, nk, off,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _bias_spec(bias, bq, bk, order="qk"):
-    """BlockSpec for a [1|B, 1|H, Tq, Tk] additive bias."""
+def _bias_spec(bias, bq, bk, order="qk", clamp=None):
+    """BlockSpec for a [1|B, 1|H, Tq, Tk] additive bias. ``clamp`` remaps the
+    inner grid index on skipped blocks (DMA elision, see :func:`_clamp_k`)."""
     bb, bh = bias.shape[0], bias.shape[1]
 
     def index(b, h, i, j):
         iq, ik = (i, j) if order == "qk" else (j, i)
+        if clamp is not None:
+            if order == "qk":
+                ik = clamp(ik, iq)
+            else:
+                iq = clamp(iq, ik)
         return (b if bb > 1 else 0, h if bh > 1 else 0, iq, ik)
 
     return pl.BlockSpec((1, 1, bq, bk), index)
@@ -247,17 +296,21 @@ def _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret):
                                window=window, bq=bq, bk=bk, nk=nk, off=tk - tq,
                                has_bias=bias is not None,
                                has_seg=segment_ids is not None)
+    kb = dict(causal=causal, window=window, bq=bq, bk=bk, nk=nk, off=tk - tq)
+    ck = functools.partial(_clamp_k, **kb)
     in_specs = [
         pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0)),
-        pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0)),
-        pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0)),
+        pl.BlockSpec((1, 1, bk, dh),
+                     lambda b, h, iq, ik: (b, h // rep, ck(ik, iq), 0)),
+        pl.BlockSpec((1, 1, bk, dh),
+                     lambda b, h, iq, ik: (b, h // rep, ck(ik, iq), 0)),
     ]
     args = [qt, kt, vt]
     if bias is not None:
-        in_specs.append(_bias_spec(bias, bq, bk))
+        in_specs.append(_bias_spec(bias, bq, bk, clamp=ck))
         args.append(bias)
     if segment_ids is not None:
-        qs, ks = _seg_specs(bq, bk)
+        qs, ks = _seg_specs(bq, bk, clamp=ck)
         in_specs += [qs, ks]
         args += list(_seg_inputs(segment_ids, B, tq, tk))
 
@@ -400,27 +453,32 @@ def _bwd(causal, scale, window, interpret, res, g):
 
     seg_args = None if segment_ids is None else _seg_inputs(segment_ids, B, tq, tk)
 
+    kb = dict(causal=causal, window=window, bq=bq, bk=bk, off=tk - tq)
+    ck = functools.partial(_clamp_k, nk=nk, **kb)
+    cq = functools.partial(_clamp_q, nq=nq, **kb)
+
     qspec = pl.BlockSpec((1, 1, bq, dh), lambda b, h, iq, ik: (b, h, iq, 0))
-    kspec = pl.BlockSpec((1, 1, bk, dh), lambda b, h, iq, ik: (b, h // rep, ik, 0))
+    kspec = pl.BlockSpec((1, 1, bk, dh),
+                         lambda b, h, iq, ik: (b, h // rep, ck(ik, iq), 0))
     dospec = qspec
     lspec = pl.BlockSpec((1, 1, bq, LANES), lambda b, h, iq, ik: (b, h, iq, 0))
     common = [qt, kt, vt, dot, lse, delta]
 
-    def specs_with_extras(base, order):
+    def specs_with_extras(base, order, clamp):
         sp = list(base)
         args = list(common)
         if bias is not None:
-            sp.append(_bias_spec(bias, bq, bk, order))
+            sp.append(_bias_spec(bias, bq, bk, order, clamp=clamp))
             args.append(bias)
         if seg_args is not None:
-            qs, ks = _seg_specs(bq, bk, order)
+            qs, ks = _seg_specs(bq, bk, order, clamp=clamp)
             sp += [qs, ks]
             args += list(seg_args)
         return sp, args
 
     # dQ: grid (B, H, nq, nk), k innermost
     dq_specs, dq_args = specs_with_extras(
-        [qspec, kspec, kspec, dospec, lspec, lspec], "qk")
+        [qspec, kspec, kspec, dospec, lspec, lspec], "qk", ck)
     dq_kernel = functools.partial(
         _bwd_dq_kernel, causal=causal, scale=scale, window=window,
         bq=bq, bk=bk, nk=nk, off=tk - tq,
@@ -438,10 +496,12 @@ def _bwd(causal, scale, window, interpret, res, g):
     # dK/dV: grid (B, H, nk, nq), q innermost; per-q-head results, GQA head
     # groups summed afterwards in XLA (rep is 1 for MHA so this is free there)
     kspec2 = pl.BlockSpec((1, 1, bk, dh), lambda b, h, ik, iq: (b, h // rep, ik, 0))
-    qspec2 = pl.BlockSpec((1, 1, bq, dh), lambda b, h, ik, iq: (b, h, iq, 0))
-    lspec2 = pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ik, iq: (b, h, iq, 0))
+    qspec2 = pl.BlockSpec((1, 1, bq, dh),
+                          lambda b, h, ik, iq: (b, h, cq(iq, ik), 0))
+    lspec2 = pl.BlockSpec((1, 1, bq, LANES),
+                          lambda b, h, ik, iq: (b, h, cq(iq, ik), 0))
     dkv_specs, dkv_args = specs_with_extras(
-        [qspec2, kspec2, kspec2, qspec2, lspec2, lspec2], "kq")
+        [qspec2, kspec2, kspec2, qspec2, lspec2, lspec2], "kq", cq)
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, causal=causal, scale=scale, window=window,
         bq=bq, bk=bk, nq=nq, off=tk - tq,

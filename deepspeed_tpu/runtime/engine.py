@@ -629,12 +629,16 @@ class DeepSpeedEngine:
         dq = self._dequantize_working if getattr(self, "quantized_weights", False) \
             else (lambda p: p)
         ptx = self._param_transform
-        # ZeRO-3: params are STORED sharded over the zero axes but USED
-        # gathered (model-parallel specs only) — the constraint makes GSPMD
-        # emit the per-use all-gather and keeps the storage sharding out of
-        # the activation sharding inference (partition.py use_sharding).
-        use_sh = self._shardings.get("use") \
-            if self.zero_optimization_stage() >= 3 else None
+        # ZeRO: params are STORED sharded over the zero axes but USED gathered
+        # (model-parallel specs only) — the constraint makes GSPMD emit the
+        # per-use all-gather and keeps the storage sharding out of the
+        # activation sharding inference (partition.py use_sharding). The same
+        # applies to raw gradients at stage >= 2: they are COMPUTED in use
+        # sharding and resharded (reduce-scattered) only at the accumulator
+        # write, or the grad storage sharding back-propagates through the
+        # weight-grad matmuls into activations.
+        grad_use_sh = self._shardings.get("use")
+        use_sh = grad_use_sh if self.zero_optimization_stage() >= 3 else None
 
         def make_loss_fn(batch, sub, loss_scale, global_step):
             def loss_fn(p):
@@ -706,6 +710,8 @@ class DeepSpeedEngine:
             # (XLA gathers the int8 shards, dequantizes at the use site)
             (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 dq(state.params))
+            if grad_use_sh is not None:
+                grads = constrain_tree(grads, grad_use_sh)
             grads = tree_cast(grads, accum_dtype)
             acc = jax.tree.map(lambda a, g: a + g, state.grad_acc, grads)
             acc = constrain_tree(acc, grad_sh)

@@ -161,3 +161,100 @@ def test_moe_ep_parity_with_dense_dispatch(eight_devices):
         ref.append(o * float(gates[s, e]))
     np.testing.assert_allclose(np.asarray(out).reshape(-1, 16), np.stack(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# routed (indices) dispatch vs GShard einsum oracle (VERDICT r2 #4)
+# ---------------------------------------------------------------------------
+
+def _moe_pair(k, num_experts=4, capacity_factor=2.0, drop_tokens=True):
+    mk = lambda mode: MOELayer(lambda: ExpertMLP(), num_experts=num_experts,
+                               k=k, capacity_factor=capacity_factor,
+                               drop_tokens=drop_tokens, dispatch_mode=mode)
+    return mk("indices"), mk("einsum")
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_indices_dispatch_matches_einsum(k):
+    import numpy as np
+    routed, dense = _moe_pair(k)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16))
+    params = routed.init(jax.random.PRNGKey(1), x)["params"]
+    out_r, laux_r, cnt_r = routed.apply({"params": params}, x)
+    out_d, laux_d, cnt_d = dense.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_d),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(laux_r), float(laux_d), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(cnt_r), np.asarray(cnt_d))
+
+
+def test_indices_dispatch_matches_einsum_with_drops():
+    import numpy as np
+    routed, dense = _moe_pair(k=2, capacity_factor=0.5)  # force drops
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 32, 16))
+    params = routed.init(jax.random.PRNGKey(4), x)["params"]
+    out_r, *_ = routed.apply({"params": params}, x)
+    out_d, *_ = dense.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_d),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_indices_dispatch_gradients_match_einsum():
+    import numpy as np
+    routed, dense = _moe_pair(k=2)
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 16, 16))
+    params = routed.init(jax.random.PRNGKey(6), x)["params"]
+
+    def loss(mdl):
+        def f(p, xx):
+            out, laux, _ = mdl.apply({"params": p}, xx)
+            return jnp.sum(out ** 2) + 0.01 * laux
+        return f
+
+    gr = jax.grad(loss(routed))(params, x)
+    gd = jax.grad(loss(dense))(params, x)
+    flat_r = jax.tree_util.tree_leaves(gr)
+    flat_d = jax.tree_util.tree_leaves(gd)
+    for a, b in zip(flat_r, flat_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_indices_dispatch_no_dense_sec_tensor_ep2():
+    """The ep>1 sharded lowering must not contain the dense [S, E, C]
+    dispatch tensor (VERDICT r2 #4 done-criterion): trace through the real
+    process-group topology (ep=2) so expert params carry their ep sharding."""
+    import numpy as np
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    E, k = 4, 2
+    S_tokens = 2 * 16
+    routed, dense = _moe_pair(k, num_experts=E)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16))
+    params = routed.init(jax.random.PRNGKey(1), x)["params"]
+    topo = MeshTopology(dp=-1, ep=2)
+    groups.initialize(mesh_topology=topo)
+    try:
+        def run(mdl):
+            def f(p, xx):
+                out, laux, _ = mdl.apply({"params": p}, xx)
+                return jnp.sum(out) + laux
+            # lower with sharded operands: x over the data axes, expert
+            # params over ep (stacked axis 0), everything else replicated
+            x_sh = jax.device_put(x, topo.sharding("ep", None, None))
+            p_sh = jax.tree_util.tree_map_with_path(
+                lambda path, l: jax.device_put(
+                    l, topo.sharding("ep", *([None] * (l.ndim - 1)))
+                    if "experts" in jax.tree_util.keystr(path)
+                    and l.shape[0] == E else topo.replicated()),
+                params)
+            return jax.jit(f).lower(p_sh, x_sh).as_text()
+
+        cap = int(np.ceil(S_tokens * k / E) * 2.0)  # capacity_factor=2.0
+        dense_shape = f"tensor<{S_tokens}x{E}x{cap}xf32>"
+        assert dense_shape in run(dense), "oracle lowering should carry [S,E,C]"
+        assert dense_shape not in run(routed), \
+            f"routed lowering still materializes the dense {dense_shape} dispatch"
+    finally:
+        groups.reset()
