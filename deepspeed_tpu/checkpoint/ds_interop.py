@@ -1,0 +1,320 @@
+"""Reference-format DeepSpeed checkpoint ingestion.
+
+Reads the reference's eager on-disk checkpoint layout (written by
+``deepspeed/runtime/engine.py save_checkpoint``; consumed by
+``deepspeed/utils/zero_to_fp32.py`` and ``checkpoint/ds_to_universal.py:88,171``):
+
+    <dir>/latest                                   — text file naming the tag
+    <dir>/<tag>/mp_rank_00_model_states.pt          — module state + param_shapes
+    <dir>/<tag>/zero_pp_rank_{dp}_mp_rank_{tp:02d}_optim_states.pt
+        (also with bf16_/fp16_ prefixes)            — per-rank flat fp32
+        partitions + base optimizer state
+
+so an existing DeepSpeed training run can migrate its *optimizer state* (not
+just HF-exported weights) onto this framework: the ZeRO shards are merged back
+into full fp32 tensors per parameter and re-emitted in the universal fragment
+format (``checkpoint/universal.py``), which loads at any mesh topology.
+
+Reconstruction rules (capability match of ``zero_to_fp32.py``):
+  stage 1/2 — each param group's fp32 master is a flat vector partitioned
+    contiguously across the DP ranks (2*world-aligned padding at the tail);
+    merging is rank-order concat, then per-parameter slicing in the
+    ``param_shapes`` group order. Adam moments partition identically.
+  stage 3  — every parameter is individually padded to a multiple of the
+    world size and round-robin sliced: rank r holds elements
+    [r*ceil(n/w), (r+1)*ceil(n/w)) of each param's flat buffer; per-rank flat
+    groups concatenate those slices in param order.
+
+Only torch (CPU) is needed to deserialize the .pt files; everything else is
+numpy. torch is imported lazily so the module stays importable without it.
+"""
+
+import dataclasses
+import glob
+import math
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+LATEST_FILE = "latest"
+MODEL_FILE_GLOB = "*mp_rank_*_model_states.pt"
+OPTIM_FILE_GLOB = "*_optim_states.pt"
+
+# keys of the reference's saved dicts (checkpoint/constants.py)
+_OPT_SD = "optimizer_state_dict"
+_SINGLE_PARTITION = "single_partition_of_fp32_groups"   # stage 1/2
+_FLAT_GROUPS = "fp32_flat_groups"                        # stage 3
+_BASE_OPT = "base_optimizer_state"
+_ZERO_STAGE = "zero_stage"
+_PARTITION_COUNT = "partition_count"
+_PARAM_SHAPES = "param_shapes"
+_MODULE = "module"
+_BUFFER_NAMES = "buffer_names"
+_SHARED_PARAMS = "shared_params"
+
+
+@dataclasses.dataclass
+class DsCheckpoint:
+    """A parsed reference checkpoint: full (merged) fp32 tensors by name."""
+    zero_stage: int
+    world_size: int
+    tag: str
+    fp32: Dict[str, np.ndarray]
+    exp_avg: Dict[str, np.ndarray]
+    exp_avg_sq: Dict[str, np.ndarray]
+    buffers: Dict[str, np.ndarray]
+    step: int
+    shared_params: List[Any]
+
+
+def resolve_tag(ckpt_dir: str, tag: Optional[str] = None) -> str:
+    """Tag from the ``latest`` file (reference load_checkpoint default)."""
+    if tag is not None:
+        return tag
+    latest = os.path.join(ckpt_dir, LATEST_FILE)
+    if not os.path.isfile(latest):
+        raise FileNotFoundError(
+            f"no tag given and no '{LATEST_FILE}' file in {ckpt_dir}")
+    with open(latest) as f:
+        return f.read().strip()
+
+
+def _natural(path):
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", os.path.basename(path))]
+
+
+def _load_pt(path):
+    import torch
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def _to_np(t):
+    import torch
+    if isinstance(t, torch.Tensor):
+        return t.detach().to(torch.float32).cpu().numpy()
+    return t
+
+
+def read_deepspeed_checkpoint(ckpt_dir: str, tag: Optional[str] = None
+                              ) -> DsCheckpoint:
+    """Parse and merge a reference checkpoint directory into full fp32
+    tensors (weights + Adam moments) keyed by the module parameter names."""
+    tag = resolve_tag(ckpt_dir, tag)
+    d = os.path.join(ckpt_dir, tag)
+    model_files = sorted(glob.glob(os.path.join(d, MODEL_FILE_GLOB)),
+                         key=_natural)
+    optim_files = sorted(glob.glob(os.path.join(d, OPTIM_FILE_GLOB)),
+                         key=_natural)
+    if not model_files:
+        raise FileNotFoundError(f"no *_model_states.pt under {d}")
+    if not optim_files:
+        raise FileNotFoundError(f"no *_optim_states.pt under {d}")
+
+    mstate = _load_pt(model_files[0])
+    param_shapes = mstate[_PARAM_SHAPES]
+    if isinstance(param_shapes, dict):  # some versions save a single dict
+        param_shapes = [param_shapes]
+    buffer_names = set(mstate.get(_BUFFER_NAMES, []) or [])
+    buffers = {k: _to_np(v) for k, v in mstate.get(_MODULE, {}).items()
+               if k in buffer_names}
+    shared = list(mstate.get(_SHARED_PARAMS, []) or [])
+
+    opt_sds = [_load_pt(f)[_OPT_SD] for f in optim_files]
+    zero_stage = int(opt_sds[0].get(_ZERO_STAGE, 1))
+    world = opt_sds[0].get(_PARTITION_COUNT, len(opt_sds))
+    if isinstance(world, (list, tuple)):
+        world = max(int(w) for w in world)
+    world = int(world)
+    if len(opt_sds) != world:
+        raise ValueError(f"expected {world} optim shard files, found "
+                         f"{len(opt_sds)} under {d}")
+
+    def flat_per_rank(key_fn):
+        """[rank][group] -> flat np vector (stage3: groups pre-concatenated)."""
+        out = []
+        for sd in opt_sds:
+            groups = key_fn(sd)
+            if zero_stage == 3:
+                groups = [np.concatenate([_to_np(g).reshape(-1)
+                                          for g in groups])]
+            out.append([_to_np(g).reshape(-1) for g in groups])
+        return out
+
+    if zero_stage <= 2:
+        fp32_parts = flat_per_rank(lambda sd: sd[_SINGLE_PARTITION])
+    else:
+        fp32_parts = flat_per_rank(lambda sd: sd[_FLAT_GROUPS])
+
+    base = opt_sds[0].get(_BASE_OPT, {}) or {}
+    state_groups = base.get("state", {})
+    step = 0
+    for g in (state_groups.values() if isinstance(state_groups, dict)
+              else state_groups):
+        s = g.get("step", 0)
+        try:
+            step = max(step, int(_to_np(s)))
+        except (TypeError, ValueError):
+            pass
+
+    def moment_parts(moment_key):
+        ok = all(_BASE_OPT in sd and sd[_BASE_OPT].get("state")
+                 for sd in opt_sds)
+        if not ok:
+            return None
+        try:
+            return flat_per_rank(lambda sd: [
+                sd[_BASE_OPT]["state"][g][moment_key]
+                for g in sorted(sd[_BASE_OPT]["state"])])
+        except KeyError:
+            return None
+
+    m_parts = moment_parts("exp_avg")
+    v_parts = moment_parts("exp_avg_sq")
+
+    if zero_stage <= 2:
+        merge = _merge_stage2
+    else:
+        merge = _merge_stage3
+    fp32 = merge(fp32_parts, param_shapes, world)
+    exp_avg = merge(m_parts, param_shapes, world) if m_parts else {}
+    exp_avg_sq = merge(v_parts, param_shapes, world) if v_parts else {}
+
+    return DsCheckpoint(zero_stage=zero_stage, world_size=world, tag=tag,
+                        fp32=fp32, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
+                        buffers=buffers, step=step, shared_params=shared)
+
+
+def _shape_numel(shape):
+    return int(np.prod([int(s) for s in tuple(shape)])) if len(tuple(shape)) \
+        else 1
+
+
+def _merge_stage2(parts, param_shapes, world):
+    """Concat each group's rank partitions; slice params in group order.
+    The tail may carry up to 2*world alignment padding (reference zero2
+    NCCL alignment) — tolerated, never consumed."""
+    out = {}
+    n_groups = len(parts[0])
+    for g in range(n_groups):
+        merged = np.concatenate([parts[r][g] for r in range(world)])
+        offset = 0
+        shapes = param_shapes[g] if g < len(param_shapes) else {}
+        for name, shape in shapes.items():
+            n = _shape_numel(shape)
+            if offset + n > merged.size:
+                raise ValueError(
+                    f"group {g} exhausted at '{name}': need {n} elements at "
+                    f"offset {offset}, have {merged.size}")
+            out[name] = merged[offset:offset + n].reshape(tuple(shape))
+            offset += n
+        align = 2 * world
+        if math.ceil(offset / align) * align < merged.size and shapes:
+            raise ValueError(
+                f"group {g}: {merged.size - offset} leftover elements exceed "
+                f"the 2*world alignment padding — shapes do not match shards")
+    return out
+
+
+def _merge_stage3(parts, param_shapes, world):
+    """Zip per-param slices: rank r holds [r*ceil(n/w), (r+1)*ceil(n/w)) of
+    each (padded) param, concatenated in param order."""
+    shapes = {}
+    for group in param_shapes:
+        shapes.update(group)
+    out = {}
+    offsets = [0] * world
+    for name, shape in shapes.items():
+        n = _shape_numel(shape)
+        per = math.ceil(n / world)
+        frags = []
+        for r in range(world):
+            frag = parts[r][0][offsets[r]:offsets[r] + per]
+            if frag.size < per:
+                raise ValueError(
+                    f"rank {r} flat group exhausted at '{name}'")
+            frags.append(frag)
+            offsets[r] += per
+        out[name] = np.concatenate(frags)[:n].reshape(tuple(shape))
+    return out
+
+
+def _default_name_map(name: str) -> str:
+    """torch dotted name -> jax keystr: 'layers.0.kernel' ->
+    "['layers']['0']['kernel']". No layout changes (transposition/fusion is
+    model-specific — see checkpoint/hf.py for the HF weight conventions)."""
+    return "".join(f"['{p}']" for p in name.split("."))
+
+
+def ds_checkpoint_to_universal(ckpt_dir: str, out_dir: str,
+                               tag: Optional[str] = None,
+                               name_map: Optional[Callable[[str], str]] = None
+                               ) -> str:
+    """Convert a reference checkpoint directory into this framework's
+    universal fragment format (offline; no engine or devices needed) — the
+    cross-framework analog of reference ``ds_to_universal.py`` main."""
+    import json
+    from deepspeed_tpu.checkpoint.universal import (UNIVERSAL_ARRAYS,
+                                                    UNIVERSAL_META)
+    ck = read_deepspeed_checkpoint(ckpt_dir, tag)
+    nm = name_map or _default_name_map
+    blobs, keys = {}, []
+    for name, arr in ck.fp32.items():
+        k = nm(name)
+        keys.append(k)
+        blobs[f"{k}::fp32"] = np.asarray(arr, np.float32)
+        if name in ck.exp_avg:
+            blobs[f"{k}::exp_avg"] = np.asarray(ck.exp_avg[name], np.float32)
+        if name in ck.exp_avg_sq:
+            blobs[f"{k}::exp_avg_sq"] = np.asarray(ck.exp_avg_sq[name],
+                                                   np.float32)
+    os.makedirs(out_dir, exist_ok=True)
+    np.savez(os.path.join(out_dir, UNIVERSAL_ARRAYS), **blobs)
+    meta = {
+        "counters": {"global_steps": ck.step, "global_samples": 0,
+                     "micro_steps": ck.step},
+        "param_keys": sorted(keys),
+        "optimizer_step": ck.step,
+        "format": "deepspeed_tpu_universal_v1",
+        "source": {"layout": "deepspeed_reference", "tag": ck.tag,
+                   "zero_stage": ck.zero_stage,
+                   "world_size": ck.world_size},
+    }
+    with open(os.path.join(out_dir, UNIVERSAL_META), "w") as f:
+        json.dump(meta, f)
+    return out_dir
+
+
+def load_deepspeed_checkpoint(engine, ckpt_dir: str, tag: Optional[str] = None,
+                              name_map: Optional[Callable[[str], str]] = None,
+                              load_optimizer_states: bool = True) -> int:
+    """Load a reference-format checkpoint directly into a live engine at its
+    current topology (convert-in-memory + universal load)."""
+    import tempfile
+    from deepspeed_tpu.checkpoint.universal import load_universal_checkpoint
+    with tempfile.TemporaryDirectory() as tmp:
+        ds_checkpoint_to_universal(ckpt_dir, tmp, tag=tag, name_map=name_map)
+        return load_universal_checkpoint(
+            engine, tmp, load_optimizer_states=load_optimizer_states)
+
+
+def consolidate_fp32(ck: DsCheckpoint) -> Dict[str, np.ndarray]:
+    """Full fp32 state dict from an already-parsed checkpoint: buffers +
+    merged weights, shared parameters recovered by aliasing."""
+    out = dict(ck.buffers)
+    out.update(ck.fp32)
+    for pair in ck.shared_params:
+        if len(pair) == 2 and pair[1] in out:
+            out[pair[0]] = out[pair[1]]
+    return out
+
+
+def get_fp32_state_dict_from_ds_checkpoint(ckpt_dir: str,
+                                           tag: Optional[str] = None
+                                           ) -> Dict[str, np.ndarray]:
+    """zero_to_fp32-style consolidation of reference shards: full fp32
+    weights by module parameter name (reference ``utils/zero_to_fp32.py:604``
+    ``get_fp32_state_dict_from_zero_checkpoint``)."""
+    return consolidate_fp32(read_deepspeed_checkpoint(ckpt_dir, tag))

@@ -345,6 +345,8 @@ class MOELayer(nn.Module):
         token = _token_sharding()
         if topo is None:
             return None, None
-        expert = topo.sharding("ep" if self.num_experts % topo.ep_size == 0
-                               and topo.ep_size > 1 else None, None, None)
-        return token, expert
+        if topo.ep_size <= 1 or self.num_experts % topo.ep_size != 0:
+            # no usable ep axis: leave the expert batch unconstrained so GSPMD
+            # remains free to shard the E/C dims over the data axes
+            return token, None
+        return token, topo.sharding("ep", None, None)
