@@ -28,7 +28,7 @@ from deepspeed_tpu.utils.logging import logger
 
 LLAMA_FAMILY = ("llama", "mistral", "qwen2")
 SUPPORTED = LLAMA_FAMILY + ("gpt2", "opt", "mixtral", "falcon", "phi", "bloom",
-                            "gpt_neox", "gptj")
+                            "gpt_neox", "gptj", "bert")
 
 
 class UnsupportedModelError(ValueError):
@@ -908,6 +908,109 @@ def bloom_from_flax(params, cfg, dtype=np.float32):
 # top-level API
 # ---------------------------------------------------------------------------
 
+def bert_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
+    """HF ``BertForMaskedLM`` state dict -> models/bert.py tree. torch Linear
+    weights are [out, in] and transpose to flax [in, out]; the decoder stays
+    tied to the word embeddings (cls.predictions.decoder.weight is the same
+    tensor in HF, so only the bias is read)."""
+    L = cfg.num_hidden_layers
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def lin(name):
+        return {"kernel": g(name + ".weight").T, "bias": g(name + ".bias")}
+
+    def ln(name):
+        return {"scale": g(name + ".weight"), "bias": g(name + ".bias")}
+
+    def layer(i):
+        p = f"bert.encoder.layer.{i}."
+        return {
+            "query": lin(p + "attention.self.query"),
+            "key": lin(p + "attention.self.key"),
+            "value": lin(p + "attention.self.value"),
+            "attn_out": lin(p + "attention.output.dense"),
+            "attn_ln": ln(p + "attention.output.LayerNorm"),
+            "intermediate": lin(p + "intermediate.dense"),
+            "output": lin(p + "output.dense"),
+            "out_ln": ln(p + "output.LayerNorm"),
+        }
+
+    bert = {
+        "word_embeddings": g("bert.embeddings.word_embeddings.weight"),
+        "position_embeddings": g("bert.embeddings.position_embeddings.weight"),
+        "token_type_embeddings": g("bert.embeddings.token_type_embeddings.weight"),
+        "embeddings_ln": ln("bert.embeddings.LayerNorm"),
+    }
+    layers = [layer(i) for i in range(L)]
+    if scan_layers:
+        import jax
+        bert["layers"] = {"block": jax.tree.map(lambda *xs: _stack(xs), *layers)}
+    else:
+        for i, l in enumerate(layers):
+            bert[f"layers_{i}"] = l
+    bias_key = "cls.predictions.bias" if "cls.predictions.bias" in sd \
+        else "cls.predictions.decoder.bias"
+    return {
+        "bert": bert,
+        "transform": lin("cls.predictions.transform.dense"),
+        "transform_ln": ln("cls.predictions.transform.LayerNorm"),
+        "decoder_bias": g(bias_key),
+    }
+
+
+def bert_from_flax(params, cfg, dtype=np.float32):
+    """models/bert.py tree -> HF ``BertForMaskedLM`` state dict (decoder tied:
+    cls.predictions.decoder.weight is emitted as the embedding matrix)."""
+    import jax
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+    bert = params["bert"]
+    L = cfg.num_hidden_layers
+    sd = {
+        "bert.embeddings.word_embeddings.weight": bert["word_embeddings"],
+        "bert.embeddings.position_embeddings.weight": bert["position_embeddings"],
+        "bert.embeddings.token_type_embeddings.weight": bert["token_type_embeddings"],
+        "bert.embeddings.LayerNorm.weight": bert["embeddings_ln"]["scale"],
+        "bert.embeddings.LayerNorm.bias": bert["embeddings_ln"]["bias"],
+        "cls.predictions.transform.dense.weight": params["transform"]["kernel"].T,
+        "cls.predictions.transform.dense.bias": params["transform"]["bias"],
+        "cls.predictions.transform.LayerNorm.weight": params["transform_ln"]["scale"],
+        "cls.predictions.transform.LayerNorm.bias": params["transform_ln"]["bias"],
+        "cls.predictions.bias": params["decoder_bias"],
+        "cls.predictions.decoder.weight": bert["word_embeddings"],
+        "cls.predictions.decoder.bias": params["decoder_bias"],
+    }
+    hf_of = {"query": "attention.self.query", "key": "attention.self.key",
+             "value": "attention.self.value", "attn_out": "attention.output.dense",
+             "intermediate": "intermediate.dense", "output": "output.dense"}
+    ln_of = {"attn_ln": "attention.output.LayerNorm", "out_ln": "output.LayerNorm"}
+    for i in range(L):
+        l = (jax.tree.map(lambda x: x[i], bert["layers"]["block"])
+             if "layers" in bert else bert[f"layers_{i}"])
+        p = f"bert.encoder.layer.{i}."
+        for ours, theirs in hf_of.items():
+            sd[p + theirs + ".weight"] = l[ours]["kernel"].T
+            sd[p + theirs + ".bias"] = l[ours]["bias"]
+        for ours, theirs in ln_of.items():
+            sd[p + theirs + ".weight"] = l[ours]["scale"]
+            sd[p + theirs + ".bias"] = l[ours]["bias"]
+    return sd
+
+
+def bert_config_from_hf(hf_cfg, **overrides):
+    from deepspeed_tpu.models.bert import BertConfig
+    kw = dict(vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+              num_hidden_layers=hf_cfg.num_hidden_layers,
+              num_attention_heads=hf_cfg.num_attention_heads,
+              intermediate_size=hf_cfg.intermediate_size,
+              max_position_embeddings=hf_cfg.max_position_embeddings,
+              type_vocab_size=hf_cfg.type_vocab_size,
+              layer_norm_eps=hf_cfg.layer_norm_eps)
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
 def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
     """Load an HF checkpoint directory -> (model, flax params).
 
@@ -931,6 +1034,25 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
                          scan_layers=scan_layers)
         return GPT2LMHeadModel(cfg), gpt2_to_flax(sd, cfg, scan_layers=scan_layers,
                                                   dtype=dtype)
+    if mt == "bert":
+        from deepspeed_tpu.models.bert import BertForMaskedLM
+        act = getattr(hf_cfg, "hidden_act", "gelu")
+        if act != "gelu":
+            raise UnsupportedModelError(
+                f"BERT hidden_act={act!r} not supported — models/bert.py "
+                "hardcodes exact gelu (the bert-base/large lineage)")
+        pet = getattr(hf_cfg, "position_embedding_type", "absolute")
+        if pet != "absolute":
+            raise UnsupportedModelError(
+                f"BERT position_embedding_type={pet!r} not supported — only "
+                "learned absolute positions are represented")
+        if not getattr(hf_cfg, "tie_word_embeddings", True):
+            raise UnsupportedModelError(
+                "BERT tie_word_embeddings=False not supported — the MLM "
+                "decoder is tied to the word embeddings")
+        cfg = bert_config_from_hf(hf_cfg, scan_layers=scan_layers)
+        return (BertForMaskedLM(cfg),
+                bert_to_flax(sd, cfg, scan_layers=scan_layers, dtype=dtype))
     if mt == "opt":
         from deepspeed_tpu.models.opt import OPTConfig, OPTForCausalLM
         if not getattr(hf_cfg, "do_layer_norm_before", True):
@@ -1113,6 +1235,17 @@ def export_pretrained(params, cfg, save_dir, dtype=np.float32):
               "vocab_size": cfg.vocab_size, "n_positions": cfg.n_positions,
               "n_embd": cfg.n_embd, "n_layer": cfg.n_layer, "n_head": cfg.n_head,
               "layer_norm_epsilon": cfg.layer_norm_epsilon}
+    elif name == "BertConfig":
+        sd = bert_from_flax(params, cfg, dtype=dtype)
+        hf = {"model_type": "bert", "architectures": ["BertForMaskedLM"],
+              "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+              "num_hidden_layers": cfg.num_hidden_layers,
+              "num_attention_heads": cfg.num_attention_heads,
+              "intermediate_size": cfg.intermediate_size,
+              "max_position_embeddings": cfg.max_position_embeddings,
+              "type_vocab_size": cfg.type_vocab_size,
+              "layer_norm_eps": cfg.layer_norm_eps,
+              "hidden_act": "gelu", "position_embedding_type": "absolute"}
     elif name == "OPTConfig":
         sd = opt_from_flax(params, cfg, dtype=dtype)
         hf = {"model_type": "opt", "architectures": ["OPTForCausalLM"],

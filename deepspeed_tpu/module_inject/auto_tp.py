@@ -24,10 +24,14 @@ from jax.sharding import PartitionSpec as P
 # ordered: the ROW patterns must win over generic matches
 ROW_PATTERNS = re.compile(
     r"(o_proj|out_proj|down_proj|dense_4h_to_h|dense/kernel"
-    r"|fc2|fc_out|c_proj|wo)\b")
+    r"|fc2|fc_out|c_proj|wo|attn_out)\b")
 COLUMN_PATTERNS = re.compile(
     r"(q_proj|k_proj|v_proj|query_key_value|c_attn|qkv"
-    r"|gate_proj|up_proj|dense_h_to_4h|fc1|fc_in|c_fc|wi)\b")
+    r"|gate_proj|up_proj|dense_h_to_4h|fc1|fc_in|c_fc|wi"
+    r"|query|key|value|intermediate)\b")
+# parent-qualified column matches that must beat the generic ROW
+# "dense/kernel" rule (HF-flax BERT: intermediate/dense is the up-projection)
+COLUMN_FIRST_PATTERNS = re.compile(r"intermediate/dense\b")
 VOCAB_PATTERNS = re.compile(
     r"(embed_tokens|word_embeddings$|wte|embed_in|lm_head|embed_out|shared)\b")
 
@@ -35,6 +39,8 @@ VOCAB_PATTERNS = re.compile(
 def _classify(name):
     if VOCAB_PATTERNS.search(name):
         return "vocab"
+    if COLUMN_FIRST_PATTERNS.search(name):
+        return "column"
     if ROW_PATTERNS.search(name):
         return "row"
     if COLUMN_PATTERNS.search(name):

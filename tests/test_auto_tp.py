@@ -86,3 +86,38 @@ def test_hf_flax_digit_nesting_not_mistaken_for_scan():
     stacked = {"blocks": {"q_proj": {"kernel": np.zeros((4, 64, 64))}}}
     s2 = flat_named(infer_tp_specs(stacked))
     assert s2["['blocks']['q_proj']['kernel']"] == P(None, None, "tp")
+
+
+def test_auto_tp_bert_encoder():
+    """BERT (encoder) TP policy (VERDICT r2 #8): AutoTP routes through the
+    model's exact param_specs; the name fallback classifies the HF-flax-style
+    encoder names (query/key/value/intermediate nested kernels) too."""
+    import numpy as np
+    import jax
+    from deepspeed_tpu.models.bert import BertConfig, BertForMaskedLM
+    from deepspeed_tpu.module_inject.auto_tp import AutoTP, infer_tp_specs
+    from jax.sharding import PartitionSpec as P
+    cfg = BertConfig.tiny()
+    model = BertForMaskedLM(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    specs = AutoTP.get_policy(model, params)
+    blk = specs["bert"]["layers"]["block"]
+    assert blk["query"]["kernel"] == P(None, None, "tp")
+    assert blk["key"]["kernel"] == P(None, None, "tp")
+    assert blk["value"]["kernel"] == P(None, None, "tp")
+    assert blk["intermediate"]["kernel"] == P(None, None, "tp")
+    assert blk["attn_out"]["kernel"] == P(None, "tp", None)
+    assert blk["output"]["kernel"] == P(None, "tp", None)
+    assert specs["bert"]["word_embeddings"] == P("tp", None)
+
+    # name-heuristic fallback on an HF-flax-shaped tree (no param_specs)
+    foreign = {
+        "attention": {"query": {"kernel": np.zeros((8, 8))},
+                      "output": {"dense": {"kernel": np.zeros((8, 8))}}},
+        "intermediate": {"dense": {"kernel": np.zeros((8, 16))}},
+    }
+    inf = infer_tp_specs(foreign)
+    assert inf["attention"]["query"]["kernel"] == P(None, "tp")
+    assert inf["attention"]["output"]["dense"]["kernel"] == P("tp", None)
+    assert inf["intermediate"]["dense"]["kernel"] == P(None, "tp")

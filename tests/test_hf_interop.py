@@ -455,3 +455,75 @@ def test_parallel_block_export_roundtrip(tmp_path, family, make_cfg):
         assert _json.load(f)["model_type"] == family
     hf_model = transformers.AutoModelForCausalLM.from_pretrained(out_dir).eval()
     assert_logits_close(our_logits(model, params, ids), hf_logits(hf_model, ids))
+
+
+def test_bert_mlm_logits(tmp_path):
+    """Encoder family oracle: exact logits vs HF BertForMaskedLM (closes the
+    encoder hole vs reference module_inject/containers/{bert,distil_bert}.py)."""
+    cfg = transformers.BertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2)
+    torch.manual_seed(0)
+    hf_model = transformers.BertForMaskedLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+
+    model, params = hf_interop.load_pretrained(d)
+    fcfg = type(model.config)(**{**model.config.__dict__, "dtype": jnp.float32,
+                                 "remat": False})
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 16)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
+
+
+def test_bert_mlm_logits_with_token_types(tmp_path):
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=128,
+        max_position_embeddings=32, type_vocab_size=2)
+    torch.manual_seed(1)
+    hf_model = transformers.BertForMaskedLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    fcfg = type(model.config)(**{**model.config.__dict__, "dtype": jnp.float32,
+                                 "remat": False})
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 128, size=(2, 12)).astype(np.int32)
+    tt = (np.arange(12)[None] >= 6).astype(np.int32).repeat(2, axis=0)
+    ours = np.asarray(type(model)(fcfg).apply(
+        {"params": params}, {"input_ids": ids, "token_type_ids": tt}), np.float32)
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids),
+                          token_type_ids=torch.from_numpy(tt)).logits.float().numpy()
+    assert_logits_close(ours, theirs)
+
+
+def test_bert_export_roundtrip(tmp_path):
+    """load -> export -> HF reload gives identical logits; unsupported
+    lineages are rejected."""
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=128,
+        max_position_embeddings=32, type_vocab_size=2)
+    torch.manual_seed(2)
+    hf_model = transformers.BertForMaskedLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+
+    out = str(tmp_path / "export")
+    hf_interop.export_pretrained(params, model.config, out)
+    re_model = transformers.BertForMaskedLM.from_pretrained(out).eval()
+    ids = np.random.default_rng(2).integers(0, 128, size=(1, 8)).astype(np.int32)
+    assert_logits_close(hf_logits(re_model, ids), hf_logits(hf_model, ids))
+
+    # unsupported lineages raise instead of silently mis-mapping
+    bad = transformers.BertConfig(vocab_size=64, hidden_size=32,
+                                  num_hidden_layers=1, num_attention_heads=2,
+                                  intermediate_size=64,
+                                  max_position_embeddings=16,
+                                  hidden_act="relu")
+    torch.manual_seed(3)
+    d2 = save_hf(transformers.BertForMaskedLM(bad).eval(), bad,
+                 tmp_path / "bad")
+    with pytest.raises(hf_interop.UnsupportedModelError):
+        hf_interop.load_pretrained(str(tmp_path / "bad" / "ckpt"))

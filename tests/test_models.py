@@ -162,3 +162,82 @@ def test_bloom_kv_cache_decode_matches_full_forward():
         want.append(tok)
         cur = np.concatenate([cur, [[tok]]], axis=1)
     np.testing.assert_array_equal(out[0], want)
+
+
+# ---------------------------------------------------------------------------
+# BERT encoder family (VERDICT r2 #8)
+# ---------------------------------------------------------------------------
+
+def test_bert_forward_logits_and_masking():
+    from deepspeed_tpu.models.bert import BertConfig, BertForMaskedLM
+    cfg = BertConfig.tiny(dtype=jnp.float32)
+    model = BertForMaskedLM(cfg)
+    ids = np.arange(32, dtype=np.int32).reshape(2, 16) % cfg.vocab_size
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    logits = model.apply({"params": params}, {"input_ids": ids})
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # bidirectional: flipping a FUTURE token must change an earlier position's
+    # logits (a causal model would be invariant)
+    ids2 = ids.copy()
+    ids2[:, -1] = (ids2[:, -1] + 1) % cfg.vocab_size
+    logits2 = model.apply({"params": params}, {"input_ids": ids2})
+    assert np.abs(np.asarray(logits[:, 0]) - np.asarray(logits2[:, 0])).max() > 1e-6
+
+
+def test_bert_mlm_trains_under_engine():
+    from deepspeed_tpu.models.bert import BertConfig, BertForMaskedLM
+    cfg = BertConfig.tiny(dtype=jnp.float32)
+    model = BertForMaskedLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = (np.arange(16)[None, :] + rng.integers(0, 64, size=(8, 1))).astype(np.int32) % 64
+    mask_pos = rng.random(ids.shape) < 0.3
+    labels = np.where(mask_pos, ids, -100).astype(np.int32)
+    inputs = np.where(mask_pos, cfg.vocab_size - 1, ids).astype(np.int32)  # [MASK]
+    batch = {"input_ids": inputs, "labels": labels}
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8, "zero_optimization": {"stage": 1},
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}}})
+    losses = []
+    for _ in range(12):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_bert_tp_specs(eight_devices):
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.models.bert import BertConfig, BertForMaskedLM
+    cfg = BertConfig.tiny(dtype=jnp.float32)
+    model = BertForMaskedLM(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    specs = model.param_specs(params)
+    blk = specs["bert"]["layers"]["block"]
+    assert blk["query"]["kernel"] == P(None, None, "tp")
+    assert blk["attn_out"]["kernel"] == P(None, "tp", None)
+    assert blk["output"]["kernel"] == P(None, "tp", None)
+    assert blk["intermediate"]["kernel"] == P(None, None, "tp")
+    assert specs["bert"]["word_embeddings"] == P("tp", None)
+
+
+def test_bert_attention_mask_blocks_padding():
+    from deepspeed_tpu.models.bert import BertConfig, BertForMaskedLM
+    cfg = BertConfig.tiny(dtype=jnp.float32)
+    model = BertForMaskedLM(cfg)
+    ids = np.arange(16, dtype=np.int32)[None, :] % cfg.vocab_size
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    mask = np.ones((1, 16), np.int32)
+    mask[:, 12:] = 0
+    base = model.apply({"params": params},
+                       {"input_ids": ids, "attention_mask": mask})
+    ids2 = ids.copy()
+    ids2[:, 12:] = (ids2[:, 12:] + 7) % cfg.vocab_size  # mutate PAD region
+    out2 = model.apply({"params": params},
+                       {"input_ids": ids2, "attention_mask": mask})
+    # logits at real positions must not see the padding change
+    np.testing.assert_allclose(np.asarray(base[:, :12]),
+                               np.asarray(out2[:, :12]), atol=1e-5)
