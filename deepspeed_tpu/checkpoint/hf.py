@@ -1050,6 +1050,10 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
             raise UnsupportedModelError(
                 "BERT tie_word_embeddings=False not supported — the MLM "
                 "decoder is tied to the word embeddings")
+        if getattr(hf_cfg, "is_decoder", False):
+            raise UnsupportedModelError(
+                "is_decoder=True (BertLMHeadModel causal lineage) not "
+                "supported — models/bert.py is a bidirectional encoder")
         cfg = bert_config_from_hf(hf_cfg, scan_layers=scan_layers)
         return (BertForMaskedLM(cfg),
                 bert_to_flax(sd, cfg, scan_layers=scan_layers, dtype=dtype))

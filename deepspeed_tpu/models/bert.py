@@ -91,13 +91,17 @@ class BertLayer(nn.Module):
 
 
 class ScanBertLayer(nn.Module):
+    """``deterministic`` is a module FIELD (static under scan+remat — a
+    carried or traced Python bool would crash flax Dropout's bool coercion
+    for any dropout > 0, the llama ``use_cache`` pattern); the attention
+    mask rides as an ``nn.broadcast`` input."""
     config: BertConfig
+    deterministic: bool = True
 
     @nn.compact
-    def __call__(self, carry, _):
-        x, mask, deterministic = carry
-        x = BertLayer(self.config, name="block")(x, mask, deterministic)
-        return (x, mask, deterministic), None
+    def __call__(self, x, mask):
+        x = BertLayer(self.config, name="block")(x, mask, self.deterministic)
+        return x, None
 
 
 class BertModel(nn.Module):
@@ -135,9 +139,9 @@ class BertModel(nn.Module):
                               variable_axes={"params": 0},
                               split_rngs={"params": True, "dropout": True},
                               length=cfg.num_hidden_layers,
+                              in_axes=nn.broadcast,
                               metadata_params={nn.meta.PARTITION_NAME: "layers"})
-            (x, _, _), _ = Scanned(cfg, name="layers")(
-                (x, attention_mask, deterministic), None)
+            x, _ = Scanned(cfg, deterministic, name="layers")(x, attention_mask)
         else:
             block_cls = nn.remat(BertLayer, prevent_cse=False,
                                  policy=remat_policy()) if cfg.remat else BertLayer

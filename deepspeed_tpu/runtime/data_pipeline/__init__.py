@@ -1,9 +1,10 @@
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
-from deepspeed_tpu.runtime.data_pipeline.data_sampler import (CurriculumDataSampler,
-                                                              DataAnalyzer)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+    CurriculumDataSampler, DataAnalyzer, DistributedDataAnalyzer)
 from deepspeed_tpu.runtime.data_pipeline.random_ltd import (RandomLTDScheduler,
                                                             random_ltd_gather,
                                                             random_ltd_scatter)
 
 __all__ = ["CurriculumScheduler", "CurriculumDataSampler", "DataAnalyzer",
-           "RandomLTDScheduler", "random_ltd_gather", "random_ltd_scatter"]
+           "DistributedDataAnalyzer", "RandomLTDScheduler",
+           "random_ltd_gather", "random_ltd_scatter"]

@@ -62,6 +62,119 @@ class DataAnalyzer:
                     np.load(os.path.join(save_path, f"{metric}_index.npy"))}
 
 
+class DistributedDataAnalyzer:
+    """Multi-worker map-reduce over the corpus (reference
+    ``data_sampling/data_analyzer.py`` DataAnalyzer: each worker maps its
+    contiguous shard of sample indices and persists per-worker
+    ``sample_to_metric`` files backed by the mmap indexed-dataset writer; a
+    reduce step merges the shards with ``MMapIndexedDatasetBuilder.merge_file``
+    and emits the same ``{metric}_values.npy`` / ``{metric}_index.npy`` maps
+    the curriculum sampler consumes — identical to the single-process
+    :class:`DataAnalyzer` output).
+
+    Workers are independent processes: ``run_map`` only touches
+    ``save_path/worker_<id>/``, so any launcher (ds_tpu ssh fan-out, slurm,
+    multiprocessing) can run them; ``run_reduce`` runs once afterwards.
+    """
+
+    def __init__(self, dataset, metric_names_and_fns, save_path,
+                 num_workers=1, worker_id=0):
+        self.dataset = dataset
+        self.metrics = dict(metric_names_and_fns)
+        self.save_path = save_path
+        self.num_workers = int(num_workers)
+        self.worker_id = int(worker_id)
+        if not (0 <= self.worker_id < self.num_workers):
+            raise ValueError(f"worker_id {worker_id} out of range for "
+                             f"{num_workers} workers")
+
+    # ---------------------------------------------------------------- map
+    def _num_samples(self):
+        if isinstance(self.dataset, dict):  # dict-of-columns form
+            return len(next(iter(self.dataset.values())))
+        return len(self.dataset)
+
+    def shard_indices(self):
+        """This worker's contiguous sample range (reference
+        ``get_shard_indices``): contiguity keeps the reduce a pure concat."""
+        return np.array_split(np.arange(self._num_samples()),
+                              self.num_workers)[self.worker_id]
+
+    def _sample(self, i):
+        if isinstance(self.dataset, dict):
+            return {k: v[i] for k, v in self.dataset.items()}
+        return self.dataset[i]
+
+    def run_map(self):
+        """Compute this worker's metric values and persist them as one
+        indexed-dataset shard per metric under ``worker_<id>/``."""
+        from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+            MMapIndexedDatasetBuilder)
+        idx = self.shard_indices()
+        wdir = os.path.join(self.save_path, f"worker_{self.worker_id}")
+        os.makedirs(wdir, exist_ok=True)
+        for m, fn in self.metrics.items():
+            builder = MMapIndexedDatasetBuilder(
+                os.path.join(wdir, f"{m}_sample_to_value"), dtype=np.float64)
+            for i in idx:
+                builder.add_item(np.asarray([fn(self._sample(int(i)))],
+                                            dtype=np.float64))
+            builder.finalize()
+        with open(os.path.join(wdir, "shard.txt"), "w") as f:
+            f.write(f"{idx[0] if len(idx) else 0} {len(idx)} "
+                    f"{self.num_workers}")
+        return wdir
+
+    # -------------------------------------------------------------- reduce
+    @staticmethod
+    def run_reduce(save_path, metric_names, num_workers):
+        """Merge all worker shards (in worker order == original sample order)
+        and write the final index maps. Returns the same structure as
+        :meth:`DataAnalyzer.run_map_reduce`."""
+        from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+            MMapIndexedDataset, MMapIndexedDatasetBuilder, data_file_path)
+        # consistency: every worker must have mapped with THIS worker count,
+        # and the contiguous shards must cover the corpus exactly
+        expected_start = 0
+        for w in range(num_workers):
+            with open(os.path.join(save_path, f"worker_{w}", "shard.txt")) as f:
+                start, count, mapped_with = (int(t) for t in f.read().split())
+            if mapped_with != num_workers:
+                raise ValueError(
+                    f"worker_{w} mapped with num_workers={mapped_with}, "
+                    f"reduce called with {num_workers}")
+            if count and start != expected_start:
+                raise ValueError(
+                    f"worker_{w} shard starts at {start}, expected "
+                    f"{expected_start} — shards are not contiguous")
+            expected_start += count
+        out = {}
+        for m in metric_names:
+            merged_prefix = os.path.join(save_path, f"{m}_sample_to_value")
+            builder = MMapIndexedDatasetBuilder(merged_prefix, dtype=np.float64)
+            for w in range(num_workers):
+                shard = os.path.join(save_path, f"worker_{w}",
+                                     f"{m}_sample_to_value")
+                builder.merge_file(shard)
+            builder.finalize()
+            ds = MMapIndexedDataset(merged_prefix)
+            if int(ds.sizes.max(initial=1)) != 1 or int(ds.sizes.min(initial=1)) != 1:
+                raise ValueError(f"metric {m}: expected one value per sample")
+            # every item is one float64: one vectorized read of the .bin
+            arr = np.array(np.memmap(data_file_path(merged_prefix),
+                                     dtype=np.float64, mode="r")) \
+                if len(ds) else np.empty((0,), np.float64)
+            if arr.size != expected_start:
+                raise ValueError(
+                    f"metric {m}: merged {arr.size} values for "
+                    f"{expected_start} samples")
+            order = np.argsort(arr, kind="stable")
+            out[m] = {"values": arr, "index_sorted_by_metric": order}
+            np.save(os.path.join(save_path, f"{m}_values.npy"), arr)
+            np.save(os.path.join(save_path, f"{m}_index.npy"), order)
+        return out
+
+
 class CurriculumDataSampler:
     """Difficulty-gated batch sampler (reference ``DeepSpeedDataSampler``).
 

@@ -233,3 +233,91 @@ def test_random_ltd_scheduler():
     assert s.get_value(0) == 16
     assert s.get_value(100) == 64
     assert s.get_value(50) in (32, 48)
+
+
+# ---------------------------------------------------------------------------
+# distributed data analyzer (VERDICT r2 #10)
+# ---------------------------------------------------------------------------
+
+def _build_corpus(prefix, n=37, seed=0):
+    from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+        MMapIndexedDatasetBuilder)
+    rng = np.random.default_rng(seed)
+    b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    for _ in range(n):
+        b.add_item(rng.integers(0, 100, size=rng.integers(3, 40)))
+    b.finalize()
+
+
+_WORKER_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DistributedDataAnalyzer
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import MMapIndexedDataset
+ds = MMapIndexedDataset({prefix!r})
+metrics = {{"seqlen": lambda s: float(len(s)), "toksum": lambda s: float(s.sum())}}
+DistributedDataAnalyzer(ds, metrics, {save!r},
+                        num_workers={nw}, worker_id={wid}).run_map()
+print("WORKER_DONE", {wid})
+"""
+
+
+def test_distributed_analyzer_matches_single_process(tmp_path):
+    """Two real worker PROCESSES map disjoint shards; reduce merges via
+    MMapIndexedDatasetBuilder.merge_file; index maps must equal the
+    single-process DataAnalyzer byte for byte."""
+    import os
+    import subprocess
+    import sys
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        DataAnalyzer, DistributedDataAnalyzer)
+    from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+        MMapIndexedDataset)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prefix = str(tmp_path / "corpus")
+    _build_corpus(prefix)
+    save = str(tmp_path / "analysis")
+
+    procs = [subprocess.run(
+        [sys.executable, "-c", _WORKER_SCRIPT.format(
+            repo=repo, prefix=prefix, save=save, nw=2, wid=w)],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}) for w in range(2)]
+    for p in procs:
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "WORKER_DONE" in p.stdout
+
+    merged = DistributedDataAnalyzer.run_reduce(save, ["seqlen", "toksum"],
+                                                num_workers=2)
+
+    ds = MMapIndexedDataset(prefix)
+    single = DataAnalyzer(ds, {"seqlen": lambda s: float(len(s)),
+                               "toksum": lambda s: float(s.sum())}).run_map_reduce()
+    for m in ("seqlen", "toksum"):
+        np.testing.assert_array_equal(merged[m]["values"], single[m]["values"])
+        np.testing.assert_array_equal(merged[m]["index_sorted_by_metric"],
+                                      single[m]["index_sorted_by_metric"])
+    # persisted maps load through the same API the curriculum sampler uses
+    loaded = DataAnalyzer.load(save, "seqlen")
+    np.testing.assert_array_equal(loaded["values"], single["seqlen"]["values"])
+
+
+def test_distributed_analyzer_uneven_shards(tmp_path):
+    """num_workers that does not divide the corpus still reduces exactly."""
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        DataAnalyzer, DistributedDataAnalyzer)
+    from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+        MMapIndexedDataset)
+    prefix = str(tmp_path / "corpus")
+    _build_corpus(prefix, n=10, seed=3)
+    save = str(tmp_path / "analysis")
+    ds = MMapIndexedDataset(prefix)
+    metrics = {"seqlen": lambda s: float(len(s))}
+    for w in range(3):  # in-process workers: shard math is what's under test
+        DistributedDataAnalyzer(ds, metrics, save, num_workers=3,
+                                worker_id=w).run_map()
+    merged = DistributedDataAnalyzer.run_reduce(save, ["seqlen"], 3)
+    single = DataAnalyzer(ds, metrics).run_map_reduce()
+    np.testing.assert_array_equal(merged["seqlen"]["values"],
+                                  single["seqlen"]["values"])
