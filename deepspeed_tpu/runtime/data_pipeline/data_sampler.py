@@ -113,13 +113,17 @@ class DistributedDataAnalyzer:
         idx = self.shard_indices()
         wdir = os.path.join(self.save_path, f"worker_{self.worker_id}")
         os.makedirs(wdir, exist_ok=True)
-        for m, fn in self.metrics.items():
-            builder = MMapIndexedDatasetBuilder(
-                os.path.join(wdir, f"{m}_sample_to_value"), dtype=np.float64)
-            for i in idx:
-                builder.add_item(np.asarray([fn(self._sample(int(i)))],
-                                            dtype=np.float64))
-            builder.finalize()
+        # one pass over the samples, all metrics per sample (corpus reads
+        # dominate; M passes would multiply shard I/O by M)
+        builders = {m: MMapIndexedDatasetBuilder(
+            os.path.join(wdir, f"{m}_sample_to_value"), dtype=np.float64)
+            for m in self.metrics}
+        for i in idx:
+            sample = self._sample(int(i))
+            for m, fn in self.metrics.items():
+                builders[m].add_item(np.asarray([fn(sample)], dtype=np.float64))
+        for b in builders.values():
+            b.finalize()
         with open(os.path.join(wdir, "shard.txt"), "w") as f:
             f.write(f"{idx[0] if len(idx) else 0} {len(idx)} "
                     f"{self.num_workers}")

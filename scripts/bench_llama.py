@@ -1,0 +1,142 @@
+"""Flagship-path on-chip bench: llama-architecture training MFU.
+
+Exercises exactly the stack BASELINE.md's north-star rows name: flash
+attention (Pallas), GQA, scan-over-layers, ZeRO-3 param partitioning, bf16 —
+on a ~0.8B llama config sized for one v5e-class chip. Prints ONE JSON line
+like bench.py (metric/value/unit/vs_baseline where vs_baseline = MFU / 0.45).
+
+Usage: python scripts/bench_llama.py [--steps N] [--seq T] [--batch B]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # repo-root bench.py: probe/retry/recovery + peak_flops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=0, help="0 = ladder")
+    ap.add_argument("--remat", default="", help="fixed remat policy")
+    args = ap.parse_args()
+
+    try:
+        devs = bench.init_backend_with_retry()
+    except Exception as e:
+        bench.emit({"metric": "llama800m_bf16_zero3_tokens_per_sec_per_chip",
+                    "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                    "extra": {"error": f"{type(e).__name__}: {e}"[:300],
+                              "holders": getattr(e, "bench_holders", None)}})
+        return
+
+    import jax
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                            llama_flops_per_token)
+
+    n_chips = len(devs)
+    kind = devs[0].device_kind
+    on_tpu = devs[0].platform in ("tpu", "axon")
+    seq = args.seq if on_tpu else 128
+
+    if on_tpu:
+        # ~0.8B: 16 layers x 1792 hidden, 14 heads (GQA 7:1 -> 2 kv heads)
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1792,
+                          intermediate_size=4864, num_hidden_layers=16,
+                          num_attention_heads=14, num_key_value_heads=2,
+                          max_position_embeddings=seq)
+    else:
+        cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+
+    if args.batch:
+        candidates = [(args.batch, args.remat or "dots")]
+    elif args.remat:
+        candidates = [(16, args.remat), (8, args.remat), (4, args.remat)]
+    else:
+        candidates = ([(16, "dots"), (8, "dots"), (8, "everything"),
+                       (4, "everything")] if on_tpu else [(2, "dots")])
+
+    engine = loss = None
+    last_err = None
+    for batch, remat_policy in candidates:
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size,
+                           size=(batch * n_chips, seq)).astype(np.int32)
+        data = {"input_ids": ids, "labels": ids}
+        try:
+            from deepspeed_tpu.parallel import groups
+            groups.reset()
+            params = model.init(jax.random.PRNGKey(0), data)["params"]
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model, model_parameters=params,
+                config={
+                    "train_micro_batch_size_per_gpu": batch,
+                    "gradient_accumulation_steps": 1,
+                    "bf16": {"enabled": True},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                    "zero_optimization": {"stage": 3,
+                                          "stage3_param_persistence_threshold": 0},
+                    "gradient_clipping": 1.0,
+                    "activation_checkpointing": {"policy": remat_policy},
+                })
+
+            def step():
+                loss = engine(data)
+                engine.backward(loss)
+                engine.step()
+                return loss
+
+            t0 = time.perf_counter()
+            loss = step()
+            jax.block_until_ready(loss)
+            print(f"llama bench: compile+first {time.perf_counter()-t0:.1f}s "
+                  f"batch={batch} remat={remat_policy} "
+                  f"loss={float(jax.device_get(loss)):.3f}", file=sys.stderr)
+            break
+        except Exception as e:
+            last_err = RuntimeError(f"{type(e).__name__}: {e}"[:400])
+            engine = params = None
+            import gc
+            gc.collect()
+            print(f"llama bench: batch {batch}/{remat_policy} failed; "
+                  f"falling back", file=sys.stderr)
+    if engine is None:
+        bench.emit({"metric": "llama800m_bf16_zero3_tokens_per_sec_per_chip",
+                    "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                    "extra": {"error": str(last_err)}})
+        return
+
+    n_steps = args.steps if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step()
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * n_chips * seq * n_steps
+    tok_chip = tokens / dt / n_chips
+    mfu = tok_chip * llama_flops_per_token(cfg, seq) / bench.peak_flops(kind)
+    bench.emit({
+        "metric": "llama800m_bf16_zero3_tokens_per_sec_per_chip",
+        "value": round(tok_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {"mfu": round(mfu, 4), "chips": n_chips, "device": kind,
+                  "params_m": round(cfg.num_parameters() / 1e6, 1),
+                  "batch_per_chip": batch, "seq": seq, "steps": n_steps,
+                  "remat_policy": remat_policy,
+                  "loss": float(jax.device_get(loss))},
+    })
+
+
+if __name__ == "__main__":
+    main()
