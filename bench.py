@@ -149,10 +149,13 @@ def _candidate_holders():
                 same_uid = os.stat(p).st_uid == os.getuid()
             except OSError:
                 same_uid = False
+            repo_dir = os.path.dirname(os.path.abspath(__file__))
             out.append({"pid": pid, "age_s": None if age is None else round(age),
                         "ancestor": pid in ancestors, "same_uid": same_uid,
-                        "ours": any(t in cmd for t in
-                                    ("deepspeed_tpu", "bench", "tpu_kernel_smoke")),
+                        # precise signatures only: this repo's package name or
+                        # a path inside this repo — a generic token like
+                        # "bench" would match a colleague's benchmark_runner
+                        "ours": ("deepspeed_tpu" in cmd or repo_dir in cmd),
                         "cmdline": cmd[:200]})
         except Exception:
             continue

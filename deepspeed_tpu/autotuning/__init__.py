@@ -1,3 +1,5 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune
+from deepspeed_tpu.autotuning.scheduler import (Node, Reservation,
+                                                ResourceManager)
 
-__all__ = ["Autotuner", "autotune"]
+__all__ = ["Autotuner", "autotune", "ResourceManager", "Node", "Reservation"]

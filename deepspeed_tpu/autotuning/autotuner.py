@@ -282,6 +282,59 @@ class Autotuner:
         log_dist(f"autotuning: best {best}", ranks=[0])
         return cfg, best.metric
 
+    def tune_scheduled(self, hosts=1, results_dir=None, tuning_budget_s=None,
+                       exp_timeout_s=None, search="cost"):
+        """Run the experiment grid through the ResourceManager (reference
+        ``autotuning/scheduler.py`` path): queue → dispatch onto free slots →
+        persist per-experiment metrics (resume skips finished ones) →
+        wall-clock caps. On a single in-process backend the slot count
+        effectively serializes experiments; multi-slot hosts model multi-host
+        tuning where each experiment owns a host. Returns (best_config,
+        metric)."""
+        from deepspeed_tpu.autotuning.scheduler import ResourceManager
+        self.profile_model_info()
+        try:
+            dp_world = max(1, jax.device_count())
+        except Exception:
+            dp_world = 1
+        stages = self.space.get("zero_stage") or [0]
+        remats = self.space.get("remat_policy") or ["everything"]
+        mbs_list = sorted(self._micro_batch_candidates())
+        grid = list(itertools.product(stages, remats, mbs_list))
+        if search == "cost":
+            grid.sort(key=lambda t: self.predicted_step_cost(
+                t[0], t[2], t[1], dp_world))
+        exps = []
+        for stage, remat, mbs in grid[:self.max_trials]:
+            reason = self.prune(stage, mbs, remat, dp_world)
+            if reason:
+                continue
+            exps.append({"name": f"z{stage}_mbs{mbs}_{remat}",
+                         "overrides": {"zero_stage": stage,
+                                       "micro_batch_size": mbs,
+                                       "remat_policy": remat}})
+        rm = ResourceManager(hosts=hosts, results_dir=results_dir,
+                             tuning_budget_s=tuning_budget_s,
+                             exp_timeout_s=exp_timeout_s)
+        rm.schedule_experiments(exps)
+
+        def run_fn(exp, reservation):
+            e = Experiment(exp["overrides"])
+            self.experiments.append(e)
+            self._run_experiment(e)
+            if e.metric is None:
+                raise RuntimeError(e.error or "experiment produced no metric")
+            return {"metric": e.metric, "overrides": exp["overrides"]}
+
+        rm.run(run_fn)
+        best = rm.parse_results("metric")
+        if best is None:
+            raise RuntimeError("autotuning: every scheduled experiment failed")
+        ov = best["result"]["overrides"]
+        cfg = self._build_config(ov["zero_stage"], ov["micro_batch_size"],
+                                 ov["remat_policy"])
+        return cfg, best["result"]["metric"]
+
     def summary(self):
         return [(e.overrides, e.metric, e.error) for e in self.experiments]
 

@@ -1,0 +1,253 @@
+"""Autotuning experiment scheduler — queue, resources, caps, resume.
+
+Capability analog of reference ``autotuning/scheduler.py`` (ResourceManager
+:33, Node :260, Reservation :275): experiments are queued, dispatched onto
+free device slots as they become available, run concurrently up to the
+resource limit, and their results are persisted so an interrupted tuning
+session resumes without re-running finished experiments.
+
+TPU-native differences: experiments are Python callables in-process (engines
+are fresh jits, no process relaunch or pdsh fan-out needed), a "slot" is a
+chip (or a whole host for multi-host experiments), and wall-clock budgets are
+enforced at dispatch time — the reference's ssh/pdsh job control collapses
+into a thread pool.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Node:
+    """A host with ``max_slots`` schedulable device slots (reference :260)."""
+
+    def __init__(self, host, max_slots):
+        self.host = host
+        self.max_slots = int(max_slots)
+        self.idle_slots = list(range(self.max_slots))
+        self._lock = threading.Lock()
+
+    def reserve_slots(self, n):
+        with self._lock:
+            if len(self.idle_slots) < n:
+                return None
+            take, self.idle_slots = self.idle_slots[:n], self.idle_slots[n:]
+            return take
+
+    def restore_slots(self, slots):
+        with self._lock:
+            self.idle_slots.extend(slots)
+
+
+class Reservation:
+    """Slots held by one running experiment (reference :275)."""
+
+    def __init__(self, node, slots):
+        self.node = node
+        self.slots = slots
+
+    def restore(self):
+        self.node.restore_slots(self.slots)
+
+    @property
+    def desc(self):
+        return f"{self.node.host}:{','.join(map(str, self.slots))}"
+
+
+class ResourceManager:
+    """Dispatch experiments onto free slots with caps and resume.
+
+    Args:
+        hosts: {host: slots} (or a plain int = slots on this host).
+        results_dir: metrics.json per experiment lands in
+            ``results_dir/<name>/``; existing results are not re-run.
+        exp_timeout_s: per-experiment wall-clock cap (best effort in-process:
+            the runner thread is abandoned and the result discarded; the
+            reference kills the remote job over ssh).
+        tuning_budget_s: total tuning wall-clock cap — no NEW experiment is
+            dispatched past it (reference autotuner exps max-time behavior).
+    """
+
+    def __init__(self, hosts=1, results_dir=None, exp_timeout_s=None,
+                 tuning_budget_s=None):
+        if isinstance(hosts, int):
+            hosts = {"localhost": hosts}
+        self.nodes = [Node(h, s) for h, s in hosts.items()]
+        self.results_dir = results_dir
+        self.exp_timeout_s = exp_timeout_s
+        self.tuning_budget_s = tuning_budget_s
+        self.experiment_queue: List[dict] = []
+        self.finished_experiments: Dict[str, dict] = {}
+        self._count = 0
+
+    # ------------------------------------------------------------- queueing
+    def schedule_experiments(self, exps):
+        """Queue experiment dicts ({'name': ..., 'num_slots': 1, ...}); a
+        finished result on disk short-circuits the run (resume semantics,
+        reference :59 skip-existing)."""
+        for exp in exps:
+            exp = dict(exp)
+            exp.setdefault("num_slots", 1)
+            exp["exp_id"] = self._count
+            self._count += 1
+            prior = self._load_result(exp["name"])
+            if prior is not None:
+                logger.info(f"autotuning scheduler: '{exp['name']}' already "
+                            "has results; skipping")
+                exp["result"] = prior
+                exp["resumed"] = True
+                self.finished_experiments[exp["name"]] = exp
+                continue
+            self.experiment_queue.append(exp)
+
+    def _result_path(self, name):
+        return None if self.results_dir is None else os.path.join(
+            self.results_dir, name, "metrics.json")
+
+    def _load_result(self, name):
+        p = self._result_path(name)
+        if p is None or not os.path.exists(p):
+            return None
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # interrupted write -> re-run
+
+    def _save_result(self, name, result):
+        p = self._result_path(name)
+        if p is None:
+            return
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f)
+        os.replace(tmp, p)  # atomic: a crash never leaves half a result
+
+    def _reserve(self, n):
+        for node in self.nodes:
+            slots = node.reserve_slots(n)
+            if slots is not None:
+                return Reservation(node, slots)
+        return None
+
+    # ------------------------------------------------------------ dispatch
+    def run(self, run_fn: Callable[[dict, Reservation], dict]):
+        """Drain the queue. ``run_fn(exp, reservation) -> result dict`` (must
+        contain the metric the caller will rank by). Returns
+        ``finished_experiments`` {name: exp} where exp['result'] holds the
+        outcome or exp['error'] the failure."""
+        start = time.time()
+        running: List[dict] = []
+        lock = threading.Lock()
+
+        def launch(exp, res):
+            done_once = threading.Event()
+            claim_lock = threading.Lock()
+            claimed = [False]
+
+            def finish(error=None, result=None, elapsed=None):
+                # first outcome wins: a timeout mark beats a late success.
+                # done_evt is signaled LAST so run() cannot return before the
+                # result file and finished_experiments entry exist — and the
+                # slot restore / bookkeeping are in finally so a result-save
+                # failure can never leak the reservation and hang run().
+                with claim_lock:
+                    if claimed[0]:
+                        return
+                    claimed[0] = True
+                try:
+                    if error is not None:
+                        exp["error"] = error
+                    if result is not None:
+                        exp["result"] = result
+                        try:
+                            self._save_result(exp["name"], result)
+                        except OSError as e:
+                            exp["persist_error"] = f"{e}"[:200]
+                    if elapsed is not None:
+                        exp["elapsed_s"] = round(elapsed, 3)
+                finally:
+                    res.restore()
+                    with lock:
+                        self.finished_experiments[exp["name"]] = exp
+                    done_once.set()
+
+            def work():
+                t0 = time.time()
+                try:
+                    out = run_fn(exp, res)
+                    finish(result=out, elapsed=time.time() - t0)
+                except Exception as e:  # experiment failure, not scheduler
+                    finish(error=f"{type(e).__name__}: {e}"[:300])
+
+            t = threading.Thread(target=work, daemon=True,
+                                 name=f"exp-{exp['exp_id']}")
+            rec = {"exp": exp, "thread": t, "finish": finish,
+                   "done_evt": done_once,
+                   "deadline": None if self.exp_timeout_s is None
+                   else time.time() + self.exp_timeout_s}
+            t.start()
+            running.append(rec)
+
+        def alive():
+            # a timed-out experiment counts as done even while its abandoned
+            # thread is still running — otherwise the loop would never exit
+            return [r for r in running
+                    if r["thread"].is_alive() and not r["done_evt"].is_set()]
+
+        while self.experiment_queue or alive():
+            if self.experiment_queue:
+                if (self.tuning_budget_s is not None
+                        and time.time() - start > self.tuning_budget_s):
+                    for exp in self.experiment_queue:
+                        exp["error"] = ("skipped: tuning wall-clock budget "
+                                        "exhausted")
+                        self.finished_experiments[exp["name"]] = exp
+                    logger.warning(
+                        f"autotuning scheduler: budget {self.tuning_budget_s}s "
+                        f"exhausted; skipping "
+                        f"{len(self.experiment_queue)} queued experiments")
+                    self.experiment_queue.clear()
+                    continue
+                exp = self.experiment_queue[0]
+                res = self._reserve(exp["num_slots"])
+                if res is not None:
+                    self.experiment_queue.pop(0)
+                    launch(exp, res)
+                    continue
+            # per-experiment cap: mark + release slots; the runner thread is
+            # abandoned (daemon) and its late outcome discarded — the
+            # reference kills the remote job over ssh instead (:402 clean_up)
+            now = time.time()
+            for r in alive():
+                if r["deadline"] is not None and now > r["deadline"]:
+                    r["finish"](error=f"timeout after {self.exp_timeout_s}s")
+                    r["deadline"] = None
+            time.sleep(0.01)
+        return self.finished_experiments
+
+    # ------------------------------------------------------------- results
+    def parse_results(self, metric, maximize=True):
+        """Best finished experiment by ``result[metric]`` (reference :212)."""
+        best = None
+        for exp in self.finished_experiments.values():
+            r = exp.get("result")
+            if not r or metric not in r:
+                continue
+            if best is None:
+                best = exp
+            elif maximize and r[metric] > best["result"][metric]:
+                best = exp
+            elif not maximize and r[metric] < best["result"][metric]:
+                best = exp
+        return best
+
+    def status(self):
+        done = sum(1 for e in self.finished_experiments.values())
+        return {"queued": len(self.experiment_queue), "finished": done,
+                "idle_slots": sum(len(n.idle_slots) for n in self.nodes)}

@@ -49,6 +49,7 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     moe = DeepSpeedMoEConfig()
     quant = QuantizationConfig()
     checkpoint = None                 # path to a saved checkpoint dir
+    replica_num = 1                   # dp-replicated serving (MII replica_num)
     replace_with_kernel_inject = False
     max_out_tokens = 1024
     min_out_tokens = 1

@@ -62,7 +62,8 @@ class InferenceEngine:
             config = DeepSpeedInferenceConfig.from_dict(config or {})
         self.module = model
         self._config = config
-        self.mesh = self._build_mesh(config.tensor_parallel.tp_size)
+        self.mesh = self._build_mesh(config.tensor_parallel.tp_size,
+                                     config.replica_num)
         if params is None and config.checkpoint:
             params = self._load_checkpoint(config.checkpoint)
         self.params = self._shard_params(params) if params is not None else None
@@ -71,12 +72,40 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**32))
 
     # -- setup -------------------------------------------------------------
-    def _build_mesh(self, tp_size):
+    def _build_mesh(self, tp_size, replica_num=1):
+        """(dp, tp) serving mesh over the GLOBAL device set.
+
+        ``jax.devices()`` spans every host of a multi-host deployment (sorted
+        by process), so reshaping to (replica, tp) keeps each tp group on
+        consecutive devices — within one host whenever tp_size <= the local
+        device count, i.e. tp collectives ride ICI and never DCN. ``dp``
+        carries request-level replicas (MII ``replica_num``): param specs
+        only name "tp", so weights replicate across dp and batches shard
+        over it (the reference runs N separate server processes instead)."""
         devices = jax.devices()
         if tp_size > len(devices):
             logger.warning(f"tp_size {tp_size} > {len(devices)} devices; clamping")
             tp_size = len(devices)
-        return Mesh(np.array(devices[:tp_size]).reshape(tp_size), ("tp",))
+        dp = max(1, int(replica_num))
+        if dp * tp_size > len(devices):
+            dp = max(1, len(devices) // tp_size)
+            logger.warning(f"replica_num x tp_size exceeds {len(devices)} "
+                           f"devices; clamping replicas to {dp}")
+        n = dp * tp_size
+        return Mesh(np.array(devices[:n]).reshape(dp, tp_size), ("dp", "tp"))
+
+    def _shard_batch(self, batch):
+        """Shard the batch dim over dp replicas (no-op on a 1-replica mesh)."""
+        if self.mesh.shape["dp"] == 1:
+            return batch
+        sh = NamedSharding(self.mesh, P("dp"))
+
+        def put(x):
+            x = jnp.asarray(x)
+            if x.ndim >= 1 and x.shape[0] % self.mesh.shape["dp"] == 0:
+                return jax.device_put(x, sh)
+            return x
+        return jax.tree.map(put, batch)
 
     def _shard_params(self, params):
         dtype = self._config.jax_dtype
@@ -153,6 +182,7 @@ class InferenceEngine:
                 lambda p, b: mod.apply({"params": p}, b))
         if isinstance(batch, (np.ndarray, jnp.ndarray)):
             batch = {"input_ids": jnp.asarray(batch, jnp.int32)}
+        batch = self._shard_batch(batch)
         with self.mesh:
             return self._forward_fn(self.params, batch)
 

@@ -2,3 +2,4 @@ from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig,
                                                   KVCacheConfig,
                                                   RaggedInferenceEngineConfig)
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2, SchedulingResult
+from deepspeed_tpu.inference.v2.replica_group import ReplicaGroup

@@ -1,0 +1,85 @@
+"""dp-replicated FastGen serving (MII ``replica_num`` analog).
+
+The reference scales FastGen across replicas by launching N server processes
+(DeepSpeed-MII) — on TPU the same capability is N independent
+(engine, scheduler) pairs inside one process, each pinned to its own slice of
+the global device set (a tp-submesh), with requests distributed round-robin.
+Computation follows parameter placement in XLA, so pinning is just
+``device_put`` of each replica's params onto its submesh; multi-host works
+the same way because ``jax.devices()`` is global.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
+from deepspeed_tpu.utils.logging import logger
+
+
+class ReplicaGroup:
+    """N replicas of ``InferenceEngineV2`` + ``SplitFuseScheduler``.
+
+    Args:
+        model: flax module (same for every replica).
+        params: parameter pytree (host or device arrays; re-placed per
+            replica).
+        replica_num: number of dp replicas.
+        tp_size: devices per replica; params are sharded over a ("tp",)
+            submesh via ``model.param_specs`` when available.
+        engine_config: per-replica ``InferenceEngineV2`` config.
+        token_budget: per-replica SplitFuse token budget.
+    """
+
+    def __init__(self, model, params, replica_num=2, tp_size=1,
+                 engine_config=None, token_budget=None):
+        devices = jax.devices()
+        if tp_size > len(devices):
+            logger.warning(f"tp_size {tp_size} > {len(devices)} devices; "
+                           "clamping")
+            tp_size = len(devices)
+        need = replica_num * tp_size
+        if need > len(devices):
+            replica_num = max(1, len(devices) // tp_size)
+            logger.warning(f"replica_num x tp_size > {len(devices)} devices; "
+                           f"clamping to {replica_num} replicas")
+        self.replicas = []
+        for r in range(replica_num):
+            sub = devices[r * tp_size:(r + 1) * tp_size]
+            mesh = Mesh(np.array(sub).reshape(tp_size), ("tp",))
+            if tp_size > 1 and hasattr(model, "param_specs"):
+                specs = model.param_specs(params)
+                sh = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s if s is not None else P()),
+                    specs, is_leaf=lambda s: s is None or isinstance(s, P))
+                local = jax.device_put(params, sh)
+            else:
+                local = jax.device_put(params, sub[0]) if tp_size == 1 else \
+                    jax.device_put(params, NamedSharding(mesh, P()))
+            engine = InferenceEngineV2(model, local, config=engine_config)
+            self.replicas.append(
+                (mesh, SplitFuseScheduler(engine, token_budget=token_budget)))
+        self._assignment = {}
+
+    @property
+    def replica_num(self):
+        return len(self.replicas)
+
+    def submit(self, uid, prompt, **kwargs):
+        """Round-robin request placement (reference MII load balancer)."""
+        r = len(self._assignment) % len(self.replicas)
+        self._assignment[uid] = r
+        mesh, sched = self.replicas[r]
+        with mesh:
+            sched.submit(uid, prompt, **kwargs)
+        return r
+
+    def run_to_completion(self):
+        """Drain every replica; merged {uid: tokens}."""
+        out = {}
+        for mesh, sched in self.replicas:
+            with mesh:
+                out.update(sched.run_to_completion())
+        return out

@@ -1,0 +1,98 @@
+"""Tiled linear layers (reference ``runtime/zero/tiling.py`` TiledLinear).
+
+The reference splits a huge Linear into an ``in_splits x out_splits`` grid of
+small Linears so ZeRO-3 can partition/gather each tile independently and the
+full weight never needs to be resident at once. Under GSPMD most of that job
+is the partitioner's (a sharded weight IS tiles), but the capability still
+matters on TPU for layers bigger than one chip's HBM arena: storing the
+weight as explicit tile parameters bounds the size of any single all-gather
+and lets the engine's persistence threshold keep individual tiles sharded.
+
+``TiledLinear`` keeps the tile grid as separate flax params named
+``tile_{i}_{j}`` (each eligible for its own ZeRO sharding decision) and
+contracts them with a python loop over output tiles — XLA fuses the
+accumulation; peak live memory is one row of tiles plus the output.
+
+The reference's ContiguousMemoryAllocator (defragmenting param buffers) has
+no analog here by design: XLA owns allocation and lays buffers out at
+compile time, so fragmentation of framework-managed arenas cannot occur.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def _splits(total, n):
+    if total % n != 0:
+        raise ValueError(f"cannot split {total} into {n} even tiles")
+    return total // n
+
+
+class TiledLinear(nn.Module):
+    """Drop-in ``nn.Dense`` with an ``in_splits x out_splits`` tiled weight.
+
+    Equivalent math to ``nn.Dense(features)``; the weight is stored as
+    ``in_splits * out_splits`` independent ``[in/i, out/j]`` params. The
+    default init scales variance by the FULL fan-in (not the tile fan-in),
+    so fresh-init statistics match ``nn.Dense`` exactly.
+    """
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Any = None
+    kernel_init: Optional[Callable] = None  # None = Dense-equivalent default
+    bias_init: Callable = nn.initializers.zeros
+
+    def _contract(self, x):
+        """Shared tile contraction: returns (y_without_bias, bias|None)."""
+        in_features = x.shape[-1]
+        di = _splits(in_features, self.in_splits)
+        dj = _splits(self.features, self.out_splits)
+        dtype = self.dtype or x.dtype
+        # lecun_normal over the whole layer: per-tile variance must be
+        # 1/in_features, not 1/di, or summing in_splits tile products gives
+        # sqrt(in_splits)x the fresh-init output std of nn.Dense
+        kinit = self.kernel_init or nn.initializers.variance_scaling(
+            1.0 / self.in_splits, "fan_in", "truncated_normal")
+        xs = [x[..., i * di:(i + 1) * di] for i in range(self.in_splits)]
+        outs = []
+        for j in range(self.out_splits):
+            acc = None
+            for i in range(self.in_splits):
+                w = self.param(f"tile_{i}_{j}", kinit, (di, dj), jnp.float32)
+                part = xs[i] @ w.astype(dtype)
+                acc = part if acc is None else acc + part
+            outs.append(acc)
+        y = jnp.concatenate(outs, axis=-1)
+        bias = None
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,),
+                              jnp.float32).astype(dtype)
+        return y, bias
+
+    @nn.compact
+    def __call__(self, x):
+        y, bias = self._contract(x)
+        return y if bias is None else y + bias
+
+    @staticmethod
+    def from_dense_kernel(kernel, in_splits, out_splits):
+        """Split a dense [in, out] kernel into the tile param dict (migration
+        helper, the reference's ``copy_params_from`` analog)."""
+        di = _splits(kernel.shape[0], in_splits)
+        dj = _splits(kernel.shape[1], out_splits)
+        return {f"tile_{i}_{j}": kernel[i * di:(i + 1) * di,
+                                        j * dj:(j + 1) * dj]
+                for i in range(in_splits) for j in range(out_splits)}
+
+
+class TiledLinearReturnBias(TiledLinear):
+    """Reference ``TiledLinearReturnBias``: returns (output_without_bias,
+    bias) so callers can defer the bias add (fused residual paths)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return self._contract(x)

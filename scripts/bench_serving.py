@@ -69,7 +69,9 @@ def serving_bench(args, on_tpu):
     t0 = time.perf_counter()
     got = sched.run_to_completion()
     dt = time.perf_counter() - t0
-    decoded = sum(len(v) for v in got.values())
+    # count ONLY the timed requests — run_to_completion also returns the
+    # warmup uid, whose tokens were generated before the timer started
+    decoded = sum(len(got[u]) for u in prompts)
     total = decoded + n_req * prompt_len
     payload = {
         "metric": "splitfuse_serving_tokens_per_sec",

@@ -233,3 +233,81 @@ def test_qwz_checkpoint_roundtrip(tmp_path):
     after = engine2.get_model_parameters()
     for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# TiledLinear (reference runtime/zero/tiling.py; closes the last §2 partial)
+# ---------------------------------------------------------------------------
+
+def test_tiled_linear_matches_dense():
+    import numpy as np
+    import flax.linen as nn
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 24))
+    dense = nn.Dense(12)
+    dp = dense.init(jax.random.PRNGKey(1), x)["params"]
+    tiled = TiledLinear(features=12, in_splits=3, out_splits=2)
+    tiles = TiledLinear.from_dense_kernel(dp["kernel"], 3, 2)
+    tp = {**tiles, "bias": dp["bias"]}
+    got = tiled.apply({"params": tp}, x)
+    want = dense.apply({"params": dp}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_tiled_linear_params_shard_independently(eight_devices):
+    """Each tile is its own leaf -> the ZeRO partitioner shards tiles
+    independently (the point of tiling: no single giant gather)."""
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+    x = jnp.zeros((2, 32))
+    mod = TiledLinear(features=16, in_splits=2, out_splits=2)
+    params = mod.init(jax.random.PRNGKey(0), x)["params"]
+    assert sum(1 for k in params if k.startswith("tile_")) == 4
+    topo = MeshTopology(dp=-1)
+    part = ZeroPartitioner(topo, DeepSpeedZeroConfig(
+        **{"stage": 3, "stage3_param_persistence_threshold": 0}))
+    sh = part.param_sharding(params)
+    from jax.sharding import PartitionSpec as P
+    tile_specs = [s.spec for k, s in sh.items() if k.startswith("tile_")]
+    assert all(s != P() for s in tile_specs), "every tile must be sharded"
+
+
+def test_tiled_linear_return_bias():
+    import numpy as np
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinearReturnBias
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 8))
+    mod = TiledLinearReturnBias(features=6, in_splits=2, out_splits=3)
+    params = mod.init(jax.random.PRNGKey(3), x)["params"]
+    y, b = mod.apply({"params": params}, x)
+    assert y.shape == (3, 6) and b.shape == (6,)
+    # y + b equals the fused TiledLinear on the same params
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+    fused = TiledLinear(features=6, in_splits=2, out_splits=3)
+    np.testing.assert_allclose(np.asarray(y + b),
+                               np.asarray(fused.apply({"params": params}, x)),
+                               atol=1e-6)
+
+
+def test_tiled_linear_rejects_uneven_split():
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+    x = jnp.zeros((1, 10))
+    with pytest.raises(ValueError):
+        TiledLinear(features=8, in_splits=3).init(jax.random.PRNGKey(0), x)
+
+
+def test_tiled_linear_init_variance_matches_dense():
+    """Fresh-init output std must match nn.Dense (full fan-in scaling)."""
+    import numpy as np
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+    x = jax.random.normal(jax.random.PRNGKey(5), (512, 256))
+    tiled = TiledLinear(features=128, in_splits=4, use_bias=False)
+    tp = tiled.init(jax.random.PRNGKey(6), x)["params"]
+    y_t = np.asarray(tiled.apply({"params": tp}, x))
+    import flax.linen as nn
+    dense = nn.Dense(128, use_bias=False)
+    dp = dense.init(jax.random.PRNGKey(6), x)["params"]
+    y_d = np.asarray(dense.apply({"params": dp}, x))
+    assert abs(np.std(y_t) - np.std(y_d)) < 0.15 * np.std(y_d), \
+        (np.std(y_t), np.std(y_d))
