@@ -125,15 +125,17 @@ class BloomBlock(nn.Module):
 
 
 class ScanBloomBlock(nn.Module):
+    # deterministic is a static FIELD: carried through lax.scan it becomes a
+    # tracer and crashes flax Dropout's bool coercion for dropout > 0
     config: BloomConfig
     use_cache: bool = False
+    deterministic: bool = True
 
     @nn.compact
-    def __call__(self, carry, _):
-        x, deterministic = carry
+    def __call__(self, x, _):
         x = BloomBlock(self.config, self.use_cache, name="block")(
-            x, deterministic)
-        return (x, deterministic), None
+            x, self.deterministic)
+        return x, None
 
 
 class BloomForCausalLM(nn.Module):
@@ -170,7 +172,7 @@ class BloomForCausalLM(nn.Module):
                               split_rngs={"params": True, "dropout": True},
                               length=cfg.num_hidden_layers,
                               metadata_params={nn.meta.PARTITION_NAME: "layers"})
-            (x, _), _ = Scanned(cfg, use_cache, name="h")((x, deterministic),
+            x, _ = Scanned(cfg, use_cache, deterministic, name="h")((x),
                                                           None)
         else:
             block_cls = nn.remat(BloomBlock, prevent_cse=False,

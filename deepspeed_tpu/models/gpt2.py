@@ -112,14 +112,16 @@ class Block(nn.Module):
 
 
 class ScanBlock(nn.Module):
-    """Block adapted for nn.scan carry signature."""
+    """Block adapted for nn.scan. ``deterministic`` is a static module FIELD:
+    carried through lax.scan (or traced by remat) it would become a tracer
+    and crash flax Dropout's bool coercion for any dropout > 0."""
     config: GPT2Config
+    deterministic: bool = True
 
     @nn.compact
-    def __call__(self, carry, _):
-        x, deterministic = carry
-        x = Block(self.config, name="block")(x, deterministic)
-        return (x, deterministic), None
+    def __call__(self, x, _):
+        x = Block(self.config, name="block")(x, self.deterministic)
+        return x, None
 
 
 class GPT2LMHeadModel(nn.Module):
@@ -155,7 +157,7 @@ class GPT2LMHeadModel(nn.Module):
                                     split_rngs={"params": True, "dropout": True},
                                     length=cfg.n_layer,
                                     metadata_params={nn.meta.PARTITION_NAME: "layers"})
-            (x, _), _ = ScannedBlocks(cfg, name="h")((x, deterministic), None)
+            x, _ = ScannedBlocks(cfg, deterministic, name="h")(x, None)
         else:
             block_cls = nn.remat(Block, prevent_cse=False,
                                  policy=remat_policy()) if cfg.remat else Block

@@ -98,13 +98,14 @@ class OPTBlock(nn.Module):
 
 
 class ScanOPTBlock(nn.Module):
+    # deterministic is a static FIELD (see ScanBloomBlock note)
     config: OPTConfig
+    deterministic: bool = True
 
     @nn.compact
-    def __call__(self, carry, _):
-        x, deterministic = carry
-        x = OPTBlock(self.config, name="block")(x, deterministic)
-        return (x, deterministic), None
+    def __call__(self, x, _):
+        x = OPTBlock(self.config, name="block")(x, self.deterministic)
+        return x, None
 
 
 class OPTForCausalLM(nn.Module):
@@ -138,7 +139,7 @@ class OPTForCausalLM(nn.Module):
                               split_rngs={"params": True, "dropout": True},
                               length=cfg.num_hidden_layers,
                               metadata_params={nn.meta.PARTITION_NAME: "layers"})
-            (x, _), _ = Scanned(cfg, name="layers")((x, deterministic), None)
+            x, _ = Scanned(cfg, deterministic, name="layers")(x, None)
         else:
             blk = nn.remat(OPTBlock, prevent_cse=False,
                            policy=remat_policy()) if cfg.remat else OPTBlock
