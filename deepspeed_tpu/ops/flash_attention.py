@@ -64,6 +64,37 @@ def mha_reference(q, k, v, bias=None, causal=True, softmax_scale=None,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _pad_seq_to_lanes(q, k, v, bias, segment_ids, causal):
+    """Pad Tq == Tk sequences to a multiple of 128 so they stay on the
+    kernel path (packed/odd-length inputs). Padding goes at the END: under
+    causal masking real queries never see the later pad keys, and for
+    bidirectional attention pad keys get a reserved segment id no real token
+    carries. Returns (padded tensors..., original T) — caller slices the
+    output back. Tq != Tk is NOT padded (bottom-right causal alignment would
+    shift with unequal pads)."""
+    T = q.shape[1]
+    pad = (-T) % 128
+    padded = lambda x, val=0: jnp.pad(
+        x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2), constant_values=val)
+    q2, k2, v2 = padded(q), padded(k), padded(v)
+    if bias is not None:
+        bias = jnp.pad(bias, [(0, 0), (0, 0), (0, pad), (0, pad)])
+    if segment_ids is not None:
+        qs, ks = segment_ids
+        # reserved pad id: one past the max real id, so pads never match
+        pad_id = jnp.maximum(jnp.max(qs), jnp.max(ks)) + 1
+        in_real = jnp.arange(T + pad)[None, :] < T
+        qs2 = jnp.where(in_real, padded(qs.astype(jnp.int32)), pad_id)
+        ks2 = jnp.where(in_real, padded(ks.astype(jnp.int32)), pad_id)
+        segment_ids = (qs2, ks2)
+    elif not causal:
+        # bidirectional without user segments: synthesize real/pad segments
+        real = (jnp.arange(T + pad)[None, :] < T).astype(jnp.int32)
+        seg = jnp.broadcast_to(real, (q.shape[0], T + pad))
+        segment_ids = (seg, seg)
+    return q2, k2, v2, bias, segment_ids, T
+
+
 def mha(q, k, v, bias=None, causal=True, softmax_scale=None, window=None,
         segment_ids=None):
     if window is not None and int(window) <= 0:
@@ -75,6 +106,17 @@ def mha(q, k, v, bias=None, causal=True, softmax_scale=None, window=None,
         from deepspeed_tpu.ops.pallas import flash_attention as fa
         if segment_ids is not None and not isinstance(segment_ids, (tuple, list)):
             segment_ids = (segment_ids, segment_ids)
+        orig = (q, k, v, bias, segment_ids)
+        orig_t = None
+        T = q.shape[1]
+        # only pad when the bias (if any) is a full [.,.,T,T] — padding a
+        # non-4D or Tq/Tk-broadcast bias would corrupt or crash, and those
+        # shapes belong on the reference fallback anyway
+        bias_paddable = bias is None or (
+            bias.ndim == 4 and bias.shape[2] == T and bias.shape[3] == T)
+        if (T == k.shape[1] and T % 128 != 0 and T >= 16 and bias_paddable):
+            q, k, v, bias, segment_ids, orig_t = _pad_seq_to_lanes(
+                q, k, v, bias, segment_ids, causal)
         seg_shape = None if segment_ids is None else (segment_ids[0].shape,
                                                       segment_ids[1].shape)
         reason = fa.unsupported_reason(q.shape, k.shape,
@@ -84,11 +126,23 @@ def mha(q, k, v, bias=None, causal=True, softmax_scale=None, window=None,
             out = fa.flash_mha(q, k, v, bias=bias, causal=causal,
                                softmax_scale=softmax_scale, window=window,
                                segment_ids=segment_ids)
+            if orig_t is not None:
+                out = out[:, :orig_t]
             # named so remat policies can choose to save attention outputs
             # (see activation_checkpointing "dots" policy) — recomputing the
             # flash kernel in backward doubles its cost for no memory win
             # beyond the [B,T,H,Dh] output itself
             return jax.ad_checkpoint.checkpoint_name(out, "flash_attn_out")
+        q, k, v, bias, segment_ids = orig  # fall back on the UNpadded inputs
+        if orig_t is not None:
+            # re-derive the reason from the shapes the CALLER passed so the
+            # warning is actionable (the padded-shape reason can name sizes
+            # the user never wrote)
+            seg_shape = None if segment_ids is None else (
+                segment_ids[0].shape, segment_ids[1].shape)
+            reason = fa.unsupported_reason(
+                q.shape, k.shape, None if bias is None else bias.shape,
+                window, seg_shape) or reason
         key = (q.shape, k.shape, None if bias is None else bias.shape,
                window, seg_shape)
         if key not in _warned_shapes:
