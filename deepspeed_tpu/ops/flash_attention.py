@@ -115,8 +115,19 @@ def mha(q, k, v, bias=None, causal=True, softmax_scale=None, window=None,
         bias_paddable = bias is None or (
             bias.ndim == 4 and bias.shape[2] == T and bias.shape[3] == T)
         if (T == k.shape[1] and T % 128 != 0 and T >= 16 and bias_paddable):
-            q, k, v, bias, segment_ids, orig_t = _pad_seq_to_lanes(
-                q, k, v, bias, segment_ids, causal)
+            # check the WOULD-BE padded shapes first: unsupported_reason is
+            # shape-only, so an ultimately-unsupported config (head dim,
+            # GQA ratio, ...) never pays for materializing padded copies
+            Tp = T + ((-T) % 128)
+            pq = (q.shape[0], Tp, q.shape[2], q.shape[3])
+            pk = (k.shape[0], Tp, k.shape[2], k.shape[3])
+            pb = None if bias is None else (bias.shape[0], bias.shape[1],
+                                            Tp, Tp)
+            ps = ((q.shape[0], Tp), (k.shape[0], Tp)) \
+                if (segment_ids is not None or not causal) else None
+            if fa.unsupported_reason(pq, pk, pb, window, ps) is None:
+                q, k, v, bias, segment_ids, orig_t = _pad_seq_to_lanes(
+                    q, k, v, bias, segment_ids, causal)
         seg_shape = None if segment_ids is None else (segment_ids[0].shape,
                                                       segment_ids[1].shape)
         reason = fa.unsupported_reason(q.shape, k.shape,

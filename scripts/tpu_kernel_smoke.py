@@ -56,6 +56,36 @@ def smoke_flash():
         check(f"flash_mha d{n}", np.asarray(a) / scale, np.asarray(b) / scale,
               atol=0.05)
 
+    # sliding window (in-kernel block skip + DMA-clamped index maps) — the
+    # clamped index maps are traced scalar programs that must lower on Mosaic
+    out_w = jax.jit(lambda q, k, v: flash_mha(q, k, v, causal=True,
+                                              window=128))(q, k, v)
+    ref_w = mha_reference(q, k, v, causal=True, window=128)
+    check("flash_mha window fwd", out_w, ref_w, atol=0.05)
+    gw = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(flash_mha(q, k, v, causal=True, window=128)
+                                .astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    gwr = jax.grad(
+        lambda q, k, v: jnp.sum(mha_reference(q, k, v, causal=True,
+                                              window=128)
+                                .astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for n, a, b in zip("qkv", gw, gwr):
+        scale = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) or 1.0
+        check(f"flash_mha window d{n}", np.asarray(a) / scale,
+              np.asarray(b) / scale, atol=0.05)
+
+    # packed-sequence segment ids (lane-/sublane-replicated tile layouts)
+    rng = np.random.default_rng(0)
+    cuts = np.sort(rng.choice(np.arange(1, T), size=3, replace=False))
+    seg = jnp.asarray(np.searchsorted(cuts, np.arange(T), side="right")
+                      [None, :].repeat(B, axis=0).astype(np.int32))
+    out_s = jax.jit(lambda q, k, v: flash_mha(q, k, v, causal=True,
+                                              segment_ids=(seg, seg)))(q, k, v)
+    ref_s = mha_reference(q, k, v, causal=True, segment_ids=(seg, seg))
+    check("flash_mha segments fwd", out_s, ref_s, atol=0.05)
+
 
 def smoke_paged():
     from deepspeed_tpu.inference.v2.model_implementations.llama import (
