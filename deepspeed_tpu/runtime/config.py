@@ -160,7 +160,8 @@ KNOWN_TOP_LEVEL_KEYS = {
     C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
     C.GRADIENT_ACCUMULATION_STEPS, C.STEPS_PER_PRINT, C.WALL_CLOCK_BREAKDOWN,
     C.DUMP_STATE, C.GRADIENT_CLIPPING, C.PRESCALE_GRADIENTS,
-    C.GRADIENT_PREDIVIDE_FACTOR, C.SPARSE_GRADIENTS, C.OPTIMIZER, C.SCHEDULER,
+    C.GRADIENT_PREDIVIDE_FACTOR, C.SPARSE_GRADIENTS, C.PREFETCH_BATCHES,
+    C.OPTIMIZER, C.SCHEDULER,
     C.FP16, C.BF16, C.DATA_TYPES, C.ZERO_OPTIMIZATION,
     C.ACTIVATION_CHECKPOINTING, C.PIPELINE, C.TENSOR_PARALLEL,
     C.SEQUENCE_PARALLEL_SIZE, C.EXPERT_PARALLEL_SIZE, C.COMMS_LOGGER,
@@ -252,6 +253,9 @@ class DeepSpeedConfig:
         self.prescale_gradients = get_scalar_param(pd, C.PRESCALE_GRADIENTS, False)
         self.gradient_predivide_factor = get_scalar_param(pd, C.GRADIENT_PREDIVIDE_FACTOR, 1.0)
         self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS, False)
+        # background input pipeline: 0 disables, N>0 keeps N batches
+        # assembled + device_put ahead (runtime/dataloader.py PrefetchLoader)
+        self.prefetch_batches = int(get_scalar_param(pd, C.PREFETCH_BATCHES, 0))
 
         self.optimizer = OptimizerConfig(pd.get(C.OPTIMIZER, {}))
         self.scheduler = SchedulerConfig(pd.get(C.SCHEDULER, {}))

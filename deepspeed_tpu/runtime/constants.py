@@ -27,6 +27,7 @@ BF16 = "bf16"
 ZERO_OPTIMIZATION = "zero_optimization"
 
 SPARSE_GRADIENTS = "sparse_gradients"
+PREFETCH_BATCHES = "prefetch_batches"
 
 DATA_TYPES = "data_types"
 GRAD_ACCUM_DTYPE = "grad_accum_dtype"

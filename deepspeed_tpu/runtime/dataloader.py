@@ -82,3 +82,89 @@ class RepeatingLoader:
         except StopIteration:
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
+
+
+class PrefetchLoader:
+    """Background batch assembly + ahead-of-time device placement.
+
+    The synchronous loader assembles the next batch and pays the host→HBM
+    transfer INSIDE the step gap; this wrapper runs assembly in a worker
+    thread and ``jax.device_put``s up to ``depth`` batches onto the mesh
+    while the current step computes — the input pipeline overlaps with
+    device work (the reference gets this from torch DataLoader workers +
+    pin_memory/CUDA-stream copies; on TPU the async dispatch of device_put
+    is the copy stream).
+
+    Args:
+        loader: any iterable of pytree batches (numpy leaves).
+        sharding: optional ``jax.sharding.Sharding`` (or pytree of) applied
+            at device_put — pass ``engine.topology.batch_sharding()`` so
+            batches land pre-sharded; ``None`` leaves host arrays for the
+            engine's own placement.
+        depth: number of batches resident ahead of the consumer.
+    """
+
+    _END = object()
+
+    def __init__(self, loader, sharding=None, depth=2):
+        self.loader = loader
+        self.sharding = sharding
+        self.depth = max(1, int(depth))
+        self._active_cancel = None  # cancels the previous pass's worker
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __getattr__(self, name):
+        # delegate the wrapped loader's surface (batch_size, dataset, ...)
+        return getattr(self.loader, name)
+
+    def _put(self, batch):
+        if self.sharding is None:
+            return batch
+        import jax.tree_util as jtu
+        if jtu.all_leaves([self.sharding]):
+            return jax.tree.map(lambda x: jax.device_put(x, self.sharding),
+                                batch)
+        return jax.tree.map(jax.device_put, batch, self.sharding)
+
+    def __iter__(self):
+        import queue
+        import threading
+        # fresh queue/worker per pass: sharing them across iterations would
+        # leak a previous pass's leftover batches (and its _END) into this
+        # one. A semaphore of `depth` bounds RESIDENT device batches to
+        # exactly depth — the worker only device_puts after securing a slot.
+        q = queue.Queue()
+        slots = threading.Semaphore(self.depth)
+        cancel = threading.Event()
+        if self._active_cancel is not None:
+            self._active_cancel.set()  # release an abandoned pass's worker
+        self._active_cancel = cancel
+
+        def worker():
+            try:
+                for batch in self.loader:
+                    while not slots.acquire(timeout=0.1):
+                        if cancel.is_set():
+                            return
+                    if cancel.is_set():
+                        return
+                    # device_put dispatches async: transfer overlaps compute
+                    q.put(self._put(batch))
+            except Exception as e:  # surfaced at the consumer's next next()
+                q.put(e)
+                return
+            q.put(self._END)
+
+        threading.Thread(target=worker, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is self._END:
+                return
+            if isinstance(item, Exception):
+                raise item
+            try:
+                yield item
+            finally:
+                slots.release()

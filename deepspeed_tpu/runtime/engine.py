@@ -248,6 +248,14 @@ class DeepSpeedEngine:
             self.training_dataloader = DeepSpeedDataLoader(
                 training_data, batch_size=self.micro_batch_size * self.topology.data_parallel_size,
                 collate_fn=collate_fn, topology=self.topology)
+            if self.config.prefetch_batches:
+                # background assembly + ahead-of-time sharded device_put:
+                # the host input pipeline overlaps the device step
+                from deepspeed_tpu.runtime.dataloader import PrefetchLoader
+                self.training_dataloader = PrefetchLoader(
+                    self.training_dataloader,
+                    sharding=self.topology.batch_sharding(),
+                    depth=self.config.prefetch_batches)
 
         # --- monitoring / timers (reference engine.py:252, 2238) ---
         from deepspeed_tpu.monitor.monitor import MonitorMaster

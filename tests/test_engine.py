@@ -430,3 +430,23 @@ def test_gas_offset_survives_checkpoint(tmp_path):
     assert eng2.micro_steps == 4 and eng2._gas_offset == 3
     # next micro-step closes the 2-window that began before the save
     assert eng2.is_gradient_accumulation_boundary()
+
+
+def test_engine_prefetch_batches_config():
+    """prefetch_batches=N wraps the training dataloader in PrefetchLoader
+    and train_batch consumes pre-sharded batches unchanged."""
+    import numpy as np
+    from deepspeed_tpu.runtime.dataloader import PrefetchLoader
+    from tests.simple_model import SimpleModel, random_dataset
+    model = SimpleModel(hidden_dim=16)
+    data = random_dataset(n=32)
+    params = model.init(jax.random.PRNGKey(0),
+                        {k: v[:8] for k, v in data.items()})["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, training_data=data,
+        config={"train_batch_size": 8, "prefetch_batches": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+    assert isinstance(engine.training_dataloader, PrefetchLoader)
+    l1 = engine.train_batch()
+    l2 = engine.train_batch()
+    assert np.isfinite(l1) and np.isfinite(l2)
