@@ -9,21 +9,35 @@ import numpy as np
 import pytest
 
 
-def test_train_gpt2_example(tmp_path):
+def _run_example(script, argv, timeout=420):
+    """Run an example in a child with the CPU mesh forced from inside (the
+    sitecustomize ignores JAX_PLATFORMS from the environment)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ,
                XLA_FLAGS=os.environ.get("XLA_FLAGS", "") +
                " --xla_force_host_platform_device_count=8")
-    # force CPU from inside the child (sitecustomize ignores JAX_PLATFORMS)
+    path = os.path.join(repo, "examples", script)
     code = (
         "import jax; jax.config.update('jax_platforms', 'cpu');"
-        "import runpy, sys; sys.argv = ['train_gpt2.py', '--steps', '6'];"
-        f"runpy.run_path(r'{os.path.join(repo, 'examples', 'train_gpt2.py')}',"
-        "run_name='__main__')")
-    r = subprocess.run([sys.executable, "-c", code], env=env,
-                       capture_output=True, text=True, timeout=420)
+        f"import runpy, sys; sys.argv = {argv!r};"
+        f"runpy.run_path({path!r}, run_name='__main__')")
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_train_gpt2_example(tmp_path):
+    r = _run_example("train_gpt2.py", ["train_gpt2.py", "--steps", "6"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "saved checkpoint" in r.stdout
     losses = [float(l.rsplit(" ", 1)[1]) for l in r.stdout.splitlines()
               if l.startswith("step ")]
     assert losses and losses[-1] < losses[0]
+
+
+def test_migrate_from_deepspeed_example():
+    pytest.importorskip("torch")  # checkpoint synthesis writes .pt shards
+    r = _run_example("migrate_from_deepspeed.py",
+                     ["migrate_from_deepspeed.py", "--steps", "3"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "loaded 4 parameters (+ moments) at step 100" in r.stdout
+    assert "resumed 3 steps" in r.stdout
