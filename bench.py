@@ -316,6 +316,7 @@ def run_bench():
                     "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
                     "zero_optimization": {"stage": 1},
                     "gradient_clipping": 1.0,
+                    "fused_step": True,
                     "activation_checkpointing": {"policy": remat_policy},
                 })
 

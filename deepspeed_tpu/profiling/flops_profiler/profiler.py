@@ -151,11 +151,20 @@ class FlopsProfiler:
         eng._ensure_initialized(batch)
         eng._compiled()
         sharded = eng._shard_batch(batch)
-        fn = eng._micro_step_fn
+        fused = getattr(eng, "_fused_step_fn", None)
+        if fused is not None:
+            # fused_step: the program that actually runs includes the
+            # optimizer apply — profile it, not the unused micro-step
+            lr = eng._schedule_fn(eng.global_steps)
+            fn = lambda st, b: fused(st, b, lr)
+        else:
+            fn = eng._micro_step_fn
         jaxpr = jax.make_jaxpr(fn)(eng.state, sharded)
         self.macs = count_macs_jaxpr(jaxpr.jaxpr)
         try:
-            ca = fn.lower(eng.state, sharded).compile().cost_analysis()
+            lowered = (fused.lower(eng.state, sharded, lr) if fused is not None
+                       else fn.lower(eng.state, sharded))
+            ca = lowered.compile().cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else {}
         except Exception:

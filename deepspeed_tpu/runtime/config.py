@@ -161,6 +161,7 @@ KNOWN_TOP_LEVEL_KEYS = {
     C.GRADIENT_ACCUMULATION_STEPS, C.STEPS_PER_PRINT, C.WALL_CLOCK_BREAKDOWN,
     C.DUMP_STATE, C.GRADIENT_CLIPPING, C.PRESCALE_GRADIENTS,
     C.GRADIENT_PREDIVIDE_FACTOR, C.SPARSE_GRADIENTS, C.PREFETCH_BATCHES,
+    C.FUSED_STEP,
     C.OPTIMIZER, C.SCHEDULER,
     C.FP16, C.BF16, C.DATA_TYPES, C.ZERO_OPTIMIZATION,
     C.ACTIVATION_CHECKPOINTING, C.PIPELINE, C.TENSOR_PARALLEL,
@@ -256,6 +257,11 @@ class DeepSpeedConfig:
         # background input pipeline: 0 disables, N>0 keeps N batches
         # assembled + device_put ahead (runtime/dataloader.py PrefetchLoader)
         self.prefetch_batches = int(get_scalar_param(pd, C.PREFETCH_BATCHES, 0))
+        # fuse grad computation + optimizer apply into ONE jit at GAS=1:
+        # forward() applies the update at the boundary (standard
+        # forward/backward/step training loops only — a bare engine(batch)
+        # call also steps the optimizer when this is on)
+        self.fused_step = bool(get_scalar_param(pd, C.FUSED_STEP, False))
 
         self.optimizer = OptimizerConfig(pd.get(C.OPTIMIZER, {}))
         self.scheduler = SchedulerConfig(pd.get(C.SCHEDULER, {}))

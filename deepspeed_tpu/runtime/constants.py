@@ -28,6 +28,7 @@ ZERO_OPTIMIZATION = "zero_optimization"
 
 SPARSE_GRADIENTS = "sparse_gradients"
 PREFETCH_BATCHES = "prefetch_batches"
+FUSED_STEP = "fused_step"
 
 DATA_TYPES = "data_types"
 GRAD_ACCUM_DTYPE = "grad_accum_dtype"

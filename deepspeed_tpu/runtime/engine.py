@@ -289,6 +289,8 @@ class DeepSpeedEngine:
         self.state: Optional[TrainState] = None
         self._micro_step_fn = None
         self._apply_step_fn = None
+        self._fused_step_fn = None
+        self._pending_fused_stats = None
         self._eval_step_fn = None
         self._offload = None  # ZeRO-Offload host tier (zero/offload.py)
         self.quantized_weights = False  # ZeRO++ qwZ (set in _init_state)
@@ -622,12 +624,10 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # compiled step functions
     # ------------------------------------------------------------------
-    def _build_micro_step(self):
-        gas = self.gradient_accumulation_steps_value
+    def _loss_closures(self):
+        """Shared captures for every grad-computing step (micro and fused)."""
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
-        grad_sh = self._shardings["grad"]
-        accum_dtype = self.grad_accum_dtype
         fp16 = self.fp16_enabled
         model_fn = self._model_fn
         # PipelineEngine pre-multiplies: its one fused call already averages over
@@ -668,6 +668,13 @@ class DeepSpeedEngine:
                     scaled = scaled / predivide
                 return scaled, loss
             return loss_fn
+
+        return make_loss_fn, dq, grad_use_sh
+
+    def _build_micro_step(self):
+        grad_sh = self._shardings["grad"]
+        accum_dtype = self.grad_accum_dtype
+        make_loss_fn, dq, grad_use_sh = self._loss_closures()
 
         plan = self._qgz_plan
         if plan is not None:
@@ -727,8 +734,11 @@ class DeepSpeedEngine:
 
         return jax.jit(micro_step, donate_argnums=(0,))
 
-    def _build_apply_step(self):
-        gas = self.gradient_accumulation_steps_value
+    def _apply_core_builder(self):
+        """Shared optimizer-apply body: mean f32 grads -> new state + stats.
+        Used by the standalone apply-step (grads from the accumulator) and
+        the fused step (grads straight from backward, never materialized to
+        the HBM accumulator)."""
         fp16 = self.fp16_enabled
         clip = self.config.gradient_clipping
         tx = self._tx
@@ -738,29 +748,10 @@ class DeepSpeedEngine:
         mixed = self.mixed_precision
         fp16_cfg = self.config.fp16
         dynamic = self.dynamic_loss_scale
-        prescale = self.config.prescale_gradients
-        predivide = self.config.gradient_predivide_factor
         quantized = getattr(self, "quantized_weights", False)
         quantize_fn = self._quantize_working
 
-        plan = self._qgz_plan
-
-        def apply_step(state: TrainState, lr):
-            denom = jnp.float32(gas)
-            if fp16:
-                denom = denom * state.scale.loss_scale
-            if prescale and predivide != 1.0:
-                denom = denom / jnp.float32(predivide)
-            if plan is not None:
-                # qgZ boundary: quantized hierarchical reduction of the stacked
-                # local grads (zero/qgz.py). The sum over the world of local
-                # batch-means is world x the global mean — fold into the denom.
-                summed = plan.reduce(state.grad_acc)
-                qdenom = denom * jnp.float32(plan.world)
-                grads = jax.tree.map(lambda g: g / qdenom, summed)
-            else:
-                grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, state.grad_acc)
-
+        def core(state: TrainState, grads, lr):
             overflow = has_overflow(grads) if fp16 else jnp.asarray(False)
             safe_grads = jax.tree.map(lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
             norm = global_norm(safe_grads)
@@ -801,7 +792,66 @@ class DeepSpeedEngine:
                               loss_scale=state.scale.loss_scale)
             return new_state, stats
 
+        return core
+
+    def _grad_denom(self, state, gas):
+        denom = jnp.float32(gas)
+        if self.fp16_enabled:
+            denom = denom * state.scale.loss_scale
+        predivide = self.config.gradient_predivide_factor
+        if self.config.prescale_gradients and predivide != 1.0:
+            denom = denom / jnp.float32(predivide)
+        return denom
+
+    def _build_apply_step(self):
+        gas = self.gradient_accumulation_steps_value
+        plan = self._qgz_plan
+        core = self._apply_core_builder()
+
+        def apply_step(state: TrainState, lr):
+            denom = self._grad_denom(state, gas)
+            if plan is not None:
+                # qgZ boundary: quantized hierarchical reduction of the stacked
+                # local grads (zero/qgz.py). The sum over the world of local
+                # batch-means is world x the global mean — fold into the denom.
+                summed = plan.reduce(state.grad_acc)
+                qdenom = denom * jnp.float32(plan.world)
+                grads = jax.tree.map(lambda g: g / qdenom, summed)
+            else:
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom,
+                                     state.grad_acc)
+            return core(state, grads, lr)
+
         return jax.jit(apply_step, donate_argnums=(0,))
+
+    def _build_fused_step(self):
+        """One jit for grad computation + optimizer apply (``fused_step``
+        config, GAS=1 only): gradients flow from backward straight into the
+        update without the accumulator's HBM round-trip, and XLA schedules
+        the update against the backward epilogue. forward() applies the
+        optimizer at the boundary; step() consumes the staged stats."""
+        make_loss_fn, dq, grad_use_sh = self._loss_closures()
+        core = self._apply_core_builder()
+
+        def fused_step(state: TrainState, batch, lr):
+            rng, sub = jax.random.split(state.rng)
+            loss_fn = make_loss_fn(batch, sub, state.scale.loss_scale,
+                                   state.global_step)
+            (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                dq(state.params))
+            if grad_use_sh is not None:
+                grads = constrain_tree(grads, grad_use_sh)
+            denom = self._grad_denom(state, 1)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, grads)
+            new_state, stats = core(state._replace(rng=rng), grads, lr)
+            return new_state, loss, stats
+
+        return jax.jit(fused_step, donate_argnums=(0,))
+
+    def _fused_enabled(self):
+        return (self.config.fused_step
+                and self.gradient_accumulation_steps_value == 1
+                and self._qgz_plan is None and self._offload is None)
 
     def _build_eval_step(self):
         model_fn = self._model_fn
@@ -829,6 +879,8 @@ class DeepSpeedEngine:
         self._param_transform = fn
         self._micro_step_fn = None
         self._apply_step_fn = None
+        self._fused_step_fn = None
+        self._pending_fused_stats = None
         self._eval_step_fn = None
 
     def _build_offload_fns(self):
@@ -930,17 +982,27 @@ class DeepSpeedEngine:
 
     def _compiled(self):
         if self._micro_step_fn is None:
-            self._micro_step_fn = self._build_micro_step()
-            if self._offload is not None:
-                self._build_offload_fns()
-                self._apply_step_fn = None
-            else:
+            if self._fused_enabled():
+                self._fused_step_fn = self._build_fused_step()
+                self._micro_step_fn = self._build_micro_step()  # eval/GAS path
                 self._apply_step_fn = self._build_apply_step()
+            else:
+                self._fused_step_fn = None
+                self._micro_step_fn = self._build_micro_step()
+                if self._offload is not None:
+                    self._build_offload_fns()
+                    self._apply_step_fn = None
+                else:
+                    self._apply_step_fn = self._build_apply_step()
             self._eval_step_fn = self._build_eval_step()
         elif self._apply_step_fn is None and self._offload is None:
             # invalidated (e.g. set_train_batch_size changed the baked-in
             # GAS denominator) — rebuild just the apply step
             self._apply_step_fn = self._build_apply_step()
+            if self._fused_enabled():
+                self._fused_step_fn = self._build_fused_step()
+            else:
+                self._fused_step_fn = None
 
     # ------------------------------------------------------------------
     # public API (reference engine.py:1794/1933/2132)
@@ -995,7 +1057,14 @@ class DeepSpeedEngine:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         self.tput_timer.start()
         batch = self._shard_batch(batch)
-        self.state, loss = self._micro_step_fn(self.state, batch)
+        if getattr(self, "_fused_step_fn", None) is not None:
+            # fused_step config: grads + optimizer apply in ONE jit (GAS=1).
+            # The update is applied HERE; step() consumes the staged stats.
+            lr = self._schedule_fn(self.global_steps)
+            self.state, loss, stats = self._fused_step_fn(self.state, batch, lr)
+            self._pending_fused_stats = stats
+        else:
+            self.state, loss = self._micro_step_fn(self.state, batch)
         self._staged_loss = loss
         # device-side running mean across the GAS window (reference averages
         # micro-step losses before the train_loss event; no host sync here)
@@ -1072,10 +1141,14 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
-            lr = self._schedule_fn(self.global_steps)
-            if self._offload is not None:
-                stats = self._offload_step(lr)
+            staged = getattr(self, "_pending_fused_stats", None)
+            if staged is not None:
+                stats = staged  # fused step already applied in forward()
+                self._pending_fused_stats = None
+            elif self._offload is not None:
+                stats = self._offload_step(self._schedule_fn(self.global_steps))
             else:
+                lr = self._schedule_fn(self.global_steps)
                 self.state, stats = self._apply_step_fn(self.state, lr)
             self._last_stats = stats
             self._step_applied = True
@@ -1192,8 +1265,12 @@ class DeepSpeedEngine:
         self._gas_offset = self.micro_steps  # rebase the window
         # the fused apply-step bakes the GAS denominator in: invalidate and
         # let _compiled() rebuild lazily (offload keeps its own path; an
-        # uninitialized engine has no shardings to build against yet)
+        # uninitialized engine has no shardings to build against yet). A
+        # staged fused result from a pre-resize forward() is stale — dropping
+        # it means that window's step is skipped, never double-applied.
         self._apply_step_fn = None
+        self._fused_step_fn = None
+        self._pending_fused_stats = None
 
     @property
     def skipped_steps(self):

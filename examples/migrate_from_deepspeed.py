@@ -109,12 +109,14 @@ def main():
                 "zero_optimization": {"stage": 1}})
     n = load_deepspeed_checkpoint(engine, ckpt)
     print(f"loaded {n} parameters (+ moments) at step {engine.global_steps}")
+    loss = None
     for b in batches:
         loss = engine(b)
         engine.backward(loss)
         engine.step()
-    print(f"resumed {args.steps} steps; final loss "
-          f"{float(jax.device_get(loss)):.4f}")
+    if loss is not None:
+        print(f"resumed {args.steps} steps; final loss "
+              f"{float(jax.device_get(loss)):.4f}")
 
 
 if __name__ == "__main__":

@@ -86,6 +86,7 @@ def main():
                     "zero_optimization": {"stage": 3,
                                           "stage3_param_persistence_threshold": 0},
                     "gradient_clipping": 1.0,
+                    "fused_step": True,
                     "activation_checkpointing": {"policy": remat_policy},
                 })
 

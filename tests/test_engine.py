@@ -450,3 +450,100 @@ def test_engine_prefetch_batches_config():
     l1 = engine.train_batch()
     l2 = engine.train_batch()
     assert np.isfinite(l1) and np.isfinite(l2)
+
+
+def test_fused_step_matches_two_phase():
+    """fused_step=True must reproduce the two-jit path to float tolerance
+    (fusion reorders float ops, so bit-exactness is not expected)."""
+    import numpy as np
+    from tests.simple_model import SimpleModel, random_batches
+    batches = random_batches(6, batch_size=8, seed=3)
+
+    def train(fused):
+        model = SimpleModel(hidden_dim=32)
+        params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8, "fused_step": fused,
+                    "gradient_clipping": 1.0,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}}})
+        losses = []
+        for b in batches:
+            loss = engine(b)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(jax.device_get(loss)))
+        assert engine.was_step_applied()
+        return losses, jax.device_get(engine.state.params), \
+            engine.get_global_grad_norm()
+
+    l_fused, p_fused, n_fused = train(True)
+    l_plain, p_plain, n_plain = train(False)
+    np.testing.assert_allclose(l_fused, l_plain, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_fused), jax.tree.leaves(p_plain)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(float(n_fused), float(n_plain), rtol=1e-4)
+
+
+def test_fused_step_disabled_for_gas():
+    """fused_step silently degrades to the two-phase path when GAS > 1."""
+    from tests.simple_model import SimpleModel, random_batches
+    batches = random_batches(2, batch_size=8, seed=4)
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 16, "train_micro_batch_size_per_gpu": 8 // max(1, jax.device_count() // 1),
+                "gradient_accumulation_steps": 2, "fused_step": True,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+    for b in batches:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+    assert engine._fused_step_fn is None
+    assert engine.was_step_applied()
+
+
+def test_fused_step_fp16_overflow_skip():
+    """Dynamic loss scaling + overflow skip works inside the fused jit."""
+    import numpy as np
+    from tests.simple_model import SimpleModel, random_batches
+    batches = random_batches(1, batch_size=8, seed=5)
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8, "fused_step": True,
+                "fp16": {"enabled": True, "initial_scale_power": 4},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+    bad = {k: v.copy() for k, v in batches[0].items()}
+    bad["x"][0, 0] = np.inf  # poison -> overflow -> skip
+    before = jax.device_get(engine.state.params)
+    loss = engine(bad)
+    engine.backward(loss)
+    engine.step()
+    assert engine.skipped_steps == 1, "overflow must increment the skip counter"
+    after = jax.device_get(engine.state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flops_profiler_profiles_fused_program():
+    """With fused_step on, the profiler must profile the program that runs
+    (the fused grad+apply jit), not the unused micro-step."""
+    from tests.simple_model import SimpleModel, random_batches
+    batches = random_batches(2, batch_size=8, seed=6)
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8, "fused_step": True,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1}})
+    for b in batches:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+    assert engine.flops_profiler is not None
+    assert engine.flops_profiler.macs and engine.flops_profiler.macs > 0
