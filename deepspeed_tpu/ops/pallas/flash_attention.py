@@ -45,8 +45,15 @@ def _largest_divisor(n, candidates):
 
 
 def _pick_blocks(tq, tk):
-    bq = _largest_divisor(tq, (512, 256, 128))
-    bk = _largest_divisor(tk, (512, 256, 128))
+    """Default block ladder, overridable via DS_FLASH_BQ / DS_FLASH_BK for
+    on-chip block-size tuning (a forced size must still divide the seq)."""
+    import os
+    force_q = int(os.environ.get("DS_FLASH_BQ", "0"))
+    force_k = int(os.environ.get("DS_FLASH_BK", "0"))
+    bq = force_q if force_q and tq % force_q == 0 else \
+        _largest_divisor(tq, (512, 256, 128))
+    bk = force_k if force_k and tk % force_k == 0 else \
+        _largest_divisor(tk, (512, 256, 128))
     return bq, bk
 
 
