@@ -48,6 +48,7 @@ def update_loss_scale(state, found_inf, fp16_config, dynamic):
     do_grow = (~found_inf) & (good % window == 0) & (good > 0)
     scale = jnp.where(do_grow, scale * 2.0, scale)
     # reset hysteresis on successful growth interval (consecutive_hysteresis=False default)
-    hys = jnp.where(do_grow | (~found_inf & ~fp16_config.consecutive_hysteresis),
+    hys = jnp.where(do_grow | ((~found_inf)
+                               & (not fp16_config.consecutive_hysteresis)),
                     jnp.int32(fp16_config.hysteresis), hys_left)
     return LossScaleState(loss_scale=scale, good_steps=good, hysteresis=hys)
