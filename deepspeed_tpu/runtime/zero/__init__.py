@@ -8,3 +8,5 @@ partition-at-construction model initialization (reference
 """
 
 from deepspeed_tpu.runtime.zero.sharded_init import Init  # noqa: F401
+from deepspeed_tpu.runtime.zero.tiling import (TiledLinear,  # noqa: F401
+                                               TiledLinearReturnBias)
