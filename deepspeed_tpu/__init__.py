@@ -43,6 +43,12 @@ _LAZY_EXPORTS = {
                              "add_tuning_arguments"),
     "checkpointing": ("deepspeed_tpu.runtime.activation_checkpointing."
                       "checkpointing", None),
+    "DeepSpeedConfigError": ("deepspeed_tpu.runtime.config",
+                             "DeepSpeedConfigError"),
+    "ADAM_OPTIMIZER": ("deepspeed_tpu.runtime.engine", "ADAM_OPTIMIZER"),
+    "LAMB_OPTIMIZER": ("deepspeed_tpu.runtime.engine", "LAMB_OPTIMIZER"),
+    "is_compile_supported": ("deepspeed_tpu.runtime.compiler",
+                             "is_compile_supported"),
 }
 
 

@@ -196,6 +196,11 @@ DEPRECATED_TOP_LEVEL_KEYS = {
 AUTO = "auto"
 
 
+class DeepSpeedConfigError(ValueError):
+    """Configuration error (reference ``runtime/config.py`` DeepSpeedConfigError).
+    Subclasses ValueError so existing except-ValueError callers keep working."""
+
+
 class DeepSpeedConfig:
 
     def __init__(self, config, mpu=None, mesh_topology=None):
@@ -209,7 +214,8 @@ class DeepSpeedConfig:
         elif config is None:
             self._param_dict = {}
         else:
-            raise ValueError(f"Expected dict or path for config, got {type(config)}")
+            raise DeepSpeedConfigError(
+                f"Expected dict or path for config, got {type(config)}")
         self.mesh_topology = mesh_topology
         self._validate_top_level_keys(self._param_dict)
         self._initialize_params(self._param_dict)
@@ -231,7 +237,7 @@ class DeepSpeedConfig:
             close = difflib.get_close_matches(
                 key, KNOWN_TOP_LEVEL_KEYS | INERT_TOP_LEVEL_KEYS, n=1)
             hint = f" (did you mean '{close[0]}'?)" if close else ""
-            raise ValueError(f"Unknown top-level config key '{key}'{hint}. "
+            raise DeepSpeedConfigError(f"Unknown top-level config key '{key}'{hint}. "
                              f"Valid keys: {sorted(KNOWN_TOP_LEVEL_KEYS)}")
 
     @staticmethod

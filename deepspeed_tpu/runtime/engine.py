@@ -133,6 +133,11 @@ class OptimizerShim:
         raise RuntimeError("Call engine.step() — the engine owns the optimizer step")
 
 
+# optimizer-name constants (reference runtime/engine.py:84)
+ADAM_OPTIMIZER = "adam"
+LAMB_OPTIMIZER = "lamb"
+
+
 class DeepSpeedEngine:
 
     def __init__(self,

@@ -181,3 +181,18 @@ def test_top_level_api_surface():
     assert args.deepspeed and args.deepspeed_config == "c.json"
     with d.OnDevice(dtype=None, device="meta"):
         pass
+
+
+def test_top_level_api_parity_names():
+    """Reference __init__ names present (deepspeed/__init__.py surface)."""
+    import deepspeed_tpu as ds
+    for name in ("DeepSpeedEngine", "DeepSpeedHybridEngine", "PipelineEngine",
+                 "InferenceEngine", "DeepSpeedInferenceConfig",
+                 "add_tuning_arguments", "DeepSpeedConfig", "checkpointing",
+                 "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
+                 "log_dist", "OnDevice", "logger", "init_distributed", "zero",
+                 "PipelineModule", "initialize", "init_inference",
+                 "get_accelerator", "DeepSpeedConfigError", "ADAM_OPTIMIZER",
+                 "LAMB_OPTIMIZER", "is_compile_supported"):
+        assert hasattr(ds, name), name
+    assert issubclass(ds.DeepSpeedConfigError, ValueError)
