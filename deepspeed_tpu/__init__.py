@@ -49,6 +49,11 @@ _LAZY_EXPORTS = {
     "LAMB_OPTIMIZER": ("deepspeed_tpu.runtime.engine", "LAMB_OPTIMIZER"),
     "is_compile_supported": ("deepspeed_tpu.runtime.compiler",
                              "is_compile_supported"),
+    "replace_transformer_layer": ("deepspeed_tpu.module_inject",
+                                  "replace_transformer_layer"),
+    "revert_transformer_layer": ("deepspeed_tpu.module_inject",
+                                 "revert_transformer_layer"),
+    "module_inject": ("deepspeed_tpu.module_inject", None),
 }
 
 

@@ -13,3 +13,22 @@ so this package provides the same capability as pure functions:
 """
 
 from deepspeed_tpu.module_inject.auto_tp import AutoTP, infer_tp_specs  # noqa: F401
+
+
+def replace_transformer_layer(orig_layer_impl, model, checkpoint_dict=None,
+                              config=None, model_config=None):
+    """Reference ``module_inject.replace_transformer_layer``: swap torch
+    layers for fused-kernel containers. On TPU kernel injection is ALWAYS on
+    — every in-tree model routes attention through the ops registry, which
+    selects the Pallas kernels on TPU hardware — so this is the identity,
+    kept for API parity with reference call sites."""
+    from deepspeed_tpu.utils.logging import logger
+    logger.info("replace_transformer_layer: TPU kernel injection is always "
+                "on (ops registry); returning the model unchanged")
+    return model
+
+
+def revert_transformer_layer(orig_layer_impl, model, config=None,
+                             preln=False):
+    """Inverse of :func:`replace_transformer_layer` — identity here too."""
+    return model

@@ -193,6 +193,7 @@ def test_top_level_api_parity_names():
                  "log_dist", "OnDevice", "logger", "init_distributed", "zero",
                  "PipelineModule", "initialize", "init_inference",
                  "get_accelerator", "DeepSpeedConfigError", "ADAM_OPTIMIZER",
-                 "LAMB_OPTIMIZER", "is_compile_supported"):
+                 "LAMB_OPTIMIZER", "is_compile_supported",
+                 "replace_transformer_layer", "revert_transformer_layer"):
         assert hasattr(ds, name), name
     assert issubclass(ds.DeepSpeedConfigError, ValueError)
