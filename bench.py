@@ -294,10 +294,15 @@ def run_bench():
         candidates = ([(32, "dots"), (32, "everything"), (16, "dots"),
                        (16, "everything"), (8, "everything")]
                       if on_tpu else [(2, "dots")])
+    # fused grad+apply is the fast path; if it fails on hardware the same
+    # ladder retries with the proven two-phase step (DS_BENCH_FUSED=0 forces)
+    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
+        else [False]
+    candidates = [(b, r, f) for f in fused_modes for (b, r) in candidates]
 
     engine = batch_data = None
     last_err = None
-    for batch, remat_policy in candidates:
+    for batch, remat_policy, fused in candidates:
         rng = np.random.default_rng(0)
         ids = rng.integers(0, cfg.vocab_size,
                            size=(batch * max(n_chips, 1), seq)).astype(np.int32)
@@ -316,7 +321,7 @@ def run_bench():
                     "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
                     "zero_optimization": {"stage": 1},
                     "gradient_clipping": 1.0,
-                    "fused_step": True,
+                    "fused_step": fused,
                     "activation_checkpointing": {"policy": remat_policy},
                 })
 
@@ -337,15 +342,15 @@ def run_bench():
             engine = params = None
             import gc
             gc.collect()
-            print(f"bench: batch {batch}/{remat_policy} failed "
+            print(f"bench: batch {batch}/{remat_policy}/fused={fused} failed "
                   f"({type(e).__name__}); falling back", file=sys.stderr)
     if engine is None:
         raise last_err
 
     first_loss = float(jax.device_get(loss))
     print(f"compile+first step: {time.perf_counter()-t0:.1f}s "
-          f"batch={batch} remat={remat_policy} loss={first_loss:.3f}",
-          file=sys.stderr)
+          f"batch={batch} remat={remat_policy} fused={fused} "
+          f"loss={first_loss:.3f}", file=sys.stderr)
     # sanity: random-init CE should be ~ln(vocab). An insane/NaN loss on the
     # Pallas path means a kernel miscompile — rerun once on pure XLA.
     import math
@@ -376,7 +381,7 @@ def run_bench():
         "vs_baseline": round(mfu / 0.45, 4),
         "extra": {"mfu": round(mfu, 4), "chips": n_chips, "device": kind,
                   "batch_per_chip": batch, "seq": seq, "steps": n_steps,
-                  "remat_policy": remat_policy,
+                  "remat_policy": remat_policy, "fused_step": fused,
                   "loss": float(jax.device_get(loss))},
     }
     if on_tpu:

@@ -65,9 +65,12 @@ def main():
         candidates = ([(16, "dots"), (8, "dots"), (8, "everything"),
                        (4, "everything")] if on_tpu else [(2, "dots")])
 
+    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
+        else [False]
+    candidates = [(b, r, f) for f in fused_modes for (b, r) in candidates]
     engine = loss = None
     last_err = None
-    for batch, remat_policy in candidates:
+    for batch, remat_policy, fused in candidates:
         rng = np.random.default_rng(0)
         ids = rng.integers(0, cfg.vocab_size,
                            size=(batch * n_chips, seq)).astype(np.int32)
@@ -86,7 +89,7 @@ def main():
                     "zero_optimization": {"stage": 3,
                                           "stage3_param_persistence_threshold": 0},
                     "gradient_clipping": 1.0,
-                    "fused_step": True,
+                    "fused_step": fused,
                     "activation_checkpointing": {"policy": remat_policy},
                 })
 
@@ -100,7 +103,7 @@ def main():
             loss = step()
             jax.block_until_ready(loss)
             print(f"llama bench: compile+first {time.perf_counter()-t0:.1f}s "
-                  f"batch={batch} remat={remat_policy} "
+                  f"batch={batch} remat={remat_policy} fused={fused} "
                   f"loss={float(jax.device_get(loss)):.3f}", file=sys.stderr)
             break
         except Exception as e:
@@ -134,7 +137,7 @@ def main():
         "extra": {"mfu": round(mfu, 4), "chips": n_chips, "device": kind,
                   "params_m": round(cfg.num_parameters() / 1e6, 1),
                   "batch_per_chip": batch, "seq": seq, "steps": n_steps,
-                  "remat_policy": remat_policy,
+                  "remat_policy": remat_policy, "fused_step": fused,
                   "loss": float(jax.device_get(loss))},
     })
 
