@@ -34,6 +34,9 @@ class MixtralConfig:
     num_experts_per_tok: int = 2
     router_aux_loss_coef: float = 0.02
     capacity_factor: float = 2.0
+    # "indices" (routed gather/scatter, default) | "einsum" (GShard oracle) |
+    # "gmm" (megablox grouped GEMM, capacity-free; needs 128-aligned dims)
+    moe_backend: str = "indices"
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 1e6
@@ -65,6 +68,14 @@ class MixtralConfig:
 class MixtralExpertMLP(nn.Module):
     config: MixtralConfig
 
+    # grouped-GEMM backend contract (moe/sharded_moe.py dispatch_mode="gmm"):
+    # silu(x@w1) * (x@w3) @ w2, kernels listed gate/up/down
+    GMM_COMPAT = ("w1", "w3", "w2")
+
+    def gmm_shapes(self, d_model):
+        f = self.config.intermediate_size
+        return {"w1": (d_model, f), "w3": (d_model, f), "w2": (f, d_model)}
+
     @nn.compact
     def __call__(self, x):
         cfg = self.config
@@ -88,6 +99,7 @@ class MixtralBlock(nn.Module):
             k=cfg.num_experts_per_tok,
             capacity_factor=cfg.capacity_factor,
             eval_capacity_factor=cfg.capacity_factor,
+            dispatch_mode=cfg.moe_backend,
             name="block_sparse_moe")(
                 RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(x),
                 train)

@@ -220,6 +220,38 @@ class TopKGate(nn.Module):
         return plan.l_aux, combine, dispatch, plan.exp_counts
 
 
+class _GmmParam(nn.Module):
+    """One stacked [E, in, out] expert kernel under the SAME flax path the
+    vmapped Experts module would create (experts/<Cls>_0/<name>/kernel), so
+    the gmm backend is checkpoint/HF-interop compatible with the vmap one."""
+    shape: tuple
+
+    @nn.compact
+    def __call__(self):
+        # lecun_normal with in_axis=-2 == per-expert Dense default variance
+        return self.param("kernel", nn.initializers.lecun_normal(
+            in_axis=-2, out_axis=-1, batch_axis=(0,)), self.shape, jnp.float32)
+
+
+class _GmmInner(nn.Module):
+    shapes: dict
+
+    @nn.compact
+    def __call__(self):
+        return {nm: _GmmParam(tuple(shp), name=nm)()
+                for nm, shp in self.shapes.items()}
+
+
+class _GmmExpertBox(nn.Module):
+    """Creates the stacked expert kernels at vmap-identical paths."""
+    inner_name: str
+    shapes: dict
+
+    @nn.compact
+    def __call__(self):
+        return _GmmInner(self.shapes, name=self.inner_name)()
+
+
 class Experts(nn.Module):
     """E experts applied to [E, C, D] inputs; parameters stacked on the expert
     axis and sharded over 'ep' (reference ``moe/experts.py`` DistributedExperts)."""
@@ -252,6 +284,13 @@ class MOELayer(nn.Module):
       "einsum" — the GShard [S,E,C] one-hot einsum formulation; O(S·E·C·D)
         MXU/HBM work. Kept as the numerics oracle; both modes consume the same
         RoutingPlan so they agree to float tolerance.
+      "gmm" — megablox grouped GEMM over ragged expert row-groups
+        (``ops/pallas/grouped_gemm.py``) as the TRAINING path: no capacity
+        dimension at all, O(S·k) MXU rows regardless of skew. Requires a
+        gated-MLP expert that declares GMM_COMPAT/gmm_shapes (e.g.
+        MixtralExpertMLP); the expert params are created at vmap-identical
+        flax paths so checkpoints/HF interop are unchanged. Same RoutingPlan,
+        same numerics (dropped choices contribute zero-weighted rows).
     """
     expert_factory: Callable[[], nn.Module]
     num_experts: int
@@ -265,9 +304,9 @@ class MOELayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, train=True):
-        if self.dispatch_mode not in ("indices", "einsum"):
-            raise ValueError(f"MOELayer dispatch_mode must be 'indices' or "
-                             f"'einsum', got {self.dispatch_mode!r}")
+        if self.dispatch_mode not in ("indices", "einsum", "gmm"):
+            raise ValueError(f"MOELayer dispatch_mode must be 'indices', "
+                             f"'einsum' or 'gmm', got {self.dispatch_mode!r}")
         orig_shape = x.shape
         D = x.shape[-1]
         xf = x.reshape(-1, D)  # [S, D] tokens sharded over data axes
@@ -277,6 +316,9 @@ class MOELayer(nn.Module):
             self.min_capacity, self.noisy_gate_policy, self.drop_tokens,
             name="gate")(xf, train, as_plan=True)
         E, C = plan.num_experts, plan.capacity
+
+        if self.dispatch_mode == "gmm":
+            return self._gmm_forward(x, xf, plan)
 
         if self.dispatch_mode == "einsum":
             combine, dispatch = _densify(plan, S)
@@ -334,6 +376,46 @@ class MOELayer(nn.Module):
             out = term if out is None else out + term
         return (out.astype(x.dtype).reshape(orig_shape), plan.l_aux,
                 plan.exp_counts)
+
+    def _gmm_forward(self, x, xf, plan):
+        """Ragged grouped-GEMM expert FFN (megablox) routed by the plan."""
+        expert = self.expert_factory()
+        names = getattr(expert, "GMM_COMPAT", None)
+        if names is None or not hasattr(expert, "gmm_shapes"):
+            raise ValueError(
+                "dispatch_mode='gmm' needs a gated-MLP expert declaring "
+                "GMM_COMPAT + gmm_shapes (e.g. MixtralExpertMLP); "
+                f"{type(expert).__name__} does not")
+        D = xf.shape[-1]
+        shapes = {nm: (self.num_experts, *shp)
+                  for nm, shp in expert.gmm_shapes(D).items()}
+        kernels = _GmmExpertBox(f"{type(expert).__name__}_0", shapes,
+                                name="experts")()
+        from deepspeed_tpu.parallel import groups
+        topo = groups._TOPOLOGY
+        if topo is not None and (topo.ep_size > 1 or topo.tp_size > 1):
+            # the ragged kernel computes over the FULL expert stack on each
+            # data replica; running it under an ep/tp-sharded mesh would make
+            # GSPMD all-gather every expert onto every chip each step. Use
+            # dispatch_mode="indices" for expert/tensor parallelism.
+            raise ValueError(
+                "dispatch_mode='gmm' does not compose with ep/tp meshes yet "
+                f"(mesh has ep={topo.ep_size}, tp={topo.tp_size}); use "
+                "dispatch_mode='indices'")
+        from deepspeed_tpu.ops.pallas import grouped_gemm as gg
+        if not gg.is_supported(D, shapes[names[0]][-1]):
+            raise ValueError(
+                f"dispatch_mode='gmm': d_model={D} / d_ff="
+                f"{shapes[names[0]][-1]} must be multiples of "
+                f"{gg.ROW_ALIGN} for the megablox kernel")
+        interpret = jax.devices()[0].platform not in ("tpu", "axon")
+        w1 = kernels[names[0]].astype(x.dtype)
+        w3 = kernels[names[1]].astype(x.dtype)
+        w2 = kernels[names[2]].astype(x.dtype)
+        out = gg.moe_ffn_gmm(xf, plan.gates, plan.experts, w1, w2, w3,
+                             n_experts=self.num_experts, dtype=x.dtype,
+                             interpret=interpret)
+        return out.reshape(x.shape), plan.l_aux, plan.exp_counts
 
     def _dispatch_shardings(self):
         """(token [S,D], expert [E,C,D]) NamedShardings from the process-group

@@ -258,3 +258,117 @@ def test_indices_dispatch_no_dense_sec_tensor_ep2():
             f"routed lowering still materializes the dense {dense_shape} dispatch"
     finally:
         groups.reset()
+
+
+# ---------------------------------------------------------------------------
+# megablox grouped-GEMM training backend (VERDICT r2 #4 "call grouped_gemm")
+# ---------------------------------------------------------------------------
+
+class GmmExpertMLP(nn.Module):
+    """Gated MLP matching the gmm contract (128-aligned dims)."""
+    hidden: int = 128
+    d_model: int = 128
+    GMM_COMPAT = ("w1", "w3", "w2")
+
+    def gmm_shapes(self, d_model):
+        return {"w1": (d_model, self.hidden), "w3": (d_model, self.hidden),
+                "w2": (self.hidden, d_model)}
+
+    @nn.compact
+    def __call__(self, x):
+        dense = lambda f, nm: nn.Dense(f, use_bias=False, name=nm)
+        return dense(self.d_model, "w2")(
+            nn.silu(dense(self.hidden, "w1")(x)) * dense(self.hidden, "w3")(x))
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_gmm_backend_matches_indices(k):
+    mk = lambda mode: MOELayer(lambda: GmmExpertMLP(), num_experts=4, k=k,
+                               capacity_factor=100.0, dispatch_mode=mode)
+    gmm, routed = mk("gmm"), mk("indices")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 128))
+    params = gmm.init(jax.random.PRNGKey(1), x)["params"]
+    # identical param structure -> the vmap/indices path runs the SAME params
+    out_g, laux_g, cnt_g = gmm.apply({"params": params}, x)
+    out_r, laux_r, cnt_r = routed.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_r),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(float(laux_g), float(laux_r), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(cnt_g), np.asarray(cnt_r))
+
+
+def test_gmm_backend_gradients_match():
+    mk = lambda mode: MOELayer(lambda: GmmExpertMLP(), num_experts=4, k=2,
+                               capacity_factor=100.0, dispatch_mode=mode)
+    gmm, routed = mk("gmm"), mk("indices")
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 128))
+    params = gmm.init(jax.random.PRNGKey(3), x)["params"]
+
+    def loss(mdl):
+        def f(p, xx):
+            out, laux, _ = mdl.apply({"params": p}, xx)
+            return jnp.sum(out ** 2) + 0.01 * laux
+        return f
+
+    gg = jax.grad(loss(gmm))(params, x)
+    gr = jax.grad(loss(routed))(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(gg),
+                    jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_gmm_backend_param_tree_matches_vmap():
+    """gmm creates kernels at vmap-identical paths (checkpoint/HF compat)."""
+    mk = lambda mode: MOELayer(lambda: GmmExpertMLP(), num_experts=4, k=1,
+                               dispatch_mode=mode)
+    x = jnp.zeros((1, 8, 128))
+    pg = mk("gmm").init(jax.random.PRNGKey(0), x)["params"]
+    pv = mk("indices").init(jax.random.PRNGKey(0), x)["params"]
+    sg = jax.tree_util.tree_structure(pg)
+    sv = jax.tree_util.tree_structure(pv)
+    assert sg == sv, f"{sg} != {sv}"
+    for a, b in zip(jax.tree_util.tree_leaves(pg),
+                    jax.tree_util.tree_leaves(pv)):
+        assert a.shape == b.shape
+
+
+def test_gmm_backend_rejects_incompatible_expert():
+    layer = MOELayer(lambda: ExpertMLP(), num_experts=4, dispatch_mode="gmm")
+    x = jnp.zeros((1, 8, 16))
+    with pytest.raises(ValueError, match="gated-MLP"):
+        layer.init(jax.random.PRNGKey(0), x)
+
+
+def test_mixtral_gmm_backend_forward_parity():
+    """Mixtral with moe_backend='gmm' matches the default backend on the
+    same params (128-aligned tiny config)."""
+    from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+    base = dict(vocab_size=256, hidden_size=128, intermediate_size=128,
+                num_hidden_layers=1, num_attention_heads=4,
+                num_key_value_heads=2, num_local_experts=4,
+                max_position_embeddings=64, dtype=jnp.float32)
+    m_v = MixtralForCausalLM(MixtralConfig(**base))
+    m_g = MixtralForCausalLM(MixtralConfig(**base, moe_backend="gmm"))
+    ids = np.arange(32, dtype=np.int32).reshape(2, 16) % 256
+    params = m_v.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    out_v = m_v.apply({"params": params}, {"input_ids": ids})
+    out_g = m_g.apply({"params": params}, {"input_ids": ids})
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_v),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_gmm_backend_rejects_ep_mesh():
+    """gmm must refuse ep/tp meshes instead of silently all-gathering the
+    expert stacks (review r3 finding)."""
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    groups.initialize(mesh_topology=MeshTopology(dp=-1, ep=2))
+    try:
+        layer = MOELayer(lambda: GmmExpertMLP(), num_experts=4,
+                         dispatch_mode="gmm")
+        x = jnp.zeros((1, 8, 128))
+        with pytest.raises(ValueError, match="does not compose"):
+            layer.init(jax.random.PRNGKey(0), x)
+    finally:
+        groups.reset()
