@@ -28,7 +28,8 @@ from deepspeed_tpu.utils.logging import logger
 
 LLAMA_FAMILY = ("llama", "mistral", "qwen2")
 SUPPORTED = LLAMA_FAMILY + ("gpt2", "opt", "mixtral", "falcon", "phi", "bloom",
-                            "gpt_neox", "gptj", "bert")
+                            "gpt_neox", "gptj", "bert", "roberta",
+                            "distilbert")
 
 
 class UnsupportedModelError(ValueError):
@@ -940,9 +941,11 @@ def bert_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
     bert = {
         "word_embeddings": g("bert.embeddings.word_embeddings.weight"),
         "position_embeddings": g("bert.embeddings.position_embeddings.weight"),
-        "token_type_embeddings": g("bert.embeddings.token_type_embeddings.weight"),
         "embeddings_ln": ln("bert.embeddings.LayerNorm"),
     }
+    if cfg.type_vocab_size:
+        bert["token_type_embeddings"] = g(
+            "bert.embeddings.token_type_embeddings.weight")
     layers = [layer(i) for i in range(L)]
     if scan_layers:
         import jax
@@ -996,6 +999,54 @@ def bert_from_flax(params, cfg, dtype=np.float32):
             sd[p + theirs + ".weight"] = l[ours]["scale"]
             sd[p + theirs + ".bias"] = l[ours]["bias"]
     return sd
+
+
+def roberta_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
+    """HF ``RobertaForMaskedLM`` -> models/bert.py tree (same architecture:
+    renamed modules, lm_head instead of cls.predictions, position offset 2).
+    reference encoder coverage: ``module_inject/replace_policy.py`` lists
+    bert/roberta in one policy family."""
+    renamed = {}
+    for k, v in sd.items():
+        k2 = k.replace("roberta.", "bert.")
+        k2 = k2.replace("lm_head.dense.", "cls.predictions.transform.dense.")
+        k2 = k2.replace("lm_head.layer_norm.",
+                        "cls.predictions.transform.LayerNorm.")
+        k2 = k2.replace("lm_head.decoder.", "cls.predictions.decoder.")
+        if k2 == "lm_head.bias":
+            k2 = "cls.predictions.bias"
+        renamed[k2] = v
+    return bert_to_flax(renamed, cfg, scan_layers=scan_layers, dtype=dtype)
+
+
+def distilbert_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
+    """HF ``DistilBertForMaskedLM`` -> models/bert.py tree (BERT without
+    token types; q_lin/k_lin/v_lin/out_lin + ffn naming; vocab_* MLM head).
+    reference ``module_inject/containers/distil_bert.py`` coverage."""
+    renamed = {}
+    layer_map = {
+        "attention.q_lin.": "attention.self.query.",
+        "attention.k_lin.": "attention.self.key.",
+        "attention.v_lin.": "attention.self.value.",
+        "attention.out_lin.": "attention.output.dense.",
+        "sa_layer_norm.": "attention.output.LayerNorm.",
+        "ffn.lin1.": "intermediate.dense.",
+        "ffn.lin2.": "output.dense.",
+        "output_layer_norm.": "output.LayerNorm.",
+    }
+    for k, v in sd.items():
+        k2 = k.replace("distilbert.transformer.layer.", "bert.encoder.layer.")
+        k2 = k2.replace("distilbert.embeddings.", "bert.embeddings.")
+        for old, new in layer_map.items():
+            k2 = k2.replace(old, new)
+        k2 = k2.replace("vocab_transform.", "cls.predictions.transform.dense.")
+        k2 = k2.replace("vocab_layer_norm.",
+                        "cls.predictions.transform.LayerNorm.")
+        if k2 == "vocab_projector.bias":
+            k2 = "cls.predictions.bias"
+        k2 = k2.replace("vocab_projector.", "cls.predictions.decoder.")
+        renamed[k2] = v
+    return bert_to_flax(renamed, cfg, scan_layers=scan_layers, dtype=dtype)
 
 
 def bert_config_from_hf(hf_cfg, **overrides):
@@ -1057,6 +1108,52 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
         cfg = bert_config_from_hf(hf_cfg, scan_layers=scan_layers)
         return (BertForMaskedLM(cfg),
                 bert_to_flax(sd, cfg, scan_layers=scan_layers, dtype=dtype))
+    if mt == "roberta":
+        from deepspeed_tpu.models.bert import BertForMaskedLM
+        act = getattr(hf_cfg, "hidden_act", "gelu")
+        if act != "gelu":
+            raise UnsupportedModelError(f"RoBERTa hidden_act={act!r} "
+                                        "not supported (exact gelu only)")
+        if getattr(hf_cfg, "position_embedding_type", "absolute") != "absolute":
+            raise UnsupportedModelError(
+                "RoBERTa relative position embeddings not supported")
+        if not getattr(hf_cfg, "tie_word_embeddings", True):
+            raise UnsupportedModelError(
+                "RoBERTa tie_word_embeddings=False not supported — the MLM "
+                "decoder is tied to the word embeddings")
+        if getattr(hf_cfg, "is_decoder", False):
+            raise UnsupportedModelError(
+                "is_decoder=True causal RoBERTa not supported")
+        offset = (getattr(hf_cfg, "pad_token_id", 1) or 1) + 1
+        cfg = bert_config_from_hf(
+            hf_cfg, scan_layers=scan_layers, position_offset=offset,
+            # HF stores max_position_embeddings INCLUDING the offset rows
+            max_position_embeddings=hf_cfg.max_position_embeddings - offset)
+        return (BertForMaskedLM(cfg),
+                roberta_to_flax(sd, cfg, scan_layers=scan_layers, dtype=dtype))
+    if mt == "distilbert":
+        from deepspeed_tpu.models.bert import BertConfig, BertForMaskedLM
+        act = getattr(hf_cfg, "activation", "gelu")
+        if act != "gelu":
+            raise UnsupportedModelError(f"DistilBERT activation={act!r} "
+                                        "not supported (exact gelu only)")
+        if not getattr(hf_cfg, "tie_word_embeddings", True):
+            raise UnsupportedModelError(
+                "DistilBERT tie_word_embeddings=False not supported")
+        if getattr(hf_cfg, "sinusoidal_pos_embds", False):
+            raise UnsupportedModelError(
+                "DistilBERT sinusoidal_pos_embds not supported (learned "
+                "positions only)")
+        cfg = BertConfig(vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.dim,
+                         num_hidden_layers=hf_cfg.n_layers,
+                         num_attention_heads=hf_cfg.n_heads,
+                         intermediate_size=hf_cfg.hidden_dim,
+                         max_position_embeddings=hf_cfg.max_position_embeddings,
+                         type_vocab_size=0, layer_norm_eps=1e-12,
+                         scan_layers=scan_layers)
+        return (BertForMaskedLM(cfg),
+                distilbert_to_flax(sd, cfg, scan_layers=scan_layers,
+                                   dtype=dtype))
     if mt == "opt":
         from deepspeed_tpu.models.opt import OPTConfig, OPTForCausalLM
         if not getattr(hf_cfg, "do_layer_norm_before", True):
@@ -1240,6 +1337,11 @@ def export_pretrained(params, cfg, save_dir, dtype=np.float32):
               "n_embd": cfg.n_embd, "n_layer": cfg.n_layer, "n_head": cfg.n_head,
               "layer_norm_epsilon": cfg.layer_norm_epsilon}
     elif name == "BertConfig":
+        if cfg.position_offset or not cfg.type_vocab_size:
+            raise UnsupportedModelError(
+                "HF export is implemented for the plain BERT naming only; "
+                "RoBERTa/DistilBERT-loaded trees (position_offset or no "
+                "token types) are load-only")
         sd = bert_from_flax(params, cfg, dtype=dtype)
         hf = {"model_type": "bert", "architectures": ["BertForMaskedLM"],
               "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,

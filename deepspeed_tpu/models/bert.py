@@ -37,7 +37,8 @@ class BertConfig:
     num_attention_heads: int = 12
     intermediate_size: int = 3072
     max_position_embeddings: int = 512
-    type_vocab_size: int = 2
+    type_vocab_size: int = 2          # 0 = no token-type embedding (DistilBERT)
+    position_offset: int = 0          # RoBERTa: padding_idx+1 = 2
     layer_norm_eps: float = 1e-12
     dropout: float = 0.0
     scan_layers: bool = True
@@ -118,14 +119,26 @@ class BertModel(nn.Module):
         word = self.param("word_embeddings", nn.initializers.normal(0.02),
                           (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         pos = self.param("position_embeddings", nn.initializers.normal(0.02),
-                         (cfg.max_position_embeddings, cfg.hidden_size),
-                         jnp.float32)
-        typ = self.param("token_type_embeddings", nn.initializers.normal(0.02),
-                         (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(input_ids)
-        x = (word[input_ids] + pos[jnp.arange(T)][None] +
-             typ[token_type_ids]).astype(cfg.dtype)
+                         (cfg.max_position_embeddings + cfg.position_offset,
+                          cfg.hidden_size), jnp.float32)
+        if cfg.position_offset and attention_mask is not None:
+            # RoBERTa position ids are pad-aware: cumsum of the non-pad mask
+            # plus padding_idx (pads share padding_idx) — matches HF for any
+            # padding layout, not just suffix padding
+            m = attention_mask.astype(jnp.int32)
+            pos_ids = jnp.cumsum(m, axis=1) * m + (cfg.position_offset - 1)
+            x = word[input_ids] + pos[pos_ids]
+        else:
+            x = word[input_ids] + pos[jnp.arange(T) + cfg.position_offset][None]
+        if cfg.type_vocab_size:
+            typ = self.param("token_type_embeddings",
+                             nn.initializers.normal(0.02),
+                             (cfg.type_vocab_size, cfg.hidden_size),
+                             jnp.float32)
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + typ[token_type_ids]
+        x = x.astype(cfg.dtype)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="embeddings_ln")(x)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)

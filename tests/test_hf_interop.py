@@ -527,3 +527,79 @@ def test_bert_export_roundtrip(tmp_path):
                  tmp_path / "bad")
     with pytest.raises(hf_interop.UnsupportedModelError):
         hf_interop.load_pretrained(str(tmp_path / "bad" / "ckpt"))
+
+
+def test_roberta_mlm_logits(tmp_path):
+    """RoBERTa through the BERT encoder (renames + position offset 2)."""
+    cfg = transformers.RobertaConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=66, type_vocab_size=1, pad_token_id=1)
+    torch.manual_seed(4)
+    hf_model = transformers.RobertaForMaskedLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    fcfg = type(model.config)(**{**model.config.__dict__, "dtype": jnp.float32,
+                                 "remat": False})
+    ids = np.random.default_rng(4).integers(4, 256, size=(2, 12)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
+
+
+def test_distilbert_mlm_logits(tmp_path):
+    """DistilBERT through the BERT encoder (no token types, renamed
+    modules, vocab_* MLM head) — reference containers/distil_bert.py."""
+    cfg = transformers.DistilBertConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+        max_position_embeddings=32, activation="gelu", dropout=0.0,
+        attention_dropout=0.0)
+    torch.manual_seed(5)
+    hf_model = transformers.DistilBertForMaskedLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    assert model.config.type_vocab_size == 0
+    fcfg = type(model.config)(**{**model.config.__dict__, "dtype": jnp.float32,
+                                 "remat": False})
+    ids = np.random.default_rng(5).integers(0, 128, size=(2, 10)).astype(np.int32)
+    assert_logits_close(our_logits(type(model)(fcfg), params, ids),
+                        hf_logits(hf_model, ids))
+
+
+def test_roberta_padded_positions_match_hf(tmp_path):
+    """Pad-aware RoBERTa positions: suffix padding matches HF exactly at the
+    real-token rows."""
+    cfg = transformers.RobertaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=128,
+        max_position_embeddings=34, type_vocab_size=1, pad_token_id=1)
+    torch.manual_seed(6)
+    hf_model = transformers.RobertaForMaskedLM(cfg).eval()
+    d = save_hf(hf_model, cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    fcfg = type(model.config)(**{**model.config.__dict__, "dtype": jnp.float32,
+                                 "remat": False})
+    rng = np.random.default_rng(6)
+    ids = rng.integers(4, 128, size=(1, 12)).astype(np.int32)
+    ids[:, 9:] = 1  # suffix padding
+    mask = (ids != 1).astype(np.int32)
+    ours = np.asarray(type(model)(fcfg).apply(
+        {"params": params},
+        {"input_ids": ids, "attention_mask": mask}), np.float32)
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids),
+                          attention_mask=torch.from_numpy(mask)).logits.float().numpy()
+    np.testing.assert_allclose(ours[:, :9], theirs[:, :9], atol=2e-3, rtol=1e-3)
+
+
+def test_encoder_variant_export_is_guarded(tmp_path):
+    """RoBERTa/DistilBERT-loaded trees are load-only: export raises instead
+    of writing a corrupt plain-BERT checkpoint."""
+    cfg = transformers.RobertaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=128,
+        max_position_embeddings=34, type_vocab_size=1, pad_token_id=1)
+    torch.manual_seed(7)
+    d = save_hf(transformers.RobertaForMaskedLM(cfg).eval(), cfg, tmp_path)
+    model, params = hf_interop.load_pretrained(d)
+    with pytest.raises(hf_interop.UnsupportedModelError, match="load-only"):
+        hf_interop.export_pretrained(params, model.config, str(tmp_path / "x"))
