@@ -1,0 +1,26 @@
+#!/bin/bash
+# Full on-chip evidence sequence, strictly serial (ONE TPU job at a time).
+# Results land in onchip_results/ so the driver's end-of-round snapshot
+# keeps them. Safe to re-run; each leg overwrites its own files.
+OUT=/root/repo/onchip_results
+LOG=$OUT/sequence.log
+mkdir -p "$OUT"
+cd /root/repo
+echo "sequence start $(date)" >> "$LOG"
+
+run_leg() {
+  local name=$1 timeout_s=$2; shift 2
+  echo "leg $name start $(date)" >> "$LOG"
+  timeout "$timeout_s" "$@" > "$OUT/$name.json" 2> "$OUT/$name.err"
+  echo "leg $name rc=$? $(date)" >> "$LOG"
+}
+
+run_leg smoke 3600 python scripts/tpu_kernel_smoke.py --timeout 600
+if grep -q "FAIL\|TIMEOUT/hang" "$OUT/smoke.json" 2>/dev/null; then
+  echo "smoke not clean; continuing with bench anyway (driver wants a number)" >> "$LOG"
+fi
+run_leg bench 1800 python bench.py
+run_leg llama 2400 python scripts/bench_llama.py
+run_leg longctx 2400 python scripts/bench_long_context.py --seqs 4096,8192 --layers 8
+run_leg serving 1800 python scripts/bench_serving.py
+echo "sequence done $(date)" >> "$LOG"

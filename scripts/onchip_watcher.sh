@@ -1,0 +1,27 @@
+#!/bin/bash
+# Autonomous recovery watcher: wait for the chip, then run the full on-chip
+# sequence ONCE. Deadline-bounded so it never outlives the round. A lockfile
+# keeps it from colliding with an interactive session that took over.
+DEADLINE_S=${1:-25200}   # default 7h from launch
+LOCK=/tmp/ds_tpu_onchip.lock
+OUT=/root/repo/onchip_results
+LOG=$OUT/watcher.log
+mkdir -p "$OUT"
+cd /root/repo
+START=$(date +%s)
+echo "onchip_watcher start $(date) deadline=${DEADLINE_S}s" >> "$LOG"
+while [ $(( $(date +%s) - START )) -lt "$DEADLINE_S" ]; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "CHIP BACK $(date)" >> "$LOG"
+    if ! mkdir "$LOCK" 2>/dev/null; then
+      echo "another session holds $LOCK; exiting" >> "$LOG"
+      exit 0
+    fi
+    trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+    bash scripts/onchip_sequence.sh
+    exit 0
+  fi
+  echo "probe: still wedged $(date)" >> "$LOG"
+  sleep 300
+done
+echo "onchip_watcher deadline reached $(date)" >> "$LOG"
