@@ -4,11 +4,13 @@ from deepspeed_tpu.checkpoint.universal import (
     ds_to_universal, get_fp32_state_dict_from_zero_checkpoint,
     load_universal_checkpoint, save_universal_checkpoint)
 from deepspeed_tpu.checkpoint.ds_interop import (
-    ds_checkpoint_to_universal, get_fp32_state_dict_from_ds_checkpoint,
-    load_deepspeed_checkpoint, read_deepspeed_checkpoint)
+    DeepSpeedCheckpoint, ds_checkpoint_to_universal,
+    get_fp32_state_dict_from_ds_checkpoint, load_deepspeed_checkpoint,
+    read_deepspeed_checkpoint)
 
 __all__ = ["ds_to_universal", "get_fp32_state_dict_from_zero_checkpoint",
            "load_universal_checkpoint", "save_universal_checkpoint",
            "ds_checkpoint_to_universal",
            "get_fp32_state_dict_from_ds_checkpoint",
-           "load_deepspeed_checkpoint", "read_deepspeed_checkpoint"]
+           "load_deepspeed_checkpoint", "read_deepspeed_checkpoint",
+           "DeepSpeedCheckpoint"]

@@ -255,10 +255,18 @@ def ds_checkpoint_to_universal(ckpt_dir: str, out_dir: str,
     """Convert a reference checkpoint directory into this framework's
     universal fragment format (offline; no engine or devices needed) — the
     cross-framework analog of reference ``ds_to_universal.py`` main."""
+    return universal_from_parsed(read_deepspeed_checkpoint(ckpt_dir, tag),
+                                 out_dir, name_map=name_map)
+
+
+def universal_from_parsed(ck: DsCheckpoint, out_dir: str,
+                          name_map: Optional[Callable[[str], str]] = None
+                          ) -> str:
+    """Write-out half of the conversion, reusing an already-parsed
+    checkpoint (no second disk parse/merge)."""
     import json
     from deepspeed_tpu.checkpoint.universal import (UNIVERSAL_ARRAYS,
                                                     UNIVERSAL_META)
-    ck = read_deepspeed_checkpoint(ckpt_dir, tag)
     nm = name_map or _default_name_map
     blobs, keys = {}, []
     for name, arr in ck.fp32.items():
@@ -318,3 +326,46 @@ def get_fp32_state_dict_from_ds_checkpoint(ckpt_dir: str,
     weights by module parameter name (reference ``utils/zero_to_fp32.py:604``
     ``get_fp32_state_dict_from_zero_checkpoint``)."""
     return consolidate_fp32(read_deepspeed_checkpoint(ckpt_dir, tag))
+
+
+class DeepSpeedCheckpoint:
+    """Inspection wrapper over a parsed reference checkpoint (the TPU-native
+    subset of reference ``checkpoint/deepspeed_checkpoint.py:33`` — iteration,
+    degrees, merged states; the Megatron layer_*-file 3D maps don't apply to
+    the mesh-sharded runtime, conversion goes through
+    :func:`ds_checkpoint_to_universal` instead of file surgery)."""
+
+    def __init__(self, ckpt_dir, tag=None):
+        self.dir = ckpt_dir
+        self._ck = read_deepspeed_checkpoint(ckpt_dir, tag)
+        self.tag = self._ck.tag
+
+    @property
+    def zero_stage(self):
+        return self._ck.zero_stage
+
+    @property
+    def dp_degree(self):
+        return self._ck.world_size
+
+    def get_iteration(self):
+        return self._ck.step
+
+    def parameter_names(self):
+        return sorted(self._ck.fp32)
+
+    def get_fp32_state_dict(self):
+        """Merged full-precision weights (zero_to_fp32 semantics)."""
+        return consolidate_fp32(self._ck)
+
+    def get_optimizer_state(self, name):
+        """{exp_avg, exp_avg_sq} for one parameter (merged across shards)."""
+        out = {}
+        if name in self._ck.exp_avg:
+            out["exp_avg"] = self._ck.exp_avg[name]
+        if name in self._ck.exp_avg_sq:
+            out["exp_avg_sq"] = self._ck.exp_avg_sq[name]
+        return out
+
+    def to_universal(self, out_dir, name_map=None):
+        return universal_from_parsed(self._ck, out_dir, name_map=name_map)
