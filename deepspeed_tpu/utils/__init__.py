@@ -20,3 +20,11 @@ class OnDevice:
 
     def __exit__(self, *exc):
         return False
+
+
+# tensor-fragment API re-exports (reference deepspeed/utils/__init__.py:14-18)
+from deepspeed_tpu.utils.tensor_fragment import (  # noqa: E402,F401
+    safe_get_full_fp32_param, safe_get_full_grad,
+    safe_get_full_optimizer_state, safe_set_full_fp32_param,
+    safe_set_full_optimizer_state, safe_get_local_fp32_param,
+    safe_get_local_grad, safe_get_local_optimizer_state)

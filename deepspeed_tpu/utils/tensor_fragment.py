@@ -159,3 +159,80 @@ def safe_get_full_grad(engine, key):
     between backward and the accumulation-boundary step."""
     leaf = _find_leaf(engine.state.grad_acc, key)
     return None if leaf is None else np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_optimizer_state(engine, key, value, state_name):
+    """Scatter a new optimizer-state value (reference
+    safe_set_full_optimizer_state); state_name in {"exp_avg", "exp_avg_sq"}."""
+    import jax.numpy as jnp
+    if state_name not in ("exp_avg", "exp_avg_sq"):
+        return False
+    value = np.asarray(value, dtype=np.float32)
+    if engine._offload is not None and key in engine._offload.masters:
+        n = engine._offload.masters[key].size
+        idx = 0 if state_name == "exp_avg" else 1
+        if engine._offload.swapper is not None:
+            # NVMe tier owns the moments: fetch, modify, write back through
+            # the swapper (a bare adam.state_for would be throwaway zeros)
+            m, v = engine._offload.swapper.fetch(key)
+            pair = [m, v]
+            pair[idx] = value.reshape(-1)
+            engine._offload.swapper.commit(key)
+            engine._offload.swapper.finish_step()
+            engine._offload.swapper.load_state_arrays({key: tuple(pair)})
+            return True
+        state = engine._offload.adam.state_for(key, n)
+        if idx >= len(state):  # Lion/Adagrad host steps carry one moment
+            return False
+        state[idx][:] = value.reshape(-1)
+        return True
+    frags = moment_leaves(engine.state.opt_state, opt_param_paths(engine))
+    hit = frags.get(f"{key}::{state_name}")
+    if hit is None:
+        return False
+    path, leaf = hit
+    new = jax.device_put(jnp.asarray(value, leaf.dtype), leaf.sharding)
+
+    def rep(p, l):
+        return new if tuple(p) == tuple(path) else l
+
+    engine.state = engine.state._replace(
+        opt_state=jax.tree_util.tree_map_with_path(rep, engine.state.opt_state))
+    return True
+
+
+def _local_shard(arr):
+    """Process-local shard of a (possibly sharded) array (the reference's
+    rank-local fragment view: under GSPMD the addressable shard IS the local
+    partition)."""
+    if arr is None:
+        return None
+    if hasattr(arr, "addressable_shards") and arr.addressable_shards:
+        return np.asarray(arr.addressable_shards[0].data)
+    return np.asarray(arr)
+
+
+def safe_get_local_fp32_param(engine, key):
+    """Rank-local shard of the fp32 master (reference
+    safe_get_local_fp32_param)."""
+    if engine._offload is not None:
+        return safe_get_full_fp32_param(engine, key)  # host tier is local
+    tree = engine.state.master if engine.state.master is not None \
+        else engine.state.params
+    leaf = _find_leaf(tree, key)
+    return None if leaf is None else _local_shard(leaf).astype(np.float32)
+
+
+def safe_get_local_grad(engine, key):
+    """Rank-local shard of the accumulated gradient."""
+    leaf = _find_leaf(engine.state.grad_acc, key)
+    return None if leaf is None else _local_shard(leaf).astype(np.float32)
+
+
+def safe_get_local_optimizer_state(engine, key, state_name):
+    """Rank-local shard of an optimizer-state fragment."""
+    if engine._offload is not None and key in engine._offload.masters:
+        return safe_get_full_optimizer_state(engine, key, state_name)
+    frags = moment_leaves(engine.state.opt_state, opt_param_paths(engine))
+    hit = frags.get(f"{key}::{state_name}")
+    return None if hit is None else _local_shard(hit[1]).astype(np.float32)

@@ -305,3 +305,32 @@ def test_zero_to_fp32_cli(tmp_path):
     assert len(data.files) == n_leaves
     total = sum(data[k].size for k in data.files)
     assert total == sum(l.size for l in _jax.tree_util.tree_leaves(ref))
+
+
+def test_fragment_api_utils_exports_and_setters():
+    """reference deepspeed.utils surface: safe_get/set full + local variants
+    importable from deepspeed_tpu.utils; optimizer-state setter round-trips."""
+    from deepspeed_tpu.utils import (safe_get_full_optimizer_state,
+                                     safe_get_local_fp32_param,
+                                     safe_get_local_grad,
+                                     safe_get_local_optimizer_state,
+                                     safe_set_full_optimizer_state)
+    cfg = dict(_BASE, zero_optimization={"stage": 1})
+    engine = _train(cfg)
+    key = [k for k in param_names(engine) if "kernel" in k][0]
+    m = safe_get_full_optimizer_state(engine, key, "exp_avg")
+    assert m is not None
+    new = np.full_like(m, 0.5)
+    assert safe_set_full_optimizer_state(engine, key, new, "exp_avg")
+    np.testing.assert_allclose(
+        safe_get_full_optimizer_state(engine, key, "exp_avg"), new)
+    # local variants return the addressable shard (smaller or equal)
+    local = safe_get_local_fp32_param(engine, key)
+    full = safe_get_full_fp32_param(engine, key)
+    assert local is not None and local.size <= full.size
+    lm = safe_get_local_optimizer_state(engine, key, "exp_avg")
+    assert lm is not None and lm.size <= m.size
+    loss = engine(random_batches(1, batch_size=8)[0])
+    engine.backward(loss)
+    g = safe_get_local_grad(engine, key)
+    assert g is not None

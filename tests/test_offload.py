@@ -328,3 +328,37 @@ def test_offload_moment_mismatch_raises(tmp_path):
     eng_adam, _ = _train(cfg_adam, steps=1)
     with pytest.raises(ValueError, match="different optimizer"):
         eng_adam._offload.load_state_dict(sd)
+
+
+def test_fragment_setters_with_offload(tmp_path):
+    """Setter/local-getter fragment API against the host-offload tier
+    (review r3 findings: swapper/1-moment paths must not silently no-op)."""
+    import numpy as np
+    from deepspeed_tpu.utils import (safe_get_full_optimizer_state,
+                                     safe_get_local_optimizer_state,
+                                     safe_set_full_optimizer_state)
+    from deepspeed_tpu.utils.tensor_fragment import param_names
+    from tests.simple_model import SimpleModel, random_batches
+    model = SimpleModel(hidden_dim=16)
+    batches = random_batches(2, batch_size=8)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 2,
+                    "offload_optimizer": {"device": "cpu"}}})
+    for b in batches:
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+    key = [k for k in param_names(engine) if "kernel" in k][0]
+    m = safe_get_full_optimizer_state(engine, key, "exp_avg")
+    assert m is not None
+    new = np.full_like(m, 0.25)
+    assert safe_set_full_optimizer_state(engine, key, new, "exp_avg")
+    np.testing.assert_allclose(
+        safe_get_full_optimizer_state(engine, key, "exp_avg"), new)
+    # local getter delegates for host-offloaded params (never a bare None)
+    assert safe_get_local_optimizer_state(engine, key, "exp_avg") is not None
