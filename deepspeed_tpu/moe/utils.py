@@ -35,3 +35,44 @@ def moe_param_specs(params, scan_layers=False):
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     specs = [spec_for(p, l) for p, l in flat]
     return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(params), specs)
+
+
+# reference-name aliases and the remaining deepspeed.moe.utils surface
+is_moe_param = is_moe_param_path  # the torch version tags tensors; paths here
+
+
+def has_moe_layers(params):
+    """(bool, num_expert_leaf_groups) — reference ``has_moe_layers``: detect
+    MoE content in a param tree (the torch version walks modules)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    n = sum(1 for path, _ in flat
+            if is_moe_param_path(jax.tree_util.keystr(path)))
+    return n > 0, n
+
+
+def split_params_into_shared_and_expert_params(params):
+    """Two {keystr: leaf} dicts (shared, expert) — reference
+    ``split_params_into_shared_and_expert_params``."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    shared, expert = {}, {}
+    for path, leaf in flat:
+        s = jax.tree_util.keystr(path)
+        (expert if is_moe_param_path(s) else shared)[s] = leaf
+    return shared, expert
+
+
+def is_moe_param_group(param_group):
+    """reference ``is_moe_param_group``: group dicts tagged {'moe': True}."""
+    return bool(param_group.get("moe", False))
+
+
+def configure_moe_param_groups(params):
+    """Optimizer param groups with experts split out (reference
+    ``configure_moe_param_groups``): [{'params': [...], 'moe': False},
+    {'params': [...], 'moe': True, 'name': 'ep_group'}]."""
+    shared, expert = split_params_into_shared_and_expert_params(params)
+    groups = [{"params": sorted(shared), "moe": False}]
+    if expert:
+        groups.append({"params": sorted(expert), "moe": True,
+                       "name": "ep_group"})
+    return groups

@@ -372,3 +372,25 @@ def test_gmm_backend_rejects_ep_mesh():
             layer.init(jax.random.PRNGKey(0), x)
     finally:
         groups.reset()
+
+
+def test_moe_utils_reference_surface():
+    """has_moe_layers / split / group helpers (reference moe/utils.py)."""
+    from deepspeed_tpu.moe.utils import (configure_moe_param_groups,
+                                         has_moe_layers, is_moe_param,
+                                         is_moe_param_group,
+                                         split_params_into_shared_and_expert_params)
+    model = MOELayer(lambda: ExpertMLP(), num_experts=4, k=1)
+    x = jnp.zeros((1, 8, 16))
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    found, n = has_moe_layers(params)
+    assert found and n > 0
+    shared, expert = split_params_into_shared_and_expert_params(params)
+    assert expert and shared  # gate wg is shared; expert kernels are expert
+    assert all(is_moe_param(k) for k in expert)
+    groups = configure_moe_param_groups(params)
+    assert len(groups) == 2
+    assert not is_moe_param_group(groups[0]) and is_moe_param_group(groups[1])
+    dense_only = {"dense": {"kernel": jnp.zeros((4, 4))}}
+    assert has_moe_layers(dense_only) == (False, 0)
+    assert len(configure_moe_param_groups(dense_only)) == 1
