@@ -76,6 +76,26 @@ def main():
     print(f"4k GQA fwd max err: {float(e2)}", flush=True)
     print(f"4k GQA fwd flash {bench(f2, q2, k2, v2):.2f}ms  ref {bench(r2, q2, k2, v2):.2f}ms", flush=True)
 
+    # sliding-window leg at 4k: DMA-elided block skip should scale ~T*W
+    fw = jax.jit(lambda q, k, v: flash_mha(q, k, v, causal=True, window=512))
+    print("compiling 4k window...", flush=True)
+    jax.block_until_ready(fw(q2, k2, v2))
+    print(f"4k GQA window=512 fwd flash {bench(fw, q2, k2, v2):.2f}ms "
+          f"(vs full-causal above)", flush=True)
+
+    # packed-segments leg: 8 random documents per row
+    import numpy as np
+    rng = np.random.default_rng(0)
+    cuts = np.sort(rng.choice(np.arange(1, T2), size=7, replace=False))
+    seg = jnp.asarray(np.searchsorted(cuts, np.arange(T2), side="right")
+                      [None, :].repeat(B2, axis=0).astype(np.int32))
+    fs = jax.jit(lambda q, k, v: flash_mha(q, k, v, causal=True,
+                                           segment_ids=(seg, seg)))
+    print("compiling 4k segments...", flush=True)
+    jax.block_until_ready(fs(q2, k2, v2))
+    print(f"4k GQA packed-segments fwd flash {bench(fs, q2, k2, v2):.2f}ms",
+          flush=True)
+
 
 if __name__ == "__main__":
     main()
