@@ -291,8 +291,8 @@ def run_bench():
         pol = os.environ["DS_BENCH_REMAT"]
         candidates = [(32, pol), (16, pol), (8, pol)] if on_tpu else [(2, pol)]
     else:
-        candidates = ([(32, "dots"), (32, "everything"), (16, "dots"),
-                       (16, "everything"), (8, "everything")]
+        candidates = ([(64, "dots"), (32, "dots"), (32, "everything"),
+                       (16, "dots"), (16, "everything"), (8, "everything")]
                       if on_tpu else [(2, "dots")])
     # fused grad+apply is the fast path; if it fails on hardware the same
     # ladder retries with the proven two-phase step (DS_BENCH_FUSED=0 forces)
