@@ -39,11 +39,19 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc, *, nk, bn, group_size):
 
     x = x_ref[...]                                    # [bm, bk]
     w8 = q_ref[...].astype(jnp.float32)               # [bk, bn]
-    j = pl.program_id(1)
-    g0 = j * (bn // group_size)
-    s = s_ref[:, pl.ds(g0, bn // group_size)]         # [bk, bn/G]
-    w = (w8.reshape(w8.shape[0], bn // group_size, group_size)
-         * s[:, :, None]).reshape(w8.shape[0], bn).astype(x.dtype)
+    s = s_ref[...]                                    # [bk, bn/G] (BlockSpec
+    # already DMA'd this j-block: an in-kernel lane-dim dynamic slice is a
+    # vector.load Mosaic cannot prove 128-aligned — it must not appear here)
+    ng = bn // group_size
+    # expand group scales to lanes with a one-hot matmul: [bk,ng] @ [ng,bn].
+    # A [bk, ng, G] reshape+broadcast would be a 3D relayout; iota + dot
+    # keeps every op 2D and MXU-shaped.
+    col_group = jax.lax.broadcasted_iota(jnp.int32, (ng, bn), 1) // group_size
+    row_id = jax.lax.broadcasted_iota(jnp.int32, (ng, bn), 0)
+    expand = (col_group == row_id).astype(jnp.float32)
+    s_lanes = jax.lax.dot_general(s, expand, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    w = (w8 * s_lanes).astype(x.dtype)
     acc[...] += jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)
 
@@ -67,9 +75,9 @@ def quantized_matmul(x, q, scale, group_size, out_dtype=None,
         in_specs=[
             pl.BlockSpec((bm, BK), lambda i, j, kk: (i, kk)),
             pl.BlockSpec((BK, BN), lambda i, j, kk: (kk, j)),
-            # full scale rows for this k-block: [bk, N//G] is narrow (N/G
-            # lanes) — the n-slice happens in-kernel
-            pl.BlockSpec((BK, N // group_size), lambda i, j, kk: (kk, 0)),
+            # per-j scale block [bk, bn//G]: sliced by the DMA machinery
+            # here, never by an in-kernel lane-dim dynamic slice
+            pl.BlockSpec((BK, BN // group_size), lambda i, j, kk: (kk, j)),
         ],
         out_specs=pl.BlockSpec((bm, BN), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),

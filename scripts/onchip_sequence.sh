@@ -2,22 +2,101 @@
 # Full on-chip evidence sequence, strictly serial (ONE TPU job at a time).
 # Results land in onchip_results/ so the driver's end-of-round snapshot
 # keeps them. Safe to re-run; each leg overwrites its own files.
+#
+# Wedge-proof (round-3 postmortem): a leg that times out or a probe that
+# fails ABORTS the remaining legs and kills every child this script spawned.
+# Round 3 died by stacking bench/llama/longctx onto a chip already wedged by
+# the smoke leg's hung kernel — each new leg became a "holder" blocking the
+# next, including the driver's own bench run.
 OUT=/root/repo/onchip_results
 LOG=$OUT/sequence.log
 mkdir -p "$OUT"
 cd /root/repo
-echo "sequence start $(date)" >> "$LOG"
+# one run id for the whole sequence: legs are recognisable as "this run" by
+# bench.py recovery, and never reaped as stale by their own sequence-mates
+export DS_TPU_HARNESS_RUN_ID="seq-$$-$(date +%s)"
+echo "sequence start $(date) run_id=$DS_TPU_HARNESS_RUN_ID" >> "$LOG"
+
+# every leg runs as its own setsid process GROUP so that grandchildren
+# orphaned by `timeout`'s kill (the usual wedge: a libtpu worker reparented
+# to init) still die with the group — pgrep -P walks only LIVE direct
+# children and misses exactly those
+LEG_PGIDS=""
+
+kill_children() {
+  local pg
+  for pg in $LEG_PGIDS; do
+    kill -TERM -- "-$pg" 2>/dev/null
+  done
+  sleep 5
+  for pg in $LEG_PGIDS; do
+    kill -KILL -- "-$pg" 2>/dev/null
+  done
+}
+
+abort() {
+  echo "ABORT: $1 $(date)" >> "$LOG"
+  kill_children
+  echo "sequence aborted $(date)" >> "$LOG"
+  exit 1
+}
+
+probe() {
+  # cheap backend liveness check between legs; rc!=0 = chip held/wedged.
+  # --kill-after: a probe wedged in libtpu can survive SIGTERM and become
+  # the next chip holder itself
+  timeout --kill-after=30 120 python - <<'EOF'
+from deepspeed_tpu.utils.backend_probe import probe_backend
+import sys
+kind, detail = probe_backend(timeout_s=90)
+print(f"probe: {kind} {detail}", flush=True)
+sys.exit(0 if kind == "ok" else 1)
+EOF
+}
 
 run_leg() {
   local name=$1 timeout_s=$2; shift 2
   echo "leg $name start $(date)" >> "$LOG"
-  timeout "$timeout_s" "$@" > "$OUT/$name.json" 2> "$OUT/$name.err"
-  echo "leg $name rc=$? $(date)" >> "$LOG"
+  setsid timeout --kill-after=30 "$timeout_s" "$@" \
+    > "$OUT/$name.json" 2> "$OUT/$name.err" &
+  local pid=$!
+  LEG_PGIDS="$LEG_PGIDS $pid"
+  wait "$pid"
+  local rc=$?
+  echo "leg $name rc=$rc $(date)" >> "$LOG"
+  if [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
+    # leg exited on its own: drop its pgid so a later kill_children can't
+    # signal a recycled pid's process group
+    LEG_PGIDS=$(printf '%s' "$LEG_PGIDS" | sed "s/ $pid\b//")
+  fi
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    # leg timed out -> its client may have wedged the chip; do NOT stack
+    # more work on it. Reap the whole process group (incl. orphaned
+    # grandchildren), verify with a probe, abort the sequence if held.
+    kill_children
+    if ! probe >> "$LOG" 2>&1; then
+      abort "leg $name timed out and chip probe failed"
+    fi
+    echo "leg $name timed out but chip recovered; continuing" >> "$LOG"
+  fi
+  return $rc
 }
 
-run_leg smoke 3600 python scripts/tpu_kernel_smoke.py --timeout 600
+if ! probe >> "$LOG" 2>&1; then
+  abort "initial chip probe failed"
+fi
+
+run_leg smoke 3600 python scripts/tpu_kernel_smoke.py --timeout 420
 if grep -q "FAIL\|TIMEOUT/hang" "$OUT/smoke.json" 2>/dev/null; then
-  echo "smoke not clean; continuing with bench anyway (driver wants a number)" >> "$LOG"
+  # a hung kernel smoke means the Pallas path wedges THIS platform: gate it
+  # off for the remaining legs instead of re-wedging the chip leg by leg
+  if grep -q "TIMEOUT/hang" "$OUT/smoke.json"; then
+    echo "smoke hang detected: exporting DS_TPU_DISABLE_PALLAS=1 for remaining legs" >> "$LOG"
+    export DS_TPU_DISABLE_PALLAS=1
+    probe >> "$LOG" 2>&1 || abort "chip did not recover after smoke hang"
+  else
+    echo "smoke numeric FAIL; continuing (kernels compile+run, numbers logged)" >> "$LOG"
+  fi
 fi
 run_leg bench 1800 python bench.py
 run_leg llama 2400 python scripts/bench_llama.py
