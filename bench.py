@@ -335,6 +335,94 @@ def init_backend_with_retry():
     raise last if last is not None else RuntimeError("no devices found")
 
 
+def gpt2_candidates(on_tpu):
+    if os.environ.get("DS_BENCH_BATCH"):
+        pol = os.environ.get("DS_BENCH_REMAT", "dots")
+        pairs = [(int(os.environ["DS_BENCH_BATCH"]), pol)]
+    elif os.environ.get("DS_BENCH_REMAT"):
+        pol = os.environ["DS_BENCH_REMAT"]
+        pairs = [(32, pol), (16, pol), (8, pol)] if on_tpu else [(2, pol)]
+    else:
+        pairs = ([(64, "dots"), (32, "dots"), (32, "everything"),
+                  (16, "dots"), (16, "everything"), (8, "everything")]
+                 if on_tpu else [(2, "dots")])
+    # fused grad+apply is the fast path; if it fails on hardware the same
+    # ladder retries with the proven two-phase step (DS_BENCH_FUSED=0 forces)
+    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
+        else [False]
+    return [(b, r, f) for f in fused_modes for (b, r) in pairs]
+
+
+def parse_attempt_env():
+    """``DS_BENCH_ATTEMPT=batch:remat:fused`` pins a single ladder config —
+    set by the parent-mode subprocess ladder below."""
+    att = os.environ.get("DS_BENCH_ATTEMPT")
+    if not att:
+        return None
+    b, r, f = att.split(":")
+    return [(int(b), r, f == "1")]
+
+
+def run_ladder_subprocess(candidates, argv):
+    """Try each ladder config in a FRESH child process.
+
+    On the axon/TPU backend a RESOURCE_EXHAUSTED poisons the whole process:
+    every later execution in the same process fails with ResourceExhausted
+    even for configs that fit comfortably (verified empirically — a
+    standalone batch-8 run works, the same config after an in-process
+    batch-64 OOM does not). So OOM fallback MUST restart the process; the
+    child pins one config via DS_BENCH_ATTEMPT and emits the JSON line,
+    which the parent re-emits verbatim.
+
+    Returns True if a JSON line (success or structured error) was emitted.
+    """
+    import subprocess
+    deadline = _START_MONO + float(
+        os.environ.get("DS_BENCH_LADDER_DEADLINE", "1100"))
+    last_line = None
+    for batch, remat_policy, fused in candidates:
+        remaining = deadline - time.monotonic()
+        if remaining < 60:
+            print("bench: ladder deadline reached; stopping new attempts",
+                  file=sys.stderr)
+            break
+        env = dict(os.environ,
+                   DS_BENCH_ATTEMPT=f"{batch}:{remat_policy}:{int(fused)}")
+        print(f"bench: attempt batch={batch} remat={remat_policy} "
+              f"fused={fused} (fresh process, {remaining:.0f}s left)",
+              file=sys.stderr)
+        try:
+            proc = subprocess.run([sys.executable, "-u"] + argv, env=env,
+                                  capture_output=True, text=True,
+                                  timeout=remaining)
+        except subprocess.TimeoutExpired as e:
+            sys.stderr.write((e.stderr or b"").decode(errors="replace")[-2000:]
+                             if isinstance(e.stderr, bytes)
+                             else (e.stderr or "")[-2000:])
+            print(f"bench: attempt timed out after {remaining:.0f}s",
+                  file=sys.stderr)
+            continue
+        sys.stderr.write(proc.stderr[-4000:])
+        json_lines = [ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")]
+        if not json_lines:
+            continue
+        last_line = json_lines[-1]
+        try:
+            payload = json.loads(last_line)
+        except ValueError:
+            continue
+        if payload.get("value", 0) > 0:
+            print(last_line)
+            sys.stdout.flush()
+            return True
+    if last_line is not None:
+        print(last_line)   # structured error from the final attempt
+        sys.stdout.flush()
+        return True
+    return False
+
+
 def run_bench():
     import jax
     import numpy as np
@@ -360,21 +448,7 @@ def run_bench():
     # outputs) is fastest when it fits, "everything" (recompute-all) is the
     # memory floor — prefer a big batch with dots, degrade policy before
     # batch.
-    if os.environ.get("DS_BENCH_BATCH"):
-        pol = os.environ.get("DS_BENCH_REMAT", "dots")
-        candidates = [(int(os.environ["DS_BENCH_BATCH"]), pol)]
-    elif os.environ.get("DS_BENCH_REMAT"):
-        pol = os.environ["DS_BENCH_REMAT"]
-        candidates = [(32, pol), (16, pol), (8, pol)] if on_tpu else [(2, pol)]
-    else:
-        candidates = ([(64, "dots"), (32, "dots"), (32, "everything"),
-                       (16, "dots"), (16, "everything"), (8, "everything")]
-                      if on_tpu else [(2, "dots")])
-    # fused grad+apply is the fast path; if it fails on hardware the same
-    # ladder retries with the proven two-phase step (DS_BENCH_FUSED=0 forces)
-    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
-        else [False]
-    candidates = [(b, r, f) for f in fused_modes for (b, r) in candidates]
+    candidates = parse_attempt_env() or gpt2_candidates(on_tpu)
 
     engine = batch_data = None
     last_err = None
@@ -421,20 +495,29 @@ def run_bench():
             t0 = time.perf_counter()
             loss = step()
             jax.block_until_ready(loss)
+            # the device->host transfer can be where a deferred OOM actually
+            # surfaces (seen on the axon backend: block_until_ready returns,
+            # device_get raises RESOURCE_EXHAUSTED) — it must stay inside
+            # the try so the ladder falls back instead of dying
+            first_loss = float(jax.device_get(loss))
             break
         except Exception as e:  # OOM at this batch -> try the next size down
             # keep only the message: the traceback would pin the failed
-            # attempt's device buffers and params, OOMing the retry too
+            # attempt's device buffers and params, OOMing the retry too.
+            # `step` (whose closure cell pins the dead engine) and `loss`
+            # (a live device array keeping the failed execution reachable)
+            # must be dropped too — leaking them OOMs every later attempt.
             last_err = RuntimeError(f"{type(e).__name__}: {e}")
-            engine = params = None
+            engine = params = step = loss = None
             import gc
             gc.collect()
+            jax.clear_caches()  # traced jaxprs also pin donated buffers
             print(f"bench: batch {batch}/{remat_policy}/fused={fused} failed "
                   f"({type(e).__name__}); falling back", file=sys.stderr)
     if engine is None:
-        raise last_err
+        raise (last_err if last_err is not None else
+               RuntimeError("no ladder attempt ran (deadline exhausted)"))
 
-    first_loss = float(jax.device_get(loss))
     print(f"compile+first step: {time.perf_counter()-t0:.1f}s "
           f"batch={batch} remat={remat_policy} fused={fused} "
           f"loss={first_loss:.3f}", file=sys.stderr)
@@ -477,6 +560,17 @@ def run_bench():
 
 
 def main():
+    # parent mode on TPU-class platforms: run the ladder as fresh
+    # subprocesses (a single in-process OOM poisons the axon backend).
+    # DS_BENCH_ATTEMPT children and CPU smoke runs take the direct path.
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if (parse_attempt_env() is None
+            and any(p in platforms for p in ("axon", "tpu"))):
+        if run_ladder_subprocess(gpt2_candidates(on_tpu=True),
+                                 [os.path.abspath(__file__)]):
+            return
+        # no child produced any JSON (e.g. every attempt hard-timed-out):
+        # fall through to the in-process path for the structured error
     try:
         run_bench()
     except Exception as e:

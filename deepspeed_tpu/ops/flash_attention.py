@@ -15,6 +15,7 @@ no caller-side ``jnp.repeat`` (which would materialize rep× K/V HBM traffic).
 """
 
 import jax
+import jax.ad_checkpoint  # jax 0.9 removed the lazy `jax.ad_checkpoint` attr
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.registry import OpBuilder, register_op_builder

@@ -19,6 +19,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # repo-root bench.py: probe/retry/recovery + peak_flops
 
 
+def ladder(args, on_tpu):
+    if args.batch:
+        pairs = [(args.batch, args.remat or "dots")]
+    elif args.remat:
+        pairs = [(16, args.remat), (8, args.remat), (4, args.remat)]
+    else:
+        pairs = ([(16, "dots"), (8, "dots"), (8, "everything"),
+                  (4, "everything")] if on_tpu else [(2, "dots")])
+    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
+        else [False]
+    return [(b, r, f) for f in fused_modes for (b, r) in pairs]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
@@ -26,6 +39,15 @@ def main():
     ap.add_argument("--batch", type=int, default=0, help="0 = ladder")
     ap.add_argument("--remat", default="", help="fixed remat policy")
     args = ap.parse_args()
+
+    # parent mode on TPU-class platforms: one fresh process per config —
+    # an in-process OOM poisons the axon backend for every later attempt
+    pinned = bench.parse_attempt_env()
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if pinned is None and any(p in platforms for p in ("axon", "tpu")):
+        argv = [os.path.abspath(__file__)] + sys.argv[1:]
+        if bench.run_ladder_subprocess(ladder(args, on_tpu=True), argv):
+            return
 
     try:
         devs = bench.init_backend_with_retry()
@@ -57,17 +79,7 @@ def main():
         cfg = LlamaConfig.tiny()
     model = LlamaForCausalLM(cfg)
 
-    if args.batch:
-        candidates = [(args.batch, args.remat or "dots")]
-    elif args.remat:
-        candidates = [(16, args.remat), (8, args.remat), (4, args.remat)]
-    else:
-        candidates = ([(16, "dots"), (8, "dots"), (8, "everything"),
-                       (4, "everything")] if on_tpu else [(2, "dots")])
-
-    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
-        else [False]
-    candidates = [(b, r, f) for f in fused_modes for (b, r) in candidates]
+    candidates = pinned or ladder(args, on_tpu)
     engine = loss = None
     last_err = None
     for batch, remat_policy, fused in candidates:
@@ -108,9 +120,13 @@ def main():
             break
         except Exception as e:
             last_err = RuntimeError(f"{type(e).__name__}: {e}"[:400])
-            engine = params = None
+            # `step`/`loss` pin the failed engine's device buffers via the
+            # closure cell and the live array — leak them and every later
+            # (smaller) attempt inherits the OOM
+            engine = params = step = loss = None
             import gc
             gc.collect()
+            jax.clear_caches()
             print(f"llama bench: batch {batch}/{remat_policy} failed; "
                   f"falling back", file=sys.stderr)
     if engine is None:
