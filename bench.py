@@ -335,6 +335,29 @@ def init_backend_with_retry():
     raise last if last is not None else RuntimeError("no devices found")
 
 
+def expand_fused(pairs):
+    """Cross (batch, remat) pairs with the fused-step modes: fused grad+apply
+    is the fast path; if it fails on hardware the same ladder retries with
+    the proven two-phase step (DS_BENCH_FUSED=0 forces two-phase only).
+    Shared by every bench ladder so the fallback policy lives in ONE place."""
+    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
+        else [False]
+    return [(b, r, f) for f in fused_modes for (b, r) in pairs]
+
+
+def subprocess_ladder_applies():
+    """Parent-mode gate: spawn one fresh process per ladder config unless the
+    platform is explicitly CPU-only. Default ON — on real TPU hosts
+    JAX_PLATFORMS is often unset (auto-detection), and the in-process ladder
+    is unusable there (one OOM poisons the process, see run_ladder_subprocess)."""
+    if parse_attempt_env() is not None:
+        return False
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    cpu_only = platforms and all(
+        p.strip() in ("cpu", "") for p in platforms.split(","))
+    return not cpu_only
+
+
 def gpt2_candidates(on_tpu):
     if os.environ.get("DS_BENCH_BATCH"):
         pol = os.environ.get("DS_BENCH_REMAT", "dots")
@@ -346,11 +369,7 @@ def gpt2_candidates(on_tpu):
         pairs = ([(64, "dots"), (32, "dots"), (32, "everything"),
                   (16, "dots"), (16, "everything"), (8, "everything")]
                  if on_tpu else [(2, "dots")])
-    # fused grad+apply is the fast path; if it fails on hardware the same
-    # ladder retries with the proven two-phase step (DS_BENCH_FUSED=0 forces)
-    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
-        else [False]
-    return [(b, r, f) for f in fused_modes for (b, r) in pairs]
+    return expand_fused(pairs)
 
 
 def parse_attempt_env():
@@ -407,11 +426,11 @@ def run_ladder_subprocess(candidates, argv):
                       if ln.startswith("{")]
         if not json_lines:
             continue
-        last_line = json_lines[-1]
         try:
-            payload = json.loads(last_line)
+            payload = json.loads(json_lines[-1])
         except ValueError:
-            continue
+            continue   # never re-emit a '{'-prefixed line that isn't JSON
+        last_line = json_lines[-1]
         if payload.get("value", 0) > 0:
             print(last_line)
             sys.stdout.flush()
@@ -560,12 +579,10 @@ def run_bench():
 
 
 def main():
-    # parent mode on TPU-class platforms: run the ladder as fresh
-    # subprocesses (a single in-process OOM poisons the axon backend).
-    # DS_BENCH_ATTEMPT children and CPU smoke runs take the direct path.
-    platforms = os.environ.get("JAX_PLATFORMS", "")
-    if (parse_attempt_env() is None
-            and any(p in platforms for p in ("axon", "tpu"))):
+    # parent mode: run the ladder as fresh subprocesses (a single in-process
+    # OOM poisons the axon/TPU backend). DS_BENCH_ATTEMPT children and
+    # explicitly-CPU-pinned smoke runs take the direct path.
+    if subprocess_ladder_applies():
         if run_ladder_subprocess(gpt2_candidates(on_tpu=True),
                                  [os.path.abspath(__file__)]):
             return

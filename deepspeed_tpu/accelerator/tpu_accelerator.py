@@ -2,14 +2,123 @@
 
 The reference routes *all* device access through ``get_accelerator()``
 (``accelerator/cuda_accelerator.py`` for CUDA); this is the TPU implementation
-slot the reference left open (SURVEY §2.5). Devices come from ``jax.devices()``;
-memory stats from PJRT; the communication backend name is "xla" (collectives are
-compiled into programs over the mesh rather than issued by a comm library).
+slot the reference left open (SURVEY §2.5). It covers the full 64-method
+``DeepSpeedAccelerator`` contract (``/root/reference/accelerator/
+abstract_accelerator.py:10``) with TPU-appropriate semantics:
+
+- devices are ``jax.Device`` objects; "streams" do not exist (XLA dispatch is
+  async per-device and ordered; synchronization is ``block_until_ready``), so
+  the Stream/Event API is a truthful no-op analog whose Events still measure
+  host wall-clock around synchronization points;
+- graph capture (``create_graph``/``capture_to_graph``/``replay_graph``,
+  reference :210-218) maps to ``jax.jit``: capture jits and warms the
+  callable, replay executes the cached executable;
+- memory stats come from PJRT ``Device.memory_stats()`` (``bytes_in_use``,
+  ``peak_bytes_in_use``, ``bytes_limit``); backends that expose none (CPU,
+  some tunneled TPU clients) report zeros rather than raising;
+- tensor factories return jnp-array constructors; f64/i64 map to f32/i32
+  under JAX's default x32 mode (TPUs have no f64 ALUs).
 """
 
 import os
+import time
 
 from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+
+
+def _drain_devices(devices=None):
+    """Block until previously-dispatched device work completes.
+
+    ``jax.effects_barrier()`` only waits for ORDERED EFFECTS, not ordinary
+    pending async dispatch — so draining means enqueueing a trivial transfer
+    behind the queued work on each device (PJRT executes launches in order
+    per device) and blocking on it. Used by every synchronize() analog here.
+    """
+    import jax
+    jax.effects_barrier()   # flush any ordered effects too
+    for d in (devices if devices is not None else jax.local_devices()):
+        try:
+            jax.device_put(0, d).block_until_ready()
+        except Exception:
+            pass
+
+
+class _NoOpStream:
+    """Stream analog (reference :92-107). XLA queues work per-device in
+    program order; there is exactly one logical stream. ``synchronize``
+    drains it."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def synchronize(self):
+        _drain_devices([self.device] if self.device is not None else None)
+
+    def wait_stream(self, other):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _HostEvent:
+    """Event analog (reference :110): records host wall-clock at a
+    synchronization point; ``elapsed_time`` matches torch's ms contract."""
+
+    def __init__(self, enable_timing=True, **_):
+        self._t = None
+
+    def record(self, stream=None):
+        self._t = time.perf_counter()
+
+    def synchronize(self):
+        _drain_devices()
+
+    def query(self):
+        return self._t is not None
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            raise RuntimeError("elapsed_time: both events must be recorded")
+        return (end_event._t - self._t) * 1000.0
+
+
+class _JitGraph:
+    """Graph-capture analog (reference :210-218). ``capture(fn, *args)`` jits
+    and warms ``fn``; ``replay()`` re-executes with the captured args —
+    the cached XLA executable plays the role of the CUDA graph."""
+
+    def __init__(self):
+        self._fn = None
+        self._args = None
+        self._kwargs = None
+
+    def capture(self, fn, *args, **kwargs):
+        import jax
+        self._fn = jax.jit(fn)
+        self._args, self._kwargs = args, kwargs
+        out = self._fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        return out
+
+    def replay(self):
+        if self._fn is None:
+            raise RuntimeError("replay before capture")
+        return self._fn(*self._args, **self._kwargs)
+
+
+class _GraphCaptureContext:
+    def __init__(self, graph):
+        self.graph = graph
+
+    def __enter__(self):
+        return self.graph
+
+    def __exit__(self, *exc):
+        return False
 
 
 class TPU_Accelerator(DeepSpeedAccelerator):
@@ -19,11 +128,28 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         self._name = "tpu"
         self._communication_backend_name = "xla"
         self._seed = 0
+        self._rng_key = None
         self._current_device = 0
+        self._annotation_stack = []
 
     def _devices(self):
         import jax
         return jax.local_devices()
+
+    # --- behavior flags (reference :16-30) ---
+    def is_synchronized_device(self):
+        return False          # XLA dispatch is asynchronous
+
+    def use_host_timers(self):
+        # no device-side event timers over PJRT: timers must bracket
+        # block_until_ready on the host (utils/timer.py does)
+        return True
+
+    def resolves_data_dependency(self):
+        return True           # XLA orders ops by dataflow, not stream order
+
+    def handles_memory_backpressure(self):
+        return False          # an HBM OOM is an error, not a stall
 
     # --- device management ---
     def device_name(self, device_index=None):
@@ -48,18 +174,80 @@ class TPU_Accelerator(DeepSpeedAccelerator):
     def current_device_name(self):
         return self.device_name(self._current_device)
 
-    # --- RNG ---
+    def set_device(self, device_index):
+        self._current_device = device_index
+
+    def synchronize(self, device_index=None):
+        _drain_devices([self.device(device_index)]
+                       if device_index is not None else None)
+
+    def is_available(self):
+        try:
+            return len(self._devices()) > 0
+        except Exception:
+            return False
+
+    # --- RNG (reference :63-88; functional keys instead of global state) ---
+    def random(self):
+        import jax
+        return jax.random
+
     def manual_seed(self, seed):
-        self._seed = seed
+        import jax
+        self._seed = int(seed)
+        self._rng_key = jax.random.PRNGKey(self._seed)
 
     def manual_seed_all(self, seed):
-        self._seed = seed
+        self.manual_seed(seed)
+
+    def initial_seed(self):
+        return self._seed
 
     def prng_key(self):
         import jax
-        return jax.random.PRNGKey(self._seed)
+        if self._rng_key is None:
+            self._rng_key = jax.random.PRNGKey(self._seed)
+        return self._rng_key
 
-    # --- memory ---
+    def get_rng_state(self, device_index=None):
+        import numpy as np
+        return np.asarray(self.prng_key())
+
+    def set_rng_state(self, new_state, device_index=None):
+        import jax.numpy as jnp
+        self._rng_key = jnp.asarray(new_state)
+
+    def default_generator(self, device_index):
+        # functional analog: the generator IS the key stream
+        return self.prng_key()
+
+    # --- streams / events (no-op analogs; see module docstring) ---
+    def Stream(self, device=None, **kwargs):
+        return _NoOpStream(device)
+
+    def stream(self, stream):
+        return stream if hasattr(stream, "__enter__") else _NoOpStream()
+
+    def current_stream(self, device_index=None):
+        return _NoOpStream(self.device(device_index))
+
+    def default_stream(self, device_index=None):
+        return _NoOpStream(self.device(device_index))
+
+    def Event(self, **kwargs):
+        return _HostEvent(**kwargs)
+
+    # --- graph capture (jit analogs) ---
+    def create_graph(self):
+        return _JitGraph()
+
+    def capture_to_graph(self, graph, pool=None, stream=None):
+        return _GraphCaptureContext(graph)
+
+    def replay_graph(self, graph):
+        return graph.replay()
+
+    # --- memory (PJRT memory_stats; reference :115-163) ---
     def memory_stats(self, device_index=None):
         try:
             dev = self.device(device_index)
@@ -68,13 +256,51 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         except Exception:
             return {}
 
-    def empty_cache(self):
-        # XLA manages HBM arena itself; garbage-collect python-side references.
-        import gc
-        gc.collect()
+    def _stat(self, key, device_index=None):
+        return int(self.memory_stats(device_index).get(key, 0))
+
+    def memory_allocated(self, device_index=None):
+        return self._stat("bytes_in_use", device_index)
+
+    def max_memory_allocated(self, device_index=None):
+        return self._stat("peak_bytes_in_use", device_index)
+
+    def reset_max_memory_allocated(self, device_index=None):
+        pass  # PJRT peak counters are monotonic per-process
+
+    def memory_cached(self, device_index=None):
+        # XLA's BFC arena holds its pool internally; in-use is the honest
+        # lower bound PJRT exposes
+        return self._stat("bytes_in_use", device_index)
+
+    def max_memory_cached(self, device_index=None):
+        return self._stat("peak_bytes_in_use", device_index)
+
+    def reset_max_memory_cached(self, device_index=None):
+        pass
+
+    def memory_reserved(self, device_index=None):
+        stats = self.memory_stats(device_index)
+        return int(stats.get("bytes_reserved", stats.get("bytes_in_use", 0)))
+
+    def max_memory_reserved(self, device_index=None):
+        return self._stat("peak_bytes_in_use", device_index)
 
     def reset_peak_memory_stats(self, device_index=None):
-        pass  # PJRT exposes no reset; peak is monotonic per-process
+        pass
+
+    def total_memory(self, device_index=None):
+        return self._stat("bytes_limit", device_index)
+
+    def available_memory(self, device_index=None):
+        stats = self.memory_stats(device_index)
+        return int(stats.get("bytes_limit", 0)) - int(stats.get("bytes_in_use", 0))
+
+    def empty_cache(self):
+        # XLA manages the HBM arena itself; garbage-collect python-side
+        # references so their buffers can be freed
+        import gc
+        gc.collect()
 
     # --- dtype caps ---
     def is_bf16_supported(self):
@@ -85,6 +311,10 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         # loss-scale advantage. We still support the fp16 engine path.
         return True
 
+    def is_fp8_supported(self):
+        import jax.numpy as jnp
+        return hasattr(jnp, "float8_e4m3fn")
+
     def is_triton_supported(self):
         return False
 
@@ -92,9 +322,28 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         import jax.numpy as jnp
         return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.int32]
 
-    def is_fp8_supported(self):
-        import jax.numpy as jnp
-        return hasattr(jnp, "float8_e4m3fn")
+    def amp(self):
+        # bf16 autocast is the engine's dtype policy, not a context manager;
+        # no torch.cuda.amp analog exists or is needed
+        return None
+
+    # --- profiling ranges (reference :189-193) ---
+    def range_push(self, msg):
+        import jax
+        ctx = jax.profiler.TraceAnnotation(msg)
+        ctx.__enter__()
+        self._annotation_stack.append(ctx)
+
+    def range_pop(self):
+        if self._annotation_stack:
+            self._annotation_stack.pop().__exit__(None, None, None)
+
+    def lazy_call(self, callback):
+        # XLA dispatch is already asynchronous; run the host callback now
+        callback()
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
 
     # --- platform info ---
     def on_tpu(self):
@@ -111,7 +360,77 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         except Exception:
             return "unknown"
 
-    # --- op builders (reference op_builder factory hooks) ---
+    # --- tensor factories (reference :224-254) ---
+    def _factory(self, dtype):
+        import functools
+
+        import jax.numpy as jnp
+
+        def make(*shape, dtype=dtype):
+            if len(shape) == 1 and not isinstance(shape[0], int):
+                return jnp.asarray(shape[0], dtype)
+            return jnp.zeros(shape, dtype)
+
+        make.dtype = dtype
+        return make
+
+    def BFloat16Tensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.bfloat16)
+
+    def ByteTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.uint8)
+
+    def DoubleTensor(self):
+        # f64 requires jax_enable_x64 and has no TPU ALUs; f32 is the
+        # honest widest float here
+        import jax.numpy as jnp
+        return self._factory(jnp.float32)
+
+    def FloatTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.float32)
+
+    def HalfTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.float16)
+
+    def IntTensor(self):
+        import jax.numpy as jnp
+        return self._factory(jnp.int32)
+
+    def LongTensor(self):
+        # x32 mode: int64 silently downcasts; int32 is the native width
+        import jax.numpy as jnp
+        return self._factory(jnp.int32)
+
+    # --- host memory (reference :258-266) ---
+    def pin_memory(self, tensor, align_bytes=1):
+        # PJRT stages host->device transfers internally; numpy arrays are
+        # the host-side representation
+        import numpy as np
+        return np.ascontiguousarray(tensor)
+
+    def is_pinned(self, tensor):
+        import numpy as np
+        return isinstance(tensor, np.ndarray) and tensor.flags["C_CONTIGUOUS"]
+
+    def on_accelerator(self, tensor):
+        import jax
+        if isinstance(tensor, jax.core.Tracer):
+            return True
+        if not isinstance(tensor, jax.Array):
+            return False
+        try:
+            return all(d.platform != "cpu" for d in tensor.devices())
+        except Exception:
+            return False
+
+    # --- op builders (reference op_builder factory hooks :270-288) ---
+    def op_builder_dir(self):
+        return "deepspeed_tpu.ops"
+
     def create_op_builder(self, op_name):
         builder = self.get_op_builder(op_name)
         return builder() if builder is not None else None
@@ -119,3 +438,14 @@ class TPU_Accelerator(DeepSpeedAccelerator):
     def get_op_builder(self, op_name):
         from deepspeed_tpu.ops.registry import get_op_builder
         return get_op_builder(op_name)
+
+    def build_extension(self):
+        # native C extensions build via g++/ctypes JIT (ops/native), not
+        # torch.utils.cpp_extension
+        from deepspeed_tpu.ops import native
+        return native
+
+    def export_envs(self):
+        # env prefixes a launcher must propagate to workers (reference
+        # returns e.g. ['NCCL']; these are the TPU/XLA equivalents)
+        return ["JAX", "XLA", "LIBTPU", "TPU", "DS_TPU"]

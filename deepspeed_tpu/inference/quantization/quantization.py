@@ -81,9 +81,11 @@ class QuantizedParameter:
             M = int(np.prod(x.shape[:-1]))
             K, N = self.shape
             if qm.is_supported(M, K, N, self.group_size, self.num_bits):
+                from deepspeed_tpu.ops.registry import pallas_interpret
                 out = qm.quantized_matmul(x.reshape(M, K), self.q, self.scale,
                                           self.group_size,
-                                          out_dtype=out_dtype)
+                                          out_dtype=out_dtype,
+                                          interpret=pallas_interpret())
                 return out.reshape(x.shape[:-1] + (N,))
         return x @ self.dequantized(out_dtype or x.dtype)
 

@@ -19,6 +19,11 @@ def instantiate_attention(q_shape, pool_shape):
     from deepspeed_tpu.ops.pallas import paged_attention as pa
     if pallas_enabled():
         if pa.is_supported(q_shape, pool_shape):
+            from deepspeed_tpu.ops.registry import pallas_interpret
+            if pallas_interpret():
+                import functools
+                return "pallas_paged", functools.partial(pa.paged_mha,
+                                                         interpret=True)
             return "pallas_paged", pa.paged_mha
         if "attention" not in _warned:
             _warned.add("attention")

@@ -135,9 +135,11 @@ def mha(q, k, v, bias=None, causal=True, softmax_scale=None, window=None,
                                        None if bias is None else bias.shape,
                                        window, seg_shape)
         if reason is None:
+            from deepspeed_tpu.ops.registry import pallas_interpret
             out = fa.flash_mha(q, k, v, bias=bias, causal=causal,
                                softmax_scale=softmax_scale, window=window,
-                               segment_ids=segment_ids)
+                               segment_ids=segment_ids,
+                               interpret=pallas_interpret())
             if orig_t is not None:
                 out = out[:, :orig_t]
             # named so remat policies can choose to save attention outputs

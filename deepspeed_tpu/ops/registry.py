@@ -31,7 +31,8 @@ class OpBuilder:
             plat = jax.devices()[0].platform
         except Exception:
             return False
-        ok = self.pallas_available() and plat in ("tpu", "axon")
+        ok = self.pallas_available() and (plat in ("tpu", "axon")
+                                          or pallas_interpret())
         has_pallas_slot = type(self).pallas_impl is not OpBuilder.pallas_impl
         if (not ok and plat in ("tpu", "axon") and has_pallas_slot
                 and self.NAME not in OpBuilder._warned_fallback):
@@ -64,13 +65,25 @@ class OpBuilder:
         return self._loaded
 
 
+def pallas_interpret():
+    """True when Pallas kernels should run in interpret mode (CPU emulation
+    of the grid program). Slow; exists so multi-chip dryruns on a virtual
+    CPU mesh can exercise the REAL kernel code path — padding, custom vjp,
+    GSPMD composition — instead of silently taking the XLA fallback."""
+    import os
+    return bool(os.environ.get("DS_TPU_PALLAS_INTERPRET"))
+
+
 def pallas_enabled():
     """True when Pallas fast paths may be used: a TPU backend is live and the
     DS_TPU_DISABLE_PALLAS kill-switch is off. THE shared gate — heuristics
-    and op wrappers must not re-implement platform probing."""
+    and op wrappers must not re-implement platform probing.
+    DS_TPU_PALLAS_INTERPRET forces True on any platform (interpret mode)."""
     import os
     if os.environ.get("DS_TPU_DISABLE_PALLAS"):
         return False
+    if pallas_interpret():
+        return True
     try:
         import jax
         return jax.devices()[0].platform in ("tpu", "axon")

@@ -47,8 +47,10 @@ def sparse_attention(q, k, v, layout, block, causal=False, softmax_scale=None):
         from deepspeed_tpu.ops.pallas import block_sparse_attention as bsa
         if bsa.is_supported(q.shape, block) and \
                 not isinstance(layout, jax.core.Tracer):
+            from deepspeed_tpu.ops.registry import pallas_interpret
             return bsa.sparse_mha(q, k, v, layout, block, causal=causal,
-                                  softmax_scale=softmax_scale)
+                                  softmax_scale=softmax_scale,
+                                  interpret=pallas_interpret())
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(D)
     mask = _token_mask_from_layout(layout, block)  # [H, S, S]
     if causal:

@@ -2,7 +2,7 @@
 
 Exercises exactly the stack BASELINE.md's north-star rows name: flash
 attention (Pallas), GQA, scan-over-layers, ZeRO-3 param partitioning, bf16 —
-on a ~0.8B llama config sized for one v5e-class chip. Prints ONE JSON line
+on a ~0.5B llama config sized for one v5e-class chip. Prints ONE JSON line
 like bench.py (metric/value/unit/vs_baseline where vs_baseline = MFU / 0.45).
 
 Usage: python scripts/bench_llama.py [--steps N] [--seq T] [--batch B]
@@ -27,9 +27,7 @@ def ladder(args, on_tpu):
     else:
         pairs = ([(16, "dots"), (8, "dots"), (8, "everything"),
                   (4, "everything")] if on_tpu else [(2, "dots")])
-    fused_modes = [True, False] if os.environ.get("DS_BENCH_FUSED", "1") == "1" \
-        else [False]
-    return [(b, r, f) for f in fused_modes for (b, r) in pairs]
+    return bench.expand_fused(pairs)
 
 
 def main():
@@ -40,11 +38,10 @@ def main():
     ap.add_argument("--remat", default="", help="fixed remat policy")
     args = ap.parse_args()
 
-    # parent mode on TPU-class platforms: one fresh process per config —
-    # an in-process OOM poisons the axon backend for every later attempt
+    # parent mode: one fresh process per config — an in-process OOM poisons
+    # the axon/TPU backend for every later attempt
     pinned = bench.parse_attempt_env()
-    platforms = os.environ.get("JAX_PLATFORMS", "")
-    if pinned is None and any(p in platforms for p in ("axon", "tpu")):
+    if bench.subprocess_ladder_applies():
         argv = [os.path.abspath(__file__)] + sys.argv[1:]
         if bench.run_ladder_subprocess(ladder(args, on_tpu=True), argv):
             return
@@ -52,7 +49,7 @@ def main():
     try:
         devs = bench.init_backend_with_retry()
     except Exception as e:
-        bench.emit({"metric": "llama800m_bf16_zero3_tokens_per_sec_per_chip",
+        bench.emit({"metric": "llama500m_bf16_zero3_tokens_per_sec_per_chip",
                     "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                     "extra": {"error": f"{type(e).__name__}: {e}"[:300],
                               "holders": getattr(e, "bench_holders", None)}})
@@ -70,10 +67,15 @@ def main():
     seq = args.seq if on_tpu else 128
 
     if on_tpu:
-        # ~0.8B: 16 layers x 1792 hidden, 14 heads (GQA 7:1 -> 2 kv heads)
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1792,
-                          intermediate_size=4864, num_hidden_layers=16,
-                          num_attention_heads=14, num_key_value_heads=2,
+        # ~0.5B: 16 layers x 1536 hidden, 12 heads (GQA 6:1 -> 2 kv heads).
+        # Sizing is HBM-bound, not ambition-bound: params cost 14 bytes each
+        # (bf16 + fp32 master + Adam m,v) plus fp32 transients during the
+        # update, so ~0.5B is the largest llama that trains on one 16GB v5e
+        # with a batch big enough to saturate the MXU — the previous 0.8B
+        # config OOM'd at every batch size it was ever tried at.
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=2,
                           max_position_embeddings=seq)
     else:
         cfg = LlamaConfig.tiny()
@@ -130,7 +132,7 @@ def main():
             print(f"llama bench: batch {batch}/{remat_policy} failed; "
                   f"falling back", file=sys.stderr)
     if engine is None:
-        bench.emit({"metric": "llama800m_bf16_zero3_tokens_per_sec_per_chip",
+        bench.emit({"metric": "llama500m_bf16_zero3_tokens_per_sec_per_chip",
                     "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                     "extra": {"error": str(last_err)}})
         return
@@ -146,7 +148,7 @@ def main():
     tok_chip = tokens / dt / n_chips
     mfu = tok_chip * llama_flops_per_token(cfg, seq) / bench.peak_flops(kind)
     bench.emit({
-        "metric": "llama800m_bf16_zero3_tokens_per_sec_per_chip",
+        "metric": "llama500m_bf16_zero3_tokens_per_sec_per_chip",
         "value": round(tok_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),

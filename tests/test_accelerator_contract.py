@@ -1,0 +1,152 @@
+"""Accelerator contract coverage.
+
+The reference defines a 64-method ``DeepSpeedAccelerator`` abstract interface
+(``/root/reference/accelerator/abstract_accelerator.py:10``). The TPU
+accelerator must cover every method with TPU-appropriate semantics — this
+test enumerates that surface (hardcoded from the reference so the repo stays
+standalone) and exercises the behavior groups.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.accelerator.real_accelerator import get_accelerator
+
+# the reference abstract surface, by group (abstract_accelerator.py line refs)
+CONTRACT = [
+    # behavior flags (:16-30)
+    "is_synchronized_device", "use_host_timers", "resolves_data_dependency",
+    "handles_memory_backpressure",
+    # device management (:34-58)
+    "device_name", "device", "set_device", "current_device",
+    "current_device_name", "device_count", "synchronize",
+    # RNG (:63-88)
+    "random", "set_rng_state", "get_rng_state", "manual_seed",
+    "manual_seed_all", "initial_seed", "default_generator",
+    # streams/events (:92-110)
+    "Stream", "stream", "current_stream", "default_stream", "Event",
+    # memory (:115-163)
+    "empty_cache", "memory_allocated", "max_memory_allocated",
+    "reset_max_memory_allocated", "memory_cached", "max_memory_cached",
+    "reset_max_memory_cached", "memory_stats", "reset_peak_memory_stats",
+    "memory_reserved", "max_memory_reserved", "total_memory",
+    "available_memory",
+    # dtype/platform caps (:168-205)
+    "is_bf16_supported", "is_fp16_supported", "supported_dtypes", "amp",
+    "is_available", "range_push", "range_pop", "lazy_call",
+    "communication_backend_name", "is_triton_supported",
+    # graph capture (:210-218)
+    "create_graph", "capture_to_graph", "replay_graph",
+    # tensor factories (:224-254)
+    "BFloat16Tensor", "ByteTensor", "DoubleTensor", "FloatTensor",
+    "HalfTensor", "IntTensor", "LongTensor",
+    # host memory (:258-266)
+    "pin_memory", "is_pinned", "on_accelerator",
+    # op builders / build (:270-288)
+    "op_builder_dir", "create_op_builder", "get_op_builder",
+    "build_extension", "export_envs",
+]
+
+
+def test_contract_surface_complete():
+    acc = get_accelerator()
+    missing = [m for m in CONTRACT if not callable(getattr(acc, m, None))]
+    assert not missing, f"accelerator contract gaps: {missing}"
+    # the reference declares exactly 64 @abc.abstractmethod entries
+    assert len(CONTRACT) == 64
+
+
+def test_behavior_flags():
+    acc = get_accelerator()
+    assert acc.is_synchronized_device() is False
+    assert acc.resolves_data_dependency() is True
+    assert isinstance(acc.use_host_timers(), bool)
+    assert isinstance(acc.handles_memory_backpressure(), bool)
+
+
+def test_rng_state_roundtrip():
+    acc = get_accelerator()
+    acc.manual_seed(1234)
+    assert acc.initial_seed() == 1234
+    state = acc.get_rng_state()
+    k1 = np.asarray(acc.prng_key())
+    acc.manual_seed(99)
+    acc.set_rng_state(state)
+    k2 = np.asarray(acc.prng_key())
+    np.testing.assert_array_equal(k1, k2)
+
+
+def test_stream_event_analogs():
+    acc = get_accelerator()
+    s = acc.Stream()
+    with acc.stream(s):
+        pass
+    s.synchronize()
+    start, end = acc.Event(enable_timing=True), acc.Event(enable_timing=True)
+    start.record()
+    end.record()
+    assert start.query() and end.query()
+    assert end.elapsed_time(start) <= 0 <= start.elapsed_time(end) + 1e3
+
+
+def test_graph_capture_jit_analog():
+    import jax.numpy as jnp
+    acc = get_accelerator()
+    g = acc.create_graph()
+    with acc.capture_to_graph(g) as graph:
+        out = graph.capture(lambda x: x * 2 + 1, jnp.arange(8, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.arange(8) * 2 + 1)
+    np.testing.assert_allclose(np.asarray(acc.replay_graph(g)),
+                               np.arange(8) * 2 + 1)
+
+
+def test_memory_stats_shape():
+    acc = get_accelerator()
+    # CPU PJRT exposes no stats: everything must be an int >= 0, not a raise
+    for m in ("memory_allocated", "max_memory_allocated", "memory_cached",
+              "memory_reserved", "total_memory"):
+        v = getattr(acc, m)()
+        assert isinstance(v, int) and v >= 0, (m, v)
+    assert isinstance(acc.memory_stats(), dict)
+    acc.reset_peak_memory_stats()
+    acc.empty_cache()
+
+
+def test_tensor_factories():
+    import jax.numpy as jnp
+    acc = get_accelerator()
+    t = acc.FloatTensor()(2, 3)
+    assert t.shape == (2, 3) and t.dtype == jnp.float32
+    b = acc.BFloat16Tensor()([1.0, 2.0])
+    assert b.dtype == jnp.bfloat16 and b.shape == (2,)
+    assert acc.IntTensor()(4).dtype == jnp.int32
+    assert acc.LongTensor()(4).dtype == jnp.int32  # x32 mode: int32 is native
+
+
+def test_host_memory_and_placement():
+    import jax.numpy as jnp
+    acc = get_accelerator()
+    arr = acc.pin_memory(np.arange(16).reshape(4, 4))
+    assert acc.is_pinned(arr)
+    dev_arr = jnp.asarray(arr)
+    # CPU backend: on_accelerator is False; on TPU it would be True
+    assert isinstance(acc.on_accelerator(dev_arr), bool)
+    assert not acc.on_accelerator(arr)  # numpy is never on-device
+
+
+def test_ranges_and_lazy_call():
+    acc = get_accelerator()
+    acc.range_push("test-range")
+    acc.range_pop()
+    called = []
+    acc.lazy_call(lambda: called.append(1))
+    assert called == [1]
+
+
+def test_op_builder_hooks():
+    acc = get_accelerator()
+    assert acc.op_builder_dir() == "deepspeed_tpu.ops"
+    b = acc.create_op_builder("flash_attn")
+    assert b is not None and hasattr(b, "is_compatible")
+    assert acc.build_extension() is not None
+    assert any(e.startswith("XLA") for e in acc.export_envs())
