@@ -298,6 +298,60 @@ def irecv(tensor, src, dst=0, axis_name="pp", **kwargs):
 # Host-level process management
 # ---------------------------------------------------------------------------
 
+def discover_process_env(environ=None):
+    """(coordinator, num_processes, process_id) from the environment —
+    the reference's ``mpi_discovery`` (:673) + SLURM/launcher env paths,
+    covering every ``launcher/multinode_runner.py`` backend:
+
+    - explicit DST_*/MASTER_ADDR+RANK (ssh/local runners bake the rank),
+    - SLURM (``srun``): SLURM_PROCID/SLURM_NTASKS/SLURM_JOB_NODELIST,
+    - Open MPI (``mpirun``): OMPI_COMM_WORLD_RANK/SIZE,
+    - MPICH/Intel MPI hydra: PMI_RANK/PMI_SIZE,
+    - PDSH (rankless): this host's position in the broadcast DS_WORLD_INFO.
+    """
+    env = os.environ if environ is None else environ
+    coordinator = env.get("DST_COORDINATOR_ADDRESS") or env.get("MASTER_ADDR")
+    num_proc = int(env.get("DST_NUM_PROCESSES", env.get("WORLD_SIZE", "1")))
+    if "DST_PROCESS_ID" in env or "RANK" in env:
+        return coordinator, num_proc, int(env.get("DST_PROCESS_ID",
+                                                  env.get("RANK", "0")))
+    # SLURM discovery (reference comm.py:673 mpi_discovery analog)
+    if "SLURM_PROCID" in env:
+        num_proc = int(env.get("SLURM_NTASKS", num_proc))
+        coordinator = coordinator or env.get(
+            "SLURM_JOB_NODELIST", "localhost").split(",")[0]
+        return coordinator, num_proc, int(env["SLURM_PROCID"])
+    if coordinator is None and "SLURM_JOB_NODELIST" in env:
+        return (env["SLURM_JOB_NODELIST"].split(",")[0],
+                int(env.get("SLURM_NTASKS", "1")),
+                int(env.get("SLURM_PROCID", "0")))
+    # mpirun discovery: Open MPI then hydra-family (MPICH/IMPI/MVAPICH)
+    if "OMPI_COMM_WORLD_RANK" in env:
+        return (coordinator, int(env.get("OMPI_COMM_WORLD_SIZE", num_proc)),
+                int(env["OMPI_COMM_WORLD_RANK"]))
+    if "PMI_RANK" in env:
+        return (coordinator, int(env.get("PMI_SIZE", num_proc)),
+                int(env["PMI_RANK"]))
+    # PDSH: no scheduler rank — derive it from this node's hostname position
+    # in the world info the launcher broadcast
+    if "DS_WORLD_INFO" in env:
+        import socket
+        from deepspeed_tpu.launcher.runner import decode_world_info
+        hosts = list(decode_world_info(env["DS_WORLD_INFO"]))
+        if len(hosts) > 1:
+            hostname = socket.gethostname()
+            for h in (hostname, hostname.split(".")[0]):
+                if h in hosts:
+                    return coordinator, len(hosts), hosts.index(h)
+            # defaulting to rank 0 here would make EVERY unmatched node claim
+            # rank 0 and hang the coordinator with no diagnostic
+            raise RuntimeError(
+                f"rank discovery: hostname {hostname!r} not found in the "
+                f"launcher's world info {hosts} — use hostfile names matching "
+                f"`hostname` (or a scheduler launcher that assigns ranks)")
+    return coordinator, num_proc, 0
+
+
 def init_distributed(dist_backend=None,
                      auto_mpi_discovery=True,
                      distributed_port=29500,
@@ -318,16 +372,15 @@ def init_distributed(dist_backend=None,
     global _initialized
     if _initialized:
         return
-    coordinator = os.environ.get("DST_COORDINATOR_ADDRESS") or os.environ.get("MASTER_ADDR")
-    num_proc = int(os.environ.get("DST_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1")))
-    proc_id = int(os.environ.get("DST_PROCESS_ID", os.environ.get("RANK", "0")))
+    coordinator, num_proc, proc_id = discover_process_env()
     # the launcher's env contract (launcher/runner.py node_env) carries the port
     distributed_port = int(os.environ.get("MASTER_PORT", distributed_port))
-    # SLURM discovery (reference comm.py:673 mpi_discovery analog)
-    if coordinator is None and "SLURM_JOB_NODELIST" in os.environ:
-        num_proc = int(os.environ.get("SLURM_NTASKS", "1"))
-        proc_id = int(os.environ.get("SLURM_PROCID", "0"))
-        coordinator = os.environ["SLURM_JOB_NODELIST"].split(",")[0]
+    # explicit arguments override discovery (reference init_distributed
+    # rank/world_size params)
+    if rank >= 0:
+        proc_id = rank
+    if world_size > 0:
+        num_proc = world_size
     if coordinator is not None and num_proc > 1:
         if verbose:
             logger.info(f"init_distributed: coordinator={coordinator}:{distributed_port} "

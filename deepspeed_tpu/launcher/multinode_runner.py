@@ -1,0 +1,180 @@
+"""Multinode runners — PDSH / SLURM / OpenMPI / MPICH command builders.
+
+Reference ``launcher/multinode_runner.py``: ``PDSHRunner`` (:51),
+``OpenMPIRunner`` (:118), ``MPICHRunner`` (:182), ``IMPIRunner`` (:244),
+``SlurmRunner`` (:328), ``MVAPICHRunner``. Each turns (exports, resource pool,
+user command) into one scheduler invocation that starts every node.
+
+TPU adaptation: one process per HOST (a single JAX process drives all local
+chips), so every runner launches exactly ``len(pool)`` tasks, one per node.
+The per-process rank is NOT baked into the command — it comes from the
+scheduler at runtime (``SLURM_PROCID`` / ``OMPI_COMM_WORLD_RANK`` /
+``PMI_RANK``), or, for PDSH (which has no rank concept), from the node's
+hostname position in the broadcast ``DS_WORLD_INFO`` — all resolved by
+``comm.init_distributed`` discovery (comm/comm.py).
+"""
+
+import os
+import shlex
+import shutil
+from abc import ABC, abstractmethod
+
+from deepspeed_tpu.launcher.runner import EXPORT_ENVS, encode_world_info
+
+
+class MultiNodeRunner(ABC):
+    """One scheduler's command builder (reference ``MultiNodeRunner:21``)."""
+
+    def __init__(self, pool, master_addr, master_port):
+        self.pool = pool  # OrderedDict host -> slots
+        self.master_addr = master_addr
+        self.master_port = master_port
+        self.exports = {}
+
+    @property
+    def hosts(self):
+        return list(self.pool)
+
+    def add_export(self, key, value):
+        self.exports[key.strip()] = str(value).strip()
+
+    def base_env(self):
+        """The launch contract every node receives. RANK is intentionally
+        absent — the scheduler (or hostname lookup) supplies it."""
+        env = {
+            "MASTER_ADDR": str(self.master_addr),
+            "MASTER_PORT": str(self.master_port),
+            "WORLD_SIZE": str(len(self.pool)),
+            "DS_WORLD_INFO": encode_world_info(self.pool),
+        }
+        for k in EXPORT_ENVS:
+            if k in os.environ:
+                env[k] = os.environ[k]
+        env.update(self.exports)
+        return env
+
+    @property
+    @abstractmethod
+    def name(self):
+        ...
+
+    @abstractmethod
+    def backend_exists(self):
+        """Is the scheduler binary on PATH (reference ``backend_exists``)?"""
+        ...
+
+    @abstractmethod
+    def get_cmd(self, program):
+        """Full argv launching ``program`` (a token list) on every node."""
+        ...
+
+
+class PDSHRunner(MultiNodeRunner):
+    """reference ``PDSHRunner:51`` — parallel ssh fanout."""
+
+    @property
+    def name(self):
+        return "pdsh"
+
+    def backend_exists(self):
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, program):
+        env = self.base_env()
+        exports = [f"export {k}={shlex.quote(v)};" for k, v in env.items()]
+        remote = " ".join(exports + [f"cd {shlex.quote(os.getcwd())};"]
+                          + [shlex.quote(t) for t in program])
+        # -S: propagate the largest remote exit code; fanout covers all nodes
+        # at once (reference PDSH_MAX_FAN_OUT)
+        return ["pdsh", "-S", "-f", "1024", "-w", ",".join(self.hosts), remote]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """reference ``SlurmRunner:328`` — srun, one task per node. The natural
+    launcher for TPU pods driven by a SLURM-managed CPU fleet; rank/size come
+    from SLURM_PROCID/SLURM_NTASKS at runtime."""
+
+    @property
+    def name(self):
+        return "slurm"
+
+    def backend_exists(self):
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, program):
+        env = self.base_env()
+        cmd = ["srun", "-n", str(len(self.pool)), "--ntasks-per-node=1"]
+        if self.hosts and self.hosts != ["localhost"]:
+            cmd += ["--nodelist", ",".join(self.hosts)]
+        # ALL keeps the submitting shell's env; explicit pairs pin the contract
+        pairs = ",".join(f"{k}={v}" for k, v in env.items())
+        cmd += [f"--export=ALL,{pairs}"]
+        return cmd + list(program)
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """reference ``OpenMPIRunner:118`` — mpirun with per-env -x flags."""
+
+    @property
+    def name(self):
+        return "openmpi"
+
+    def backend_exists(self):
+        return shutil.which("ompi_info") is not None
+
+    def get_cmd(self, program):
+        env = self.base_env()
+        cmd = ["mpirun", "-n", str(len(self.pool)),
+               "--host", ",".join(f"{h}:1" for h in self.hosts),
+               "--mca", "btl", "^openib"]  # TCP control plane; data rides ICI
+        # NIC selection is site-specific (GCP TPU-VMs use ens*, not eth0):
+        # only pin the interface when the operator names one
+        iface = os.environ.get("DS_MPI_TCP_IF_INCLUDE")
+        if iface:
+            cmd += ["--mca", "btl_tcp_if_include", iface]
+        for k, v in env.items():
+            cmd += ["-x", f"{k}={v}"]
+        return cmd + list(program)
+
+
+class MPICHRunner(MultiNodeRunner):
+    """reference ``MPICHRunner:182`` — hydra mpirun (-hosts/-genv)."""
+
+    @property
+    def name(self):
+        return "mpich"
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, program):
+        env = self.base_env()
+        cmd = ["mpirun", "-n", str(len(self.pool)),
+               "-hosts", ",".join(self.hosts), "-ppn", "1"]
+        for k, v in env.items():
+            cmd += ["-genv", k, str(v)]
+        return cmd + list(program)
+
+
+class IMPIRunner(MPICHRunner):
+    """reference ``IMPIRunner:244`` — Intel MPI; hydra-compatible flags."""
+
+    @property
+    def name(self):
+        return "impi"
+
+
+RUNNERS = {
+    "pdsh": PDSHRunner,
+    "slurm": SlurmRunner,
+    "openmpi": OpenMPIRunner,
+    "mpich": MPICHRunner,
+    "impi": IMPIRunner,
+}
+
+
+def build_runner(launcher, pool, master_addr, master_port):
+    cls = RUNNERS.get(launcher)
+    if cls is None:
+        raise ValueError(f"unknown launcher {launcher!r}; have {sorted(RUNNERS)}")
+    return cls(pool, master_addr, master_port)

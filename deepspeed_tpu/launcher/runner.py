@@ -172,9 +172,13 @@ def main(args=None):
     parser.add_argument("--num_nodes", type=int, default=-1)
     parser.add_argument("--master_addr", default=None)
     parser.add_argument("--master_port", type=int, default=29500)
-    parser.add_argument("--launcher", default="ssh", choices=["ssh", "local"],
+    parser.add_argument("--launcher", default="ssh",
+                        choices=["ssh", "local", "pdsh", "slurm", "openmpi",
+                                 "mpich", "impi"],
                         help="'ssh' launches remote hosts over ssh; 'local' "
-                             "spawns every node locally (debug/dry-run)")
+                             "spawns every node locally (debug/dry-run); "
+                             "pdsh/slurm/openmpi/mpich/impi delegate to that "
+                             "scheduler (launcher/multinode_runner.py)")
     parser.add_argument("--force_multi", action="store_true",
                         help="use the ssh path even for localhost entries")
     parser.add_argument("user_script")
@@ -209,6 +213,30 @@ def main(args=None):
     world_info = encode_world_info(pool)
     logger.info(f"launching on {len(hosts)} host(s): {hosts} "
                 f"(coordinator {master}:{args.master_port})")
+
+    if args.launcher in ("pdsh", "slurm", "openmpi", "mpich", "impi"):
+        # one scheduler invocation starts every node; ranks resolve at
+        # runtime (scheduler env / DS_WORLD_INFO hostname lookup)
+        from deepspeed_tpu.launcher.multinode_runner import build_runner
+        runner = build_runner(args.launcher, pool, master, args.master_port)
+        if not runner.backend_exists():
+            raise RuntimeError(
+                f"--launcher {args.launcher}: backend binary not found on "
+                f"PATH (reference multinode_runner backend_exists check)")
+        cmd = runner.get_cmd(program)
+        logger.info(f"{runner.name} cmd: {cmd}")
+        proc = subprocess.Popen(cmd)
+
+        def forward(signum, frame):
+            try:
+                proc.send_signal(signum)
+            except ProcessLookupError:
+                pass
+
+        signal.signal(signal.SIGINT, forward)
+        signal.signal(signal.SIGTERM, forward)
+        proc.wait()
+        return proc.returncode
 
     procs = []
     for rank, host in enumerate(hosts):

@@ -256,6 +256,69 @@ class LlamaForCausalLM(nn.Module):
         from deepspeed_tpu.models.losses import lm_head_next_token_loss
         return lm_head_next_token_loss(x, lm_head, labels)
 
+    # --- ZeRO-Infinity streaming protocol (runtime/zero/param_offload.py) ---
+    # The engine's offload_param mode drives the layer stack through these
+    # instead of __call__: block weights are fetched from the host/NVMe tier
+    # inside the scan body, so HBM never holds the stacked parameters.
+    @nn.nowrap
+    def streaming_plan(self):
+        if not self.config.scan_layers:
+            return None
+        return {"num_blocks": self.config.num_hidden_layers}
+
+    @nn.nowrap
+    def streaming_split(self, params):
+        """(resident, stacked): resident leaves stay device-side (the
+        ``stage3_param_persistence_threshold`` analog), stacked leaves carry
+        the leading scan dim and live in the host tier."""
+        resident = {k: v for k, v in params.items() if k != "layers"}
+        return resident, params["layers"]["block"]
+
+    @nn.nowrap
+    def streaming_merge(self, resident, stacked):
+        out = dict(resident)
+        out["layers"] = {"block": stacked}
+        return out
+
+    @nn.nowrap
+    def streaming_apply(self, resident, fetch, batch, deterministic=True,
+                        rng=None):
+        """Forward pass with per-block parameter streaming. ``fetch(i)``
+        returns block ``i``'s parameter tree (engine-provided, differentiable;
+        its backward routes the block's grads to the host tier). ``rng`` (a
+        PRNGKey) is folded per block for stochastic layers. Numerics are
+        identical to ``__call__`` — same modules, same order."""
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        x = resident["embed_tokens"].astype(cfg.dtype)[input_ids]
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        block = LlamaBlock(cfg)
+
+        def body(carry, i):
+            bp = fetch(i)
+            rngs = {"dropout": jax.random.fold_in(rng, i)} \
+                if (rng is not None and not deterministic) else None
+            return block.apply({"params": bp}, carry, positions,
+                               deterministic, rngs=rngs), None
+
+        # save-nothing remat regardless of the configured policy: a policy
+        # that saved the fetched weights would pin all L blocks in HBM and
+        # defeat the tier. Backward re-streams each block (the reference
+        # re-gathers partitions for backward the same way).
+        body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, jnp.arange(cfg.num_hidden_layers))
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype).apply(
+            {"params": resident["norm"]}, x)
+        lm_head = resident["lm_head"]
+        if labels is None:
+            return x @ lm_head.astype(cfg.dtype).T
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, lm_head, labels)
+
     def param_specs(self, params):
         """Megatron-style TP specs: q/k/v/gate/up column-split, o/down row-split,
         embeddings vocab-split."""

@@ -118,6 +118,12 @@ class MeshTopology:
         from jax.sharding import NamedSharding
         return NamedSharding(self.mesh, self.batch_spec)
 
+    def stacked_batch_sharding(self):
+        """Sharding for a [gas, batch, seq, ...] micro-batch stack (the fused
+        whole-window step): window axis replicated, batch over the data axes."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(None, *self.batch_spec))
+
     def replicated(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.mesh, P())

@@ -170,6 +170,7 @@ KNOWN_TOP_LEVEL_KEYS = {
     C.ELASTICITY, C.AUTOTUNING, C.CHECKPOINT, C.COMPILE,
     "moe", "seed", "hybrid_engine", "curriculum_learning", "data_efficiency",
     "compression_training", "eigenvalue", "progressive_layer_drop",
+    "correctness_guards",
 }
 
 # Reference keys that are accepted but have no TPU effect (the GPU-side
@@ -291,6 +292,14 @@ class DeepSpeedConfig:
         self.compile_config = CompileConfig(pd.get(C.COMPILE, {}))
         self.autotuning_config = AutotuningConfig(pd.get(C.AUTOTUNING, {}))
         self.seed = get_scalar_param(pd, "seed", 42)
+        # trace-level correctness guards (runtime/guards.py — the jit-world
+        # analog of the reference's safe-mode re-verification, stage3.py:1249)
+        cg = dict(pd.get("correctness_guards", {}))
+        self.correctness_guards = {
+            "enabled": bool(cg.get("enabled", False)),
+            "check_every": int(cg.get("check_every", 1)),
+            "checkify_on_overflow": bool(cg.get("checkify_on_overflow", True)),
+        }
         # data efficiency (reference runtime/data_pipeline/config.py):
         # legacy "curriculum_learning" section + "data_efficiency" umbrella
         # RLHF hybrid engine (reference runtime/hybrid_engine.py config section)

@@ -98,6 +98,9 @@ class OptimizerShim:
         if self._engine._offload is not None:
             # ZeRO-Offload: most (ratio=1.0: all) moments live in the host tier
             sd["offload"] = self._engine._offload.state_dict()
+        if self._engine._param_store is not None:
+            # ZeRO-Infinity param tier: streamed masters + moments are host-side
+            sd["param_offload"] = self._engine._param_store.state_dict()
         return sd
 
     def load_state_dict(self, sd):
@@ -125,6 +128,8 @@ class OptimizerShim:
         if "offload" in sd and self._engine._offload is not None:
             self._engine._offload.load_state_dict(sd["offload"])
             self._engine._refresh_working_from_master()
+        if "param_offload" in sd and self._engine._param_store is not None:
+            self._engine._param_store.load_state_dict(sd["param_offload"])
 
     def zero_grad(self, set_to_none=True):
         pass  # grads live in the engine's accumulation buffer
@@ -295,15 +300,24 @@ class DeepSpeedEngine:
         self._micro_step_fn = None
         self._apply_step_fn = None
         self._fused_step_fn = None
+        self._fused_gas_step_fn = None
         self._pending_fused_stats = None
         self._eval_step_fn = None
         self._offload = None  # ZeRO-Offload host tier (zero/offload.py)
+        self._param_store = None  # ZeRO-Infinity param tier (zero/param_offload.py)
         self.quantized_weights = False  # ZeRO++ qwZ (set in _init_state)
         self._qgz_plan = None  # ZeRO++ qgZ (set in _init_state, zero/qgz.py)
         self._pending_opt_state = None  # OptimizerShim.load_state_dict pre-init
         self._async_ckpt_engine = None  # lazy (save_checkpoint(async_save=True))
         self.flops_profiler = None  # lazy (profiling/flops_profiler)
         self._param_transform = None  # compression hook (compression/compress.py)
+        # trace-level correctness guards (runtime/guards.py)
+        self._guards = None
+        self._last_guard_batch = None
+        if self.config.correctness_guards["enabled"]:
+            from deepspeed_tpu.runtime.guards import TraceStabilityGuard
+            self._guards = dict(self.config.correctness_guards,
+                                snapshot=None, trace=TraceStabilityGuard())
         # legacy seqlen curriculum (reference engine.py:1826 curriculum hook)
         self.curriculum_scheduler = None
         if self.config.curriculum_enabled_legacy:
@@ -380,6 +394,11 @@ class DeepSpeedEngine:
         self.partitioner = ZeroPartitioner(self.topology, self.config.zero_config,
                                            param_specs=self._resolve_param_specs(params_f32))
         self.partitioner.describe(params_f32)
+        if self.config.zero_config.offload_param_device in ("cpu", "nvme"):
+            # ZeRO-Infinity parameter tier: working params stream from
+            # host/NVMe per scan block (zero/param_offload.py); subsumes the
+            # optimizer-offload path for the streamed leaves
+            return self._init_state_param_offload(params_f32)
         if self._offload_device() in ("cpu", "nvme"):
             if self.config.zero_config.zero_quantized_weights:
                 raise ValueError("zero_quantized_weights cannot be combined with "
@@ -550,6 +569,97 @@ class DeepSpeedEngine:
         n = count_parameters(params_f32)
         log_dist(f"model parameters: {n/1e6:.2f}M (offload={off_cfg.device}, "
                  f"ratio={ratio})", ranks=[0])
+        if self._pending_opt_state is not None:
+            sd, self._pending_opt_state = self._pending_opt_state, None
+            self.optimizer.load_state_dict(sd)
+
+    def _init_state_param_offload(self, params_f32):
+        """ZeRO-Infinity parameter tier (zero/param_offload.py): the scan-
+        stacked block parameters live on host DRAM or NVMe and stream through
+        the compiled step per block; their fp32 masters + moments are host-side
+        (CPU Adam). Small non-stacked leaves (embeddings, head, final norm)
+        stay device-resident with the normal optax path — the
+        ``stage3_param_persistence_threshold`` analog. Mirrors the reference's
+        ``AsyncPartitionedParameterSwapper``/``DeepSpeedZeRoOffload`` stack
+        (``swap_tensor/partitioned_param_swapper.py:36``,
+        ``zero/parameter_offload.py:83``)."""
+        from deepspeed_tpu.runtime.zero.param_offload import (BlockParamStore,
+                                                              make_streaming_fetch)
+        zc = self.config.zero_config
+        if self.zero_optimization_stage() < 3:
+            raise ValueError("offload_param requires ZeRO stage 3 (reference "
+                             "zero/config.py: param offload is a stage-3 feature)")
+        if zc.zero_quantized_weights or zc.zero_quantized_gradients:
+            # neither tier exists in this mode: working params live host-side
+            # (not as int8 device shards) and grads leave via host callbacks
+            raise ValueError("zero_quantized_weights/zero_quantized_gradients "
+                             "cannot be combined with offload_param")
+        mod = self.module
+        if not (hasattr(mod, "streaming_plan") and mod.streaming_plan()):
+            raise ValueError(
+                "offload_param needs a model exposing the streaming protocol "
+                "(streaming_plan/streaming_split/streaming_apply, with "
+                f"scan_layers=True); {type(mod).__name__} does not")
+        opt_cfg = self.config.optimizer
+        opt_name = (opt_cfg.type or "adamw").lower()
+        if opt_name not in ("adam", "adamw", "adagrad", "lion"):
+            raise ValueError(f"offload_param supports adam/adamw/adagrad/lion "
+                             f"host steps, got {opt_name!r}")
+
+        resident_f32, stacked_f32 = mod.streaming_split(params_f32)
+        stacked_np = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x), np.float32), stacked_f32)
+        self._param_store = BlockParamStore(
+            stacked_np, zc.offload_param, zc.offload_optimizer,
+            dict(opt_cfg.params or {}), self.working_dtype, opt_name=opt_name)
+        self._streaming_fetch = make_streaming_fetch(self._param_store)
+
+        # resident leaves: the standard device path, partitioned over the same
+        # topology (a dedicated partitioner — specs pattern-match names, so
+        # they apply unchanged to the resident subset)
+        res_specs = None
+        if hasattr(mod, "param_specs"):
+            try:
+                res_specs = mod.param_specs(resident_f32)
+            except Exception:
+                res_specs = None
+        self._res_partitioner = ZeroPartitioner(self.topology, zc,
+                                                param_specs=res_specs)
+        working = tree_cast(resident_f32, self.working_dtype)
+        param_sh = self._res_partitioner.param_sharding(working)
+        master_sh = self._res_partitioner.master_sharding(resident_f32)
+        grad_sh = self._res_partitioner.grad_sharding(resident_f32)
+        working = jax.tree.map(jax.device_put, working, param_sh)
+        if self.mixed_precision:
+            master = jax.tree.map(jax.device_put, resident_f32, master_sh)
+        else:
+            master = None
+            working = jax.tree.map(jax.device_put, resident_f32, master_sh)
+        opt_target = master if master is not None else working
+        opt_state = self._tx.init(opt_target)
+        opt_sh = self._res_partitioner.opt_state_sharding(opt_state, resident_f32)
+        opt_state = jax.tree.map(jax.device_put, opt_state, opt_sh)
+        grad_acc = tree_zeros_like(resident_f32, self.grad_accum_dtype)
+        grad_acc = jax.tree.map(jax.device_put, grad_acc, grad_sh)
+        self._shardings = dict(params=param_sh, master=master_sh, grad=grad_sh,
+                               opt=opt_sh,
+                               use=self._res_partitioner.use_sharding(resident_f32))
+        rep = self.topology.replicated()
+        scale = init_loss_scale_state(self.config.fp16) if self.fp16_enabled \
+            else LossScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(0))
+        rng_key = jax.random.PRNGKey(self._rng_seed) if isinstance(self._rng_seed, int) \
+            else self._rng_seed
+        self.state = TrainState(
+            params=working, master=master, opt_state=opt_state, grad_acc=grad_acc,
+            scale=jax.tree.map(lambda x: jax.device_put(x, rep), scale),
+            global_step=jax.device_put(jnp.int32(0), rep),
+            skipped=jax.device_put(jnp.int32(0), rep),
+            rng=jax.device_put(rng_key, rep))
+        n = count_parameters(params_f32)
+        n_res = count_parameters(resident_f32)
+        log_dist(f"model parameters: {n/1e6:.2f}M ({(n-n_res)/1e6:.2f}M streamed "
+                 f"from {zc.offload_param_device}, {n_res/1e6:.2f}M resident)",
+                 ranks=[0])
         if self._pending_opt_state is not None:
             sd, self._pending_opt_state = self._pending_opt_state, None
             self.optimizer.load_state_dict(sd)
@@ -856,7 +966,74 @@ class DeepSpeedEngine:
     def _fused_enabled(self):
         return (self.config.fused_step
                 and self.gradient_accumulation_steps_value == 1
-                and self._qgz_plan is None and self._offload is None)
+                and self._qgz_plan is None and self._offload is None
+                and self._param_store is None)
+
+    def _fused_gas_enabled(self):
+        """Fused whole-window step: available through ``train_batch`` only —
+        the imperative forward/backward/step API hands over one micro-batch at
+        a time, but ``train_batch`` owns the window and can run it as a single
+        compiled scan. The seqlen curriculum reshapes batches per step inside
+        ``forward`` — that path must keep per-micro-step dispatch."""
+        return (self.config.fused_step
+                and self.gradient_accumulation_steps_value > 1
+                and self._qgz_plan is None and self._offload is None
+                and self._param_store is None
+                and self.curriculum_scheduler is None)
+
+    def _build_fused_gas_step(self):
+        """One jit for the WHOLE gradient-accumulation window (``fused_step``
+        at GAS>1): ``lax.scan`` over the stacked micro-batches accumulates
+        grads in the scan carry — XLA aliases the carry buffers in place, so
+        accumulation stops round-tripping a separate accumulator through HBM
+        between dispatches, and the optimizer apply fuses with the last
+        backward. The reference's analog is bucketed comm/compute overlap
+        during backward (``zero/stage_1_and_2.py:922``); under XLA the
+        scheduler owns overlap once everything is one program."""
+        make_loss_fn, dq, grad_use_sh = self._loss_closures()
+        core = self._apply_core_builder()
+        gas = self.gradient_accumulation_steps_value
+        accum_dtype = self.grad_accum_dtype
+
+        def fused_gas_step(state: TrainState, batches, lr):
+            rng, sub = jax.random.split(state.rng)
+
+            def body(carry, mb):
+                acc, key = carry
+                key, k = jax.random.split(key)
+                loss_fn = make_loss_fn(mb, k, state.scale.loss_scale,
+                                       state.global_step)
+                (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    dq(state.params))
+                if grad_use_sh is not None:
+                    grads = constrain_tree(grads, grad_use_sh)
+                acc = jax.tree.map(lambda a, g: a + g.astype(accum_dtype),
+                                   acc, grads)
+                return (acc, key), loss.astype(jnp.float32)
+
+            (acc, _), losses = jax.lax.scan(body, (state.grad_acc, sub), batches)
+            denom = self._grad_denom(state, gas)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, acc)
+            new_state, stats = core(state._replace(rng=rng), grads, lr)
+            return new_state, losses, stats
+
+        return jax.jit(fused_gas_step, donate_argnums=(0,))
+
+    def _shard_stacked_batches(self, batches):
+        """Stack ``gas`` micro-batches along a new leading axis and shard:
+        axis 0 (the window) replicated, axis 1 (the batch) over dp."""
+        stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                               *batches)
+        sharding = self.topology.stacked_batch_sharding()
+
+        def put(x):
+            x = jnp.asarray(x)
+            try:
+                return jax.device_put(x, sharding)
+            except Exception:
+                return jax.device_put(x, self.topology.replicated())
+
+        return jax.tree.map(put, stacked)
 
     def _build_eval_step(self):
         model_fn = self._model_fn
@@ -885,6 +1062,7 @@ class DeepSpeedEngine:
         self._micro_step_fn = None
         self._apply_step_fn = None
         self._fused_step_fn = None
+        self._fused_gas_step_fn = None
         self._pending_fused_stats = None
         self._eval_step_fn = None
 
@@ -985,8 +1163,147 @@ class DeepSpeedEngine:
         return StepStats(grad_norm=jnp.float32(norm), overflow=jnp.asarray(overflow),
                          lr=jnp.float32(lr), loss_scale=jnp.float32(scale_before))
 
+    def _build_param_offload_fns(self):
+        """Compiled pieces of the ZeRO-Infinity param-tier step: the streaming
+        micro-step (block fetches + host grad writes ride the compiled scan),
+        device-side stats over the resident accumulator, the resident apply,
+        and a streaming eval step."""
+        fp16 = self.fp16_enabled
+        mult = float(getattr(self, "_grad_scale_multiplier", 1.0))
+        model = self.module
+        fetch = self._streaming_fetch
+        accum_dtype = self.grad_accum_dtype
+        grad_sh = self._shardings["grad"]
+        param_sh = self._shardings["params"]
+        master_sh = self._shardings["master"]
+        use_sh = self._shardings.get("use")
+        tx = self._tx
+        mixed = self.mixed_precision
+        working_dtype = self.working_dtype
+        fp16_cfg = self.config.fp16
+        dynamic = self.dynamic_loss_scale
+        ptx = self._param_transform
+
+        def micro_step(state: TrainState, batch):
+            rng, sub = jax.random.split(state.rng)
+
+            def loss_fn(args):
+                p, tok = args
+                if use_sh is not None:
+                    p = constrain_tree(p, use_sh)
+                if ptx is not None:
+                    p = ptx(p, state.global_step)
+                loss = model.streaming_apply(p, lambda i: fetch(i, tok), batch,
+                                             deterministic=False, rng=sub)
+                if isinstance(loss, tuple):
+                    loss = loss[0]
+                scaled = loss.astype(jnp.float32)
+                if mult != 1.0:
+                    scaled = scaled * mult
+                if fp16:
+                    scaled = scaled * state.scale.loss_scale
+                return scaled, loss
+
+            (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                (state.params, jnp.zeros((), jnp.float32)))
+            gp, _ = grads  # the token cotangent is a dummy
+            acc = jax.tree.map(lambda a, g: a + g.astype(accum_dtype),
+                               state.grad_acc, gp)
+            acc = constrain_tree(acc, grad_sh)
+            return state._replace(grad_acc=acc, rng=rng), loss
+
+        def grad_stats(grad_acc):
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grad_acc)
+            overflow = has_overflow(g32) if fp16 else jnp.asarray(False)
+            return overflow, global_norm(g32) ** 2
+
+        def device_apply(state: TrainState, lr, inv_scale, overflow):
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv_scale,
+                                 state.grad_acc)
+            target = state.master if mixed else state.params
+            opt_state = set_lr(state.opt_state, lr)
+            updates, new_opt = tx.update(grads, opt_state, target)
+            new_target = optax.apply_updates(target, updates)
+            new_target = tree_where(overflow, target, new_target)
+            new_opt = tree_where(overflow, opt_state, new_opt)
+            new_target = constrain_tree(new_target, master_sh)
+            if mixed:
+                new_params = constrain_tree(tree_cast(new_target, working_dtype),
+                                            param_sh)
+                new_master = new_target
+            else:
+                new_params, new_master = new_target, None
+            new_acc = jax.tree.map(jnp.zeros_like, state.grad_acc)
+            new_scale = update_loss_scale(state.scale, overflow, fp16_cfg, dynamic)
+            return TrainState(params=new_params, master=new_master,
+                              opt_state=new_opt, grad_acc=new_acc,
+                              scale=new_scale,
+                              global_step=state.global_step + 1,
+                              skipped=state.skipped + overflow.astype(jnp.int32),
+                              rng=state.rng)
+
+        def eval_step(state: TrainState, batch):
+            p = state.params
+            if use_sh is not None:
+                p = constrain_tree(p, use_sh)
+            if ptx is not None:
+                p = ptx(p, state.global_step)
+            return model.streaming_apply(
+                p, lambda i: fetch(i, jnp.zeros((), jnp.float32)), batch)
+
+        self._micro_step_fn = jax.jit(micro_step, donate_argnums=(0,))
+        self._po_stats_fn = jax.jit(grad_stats)
+        self._po_apply_fn = jax.jit(device_apply, donate_argnums=(0,))
+        self._eval_step_fn = jax.jit(eval_step)
+
+    def _param_offload_step(self, lr):
+        """Apply-step with the ZeRO-Infinity param tier: device applies the
+        resident leaves; the host tier (CPU Adam over fp32 masters) consumes
+        the grad accumulators the backward callbacks filled, then publishes
+        the new working bytes for the next step's fetches. Global grad norm
+        and fp16 overflow merge both tiers."""
+        gas = self.gradient_accumulation_steps_value
+        # join every micro-step's backward grad-write callbacks before
+        # reading the host accumulators
+        jax.effects_barrier()
+        overflow_a, sq_a = self._po_stats_fn(self.state.grad_acc)
+        overflow = bool(jax.device_get(overflow_a))
+        dev_sq = float(jax.device_get(sq_a))
+        host_sq, host_finite = self._param_store.grad_sq_and_finite()
+        if self.fp16_enabled and not host_finite:
+            overflow = True
+        scale_before = self.cur_scale
+        denom = float(gas)
+        if self.fp16_enabled:
+            denom *= scale_before
+        if self.config.prescale_gradients and self.config.gradient_predivide_factor != 1.0:
+            denom /= float(self.config.gradient_predivide_factor)
+        norm = (dev_sq + host_sq) ** 0.5 / denom
+        clip = self.config.gradient_clipping
+        clip_coef = 1.0
+        if clip and clip > 0 and norm > clip:
+            clip_coef = clip / (norm + 1e-6)
+        inv_scale = clip_coef / denom
+        # dispatch the resident device update first (async), then run the
+        # host-tier optimizer while the device works
+        new_state = self._po_apply_fn(self.state, jnp.float32(lr),
+                                      jnp.float32(inv_scale),
+                                      jnp.asarray(overflow))
+        if overflow:
+            self._param_store.zero_grads()
+        else:
+            self._param_store.step(lr, inv_scale)
+        self.state = new_state
+        return StepStats(grad_norm=jnp.float32(norm), overflow=jnp.asarray(overflow),
+                         lr=jnp.float32(lr), loss_scale=jnp.float32(scale_before))
+
     def _compiled(self):
         if self._micro_step_fn is None:
+            if self._param_store is not None:
+                self._build_param_offload_fns()
+                self._fused_step_fn = None
+                self._apply_step_fn = None
+                return
             if self._fused_enabled():
                 self._fused_step_fn = self._build_fused_step()
                 self._micro_step_fn = self._build_micro_step()  # eval/GAS path
@@ -999,11 +1316,15 @@ class DeepSpeedEngine:
                     self._apply_step_fn = None
                 else:
                     self._apply_step_fn = self._build_apply_step()
+            if self._fused_gas_enabled():
+                self._fused_gas_step_fn = self._build_fused_gas_step()
             self._eval_step_fn = self._build_eval_step()
         elif self._apply_step_fn is None and self._offload is None:
             # invalidated (e.g. set_train_batch_size changed the baked-in
             # GAS denominator) — rebuild just the apply step
             self._apply_step_fn = self._build_apply_step()
+            if self._fused_gas_enabled():
+                self._fused_gas_step_fn = self._build_fused_gas_step()
             if self._fused_enabled():
                 self._fused_step_fn = self._build_fused_step()
             else:
@@ -1062,6 +1383,8 @@ class DeepSpeedEngine:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         self.tput_timer.start()
         batch = self._shard_batch(batch)
+        if self._guards is not None and self._guards["checkify_on_overflow"]:
+            self._last_guard_batch = batch  # for overflow localization
         if getattr(self, "_fused_step_fn", None) is not None:
             # fused_step config: grads + optimizer apply in ONE jit (GAS=1).
             # The update is applied HERE; step() consumes the staged stats.
@@ -1146,15 +1469,21 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
+            old_state = self.state if self._guards is not None else None
             staged = getattr(self, "_pending_fused_stats", None)
             if staged is not None:
                 stats = staged  # fused step already applied in forward()
                 self._pending_fused_stats = None
+                old_state = None  # forward() already replaced the state
+            elif self._param_store is not None:
+                stats = self._param_offload_step(self._schedule_fn(self.global_steps))
             elif self._offload is not None:
                 stats = self._offload_step(self._schedule_fn(self.global_steps))
             else:
                 lr = self._schedule_fn(self.global_steps)
                 self.state, stats = self._apply_step_fn(self.state, lr)
+            if self._guards is not None:
+                self._run_guards(old_state, stats)
             self._last_stats = stats
             self._step_applied = True
             self.global_steps += 1
@@ -1184,16 +1513,91 @@ class DeepSpeedEngine:
             log_dist(f"step={self.global_steps}, skipped={self.skipped_steps}, "
                      f"lr={self.get_lr()}, loss_scale={self.cur_scale}", ranks=[0])
 
+    def _run_guards(self, old_state, stats):
+        """Boundary-time correctness guards (runtime/guards.py): donation
+        audit, sharding-drift check, retrace detection, and — on overflow —
+        checkify-based NaN source localization (the reference's safe-mode
+        re-verification, ``stage3.py:1249``)."""
+        from deepspeed_tpu.runtime import guards as G
+        g = self._guards
+        # donation audit: only where XLA actually supports buffer aliasing
+        # (CPU backends never donate — every leaf would "fail" the audit)
+        if old_state is not None and jax.default_backend() != "cpu":
+            G.check_donation(old_state, self.state)
+        fns = dict(micro=self._micro_step_fn, apply=self._apply_step_fn,
+                   fused=self._fused_step_fn, fused_gas=self._fused_gas_step_fn)
+        g["boundaries"] = g.get("boundaries", 0) + 1
+        if g["snapshot"] is None:
+            g["snapshot"] = G.ShardingSnapshot(self.state)
+        elif g["boundaries"] == 2:
+            # trace baseline at the SECOND boundary: the first step's outputs
+            # feed the second step with settled (non-weak) types, so the one
+            # benign warmup retrace never counts as a storm
+            g["trace"].record(**fns)
+        elif self.global_steps % max(1, g["check_every"]) == 0:
+            g["snapshot"].verify(self.state)
+            g["trace"].verify(**fns)
+        if (g["checkify_on_overflow"] and bool(jax.device_get(stats.overflow))
+                and self._last_guard_batch is not None
+                and self._param_store is None
+                and not getattr(self, "quantized_weights", False)):
+            report = G.locate_nonfinite(self._model_fn, self.state.params,
+                                        self._last_guard_batch,
+                                        rng=self.state.rng)
+            if report:
+                logger.warning(f"overflow localized (checkify float_checks): "
+                               f"{report[:800]}")
+            self._last_overflow_report = report
+
     def train_batch(self, data_iter=None):
-        """Full GAS cycle — PipelineEngine-parity API (pipe/engine.py:327)."""
+        """Full GAS cycle — PipelineEngine-parity API (pipe/engine.py:327).
+
+        With ``fused_step`` at GAS>1 the whole window runs as ONE compiled
+        scan over the stacked micro-batches (``_build_fused_gas_step``)."""
         if data_iter is None:
             assert self.training_dataloader is not None
             if self._data_iterator is None:
                 from deepspeed_tpu.runtime.dataloader import RepeatingLoader
                 self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
             data_iter = self._data_iterator
+        gas = self.gradient_accumulation_steps_value
+        if self._fused_gas_enabled():
+            rel = self.micro_steps - getattr(self, "_gas_offset", 0)
+            if rel % gas != 0:
+                raise RuntimeError(
+                    "fused train_batch mid-accumulation-window: finish the "
+                    "window with forward/backward/step first")
+            batches = [next(data_iter) for _ in range(gas)]
+            self._ensure_initialized(batches[0])
+            self._compiled()
+            self.tput_timer.start()
+            stacked = self._shard_stacked_batches(batches)
+            lr = self._schedule_fn(self.global_steps)
+            old_state = self.state if self._guards is not None else None
+            self.state, losses, stats = self._fused_gas_step_fn(
+                self.state, stacked, lr)
+            self._last_stats = stats
+            self._step_applied = True
+            if self._guards is not None:
+                self._run_guards(old_state, stats)
+            self.micro_steps += gas
+            self.global_steps += 1
+            self.global_samples += self.micro_batch_size * \
+                self.topology.data_parallel_size * gas
+            self.lr_scheduler.step()
+            mean = losses.mean()
+            if self.monitor.enabled and \
+                    self.global_steps % self.config.steps_per_print == 0:
+                self.monitor.write_events([
+                    ("Train/Samples/train_loss", float(jax.device_get(mean)),
+                     self.global_samples),
+                    ("Train/Samples/lr", float(stats.lr), self.global_samples),
+                    ("Train/Samples/loss_scale", float(stats.loss_scale),
+                     self.global_samples)])
+            self.tput_timer.stop(global_step=True)
+            return float(jax.device_get(mean))
         losses = []
-        for _ in range(self.gradient_accumulation_steps_value):
+        for _ in range(gas):
             batch = next(data_iter)
             loss = self.forward(batch)
             self.backward(loss)
@@ -1275,6 +1679,7 @@ class DeepSpeedEngine:
         # it means that window's step is skipped, never double-applied.
         self._apply_step_fn = None
         self._fused_step_fn = None
+        self._fused_gas_step_fn = None  # bakes gas as denominator AND scan length
         self._pending_fused_stats = None
 
     @property
@@ -1305,6 +1710,16 @@ class DeepSpeedEngine:
         """Gathered full-precision parameters (analog of
         ``zero_gather_16bit_weights_on_model_save`` / zero_to_fp32)."""
         rep = self.topology.replicated()
+        if self._param_store is not None:
+            # ZeRO-Infinity param tier: streamed blocks from host masters,
+            # resident leaves from device
+            src = self.state.master if self.state.master is not None \
+                else self.state.params
+            resident = jax.tree.map(
+                lambda x: np.asarray(jax.device_get(jax.device_put(x, rep)),
+                                     dtype=dtype), src)
+            stacked = self._param_store.stacked_params(dtype=dtype)
+            return self.module.streaming_merge(resident, stacked)
         if self._offload is not None:
             # merge device-resident masters with the host tier
             pdef = jax.tree_util.tree_structure(self.state.params)
@@ -1324,7 +1739,14 @@ class DeepSpeedEngine:
         """Recompute the working-precision params from the fp32 masters (all
         tiers) — used after external master edits (tensor-fragment sets,
         universal checkpoint load)."""
-        if self._offload is not None:
+        if self._param_store is not None:
+            if self.state.master is not None:
+                working = tree_cast(self.state.master, self.working_dtype)
+                working = jax.tree.map(jax.device_put, working,
+                                       self._shardings["params"])
+                self.state = self.state._replace(params=working)
+            self._param_store._publish_from_masters()
+        elif self._offload is not None:
             flat_p, pdef = jax.tree_util.tree_flatten(self.state.params)
             for i, k in enumerate(self._flat_keys):
                 if k in self.state.master:
@@ -1391,11 +1813,18 @@ class DeepSpeedEngine:
             if self._offload is not None:
                 offload_blobs = {k: np.array(v, copy=True)
                                  for k, v in self._offload.state_dict().items()}
+            param_tier_blobs = None
+            if self._param_store is not None:
+                param_tier_blobs = {k: np.array(v, copy=True)
+                                    for k, v in self._param_store.state_dict().items()}
 
             def in_dir(p):
                 if offload_blobs is not None:
                     np.savez(os.path.join(p, "host_optimizer_states.npz"),
                              **offload_blobs)
+                if param_tier_blobs is not None:
+                    np.savez(os.path.join(p, "host_param_tier.npz"),
+                             **param_tier_blobs)
 
             def after_publish():
                 if save_latest:
@@ -1409,6 +1838,9 @@ class DeepSpeedEngine:
         engine.save(self.state, path, meta=meta)
         if self._offload is not None:
             self._offload.save(os.path.join(path, "host_optimizer_states.npz"))
+        if self._param_store is not None:
+            np.savez(os.path.join(path, "host_param_tier.npz"),
+                     **self._param_store.state_dict())
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
@@ -1451,6 +1883,11 @@ class DeepSpeedEngine:
         if self._offload is not None and load_optimizer_states and \
                 os.path.exists(host_states):
             self._offload.load(host_states)
+        host_params = os.path.join(path, "host_param_tier.npz")
+        if self._param_store is not None and os.path.exists(host_params):
+            data = np.load(host_params)
+            self._param_store.load_state_dict(
+                {name: data[name] for name in data.files})
         c = meta.get("counters", {"global_steps": 0, "global_samples": 0,
                                   "micro_steps": 0, "skipped_steps": 0})
         self.global_steps = int(c["global_steps"])

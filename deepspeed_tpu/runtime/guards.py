@@ -1,0 +1,189 @@
+"""Trace-level correctness guards — the jit-world analog of the reference's
+safe-mode re-verification.
+
+Reference capabilities being replaced (not translated):
+- non-static trace detection + invalidation
+  (``runtime/zero/partitioned_param_coordinator.py:149-160``): the reference
+  records the module fetch order and falls back to a safe path when a later
+  iteration diverges. Under jit the equivalent failure is a **recompilation
+  storm** — a shape/dtype drifting between steps silently retraces the step
+  program every iteration.
+- grad-reduction re-verification in safe mode (``stage3.py:1249``).
+
+The jit world adds its own failure classes, each with a guard here:
+
+- **Donation safety** (``check_donation``): every step donates the old state
+  buffers. Two silent bug classes: (a) a donated buffer XLA could NOT alias
+  (layout/sharding mismatch) degrades to a copy — a 2x-memory perf bug the
+  runtime only surfaces as a warning; (b) external code holding a reference to
+  a pre-step state leaf reads deleted memory (JAX raises at use, far from the
+  cause). The guard reports both right at the step.
+- **Sharding drift** (``ShardingSnapshot``): the state's shardings are an
+  invariant of the training run. A checkpoint load, tensor-fragment edit, or
+  engine-surgery bug that flips a leaf to replicated multiplies memory and
+  comm without changing numerics — nothing else would ever notice.
+- **Recompilation storm** (``TraceStabilityGuard``): the step functions must
+  compile once per config. Cache growth across steps means the input pipeline
+  leaks distinct shapes (the curriculum bucketing bug class).
+- **NaN source localization** (``locate_nonfinite``): when the loss-scaler
+  reports overflow, re-run the window under ``jax.experimental.checkify``
+  float checks — the error names the exact primitive and source line that
+  produced the first non-finite value, instead of "overflow somewhere".
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _leaves_with_paths(tree):
+    return jax.tree_util.tree_flatten_with_path(tree)[0]
+
+
+def check_donation(old_state, new_state, where="step"):
+    """Post-step donation audit. Returns (undonated, dead_new) path lists.
+
+    ``undonated``: old-state leaves still alive after a donating call — XLA
+    fell back to a copy (per-leaf 2x memory; the silent perf bug class).
+    ``dead_new``: new-state leaves already deleted — an aliasing bug that will
+    crash at first use, reported here at its cause instead.
+    """
+    undonated, dead_new = [], []
+    for path, leaf in _leaves_with_paths(old_state):
+        if hasattr(leaf, "is_deleted") and not leaf.is_deleted():
+            undonated.append(jax.tree_util.keystr(path))
+    for path, leaf in _leaves_with_paths(new_state):
+        if hasattr(leaf, "is_deleted") and leaf.is_deleted():
+            dead_new.append(jax.tree_util.keystr(path))
+    if undonated:
+        logger.warning(
+            f"donation guard ({where}): {len(undonated)} state buffer(s) were "
+            f"NOT donated (XLA copied instead of aliasing): "
+            f"{undonated[:5]}{'...' if len(undonated) > 5 else ''}")
+    if dead_new:
+        raise RuntimeError(
+            f"donation guard ({where}): new state contains deleted buffers "
+            f"{dead_new[:5]} — an aliasing bug (the donated input leaked into "
+            f"the output tree)")
+    return undonated, dead_new
+
+
+class ShardingSnapshot:
+    """Captures the state tree's (path → sharding spec, shape, dtype) and
+    verifies later states against it (drift detection between steps)."""
+
+    def __init__(self, state):
+        self._spec = self._fingerprint(state)
+
+    @staticmethod
+    def _fingerprint(state):
+        out = {}
+        for path, leaf in _leaves_with_paths(state):
+            if not hasattr(leaf, "sharding"):
+                continue
+            sh = leaf.sharding
+            spec = str(getattr(sh, "spec", sh))
+            out[jax.tree_util.keystr(path)] = (spec, tuple(leaf.shape),
+                                               str(leaf.dtype))
+        return out
+
+    def verify(self, state, raise_on_drift=False):
+        """Compare ``state`` to the snapshot; returns a {path: (was, now)}
+        drift report (empty = clean)."""
+        now = self._fingerprint(state)
+        drift = {}
+        for k, v in self._spec.items():
+            if k in now and now[k] != v:
+                drift[k] = (v, now[k])
+        msg = None
+        if drift:
+            msg = (f"sharding drift on {len(drift)} leaves: " +
+                   "; ".join(f"{k}: {was} -> {cur}"
+                             for k, (was, cur) in list(drift.items())[:3]))
+        if msg and raise_on_drift:
+            raise RuntimeError(f"sharding guard: {msg}")
+        if msg:
+            logger.warning(f"sharding guard: {msg}")
+        return drift
+
+
+class TraceStabilityGuard:
+    """Detects recompilation storms: after warmup, the engine's jitted step
+    functions must stop accumulating new traces (the reference's non-static
+    trace-order check, ``partitioned_param_coordinator.py:149``)."""
+
+    def __init__(self):
+        self._baseline = {}
+
+    @staticmethod
+    def _cache_size(fn):
+        try:
+            return fn._cache_size()
+        except Exception:
+            return None
+
+    def record(self, **fns):
+        """Snapshot cache sizes after warmup (first boundary)."""
+        for name, fn in fns.items():
+            if fn is None:
+                continue
+            n = self._cache_size(fn)
+            if n is not None:
+                self._baseline[name] = n
+
+    def verify(self, **fns):
+        """Returns {name: (baseline, now)} for fns that retraced since
+        ``record`` — each retrace means a new input shape/dtype/sharding
+        reached the step (input-pipeline leak; every retrace is a multi-
+        second XLA compile on TPU)."""
+        grew = {}
+        for name, fn in fns.items():
+            if fn is None or name not in self._baseline:
+                continue
+            n = self._cache_size(fn)
+            if n is not None and n > self._baseline[name]:
+                grew[name] = (self._baseline[name], n)
+        if grew:
+            logger.warning(
+                f"trace guard: step functions retraced since warmup {grew} — "
+                f"the input pipeline is feeding varying shapes/dtypes "
+                f"(each retrace recompiles on TPU)")
+        return grew
+
+
+def locate_nonfinite(model_fn, params, batch, rng=None):
+    """Safe-mode NaN localization: re-run the forward under checkify float
+    checks. Returns None when clean, else a string naming the first primitive
+    + source line that produced inf/nan (the actionable version of an
+    overflow flag)."""
+    from jax.experimental import checkify
+
+    def fwd(p, b, key):
+        out = model_fn(p, b, key, True)
+        return out[0] if isinstance(out, tuple) else out
+
+    try:
+        checked = checkify.checkify(fwd, errors=checkify.float_checks)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # models with dropout need a key
+        err, _ = jax.jit(checked)(params, batch, rng)
+    except Exception as e:
+        # a diagnostic must never kill the run it is diagnosing
+        return f"(checkify re-run itself failed: {type(e).__name__}: {e})"
+    try:
+        err.throw()
+    except Exception as e:  # checkify.JaxRuntimeError
+        return str(e)
+    return None
+
+
+def nonfinite_leaves(tree):
+    """Which leaves of a (grad) tree are non-finite — the cheap first half of
+    overflow localization, run on the accumulator before re-verification."""
+    bad = []
+    for path, leaf in _leaves_with_paths(tree):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            if not bool(jax.device_get(jnp.isfinite(leaf).all())):
+                bad.append(jax.tree_util.keystr(path))
+    return bad

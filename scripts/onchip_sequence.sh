@@ -15,6 +15,10 @@ cd /root/repo
 # one run id for the whole sequence: legs are recognisable as "this run" by
 # bench.py recovery, and never reaped as stale by their own sequence-mates
 export DS_TPU_HARNESS_RUN_ID="seq-$$-$(date +%s)"
+# persistent compilation cache: cold Mosaic/XLA compiles over the axon tunnel
+# run 60-120s PER PROGRAM; the cache makes every re-run (and the driver's own
+# bench) start warm
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/root/repo/.jax_cache}
 echo "sequence start $(date) run_id=$DS_TPU_HARNESS_RUN_ID" >> "$LOG"
 
 # every leg runs as its own setsid process GROUP so that grandchildren
@@ -86,7 +90,10 @@ if ! probe >> "$LOG" 2>&1; then
   abort "initial chip probe failed"
 fi
 
-run_leg smoke 3600 python scripts/tpu_kernel_smoke.py --timeout 420
+# >=900s per kernel: the flash smoke compiles fwd AND both bwd kernels; round-2
+# postmortem measured 60-120s per cold Mosaic compile over the tunnel, and the
+# round-4 run proved 420s is NOT enough (fwd passed, bwd compile hit the axe)
+run_leg smoke 5400 python scripts/tpu_kernel_smoke.py --timeout 900
 if grep -q "FAIL\|TIMEOUT/hang" "$OUT/smoke.json" 2>/dev/null; then
   # a hung kernel smoke means the Pallas path wedges THIS platform: gate it
   # off for the remaining legs instead of re-wedging the chip leg by leg

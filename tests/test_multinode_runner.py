@@ -1,0 +1,141 @@
+"""Multinode runner tests (launcher/multinode_runner.py).
+
+Reference coverage mirrored: ``tests/unit/launcher/test_multinode_runner.py``
+— command construction per backend, export handling, and the runtime rank
+discovery each backend relies on (``comm.discover_process_env``).
+"""
+
+import collections
+
+import pytest
+
+from deepspeed_tpu.comm.comm import discover_process_env
+from deepspeed_tpu.launcher.multinode_runner import (IMPIRunner, MPICHRunner,
+                                                     OpenMPIRunner, PDSHRunner,
+                                                     SlurmRunner, build_runner)
+from deepspeed_tpu.launcher.runner import decode_world_info
+
+POOL = collections.OrderedDict([("worker-0", 4), ("worker-1", 4)])
+PROG = ["python", "train.py", "--deepspeed", "cfg with space.json"]
+
+
+def _mk(cls):
+    return cls(POOL, "worker-0", 29500)
+
+
+def test_slurm_cmd():
+    r = _mk(SlurmRunner)
+    r.add_export("TOKENIZERS_PARALLELISM", "false")
+    cmd = r.get_cmd(PROG)
+    assert cmd[0] == "srun"
+    assert cmd[cmd.index("-n") + 1] == "2"
+    assert "--ntasks-per-node=1" in cmd
+    assert cmd[cmd.index("--nodelist") + 1] == "worker-0,worker-1"
+    exports = [t for t in cmd if t.startswith("--export=ALL,")][0]
+    assert "MASTER_ADDR=worker-0" in exports
+    assert "MASTER_PORT=29500" in exports
+    assert "WORLD_SIZE=2" in exports
+    assert "TOKENIZERS_PARALLELISM=false" in exports
+    assert cmd[-len(PROG):] == PROG
+
+
+def test_openmpi_cmd():
+    cmd = _mk(OpenMPIRunner).get_cmd(PROG)
+    assert cmd[0] == "mpirun"
+    assert cmd[cmd.index("-n") + 1] == "2"
+    assert cmd[cmd.index("--host") + 1] == "worker-0:1,worker-1:1"
+    xs = [cmd[i + 1] for i, t in enumerate(cmd) if t == "-x"]
+    assert any(x.startswith("MASTER_ADDR=") for x in xs)
+    assert any(x.startswith("DS_WORLD_INFO=") for x in xs)
+    assert cmd[-len(PROG):] == PROG
+
+
+@pytest.mark.parametrize("cls,name", [(MPICHRunner, "mpich"), (IMPIRunner, "impi")])
+def test_hydra_cmd(cls, name):
+    r = _mk(cls)
+    assert r.name == name
+    cmd = r.get_cmd(PROG)
+    assert cmd[0] == "mpirun"
+    assert cmd[cmd.index("-hosts") + 1] == "worker-0,worker-1"
+    assert cmd[cmd.index("-ppn") + 1] == "1"
+    genvs = {cmd[i + 1]: cmd[i + 2] for i, t in enumerate(cmd) if t == "-genv"}
+    assert genvs["MASTER_PORT"] == "29500"
+    assert cmd[-len(PROG):] == PROG
+
+
+def test_pdsh_cmd():
+    cmd = _mk(PDSHRunner).get_cmd(PROG)
+    assert cmd[:2] == ["pdsh", "-S"]
+    assert cmd[cmd.index("-w") + 1] == "worker-0,worker-1"
+    remote = cmd[-1]
+    assert "export MASTER_ADDR=worker-0;" in remote
+    assert "export DS_WORLD_INFO=" in remote
+    # args with spaces survive the remote shell
+    assert "'cfg with space.json'" in remote
+
+
+def test_build_runner_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown launcher"):
+        build_runner("kubectl", POOL, "h", 1)
+
+
+def test_pdsh_world_info_roundtrip():
+    r = _mk(PDSHRunner)
+    env = r.base_env()
+    assert decode_world_info(env["DS_WORLD_INFO"]) == dict(POOL)
+
+
+# ---------------------------------------------------------------- discovery
+
+def test_discover_explicit_rank_wins():
+    env = {"MASTER_ADDR": "m", "WORLD_SIZE": "4", "RANK": "3",
+           "SLURM_PROCID": "9"}
+    assert discover_process_env(env) == ("m", 4, 3)
+
+
+def test_discover_slurm():
+    env = {"SLURM_PROCID": "2", "SLURM_NTASKS": "8",
+           "SLURM_JOB_NODELIST": "node0,node1"}
+    assert discover_process_env(env) == ("node0", 8, 2)
+
+
+def test_discover_openmpi():
+    env = {"MASTER_ADDR": "m", "OMPI_COMM_WORLD_RANK": "5",
+           "OMPI_COMM_WORLD_SIZE": "16"}
+    assert discover_process_env(env) == ("m", 16, 5)
+
+
+def test_discover_pmi():
+    env = {"MASTER_ADDR": "m", "PMI_RANK": "1", "PMI_SIZE": "2"}
+    assert discover_process_env(env) == ("m", 2, 1)
+
+
+def test_discover_pdsh_hostname(monkeypatch):
+    import socket
+    r = _mk(PDSHRunner)
+    env = dict(r.base_env())
+    monkeypatch.setattr(socket, "gethostname", lambda: "worker-1.cluster.local")
+    assert discover_process_env(env) == ("worker-0", 2, 1)
+
+
+def test_discover_single_process_default():
+    assert discover_process_env({}) == (None, 1, 0)
+
+
+def test_discover_pdsh_unmatched_hostname_raises(monkeypatch):
+    """Defaulting an unmatched node to rank 0 would hang the whole cluster at
+    coordinator startup — it must fail loudly instead."""
+    import socket
+    env = dict(_mk(PDSHRunner).base_env())
+    monkeypatch.setattr(socket, "gethostname", lambda: "10.0.0.99")
+    with pytest.raises(RuntimeError, match="not found in the launcher's"):
+        discover_process_env(env)
+
+
+def test_openmpi_iface_via_env(monkeypatch):
+    monkeypatch.setenv("DS_MPI_TCP_IF_INCLUDE", "ens8")
+    cmd = _mk(OpenMPIRunner).get_cmd(PROG)
+    assert "btl_tcp_if_include" in cmd and cmd[cmd.index("btl_tcp_if_include") + 1] == "ens8"
+    monkeypatch.delenv("DS_MPI_TCP_IF_INCLUDE")
+    cmd = _mk(OpenMPIRunner).get_cmd(PROG)
+    assert "btl_tcp_if_include" not in cmd
