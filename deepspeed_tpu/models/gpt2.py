@@ -173,6 +173,61 @@ class GPT2LMHeadModel(nn.Module):
         from deepspeed_tpu.models.losses import lm_head_next_token_loss
         return lm_head_next_token_loss(x, wte, labels)
 
+    # --- ZeRO-Infinity streaming protocol (runtime/zero/param_offload.py) ---
+    # Same contract as models/llama.py: the engine's offload_param mode
+    # streams block weights from the host tier inside the scan body.
+    @nn.nowrap
+    def streaming_plan(self):
+        if not self.config.scan_layers:
+            return None
+        return {"num_blocks": self.config.n_layer}
+
+    @nn.nowrap
+    def streaming_split(self, params):
+        resident = {k: v for k, v in params.items() if k != "h"}
+        return resident, params["h"]["block"]
+
+    @nn.nowrap
+    def streaming_merge(self, resident, stacked):
+        out = dict(resident)
+        out["h"] = {"block": stacked}
+        return out
+
+    @nn.nowrap
+    def streaming_apply(self, resident, fetch, batch, deterministic=True,
+                        rng=None):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        wte = resident["wte"]
+        x = wte.astype(cfg.dtype)[input_ids] + \
+            resident["wpe"].astype(cfg.dtype)[None, :T]
+        stochastic = rng is not None and not deterministic and cfg.dropout > 0
+        if stochastic:
+            x = nn.Dropout(cfg.dropout).apply(
+                {}, x, deterministic=False,
+                rngs={"dropout": jax.random.fold_in(rng, -1)})
+        block = Block(cfg)
+
+        def body(carry, i):
+            bp = fetch(i)
+            rngs = {"dropout": jax.random.fold_in(rng, i)} if stochastic else None
+            return block.apply({"params": bp}, carry, deterministic,
+                               rngs=rngs), None
+
+        # save-nothing remat: backward re-streams each block (see llama.py)
+        body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, jnp.arange(cfg.n_layer))
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype).apply(
+            {"params": resident["ln_f"]}, x)
+        if labels is None:
+            return x @ wte.astype(cfg.dtype).T  # tied embeddings
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, wte, labels)
+
     def param_specs(self, params):
         """Tensor-parallel PartitionSpecs (Megatron column/row pattern)."""
         cfg = self.config

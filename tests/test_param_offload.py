@@ -199,6 +199,37 @@ def test_param_offload_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(resumed, cont, rtol=1e-3, atol=1e-3)
 
 
+def test_param_offload_gpt2_second_family():
+    """The streaming protocol is not llama-shaped: GPT-2 (dropout, tied
+    embeddings, LayerNorm blocks) trains under offload_param at loss parity
+    with its in-HBM engine."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config(vocab_size=VOCAB, n_positions=T, n_embd=32, n_layer=3,
+                     n_head=4)
+    batches = _batches(3)
+
+    def train(zero_extra):
+        model = GPT2LMHeadModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config=_config(**zero_extra))
+        losses = []
+        for bt in batches:
+            loss = engine(bt)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(jax.device_get(loss)))
+        return engine, losses
+
+    eng, streamed = train({"offload_param": {"device": "cpu"}})
+    assert eng._param_store is not None
+    assert eng._param_store.num_blocks == 3
+    _, base = train({})
+    np.testing.assert_allclose(streamed, base, rtol=2e-2, atol=2e-2)
+
+
 def test_param_offload_eval_matches_train_params():
     """eval_batch streams through the same tier (logits path, no labels)."""
     eng, _ = _train(_config(offload_param={"device": "cpu"}), steps=2,
