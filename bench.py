@@ -495,12 +495,15 @@ def run_bench():
             from deepspeed_tpu.parallel import groups
             groups.reset()
             params = model.init(jax.random.PRNGKey(0), batch_data)["params"]
+            # DS_BENCH_GAS>1 measures the fused whole-window step (one jit
+            # per accumulation window via train_batch) instead of GAS=1
+            gas = max(1, int(os.environ.get("DS_BENCH_GAS", "1")))
             engine, _, _, _ = deepspeed_tpu.initialize(
                 model=model,
                 model_parameters=params,
                 config={
                     "train_micro_batch_size_per_gpu": batch,
-                    "gradient_accumulation_steps": 1,
+                    "gradient_accumulation_steps": gas,
                     "bf16": {"enabled": True},
                     "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
                     "zero_optimization": {"stage": 1},
@@ -509,11 +512,19 @@ def run_bench():
                     "activation_checkpointing": {"policy": remat_policy},
                 })
 
-            def step():
-                loss = engine(batch_data)
-                engine.backward(loss)
-                engine.step()
-                return loss
+            if gas > 1:
+                import itertools
+                window_iter = itertools.repeat(batch_data)
+
+                def step():
+                    # train_batch returns the window-mean loss as a float
+                    return jax.numpy.asarray(engine.train_batch(window_iter))
+            else:
+                def step():
+                    loss = engine(batch_data)
+                    engine.backward(loss)
+                    engine.step()
+                    return loss
 
             t0 = time.perf_counter()
             loss = step()
@@ -562,7 +573,7 @@ def run_bench():
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    tokens = batch * max(n_chips, 1) * seq * n_steps
+    tokens = batch * max(n_chips, 1) * seq * n_steps * gas
     tok_per_sec_chip = tokens / dt / max(n_chips, 1)
     fpt = gpt2_flops_per_token(cfg, seq)
     mfu = tok_per_sec_chip * fpt / peak_flops(kind)
@@ -575,7 +586,7 @@ def run_bench():
         "extra": {"mfu": round(mfu, 4), "chips": n_chips, "device": kind,
                   "batch_per_chip": batch, "seq": seq, "steps": n_steps,
                   "remat_policy": remat_policy, "fused_step": fused,
-                  "loss": float(jax.device_get(loss))},
+                  "gas": gas, "loss": float(jax.device_get(loss))},
     }
     if on_tpu:
         record_last_good(payload)
@@ -583,6 +594,16 @@ def run_bench():
 
 
 def main():
+    # honor an explicit CPU pin IN PYTHON: the axon sitecustomize ignores
+    # JAX_PLATFORMS from the environment, so a CPU smoke run would otherwise
+    # probe (and potentially hang on) the TPU tunnel
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms and all(p.strip() in ("cpu", "") for p in platforms.split(",")):
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     # parent mode: run the ladder as fresh subprocesses (a single in-process
     # OOM poisons the axon/TPU backend). DS_BENCH_ATTEMPT children and
     # explicitly-CPU-pinned smoke runs take the direct path.

@@ -998,8 +998,15 @@ class DeepSpeedEngine:
         def fused_gas_step(state: TrainState, batches, lr):
             rng, sub = jax.random.split(state.rng)
 
-            def body(carry, mb):
-                acc, key = carry
+            # STATIC unroll over the window, not lax.scan: gas is small and
+            # known at trace time, and an XLA while-loop would carry the
+            # params-sized accumulator tree as loop state (copied at every
+            # iteration boundary when aliasing fails — measured 1.7x SLOWER
+            # than per-micro dispatch on the CPU mesh). Straight-line code
+            # lets XLA alias the accumulate in place and fuse freely.
+            acc, key, losses = state.grad_acc, sub, []
+            for i in range(gas):
+                mb = jax.tree.map(lambda x: x[i], batches)
                 key, k = jax.random.split(key)
                 loss_fn = make_loss_fn(mb, k, state.scale.loss_scale,
                                        state.global_step)
@@ -1009,13 +1016,12 @@ class DeepSpeedEngine:
                     grads = constrain_tree(grads, grad_use_sh)
                 acc = jax.tree.map(lambda a, g: a + g.astype(accum_dtype),
                                    acc, grads)
-                return (acc, key), loss.astype(jnp.float32)
+                losses.append(loss.astype(jnp.float32))
 
-            (acc, _), losses = jax.lax.scan(body, (state.grad_acc, sub), batches)
             denom = self._grad_denom(state, gas)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, acc)
             new_state, stats = core(state._replace(rng=rng), grads, lr)
-            return new_state, losses, stats
+            return new_state, jnp.stack(losses), stats
 
         return jax.jit(fused_gas_step, donate_argnums=(0,))
 

@@ -13,8 +13,16 @@ import sys
 import os
 
 
-# the child's probe body — module-level so tests can substitute a fake
-PROBE_CODE = "import jax; print(len(jax.devices()))"
+# the child's probe body — module-level so tests can substitute a fake.
+# The CPU pin must happen IN PYTHON: the axon sitecustomize force-registers
+# the TPU platform and ignores JAX_PLATFORMS from the environment, so a
+# probe child meant for CPU would otherwise grab (or hang on) the chip.
+PROBE_CODE = (
+    "import os, jax\n"
+    "p = os.environ.get('JAX_PLATFORMS', '')\n"
+    "if p and all(x.strip() in ('cpu', '') for x in p.split(',')):\n"
+    "    jax.config.update('jax_platforms', 'cpu')\n"
+    "print(len(jax.devices()))")
 
 
 def probe_backend(timeout_s=None, _code=None):

@@ -18,6 +18,25 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+_SLOW_LIST = os.path.join(os.path.dirname(__file__), "slow_tests.txt")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Apply the ``slow`` marker from tests/slow_tests.txt (measured nodeids,
+    regenerated from ``--durations`` output). The fast lane
+    ``pytest -m "not slow"`` is what CI and hosts with the TPU attached run;
+    see README "Test lanes"."""
+    try:
+        with open(_SLOW_LIST) as f:
+            slow = {ln.strip() for ln in f if ln.strip() and not ln.startswith("#")}
+    except FileNotFoundError:
+        return
+    # one slow parametrization marks every sibling (same underlying cost)
+    slow_prefixes = {s.split("[")[0] for s in slow}
+    for item in items:
+        if item.nodeid in slow or item.nodeid.split("[")[0] in slow_prefixes:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(autouse=True)
 def _reset_groups():

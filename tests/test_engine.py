@@ -537,9 +537,12 @@ def test_fused_gas_train_batch_matches_unfused():
 
 
 def test_fused_gas_fewer_bytes_accessed():
-    """Compiler-counter evidence (VERDICT r3 #5): the fused window accesses
-    fewer HBM bytes than gas x micro-step + apply-step — the accumulator
-    round-trips disappear into the scan carry."""
+    """Compiler-counter evidence (VERDICT r3 #5): the fused window is a
+    STATIC unroll (no lax.scan — a while-loop would carry and copy the
+    params-sized accumulator per iteration, and cost_analysis counts a loop
+    body only once, making comparisons dishonest). Straight-line bytes are
+    directly comparable: the standalone apply-step's full-state read/write
+    disappears into the last backward."""
     import numpy as np
     from tests.simple_model import SimpleModel, random_batches
     batches = random_batches(2, batch_size=8, seed=9)
@@ -571,9 +574,9 @@ def test_fused_gas_fewer_bytes_accessed():
     b0 = e_u._shard_batch(batches[0])
     micro_bytes = bytes_of(e_u._micro_step_fn.lower(e_u.state, b0))
     apply_bytes = bytes_of(e_u._apply_step_fn.lower(e_u.state, jnp.float32(1e-2)))
-    unfused_total = gas * micro_bytes + apply_bytes
-    if fused_bytes == 0.0 or unfused_total == 0.0:
+    if fused_bytes == 0.0 or micro_bytes == 0.0 or apply_bytes == 0.0:
         pytest.skip("cost_analysis reports no byte counts on this backend")
+    unfused_total = gas * micro_bytes + apply_bytes
     assert fused_bytes < unfused_total, \
         f"fused window {fused_bytes:.3e}B !< unfused {unfused_total:.3e}B"
 
