@@ -188,6 +188,54 @@ class BloomForCausalLM(nn.Module):
         from deepspeed_tpu.models.losses import lm_head_next_token_loss
         return lm_head_next_token_loss(x, embed, labels)
 
+    # --- ZeRO-Infinity streaming protocol (runtime/zero/param_offload.py) ---
+    @nn.nowrap
+    def streaming_plan(self):
+        if not self.config.scan_layers:
+            return None
+        return {"num_blocks": self.config.num_hidden_layers}
+
+    @nn.nowrap
+    def streaming_split(self, params):
+        resident = {k: v for k, v in params.items() if k != "h"}
+        return resident, params["h"]["block"]
+
+    @nn.nowrap
+    def streaming_merge(self, resident, stacked):
+        out = dict(resident)
+        out["h"] = {"block": stacked}
+        return out
+
+    @nn.nowrap
+    def streaming_apply(self, resident, fetch, batch, deterministic=True,
+                        rng=None):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        embed = resident["word_embeddings"]
+        x = embed.astype(cfg.dtype)[input_ids]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype).apply(
+            {"params": resident["word_embeddings_layernorm"]}, x)
+        block = BloomBlock(cfg)
+        stochastic = rng is not None and not deterministic
+
+        def body(carry, i):
+            bp = fetch(i)
+            rngs = {"dropout": jax.random.fold_in(rng, i)} if stochastic else None
+            return block.apply({"params": bp}, carry,
+                               deterministic, rngs=rngs), None
+
+        body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, jnp.arange(cfg.num_hidden_layers))
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype).apply(
+            {"params": resident["ln_f"]}, x)
+        if labels is None:
+            return x @ embed.astype(cfg.dtype).T
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, embed, labels)
+
     def param_specs(self, params):
         """Megatron TP: qkv/h_to_4h column-split, dense/4h_to_h row-split,
         vocab-split embedding (same pattern as models/llama.py)."""

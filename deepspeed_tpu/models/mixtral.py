@@ -141,6 +141,66 @@ class MixtralForCausalLM(nn.Module):
         lm_loss = lm_head_next_token_loss(x, lm_head, labels)
         return lm_loss + cfg.router_aux_loss_coef * total_aux / cfg.num_hidden_layers
 
+    # --- ZeRO-Infinity streaming protocol (runtime/zero/param_offload.py) ---
+    # MoE is the headline Infinity workload: expert weights dominate the
+    # parameter count (reference zero/parameter_offload.py was built for
+    # trillion-param MoE on few devices). Mixtral's layers are homogeneous
+    # per-layer subtrees (layers_i); the split stacks them so the host tier
+    # streams one block — attention + ALL its experts — at a time.
+    @nn.nowrap
+    def streaming_plan(self):
+        return {"num_blocks": self.config.num_hidden_layers}
+
+    @nn.nowrap
+    def streaming_split(self, params):
+        L = self.config.num_hidden_layers
+        resident = {k: v for k, v in params.items()
+                    if not k.startswith("layers_")}
+        stacked = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                               *[params[f"layers_{i}"] for i in range(L)])
+        return resident, stacked
+
+    @nn.nowrap
+    def streaming_merge(self, resident, stacked):
+        out = dict(resident)
+        for i in range(self.config.num_hidden_layers):
+            out[f"layers_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+        return out
+
+    @nn.nowrap
+    def streaming_apply(self, resident, fetch, batch, deterministic=True,
+                        rng=None):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        x = resident["embed_tokens"].astype(cfg.dtype)[input_ids]
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        block = MixtralBlock(cfg)
+
+        def body(carry, i):
+            h, aux = carry
+            bp = fetch(i)
+            rngs = {"dropout": jax.random.fold_in(rng, i)} \
+                if (rng is not None and not deterministic) else None
+            h, l_aux = block.apply({"params": bp}, h, positions,
+                                   not deterministic, rngs=rngs)
+            return (h, aux + l_aux.astype(jnp.float32)), None
+
+        body = jax.checkpoint(body, prevent_cse=False)
+        (x, total_aux), _ = jax.lax.scan(
+            body, (x, jnp.float32(0.0)), jnp.arange(cfg.num_hidden_layers))
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype).apply(
+            {"params": resident["norm"]}, x)
+        lm_head = resident["lm_head"]
+        if labels is None:
+            return x @ lm_head.astype(cfg.dtype).T
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        lm_loss = lm_head_next_token_loss(x, lm_head, labels)
+        return lm_loss + cfg.router_aux_loss_coef * total_aux / cfg.num_hidden_layers
+
     def param_specs(self, params):
         """TP specs for attention + ep sharding for stacked experts."""
         def spec_for(path, leaf):

@@ -153,6 +153,59 @@ class OPTForCausalLM(nn.Module):
         from deepspeed_tpu.models.losses import lm_head_next_token_loss
         return lm_head_next_token_loss(x, embed, labels)
 
+    # --- ZeRO-Infinity streaming protocol (runtime/zero/param_offload.py) ---
+    @nn.nowrap
+    def streaming_plan(self):
+        if not self.config.scan_layers:
+            return None
+        return {"num_blocks": self.config.num_hidden_layers}
+
+    @nn.nowrap
+    def streaming_split(self, params):
+        resident = {k: v for k, v in params.items() if k != "layers"}
+        return resident, params["layers"]["block"]
+
+    @nn.nowrap
+    def streaming_merge(self, resident, stacked):
+        out = dict(resident)
+        out["layers"] = {"block": stacked}
+        return out
+
+    @nn.nowrap
+    def streaming_apply(self, resident, fetch, batch, deterministic=True,
+                        rng=None):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        embed = resident["embed_tokens"]
+        x = embed.astype(cfg.dtype)[input_ids] + \
+            resident["embed_positions"].astype(cfg.dtype)[
+                None, cfg.POSITION_OFFSET:cfg.POSITION_OFFSET + T]
+        stochastic = rng is not None and not deterministic and cfg.dropout > 0
+        if stochastic:
+            x = nn.Dropout(cfg.dropout).apply(
+                {}, x, deterministic=False,
+                rngs={"dropout": jax.random.fold_in(rng, -1)})
+        block = OPTBlock(cfg)
+
+        def body(carry, i):
+            bp = fetch(i)
+            rngs = {"dropout": jax.random.fold_in(rng, i)} if stochastic else None
+            return block.apply({"params": bp}, carry, deterministic,
+                               rngs=rngs), None
+
+        body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, jnp.arange(cfg.num_hidden_layers))
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype).apply(
+            {"params": resident["final_layer_norm"]}, x)
+        if labels is None:
+            return x @ embed.astype(cfg.dtype).T
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, embed, labels)
+
     def param_specs(self, params):
         """Megatron column/row TP pattern over q/k/v/fc1 (column) and
         out_proj/fc2 (row)."""
