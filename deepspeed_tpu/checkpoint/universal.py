@@ -49,6 +49,28 @@ def _opt_step_count(opt_state):
     return best
 
 
+def _streamed_slots(engine):
+    """Map the ZeRO-Infinity param tier's (block, leaf) cells onto the
+    model's CANONICAL tree paths, via a sentinel pass through
+    ``streaming_merge``. Each full path maps to an ordered [(block_i,
+    leaf_j), ...] list — length L for stacked-scan families (fragment
+    carries the leading scan dim), length 1 for per-layer families like
+    Mixtral (fragment is that layer's leaf). Universal fragments therefore
+    use identical names whether the engine streams or not."""
+    store = engine._param_store
+    L = store.num_blocks
+    sentinel = jax.tree_util.tree_unflatten(
+        store._treedef,
+        [np.arange(j * L, (j + 1) * L) for j in range(len(store._paths))])
+    merged = engine.module.streaming_merge({}, sentinel)
+    slots = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(merged)[0]:
+        flat = np.asarray(leaf).reshape(-1)
+        slots[jax.tree_util.keystr(path)] = [(int(v) % L, int(v) // L)
+                                             for v in flat]
+    return slots
+
+
 def save_universal_checkpoint(engine, out_dir, tag=None):
     """Write universal fragments from a live engine (the online equivalent of
     reference ``ds_to_universal.py`` main). ``tag`` becomes a subdirectory,
@@ -74,6 +96,19 @@ def save_universal_checkpoint(engine, out_dir, tag=None):
                     k, engine._offload.masters[k].size)
             blobs[f"{k}::exp_avg"] = np.asarray(m, np.float32).reshape(shape)
             blobs[f"{k}::exp_avg_sq"] = np.asarray(v, np.float32).reshape(shape)
+    if engine._param_store is not None:
+        # ZeRO-Infinity param tier: host moments re-keyed to canonical paths
+        store = engine._param_store
+        for fk, entries in _streamed_slots(engine).items():
+            ms, vs = [], []
+            for (i, j) in entries:
+                m, v = store.get_moments(i, j)
+                shape = tuple(store.block_shapes[j])
+                ms.append(np.asarray(m, np.float32).reshape(shape))
+                vs.append(np.asarray(v, np.float32).reshape(shape))
+            blobs[f"{fk}::exp_avg"] = ms[0] if len(ms) == 1 else np.stack(ms)
+            blobs[f"{fk}::exp_avg_sq"] = vs[0] if len(vs) == 1 else np.stack(vs)
+
     # device-resident moments (the whole tree, or the offload remainder)
     for fk, (_, leaf) in moment_leaves(engine.state.opt_state,
                                        opt_param_paths(engine)).items():
@@ -122,6 +157,23 @@ def _set_all_masters(engine, new_by_key):
                 else val
         return leaf
 
+    if engine._param_store is not None:
+        store = engine._param_store
+        for fk, entries in _streamed_slots(engine).items():
+            if fk not in new_by_key:
+                continue
+            arr = np.asarray(new_by_key[fk], np.float32)
+            for idx, (i, j) in enumerate(entries):
+                store.set_master(i, j, arr[idx] if len(entries) > 1 else arr)
+            loaded[0] += 1
+        store._publish_from_masters()
+        if engine.state.master is not None:
+            engine.state = engine.state._replace(
+                master=jax.tree_util.tree_map_with_path(rep, engine.state.master))
+        else:
+            engine.state = engine.state._replace(
+                params=jax.tree_util.tree_map_with_path(rep, engine.state.params))
+        return loaded[0]
     if engine._offload is not None:
         for k, buf in engine._offload.masters.items():
             if k in new_by_key:
@@ -188,6 +240,18 @@ def load_universal_checkpoint(engine, universal_dir, load_optimizer_states=True)
 
 def _load_moments(engine, frags):
     import jax.numpy as jnp
+    if engine._param_store is not None:
+        store = engine._param_store
+        for fk, entries in _streamed_slots(engine).items():
+            if f"{fk}::exp_avg" not in frags or f"{fk}::exp_avg_sq" not in frags:
+                continue
+            m = np.asarray(frags[f"{fk}::exp_avg"], np.float32)
+            v = np.asarray(frags[f"{fk}::exp_avg_sq"], np.float32)
+            for idx, (i, j) in enumerate(entries):
+                if len(entries) > 1:
+                    store.set_moments(i, j, m[idx], v[idx])
+                else:
+                    store.set_moments(i, j, m, v)
     if engine._offload is not None:
         swap_updates = {}
         for k in engine._offload.masters:
@@ -234,6 +298,8 @@ def _restore_opt_step_count(engine, step):
         opt_state=jax.tree_util.tree_map_with_path(rep, engine.state.opt_state))
     if engine._offload is not None:
         engine._offload.adam.step_count = step
+    if engine._param_store is not None:
+        engine._param_store.set_opt_step(step)
 
 
 def get_fp32_state_dict_from_zero_checkpoint(universal_dir):

@@ -311,6 +311,33 @@ class BlockParamStore:
             else:
                 self._work[i][:] = flat
 
+    def get_moments(self, i, j):
+        """(m, v) fp32 flat moment views for block ``i``, leaf ``j`` —
+        universal-checkpoint export (checkpoint/universal.py)."""
+        key = self._key(i, j)
+        if self._opt.swapper is not None:
+            return self._opt.swapper.state_arrays()[key]
+        return self._opt.adam.state_for(key, self._sizes[j])
+
+    def set_moments(self, i, j, m, v):
+        key = self._key(i, j)
+        m = np.ascontiguousarray(m, np.float32).reshape(-1)
+        v = np.ascontiguousarray(v, np.float32).reshape(-1)
+        if self._opt.swapper is not None:
+            self._opt.swapper.load_state_arrays({key: (m, v)})
+        else:
+            self._opt.adam.set_state(key, m, v)
+
+    def set_master(self, i, j, value):
+        self._opt.masters[self._key(i, j)][:] = \
+            np.asarray(value, np.float32).reshape(-1)
+
+    def get_opt_step(self):
+        return self._opt.adam.step_count
+
+    def set_opt_step(self, step):
+        self._opt.adam.step_count = int(step)
+
     def state_dict(self):
         return self._opt.state_dict()
 

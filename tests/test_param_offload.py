@@ -301,6 +301,55 @@ def test_param_offload_more_families(family):
     np.testing.assert_allclose(streamed, base, rtol=2e-2, atol=2e-2)
 
 
+def test_param_offload_universal_checkpoint_cross_tier():
+    """Universal checkpoints are tier-independent: fragments saved from a
+    streamed (Infinity) engine load into an in-HBM engine and vice versa —
+    same canonical names, moments included — and training continues at
+    parity (reference ds_to_universal promise at any topology)."""
+    import tempfile
+    batches = _batches(5)
+
+    def make(zero_extra):
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=_config(**zero_extra))
+        return engine
+
+    def steps(engine, bts):
+        out = []
+        for bt in bts:
+            loss = engine(bt)
+            engine.backward(loss)
+            engine.step()
+            out.append(float(jax.device_get(loss)))
+        return out
+
+    # streamed -> universal -> in-HBM
+    src = make({"offload_param": {"device": "cpu"}})
+    steps(src, batches[:3])
+    d = tempfile.mkdtemp()
+    src.save_universal_checkpoint(d, tag="u")
+    cont_src = steps(src, batches[3:])
+
+    dst = make({})
+    import os
+    dst.load_universal_checkpoint(os.path.join(d, "u"))
+    cont_dst = steps(dst, batches[3:])
+    np.testing.assert_allclose(cont_dst, cont_src, rtol=2e-2, atol=2e-2)
+
+    # in-HBM -> universal -> streamed
+    src2 = make({})
+    steps(src2, batches[:3])
+    d2 = tempfile.mkdtemp()
+    src2.save_universal_checkpoint(d2, tag="u")
+    cont_src2 = steps(src2, batches[3:])
+    dst2 = make({"offload_param": {"device": "cpu"}})
+    dst2.load_universal_checkpoint(os.path.join(d2, "u"))
+    cont_dst2 = steps(dst2, batches[3:])
+    np.testing.assert_allclose(cont_dst2, cont_src2, rtol=2e-2, atol=2e-2)
+
+
 def test_param_offload_eval_matches_train_params():
     """eval_batch streams through the same tier (logits path, no labels)."""
     eng, _ = _train(_config(offload_param={"device": "cpu"}), steps=2,
