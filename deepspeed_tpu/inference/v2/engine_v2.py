@@ -148,3 +148,21 @@ class InferenceEngineV2:
     def flush(self, uid: int) -> None:
         """Retire a sequence, freeing its KV blocks (reference :242)."""
         self._state.flush_sequence(uid)
+
+    # -- KV host swap (ZeRO-Inference KV offload; scheduler preemption) ----
+    def preempt(self, uid: int) -> None:
+        """Move ``uid``'s KV cache to host memory, freeing its device blocks
+        for other sequences; generation state is preserved."""
+        self._state.swap_out_sequence(uid)
+
+    def resume(self, uid: int) -> None:
+        """Restore a preempted sequence's KV into fresh device blocks."""
+        self._state.swap_in_sequence(uid)
+
+    def blocks_to_resume(self, uid: int) -> int:
+        return self._state.blocks_to_resume(uid)
+
+    @property
+    def swap_stats(self):
+        return {"swap_outs": getattr(self._state, "swap_outs", 0),
+                "swap_ins": getattr(self._state, "swap_ins", 0)}

@@ -51,3 +51,33 @@ class BlockedKVCache:
     def update(self, k_pool, v_pool):
         """Swap in pools returned by the jitted forward."""
         self.k_pool, self.v_pool = k_pool, v_pool
+
+    # -- host swap tier (ZeRO-Inference KV offload analog) -----------------
+    # Reference capability: ``deepspeed/inference`` ZeRO-Inference offloads
+    # KV to host so more/longer sequences fit (README "20x" claim combines
+    # this with weight quant). TPU mechanics: block rows gather device→host
+    # between forwards (jax async dispatch overlaps the copy), the ids return
+    # to the allocator, and a later ``swap_in`` scatters the bytes into fresh
+    # blocks — sequences preempt under KV pressure WITHOUT losing their cache.
+    def swap_out(self, blocks):
+        """Pull the given block rows to host memory and free their ids.
+        Returns an opaque host handle for ``swap_in``."""
+        import jax
+        import numpy as np
+        idx = jnp.asarray(list(blocks), jnp.int32)
+        k = np.asarray(jax.device_get(jnp.take(self.k_pool, idx, axis=1)))
+        v = np.asarray(jax.device_get(jnp.take(self.v_pool, idx, axis=1)))
+        self._allocator.free(list(blocks))
+        return {"n": len(list(blocks)), "k": k, "v": v}
+
+    def swap_in(self, handle):
+        """Restore swapped blocks into freshly allocated ids (order preserved:
+        the i-th restored block holds what the i-th swapped-out block held).
+        Returns the new block ids."""
+        new_blocks = self._allocator.allocate(handle["n"])
+        idx = jnp.asarray(new_blocks, jnp.int32)
+        self.k_pool = self.k_pool.at[:, idx].set(
+            jnp.asarray(handle["k"], self.dtype))
+        self.v_pool = self.v_pool.at[:, idx].set(
+            jnp.asarray(handle["v"], self.dtype))
+        return new_blocks

@@ -87,6 +87,31 @@ class DSStateManager:
             return
         self.kv_cache.free(seq.kv_blocks)
 
+    # -- host swap tier (ZeRO-Inference KV offload analog) -----------------
+    def swap_out_sequence(self, uid):
+        """Move a tracked sequence's KV blocks to host memory; the sequence
+        stays tracked (seen_tokens intact) but holds no device blocks."""
+        seq = self._seqs[uid]
+        if seq.is_swapped:
+            return
+        assert seq.in_flight_tokens == 0, "cannot swap a sequence mid-forward"
+        seq.swap_handle = self.kv_cache.swap_out(seq.kv_blocks)
+        seq.kv_blocks = []
+        self.swap_outs = getattr(self, "swap_outs", 0) + 1
+
+    def swap_in_sequence(self, uid):
+        """Restore a swapped sequence into fresh device blocks."""
+        seq = self._seqs[uid]
+        if not seq.is_swapped:
+            return
+        seq.kv_blocks = list(self.kv_cache.swap_in(seq.swap_handle))
+        seq.swap_handle = None
+        self.swap_ins = getattr(self, "swap_ins", 0) + 1
+
+    def blocks_to_resume(self, uid):
+        seq = self._seqs[uid]
+        return seq.swap_handle["n"] if seq.is_swapped else 0
+
     # -- block arithmetic --------------------------------------------------
     def blocks_needed(self, seq, new_tokens):
         """Extra blocks required to grow ``seq`` by ``new_tokens``."""

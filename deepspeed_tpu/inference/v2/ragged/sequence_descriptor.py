@@ -11,6 +11,13 @@ class DSSequenceDescriptor:
     seen_tokens: int = 0          # tokens already resident in the KV cache
     in_flight_tokens: int = 0     # tokens scheduled in the current forward
     kv_blocks: List[int] = dataclasses.field(default_factory=list)
+    # host handle while the sequence's KV lives in the swap tier
+    # (ragged/kv_cache.py swap_out) — kv_blocks is empty meanwhile
+    swap_handle: object = None
+
+    @property
+    def is_swapped(self) -> bool:
+        return self.swap_handle is not None
 
     @property
     def cur_allocated_blocks(self) -> int:

@@ -31,6 +31,7 @@ class _Request:
     prefill_pos: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    preempted: bool = False  # KV host-swapped out (scheduler preemption)
 
     @property
     def prefilling(self):
@@ -96,7 +97,7 @@ class SplitFuseScheduler:
         max_ctx = self._engine._config.state_manager.max_context
         uids, chunks, budget = [], [], self._budget
         for r in list(self._requests.values()):
-            if r.done or r.prefilling or len(uids) >= self._max_seqs:
+            if r.done or r.prefilling or r.preempted or len(uids) >= self._max_seqs:
                 continue
             pos = len(r.prompt) + len(r.generated)
             if pos >= max_ctx:
@@ -112,7 +113,7 @@ class SplitFuseScheduler:
             chunks.append(np.asarray([nxt], np.int32))
             budget -= 1
         for r in self._requests.values():
-            if r.done or not r.prefilling or r.uid in uids:
+            if r.done or not r.prefilling or r.preempted or r.uid in uids:
                 continue
             if len(uids) >= self._max_seqs or budget < 1:
                 break
@@ -126,8 +127,43 @@ class SplitFuseScheduler:
             budget -= take
         return uids, chunks
 
+    def _try_resume(self):
+        """Swap preempted sequences back in (oldest first) while device
+        blocks allow — preempted work outranks new admissions."""
+        for r in list(self._requests.values()):
+            if r.done or not getattr(r, "preempted", False):
+                continue
+            need = self._engine.blocks_to_resume(r.uid)
+            if need and self._engine.free_blocks > need:
+                self._engine.resume(r.uid)
+                r.preempted = False
+
+    def _preempt_for_progress(self, exclude=()):
+        """KV pressure relief (the ZeRO-Inference KV-offload path): push the
+        request holding the most blocks out to the host tier so someone else
+        can run; its cache is restored later, not recomputed. Half-prefilled
+        sequences are valid victims — two of them deadlocking the pool
+        (neither can grow) is the classic starvation case. Returns True if a
+        sequence was preempted."""
+        def blocks_of(r):
+            seq = self._engine._state.get_sequence(r.uid)
+            return len(seq.kv_blocks) if seq is not None else 0
+
+        candidates = [r for r in self._requests.values()
+                      if not r.done and not r.preempted
+                      and r.uid not in exclude and blocks_of(r) > 0]
+        active = sum(1 for r in self._requests.values()
+                     if not r.done and not r.preempted)
+        if len(candidates) < 1 or active < 2:
+            return False  # alone: preempting would free blocks we then re-need
+        victim = max(candidates, key=blocks_of)
+        self._engine.preempt(victim.uid)
+        victim.preempted = True
+        return True
+
     def step(self):
         """One scheduling round + forward. Returns uids finished this round."""
+        self._try_resume()
         uids, chunks = self._compose()
         if not uids:
             return []
@@ -143,6 +179,10 @@ class SplitFuseScheduler:
             chunks.pop(biggest)
         if not uids:
             self._starved += 1
+            # host-swap a blocked decode's KV before declaring starvation
+            if self._preempt_for_progress():
+                self._starved = 0
+                return []
             if self._starved > 3:
                 raise RuntimeError(
                     f"no schedulable work for {self._starved} rounds: "
