@@ -94,6 +94,10 @@ class InferenceEngineV2:
         for uid, n in zip(uids, lengths):
             seq = self._state.get_sequence(uid)
             seen = seq.seen_tokens if seq else 0
+            if seq is not None and seq.is_swapped:
+                # its KV lives in the host tier: attending would silently read
+                # zeroed blocks — the caller must resume() first
+                return SchedulingResult(False, f"uid {uid} is swapped out")
             if seq is None:
                 new_seqs += 1
             if seen + n > sm.max_context:
@@ -164,5 +168,5 @@ class InferenceEngineV2:
 
     @property
     def swap_stats(self):
-        return {"swap_outs": getattr(self._state, "swap_outs", 0),
-                "swap_ins": getattr(self._state, "swap_ins", 0)}
+        return {"swap_outs": self._state.swap_outs,
+                "swap_ins": self._state.swap_ins}

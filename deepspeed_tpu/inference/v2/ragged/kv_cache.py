@@ -64,11 +64,15 @@ class BlockedKVCache:
         Returns an opaque host handle for ``swap_in``."""
         import jax
         import numpy as np
-        idx = jnp.asarray(list(blocks), jnp.int32)
-        k = np.asarray(jax.device_get(jnp.take(self.k_pool, idx, axis=1)))
-        v = np.asarray(jax.device_get(jnp.take(self.v_pool, idx, axis=1)))
-        self._allocator.free(list(blocks))
-        return {"n": len(list(blocks)), "k": k, "v": v}
+        blocks = list(blocks)
+        idx = jnp.asarray(blocks, jnp.int32)
+        # dispatch BOTH gathers before fetching so the device→host copies
+        # pipeline (jax async dispatch), instead of stalling on K before V
+        k_g = jnp.take(self.k_pool, idx, axis=1)
+        v_g = jnp.take(self.v_pool, idx, axis=1)
+        k, v = jax.device_get((k_g, v_g))
+        self._allocator.free(blocks)
+        return {"n": len(blocks), "k": np.asarray(k), "v": np.asarray(v)}
 
     def swap_in(self, handle):
         """Restore swapped blocks into freshly allocated ids (order preserved:

@@ -19,6 +19,8 @@ class DSStateManager:
         self.kv_cache = BlockedKVCache(num_layers, num_blocks, kv.block_size,
                                        num_kv_heads, head_dim, kv.cache_dtype)
         self._seqs = {}
+        self.swap_outs = 0  # host swap tier counters (kv_cache swap_out/in)
+        self.swap_ins = 0
         logger.info(f"DSStateManager: {num_blocks} KV blocks x {kv.block_size} "
                     f"tokens ({num_layers} layers, {num_kv_heads} kv heads)")
 
@@ -97,7 +99,7 @@ class DSStateManager:
         assert seq.in_flight_tokens == 0, "cannot swap a sequence mid-forward"
         seq.swap_handle = self.kv_cache.swap_out(seq.kv_blocks)
         seq.kv_blocks = []
-        self.swap_outs = getattr(self, "swap_outs", 0) + 1
+        self.swap_outs += 1
 
     def swap_in_sequence(self, uid):
         """Restore a swapped sequence into fresh device blocks."""
@@ -106,7 +108,7 @@ class DSStateManager:
             return
         seq.kv_blocks = list(self.kv_cache.swap_in(seq.swap_handle))
         seq.swap_handle = None
-        self.swap_ins = getattr(self, "swap_ins", 0) + 1
+        self.swap_ins += 1
 
     def blocks_to_resume(self, uid):
         seq = self._seqs[uid]

@@ -129,16 +129,26 @@ class SplitFuseScheduler:
 
     def _try_resume(self):
         """Swap preempted sequences back in (oldest first) while device
-        blocks allow — preempted work outranks new admissions."""
+        blocks allow — preempted work outranks new admissions. A sequence
+        only resumes when it can ALSO schedule its next chunk afterwards:
+        resuming into exactly-fitting blocks would re-preempt immediately and
+        thrash the pool while others starve."""
+        state = self._engine._state
         for r in list(self._requests.values()):
-            if r.done or not getattr(r, "preempted", False):
+            if r.done or not r.preempted:
                 continue
             need = self._engine.blocks_to_resume(r.uid)
-            if need and self._engine.free_blocks > need:
+            seq = state.get_sequence(r.uid)
+            if seq is None:
+                r.preempted = False
+                continue
+            grow = state.blocks_needed_for(seq.seen_tokens, need, 1,
+                                           state.kv_block_size)
+            if need and self._engine.free_blocks >= need + grow:
                 self._engine.resume(r.uid)
                 r.preempted = False
 
-    def _preempt_for_progress(self, exclude=()):
+    def _preempt_for_progress(self):
         """KV pressure relief (the ZeRO-Inference KV-offload path): push the
         request holding the most blocks out to the host tier so someone else
         can run; its cache is restored later, not recomputed. Half-prefilled
@@ -150,8 +160,7 @@ class SplitFuseScheduler:
             return len(seq.kv_blocks) if seq is not None else 0
 
         candidates = [r for r in self._requests.values()
-                      if not r.done and not r.preempted
-                      and r.uid not in exclude and blocks_of(r) > 0]
+                      if not r.done and not r.preempted and blocks_of(r) > 0]
         active = sum(1 for r in self._requests.values()
                      if not r.done and not r.preempted)
         if len(candidates) < 1 or active < 2:
@@ -166,6 +175,17 @@ class SplitFuseScheduler:
         self._try_resume()
         uids, chunks = self._compose()
         if not uids:
+            # nothing composable but preempted work pending and unresumable:
+            # that's starvation too (e.g. a request whose resume needs more
+            # blocks than the whole pool) — keep the counter honest so the
+            # diagnostic error fires instead of a silent spin
+            if any(not r.done and r.preempted for r in self._requests.values()):
+                self._starved += 1
+                if self._starved > 3:
+                    raise RuntimeError(
+                        f"no schedulable work for {self._starved} rounds: "
+                        f"preempted sequence(s) cannot be resumed (KV cache "
+                        f"too small for the request?)")
             return []
         # shrink the proposal until the engine admits it (KV pressure):
         # drop the largest chunk each time and RE-validate — put() would

@@ -233,3 +233,19 @@ def test_scheduler_preempts_under_kv_pressure(served):
     assert stats["swap_outs"] >= 1 and stats["swap_ins"] >= 1, stats
     for uid, p in prompts.items():
         assert_near_greedy(outs[uid], model, params, p)
+
+
+def test_engine_rejects_swapped_sequence(served):
+    """The ENGINE owns the swap invariant: a swapped-out sequence cannot be
+    scheduled (attention over zeroed blocks) until resume()."""
+    cfg, model, params = served
+    engine = make_engine(cfg, model, params)
+    prompt = np.arange(10, dtype=np.int32)
+    engine.put([7], [prompt])
+    engine.preempt(7)
+    verdict = engine.can_schedule([7], [1])
+    assert not verdict.success and "swapped" in verdict.reason
+    with pytest.raises(RuntimeError, match="swapped"):
+        engine.put([7], [np.asarray([1], np.int32)])
+    engine.resume(7)
+    assert engine.can_schedule([7], [1]).success
