@@ -358,3 +358,41 @@ def test_param_offload_eval_matches_train_params():
     logits = eng.eval_batch(batch)
     assert logits.shape == (B, T, VOCAB)
     assert bool(np.isfinite(np.asarray(jax.device_get(logits))).all())
+
+
+def test_param_offload_fp16_overflow_skip():
+    """fp16 dynamic loss scaling under the param tier: a poisoned micro-step
+    must skip BOTH tiers (device resident apply and host optimizer), halve
+    the scale, and leave masters untouched."""
+    model = _model()
+    batches = _batches(2)
+    params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+    cfg = _config(offload_param={"device": "cpu"})
+    cfg["bf16"] = {"enabled": False}
+    # hysteresis 1: the reference default of 2 absorbs the first overflow
+    # without backing the scale off — this test wants the immediate drop
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 4, "hysteresis": 1}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config=cfg)
+    # one clean step to materialize state
+    loss = engine(batches[0]); engine.backward(loss); engine.step()
+    assert not bool(engine._last_stats.overflow)
+    scale_before = engine.cur_scale
+    store = engine._param_store
+    masters_before = {k: v.copy() for k, v in store._opt.masters.items()}
+    step_before = store.get_opt_step()
+
+    # poison the host grad accumulator the way a bad batch would
+    loss = engine(batches[1]); engine.backward(loss)
+    store._grads[0][0] = np.inf
+    engine.step()
+    assert bool(engine._last_stats.overflow)
+    assert engine.cur_scale < scale_before  # dynamic scale backed off
+    for k, v in store._opt.masters.items():
+        np.testing.assert_array_equal(v, masters_before[k])
+    assert store.get_opt_step() == step_before
+    assert all((g == 0).all() for g in store._grads)  # window discarded
+
+    # recovery: the next window trains normally
+    loss = engine(batches[0]); engine.backward(loss); engine.step()
+    assert not bool(engine._last_stats.overflow)
