@@ -202,6 +202,89 @@ class BertForMaskedLM(nn.Module):
         denom = jnp.maximum(jnp.sum(valid), 1)
         return -jnp.sum(jnp.where(valid, tok, 0.0)) / denom
 
+    # --- ZeRO-Infinity streaming protocol (runtime/zero/param_offload.py) ---
+    # Encoder family: the attention mask rides the scan as a closed-over
+    # broadcast (matching the model's in_axes=nn.broadcast).
+    @nn.nowrap
+    def streaming_plan(self):
+        if not self.config.scan_layers:
+            return None
+        return {"num_blocks": self.config.num_hidden_layers}
+
+    @nn.nowrap
+    def streaming_split(self, params):
+        resident = {k: ({kk: vv for kk, vv in v.items() if kk != "layers"}
+                        if k == "bert" else v)
+                    for k, v in params.items()}
+        return resident, params["bert"]["layers"]["block"]
+
+    @nn.nowrap
+    def streaming_merge(self, resident, stacked):
+        out = {k: (dict(v) if k == "bert" else v) for k, v in resident.items()}
+        out.setdefault("bert", {})["layers"] = {"block": stacked}
+        return out
+
+    @nn.nowrap
+    def streaming_apply(self, resident, fetch, batch, deterministic=True,
+                        rng=None):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+            token_type_ids = batch.get("token_type_ids")
+            attention_mask = batch.get("attention_mask")
+        else:
+            input_ids, labels, token_type_ids, attention_mask = \
+                batch, None, None, None
+        bert = resident["bert"]
+        B, T = input_ids.shape
+        word = bert["word_embeddings"]
+        pos = bert["position_embeddings"]
+        if cfg.position_offset and attention_mask is not None:
+            m = attention_mask.astype(jnp.int32)
+            pos_ids = jnp.cumsum(m, axis=1) * m + (cfg.position_offset - 1)
+            x = word[input_ids] + pos[pos_ids]
+        else:
+            x = word[input_ids] + pos[jnp.arange(T) + cfg.position_offset][None]
+        if cfg.type_vocab_size:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + bert["token_type_embeddings"][token_type_ids]
+        x = x.astype(cfg.dtype)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype).apply(
+            {"params": bert["embeddings_ln"]}, x)
+        stochastic = rng is not None and not deterministic and cfg.dropout > 0
+        if stochastic:
+            x = nn.Dropout(cfg.dropout).apply(
+                {}, x, deterministic=False,
+                rngs={"dropout": jax.random.fold_in(rng, -1)})
+        layer = BertLayer(cfg)
+
+        def body(carry, i):
+            bp = fetch(i)
+            rngs = {"dropout": jax.random.fold_in(rng, i)} if stochastic else None
+            return layer.apply({"params": bp}, carry, attention_mask,
+                               deterministic, rngs=rngs), None
+
+        body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, jnp.arange(cfg.num_hidden_layers))
+
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype).apply(
+            {"params": resident["transform"]}, x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype).apply(
+            {"params": resident["transform_ln"]}, x)
+        logits = (x @ word.astype(cfg.dtype).T).astype(jnp.float32) + \
+            resident["decoder_bias"]
+        if labels is None:
+            return logits
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        return -jnp.sum(jnp.where(valid, tok, 0.0)) / denom
+
     def param_specs(self, params):
         """Megatron TP specs: q/k/v/intermediate column-split, attn_out/output
         row-split, embeddings vocab-split (same pattern as the decoder

@@ -194,6 +194,65 @@ class ParallelBlockForCausalLM(nn.Module):
         from deepspeed_tpu.models.losses import lm_head_next_token_loss
         return lm_head_next_token_loss(x, head, labels)
 
+    # --- ZeRO-Infinity streaming protocol (runtime/zero/param_offload.py) ---
+    # Covers falcon/phi/gptj/gpt-neox in one place (per-layer subtrees
+    # stacked at split, like models/mixtral.py).
+    @nn.nowrap
+    def streaming_plan(self):
+        return {"num_blocks": self.config.num_hidden_layers}
+
+    @nn.nowrap
+    def streaming_split(self, params):
+        L = self.config.num_hidden_layers
+        resident = {k: v for k, v in params.items()
+                    if not k.startswith("layers_")}
+        stacked = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                               *[params[f"layers_{i}"] for i in range(L)])
+        return resident, stacked
+
+    @nn.nowrap
+    def streaming_merge(self, resident, stacked):
+        out = dict(resident)
+        for i in range(self.config.num_hidden_layers):
+            out[f"layers_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+        return out
+
+    @nn.nowrap
+    def streaming_apply(self, resident, fetch, batch, deterministic=True,
+                        rng=None):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        embed = resident["embed_tokens"]
+        x = embed.astype(cfg.dtype)[input_ids]
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        block = ParallelBlock(cfg)
+
+        def body(carry, i):
+            bp = fetch(i)
+            return block.apply({"params": bp}, carry, positions), None
+
+        body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, jnp.arange(cfg.num_hidden_layers))
+        x = _LN(cfg.layer_norm_eps, cfg.dtype).apply(
+            {"params": resident["final_layernorm"]}, x)
+        head = embed if cfg.tie_lm_head else resident["lm_head"]
+        hb = resident.get("lm_head_bias") \
+            if (cfg.lm_head_bias and not cfg.tie_lm_head) else None
+        if labels is None or hb is not None:
+            logits = x @ head.astype(cfg.dtype).T
+            if hb is not None:
+                logits = logits + hb.astype(cfg.dtype)
+            if labels is None:
+                return logits
+            from deepspeed_tpu.models.losses import next_token_loss
+            return next_token_loss(logits, labels)
+        from deepspeed_tpu.models.losses import lm_head_next_token_loss
+        return lm_head_next_token_loss(x, head, labels)
+
     def param_specs(self, params):
         """Megatron TP: qkv/fc1 column-split, dense/fc2 row-split, vocab-split
         embeddings (same pattern as models/llama.py)."""
