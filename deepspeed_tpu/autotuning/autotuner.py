@@ -27,6 +27,11 @@ DEFAULT_TUNING_SPACE = {
     "zero_stage": [0, 1, 2, 3],
     "micro_batch_size": None,   # derived from the base config when None
     "remat_policy": ["nothing", "dots", "everything"],
+    # None = device-resident only; the space auto-extends with "optimizer"
+    # (ZeRO-Offload host Adam) and "param" (ZeRO-Infinity streamed params)
+    # when the model's state cannot fit HBM at any pure-device stage —
+    # the reference's z3_offload_all escalation (autotuning/config.py)
+    "offload": None,
 }
 
 METRIC_THROUGHPUT = "throughput"
@@ -94,12 +99,18 @@ class Autotuner:
             pass
         return 16 * (1 << 30)
 
-    def estimate_state_bytes(self, stage, dp_world):
+    def estimate_state_bytes(self, stage, dp_world, offload=None):
         """Static training-state bytes per device for a ZeRO stage: working
         params (bf16/fp16: 2B) + fp32 master (4B) + Adam moments (8B) + fp32
         grad accumulator (4B), each sharded per the stage semantics
         (zero/partition.py). Activation memory is left as headroom — the
-        cheap static-state estimate is what separates feasible stages."""
+        cheap static-state estimate is what separates feasible stages.
+
+        ``offload``: "optimizer" moves master+moments to host DRAM
+        (zero/offload.py); "param" (ZeRO-Infinity, zero/param_offload.py)
+        additionally streams the block params from host — device working
+        memory drops to the resident leaves + O(1) in-flight block,
+        approximated as 25% of the working set."""
         n = self.model_info["num_params"] if self.model_info else 0
         mixed = (self.base_config.get("bf16", {}).get("enabled")
                  or self.base_config.get("fp16", {}).get("enabled"))
@@ -113,21 +124,36 @@ class Autotuner:
             grads = grads / dp_world
         if stage >= 3:
             working = working / dp_world
+        if offload in ("optimizer", "param"):
+            master = opt = 0  # host tier
+        if offload == "param":
+            working *= 0.25   # resident leaves + streamed block
+            grads *= 0.25     # host accumulators own the streamed grads
         return working + master + opt + grads
 
-    def prune(self, stage, mbs, remat, dp_world, headroom=0.4):
+    def prune(self, stage, mbs, remat, dp_world, headroom=0.4, offload=None):
         """None if the experiment is worth running, else the prune reason.
         ``headroom`` reserves budget for activations/XLA workspace."""
+        if offload == "param":
+            if stage < 3:
+                return "offload_param requires ZeRO stage 3"
+            if not (hasattr(self.model, "streaming_plan")
+                    and self.model.streaming_plan()):
+                return "offload_param needs the model streaming protocol"
+        if offload == "optimizer" and stage < 1:
+            return "offload_optimizer needs ZeRO >= 1 (sharded host tier)"
         budget = self.device_hbm_budget() * (1.0 - headroom)
-        est = self.estimate_state_bytes(stage, dp_world)
+        est = self.estimate_state_bytes(stage, dp_world, offload)
         if est > budget:
             return (f"estimated state {est/1e9:.2f}GB > "
-                    f"{budget/1e9:.2f}GB budget at stage {stage}")
+                    f"{budget/1e9:.2f}GB budget at stage {stage}"
+                    + (f" offload={offload}" if offload else ""))
         return None
 
     # ---- cost model (reference model-based search, autotuner.py:42) ----
     def predicted_step_cost(self, stage, mbs, remat, dp_world,
-                            peak_flops=197e12, hbm_gbps=800e9):
+                            peak_flops=197e12, hbm_gbps=800e9,
+                            offload=None, pcie_gbps=16e9):
         """Relative predicted step time — compute plus HBM roofline terms.
 
         Compute: fwd+bwd FLOPs (3x fwd), +1 extra fwd under recompute-all
@@ -146,18 +172,29 @@ class Autotuner:
         flops *= {"everything": 4 / 3, "dots": 7 / 6,
                   "nothing": 1.0}.get(remat, 4 / 3)
         compute_t = flops / peak_flops
-        state = self.estimate_state_bytes(stage, dp_world)
+        state = self.estimate_state_bytes(stage, dp_world, offload)
         act = 2.0 * per_sample * mbs / max(
             self.model_info["num_params"], 1) * 8
         mem_t = (state + act) / hbm_gbps
+        # host tiers pay PCIe per step: grads down + new working up
+        # ("optimizer"), plus the fwd+bwd block re-streams ("param")
+        n = self.model_info["num_params"] if self.model_info else 0
+        if offload == "optimizer":
+            mem_t += (4 * n + 2 * n) / dp_world / pcie_gbps
+        elif offload == "param":
+            mem_t += (4 * n + 2 * n + 2 * 2 * n) / pcie_gbps
         # sum, not max: assumes no compute/DMA overlap — pessimistic but
         # monotone in both terms, which is all the ORDERING needs
         return (compute_t + mem_t) / max(mbs, 1)     # per-sample time
 
-    def _build_config(self, stage, mbs, remat):
+    def _build_config(self, stage, mbs, remat, offload=None):
         cfg = dict(self.base_config)
         zero = dict(cfg.get("zero_optimization", {}))
         zero["stage"] = stage
+        if offload == "optimizer":
+            zero["offload_optimizer"] = {"device": "cpu"}
+        elif offload == "param":
+            zero["offload_param"] = {"device": "cpu"}
         cfg["zero_optimization"] = zero
         ac = dict(cfg.get("activation_checkpointing", {}))
         ac["policy"] = remat
@@ -175,7 +212,8 @@ class Autotuner:
                              exp.overrides["micro_batch_size"],
                              exp.overrides["remat_policy"])
         groups.reset()
-        cfg = self._build_config(stage, mbs, remat)
+        cfg = self._build_config(stage, mbs, remat,
+                                 exp.overrides.get("offload"))
         try:
             engine, _, _, _ = deepspeed_tpu.initialize(
                 model=self.model, model_parameters=self.model_parameters,
@@ -231,27 +269,41 @@ class Autotuner:
         remats = self.space.get("remat_policy") or ["everything"]
         mbs_list = sorted(self._micro_batch_candidates())
 
-        groups_order = list(itertools.product(stages, remats))
+        offloads = self.space.get("offload")
+        if offloads is None:
+            # auto-escalation (reference z3_offload_all): host tiers enter
+            # the space only when no pure-device stage can hold the state
+            budget = self.device_hbm_budget() * 0.6
+            if all(self.estimate_state_bytes(s, dp_world) > budget
+                   for s in stages):
+                offloads = [None, "optimizer", "param"]
+                log_dist("autotuning: no pure-device stage fits — adding "
+                         "host offload tiers to the space", ranks=[0])
+            else:
+                offloads = [None]
+
+        groups_order = list(itertools.product(stages, remats, offloads))
         if search == "cost":
             mid = mbs_list[len(mbs_list) // 2]
-            groups_order.sort(key=lambda sr: self.predicted_step_cost(
-                sr[0], mid, sr[1], dp_world))
+            groups_order.sort(key=lambda sro: self.predicted_step_cost(
+                sro[0], mid, sro[1], dp_world, offload=sro[2]))
             log_dist(f"autotuning: cost-ordered groups {groups_order}",
                      ranks=[0])
 
         best = None
         since_improvement = 0
         trials = 0
-        for stage, remat in groups_order:
+        for stage, remat, offload in groups_order:
             group_best = None
             for mbs in mbs_list:
                 if trials >= self.max_trials or \
                         since_improvement >= early_stopping:
                     break
                 exp = Experiment({"zero_stage": stage, "micro_batch_size": mbs,
-                                  "remat_policy": remat})
+                                  "remat_policy": remat, "offload": offload})
                 self.experiments.append(exp)
-                reason = self.prune(stage, mbs, remat, dp_world)
+                reason = self.prune(stage, mbs, remat, dp_world,
+                                    offload=offload)
                 if reason:
                     exp.error = f"pruned: {reason}"
                     log_dist(f"autotuning: {exp}", ranks=[0])
@@ -278,7 +330,8 @@ class Autotuner:
             raise RuntimeError("autotuning: every experiment failed or was pruned")
         cfg = self._build_config(best.overrides["zero_stage"],
                                  best.overrides["micro_batch_size"],
-                                 best.overrides["remat_policy"])
+                                 best.overrides["remat_policy"],
+                                 best.overrides.get("offload"))
         log_dist(f"autotuning: best {best}", ranks=[0])
         return cfg, best.metric
 

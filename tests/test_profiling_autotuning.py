@@ -373,3 +373,56 @@ def test_autotuner_tune_scheduled_end_to_end(tmp_path):
     cfg, metric = tuner.tune_scheduled(hosts=1, results_dir=str(tmp_path))
     assert metric > 0
     assert cfg["zero_optimization"]["stage"] in (0, 1)
+
+
+def test_autotuner_offload_escalation(monkeypatch):
+    """When no pure-device stage fits the (shrunken) budget, the space
+    auto-extends with the host tiers and the winner actually trains under
+    offload (ZeRO-Infinity when the model streams)."""
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    import numpy as np
+
+    cfg_m = LlamaConfig(vocab_size=256, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=16)
+    model = LlamaForCausalLM(cfg_m)
+    rng = np.random.RandomState(0)
+    data = {"input_ids": rng.randint(0, 256, (16, 16)).astype(np.int32)}
+    data["labels"] = data["input_ids"]
+
+    def batch_fn(bs):
+        return {k: v[:bs] for k, v in data.items()}
+
+    base = {"train_micro_batch_size_per_gpu": 1,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+    tuner = Autotuner(model, None, base, batch_fn,
+                      tuning_space={"zero_stage": [3],
+                                    "micro_batch_size": [1],
+                                    "remat_policy": ["nothing"],
+                                    "offload": None},
+                      warmup_steps=1, measure_steps=1)
+    # budget smaller than ANY pure-device estimate, but big enough for the
+    # param tier's resident slice (~25% of working)
+    tuner.profile_model_info()
+    full = tuner.estimate_state_bytes(3, 8)
+    tiered = tuner.estimate_state_bytes(3, 8, offload="param")
+    assert tiered < full
+    monkeypatch.setattr(Autotuner, "device_hbm_budget",
+                        lambda self: tiered / 0.6 * 1.05)
+    cfg, metric = tuner.tune()
+    assert metric > 0
+    assert cfg["zero_optimization"].get("offload_param", {}).get("device") == "cpu"
+
+
+def test_autotuner_offload_prune_rules():
+    from tests.simple_model import SimpleModel
+    model = SimpleModel(hidden_dim=8)
+    tuner = Autotuner(model, None,
+                      {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}},
+                      lambda bs: None)
+    tuner.model_info = {"num_params": 100, "fwd_flops": 1.0, "profile_mbs": 1}
+    assert "stage 3" in tuner.prune(2, 1, "nothing", 8, offload="param")
+    # SimpleModel has no streaming protocol
+    assert "streaming" in tuner.prune(3, 1, "nothing", 8, offload="param")
+    assert "ZeRO >= 1" in tuner.prune(0, 1, "nothing", 8, offload="optimizer")
