@@ -8,6 +8,7 @@ Pallas availability of each registered op.
 Run: ``python -m deepspeed_tpu.env_report``
 """
 
+import os
 import shutil
 import subprocess
 import sys
@@ -107,6 +108,16 @@ def main(hide_operator_status=False, hide_errors_and_warnings=False):
         return [r for r in rows if FAIL not in r[2]] \
             if hide_errors_and_warnings else rows
 
+    # an explicit CPU pin must apply IN PYTHON here too: the probe child
+    # honors it (backend_probe), but this process would still init the
+    # default (axon/TPU) platform and hang on a held chip
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms and all(p.strip() in ("cpu", "") for p in platforms.split(",")):
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except ImportError:
+            pass
     from deepspeed_tpu.utils.backend_probe import probe_backend
     kind, backend_detail = probe_backend()
     backend_ok = kind == "ok"
