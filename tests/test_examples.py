@@ -41,3 +41,14 @@ def test_migrate_from_deepspeed_example():
     assert r.returncode == 0, r.stderr[-2000:]
     assert "loaded 4 parameters (+ moments) at step 100" in r.stdout
     assert "resumed 3 steps" in r.stdout
+
+
+@pytest.mark.slow
+def test_train_infinity_example():
+    r = _run_example("train_infinity.py",
+                     ["train_infinity.py", "--steps", "6", "--layers", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "streamed blocks: 2" in r.stdout
+    losses = [float(l.rsplit(" ", 1)[1]) for l in r.stdout.splitlines()
+              if l.startswith("step ")]
+    assert losses and losses[-1] < losses[0]
