@@ -32,6 +32,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     attention_bias: bool = False      # qkv bias (Qwen2-family)
     sliding_window: Any = None        # local-window attention (Mistral-family)
+    # None/"flash": the Pallas flash kernel (XLA fallback). "ring": blockwise
+    # context parallelism over the sp mesh axis (ops/ring_attention.py) — K/V
+    # rotate around the ring via ppermute, sequence length scales linearly
+    # with ring size; requires the global topology's sp axis > 1.
+    attention_impl: Any = None
     head_dim: Any = None              # explicit override (Mistral-Nemo style);
     # None derives hidden_size // num_attention_heads (resolved in __post_init__)
     scan_layers: bool = True
@@ -157,6 +162,22 @@ class LlamaAttention(nn.Module):
             logits = logits + bias[None, None, None]
             probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
             out = jnp.einsum("bkrts,bskd->btkrd", probs, v).reshape(B, T, H, Dh)
+        elif cfg.attention_impl == "ring":
+            # context parallelism: sequence stays sharded over sp; K/V blocks
+            # rotate on ICI (ring_attention.py). GQA keys/values expand to
+            # full heads first — the ring recurrence is per-head.
+            from deepspeed_tpu.ops.ring_attention import ring_attention_sharded
+            from deepspeed_tpu.parallel import groups
+            topo = groups.get_topology()
+            if topo.sp_size <= 1:
+                raise ValueError(
+                    "attention_impl='ring' needs an sp mesh axis > 1 "
+                    "(sequence_parallel_size in the engine config)")
+            rep = H // KV
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = ring_attention_sharded(q, k, v, topo.mesh, causal=True)
         else:
             # GQA k/v pass through un-repeated — both mha implementations
             # handle head grouping internally (flash kernel maps q head h to
