@@ -165,6 +165,10 @@ class DeepSpeedEngine:
         if mesh is not None:
             if isinstance(mesh, MeshTopology):
                 self.topology = mesh
+                # the explicit mesh IS the process topology: install it so
+                # model-level groups.get_topology() consumers (ring attention,
+                # MoE group getters) see the same axes as the engine
+                groups.initialize(mesh_topology=mesh)
             else:
                 raise ValueError("pass a deepspeed_tpu.parallel.topology.MeshTopology")
         else:
