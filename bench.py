@@ -366,12 +366,15 @@ def gpt2_candidates(on_tpu):
         pol = os.environ["DS_BENCH_REMAT"]
         pairs = [(32, pol), (16, pol), (8, pol)] if on_tpu else [(2, pol)]
     else:
-        # "nothing" (save ALL activations, zero recompute) first: GPT-2-small
-        # activations at these batches fit v5e HBM easily, and recompute-free
-        # backward is the single biggest MFU lever (r2's 32% was measured
-        # under FULL recompute). OOM degrades policy before batch.
-        pairs = ([(64, "nothing"), (32, "nothing"), (64, "dots"), (32, "dots"),
-                  (32, "everything"), (16, "dots"), (8, "everything")]
+        # "nothing" (save ALL activations, zero recompute) first: recompute-
+        # free backward is the single biggest MFU lever (r2's 32% was measured
+        # under FULL recompute). Activation arithmetic at seq 1024 bf16:
+        # ~1.2GB/layer-pass per 64-batch -> bs64 save-all (~14GB) cannot fit
+        # 16GB HBM next to 1.8GB of states, bs32 (~7GB) can. The KNOWN-GOOD
+        # (32, dots) sits second so a surprise OOM costs one attempt, not the
+        # ladder deadline.
+        pairs = ([(32, "nothing"), (32, "dots"), (64, "dots"),
+                  (16, "dots"), (32, "everything"), (8, "everything")]
                  if on_tpu else [(2, "dots")])
     return expand_fused(pairs)
 
