@@ -14,14 +14,17 @@ import os
 
 
 # the child's probe body — module-level so tests can substitute a fake.
-# The CPU pin must happen IN PYTHON: the axon sitecustomize force-registers
-# the TPU platform and ignores JAX_PLATFORMS from the environment, so a
-# probe child meant for CPU would otherwise grab (or hang on) the chip.
+# The probe must test the platform the PARENT will actually use. The axon
+# sitecustomize ignores JAX_PLATFORMS from the environment, so the only real
+# signal is the parent's IN-PYTHON pin (jax.config.jax_platforms), mirrored
+# into the child via DS_PROBE_PLATFORMS — a child that honored the env var
+# while the parent ran on the default platform would report "ok" for a
+# backend the caller never touches.
 PROBE_CODE = (
     "import os, jax\n"
-    "p = os.environ.get('JAX_PLATFORMS', '')\n"
-    "if p and all(x.strip() in ('cpu', '') for x in p.split(',')):\n"
-    "    jax.config.update('jax_platforms', 'cpu')\n"
+    "p = os.environ.get('DS_PROBE_PLATFORMS', '')\n"
+    "if p:\n"
+    "    jax.config.update('jax_platforms', p)\n"
     "print(len(jax.devices()))")
 
 
@@ -39,9 +42,18 @@ def probe_backend(timeout_s=None, _code=None):
     # child then WAITS for it — a child stuck in an uninterruptible tunnel
     # syscall never dies and the "bounded" probe blocks forever. Here the
     # final wait is itself bounded; an unkillable child gets ABANDONED.
+    env = dict(os.environ)
+    try:  # mirror the parent's effective in-Python platform pin (see above)
+        if "jax" in sys.modules:
+            import jax
+            plats = getattr(jax.config, "jax_platforms", None)
+            if plats:
+                env["DS_PROBE_PLATFORMS"] = plats
+    except Exception:
+        pass
     proc = subprocess.Popen(
         [sys.executable, "-c", PROBE_CODE if _code is None else _code],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
     try:
         out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
