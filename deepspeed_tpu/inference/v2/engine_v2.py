@@ -118,9 +118,10 @@ class InferenceEngineV2:
         return seq.cur_allocated_blocks * self._state.kv_block_size - seq.seen_tokens
 
     # -- serving (reference engine_v2.py:107) ------------------------------
-    def put(self, batch_uids: List[int],
-            batch_tokens: List[np.ndarray]) -> np.ndarray:
-        """Run one ragged forward; returns [len(uids), vocab] next-token logits."""
+    def _forward_device(self, batch_uids: List[int],
+                        batch_tokens: List[np.ndarray]):
+        """Run one ragged forward; returns the FULL padded [S_max, vocab]
+        logits as a device array (no host transfer)."""
         verdict = self.can_schedule(batch_uids, [len(t) for t in batch_tokens])
         if not verdict.success:
             raise RuntimeError(f"cannot schedule batch: {verdict.reason}")
@@ -147,7 +148,44 @@ class InferenceEngineV2:
 
         for uid in batch_uids:
             self._state.get_sequence(uid).post_forward()
+        return logits
+
+    def put(self, batch_uids: List[int],
+            batch_tokens: List[np.ndarray]) -> np.ndarray:
+        """Run one ragged forward; returns [len(uids), vocab] next-token logits."""
+        logits = self._forward_device(batch_uids, batch_tokens)
         return np.asarray(logits[:len(batch_uids)])
+
+    def put_sampled(self, batch_uids: List[int],
+                    batch_tokens: List[np.ndarray],
+                    temperatures, top_ks, top_ps, seeds,
+                    positions) -> np.ndarray:
+        """One ragged forward + ON-DEVICE sampling fused behind the same
+        dispatch; returns [len(uids)] int32 token ids.
+
+        The host never sees the logits — only 4 bytes per sequence cross the
+        PCIe/tunnel boundary per decode step (vs 4*vocab for ``put``). Rows
+        mid-prefill sample garbage by construction (their last-token logits
+        are mid-prompt); callers discard those ids, exactly as they discarded
+        the logits before. Per-row sampling params are traced, so one
+        compiled program covers any greedy/sampled mix.
+        """
+        from deepspeed_tpu.inference.v2.sampling import sample_rows
+        logits = self._forward_device(batch_uids, batch_tokens)
+        s_max = logits.shape[0]
+
+        def pad(vals, dtype):
+            a = np.zeros(s_max, dtype)
+            a[:len(batch_uids)] = np.asarray(vals, dtype)
+            return jnp.asarray(a)
+
+        # arbitrary Python-int seeds (the host sampler accepted any) fold
+        # deterministically into the int31 space PRNGKey wants
+        seeds = [int(s) & 0x7FFFFFFF for s in seeds]
+        ids = sample_rows(logits, pad(temperatures, np.float32),
+                          pad(top_ks, np.int32), pad(top_ps, np.float32),
+                          pad(seeds, np.int32), pad(positions, np.int32))
+        return np.asarray(ids[:len(batch_uids)])
 
     def flush(self, uid: int) -> None:
         """Retire a sequence, freeing its KV blocks (reference :242)."""

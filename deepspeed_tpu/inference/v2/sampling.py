@@ -1,0 +1,66 @@
+"""On-device per-sequence sampling for the ragged serving path.
+
+The reference's FastGen loop keeps sampling host-side in DeepSpeed-MII (the
+v2 engine returns logits — ``deepspeed/inference/v2/engine_v2.py:107`` — and
+MII's postprocessing samples them); on TPU that design transfers a full
+``[S, vocab]`` float tensor device->host every decode step, which caps
+tokens/s well below kernel capability. Here the temperature/top-k/top-p
+transform AND the categorical draw run inside one jitted program on the
+device; the host receives only ``[S]`` int32 token ids.
+
+Per-row (per-request) parameters are traced values, so one compiled program
+serves every mix of greedy/sampled requests — no retrace when a new request
+arrives with a different temperature. Determinism: each row draws from
+``fold_in(PRNGKey(seed), position)``, so a (seed, position) pair always
+yields the same token, independent of batch composition — the same contract
+the host sampler in ``scheduler.py`` provides.
+
+Semantics mirror ``SplitFuseScheduler._sample`` (greedy at temperature 0;
+top-k keeps values >= the kth largest; top-p keeps the smallest set with
+cumulative probability >= top_p, always including the top token; top-p is
+computed over the already-top-k-masked distribution).
+"""
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e9
+
+
+def _row_sample(logits, temp, top_k, top_p, seed, position):
+    """Sample one token from one row of logits. All params traced scalars."""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    v = logits.shape[-1]
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)
+    # top-k: keep values >= the kth largest (top_k <= 0 disables)
+    sorted_desc = jnp.sort(scaled)[::-1]
+    kth = sorted_desc[jnp.clip(top_k - 1, 0, v - 1)]
+    masked = jnp.where((top_k > 0) & (scaled < kth), _NEG, scaled)
+    # top-p over the post-top-k distribution (matches the host sampler's
+    # sequential masking); cutoff_idx always keeps the top token. Masking
+    # below-kth values to _NEG preserves descending order, so the sorted
+    # masked array falls out of the first sort — no second O(V log V) sort.
+    sorted_m = jnp.where((top_k > 0) & (sorted_desc < kth), _NEG, sorted_desc)
+    probs = jax.nn.softmax(sorted_m)
+    cutoff_idx = jnp.clip(jnp.sum(jnp.cumsum(probs) < top_p), 0, v - 1)
+    cutoff = sorted_m[cutoff_idx]
+    masked = jnp.where((top_p < 1.0) & (masked < cutoff), _NEG, masked)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+    sampled = jax.random.categorical(key, masked).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
+@jax.jit
+def sample_rows(logits, temps, top_ks, top_ps, seeds, positions):
+    """Vectorized per-row sampling.
+
+    Args:
+        logits: ``[S, V]`` float — device array straight from the ragged
+            forward (never materialized on the host).
+        temps/top_ps: ``[S]`` float32; top_ks/seeds/positions: ``[S]`` int32.
+
+    Returns ``[S]`` int32 token ids (still on device; the caller transfers
+    4*S bytes instead of 4*S*V).
+    """
+    return jax.vmap(_row_sample)(logits, temps, top_ks, top_ps, seeds,
+                                 positions)

@@ -47,7 +47,7 @@ class SplitFuseScheduler:
             ``max_ragged_batch_size``).
     """
 
-    def __init__(self, engine, token_budget=None):
+    def __init__(self, engine, token_budget=None, device_sampling=True):
         self._engine = engine
         sm = engine._config.state_manager
         self._budget = min(token_budget or sm.max_ragged_batch_size,
@@ -55,6 +55,13 @@ class SplitFuseScheduler:
         self._max_seqs = sm.max_ragged_sequence_count
         self._requests: Dict[int, _Request] = {}
         self._starved = 0  # consecutive rounds with nothing schedulable
+        # device_sampling=True (default) fuses temperature/top-k/top-p and
+        # the categorical draw into the decode step on the accelerator: the
+        # host receives one int32 per sequence instead of a [S, vocab] float
+        # tensor per forward. False keeps the numpy reference sampler (its
+        # draws differ stream-wise from jax.random, but both are
+        # deterministic per (seed, position)).
+        self._device_sampling = bool(device_sampling)
 
     def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None,
                temperature=0.0, top_k=0, top_p=1.0, seed=None):
@@ -209,15 +216,27 @@ class SplitFuseScheduler:
                     f"{verdict.reason} (KV cache too small for any request?)")
             return []
         self._starved = 0
-        logits = self._engine.put(uids, chunks)
+        if self._device_sampling:
+            reqs = [self._requests[u] for u in uids]
+            ids = self._engine.put_sampled(
+                uids, chunks,
+                temperatures=[r.temperature for r in reqs],
+                top_ks=[r.top_k for r in reqs],
+                top_ps=[r.top_p for r in reqs],
+                seeds=[r.seed for r in reqs],
+                positions=[len(r.generated) for r in reqs])
+            logits = None
+        else:
+            logits = self._engine.put(uids, chunks)
         finished = []
         for row, uid in enumerate(uids):
             r = self._requests[uid]
             if r.prefilling:
                 r.prefill_pos += len(chunks[row])
                 if r.prefilling:
-                    continue  # mid-prompt logits are not a next token
-            tok = self._sample(r, logits[row])
+                    continue  # mid-prompt ids/logits are not a next token
+            tok = int(ids[row]) if logits is None else \
+                self._sample(r, logits[row])
             r.generated.append(tok)
             if (r.eos_token_id is not None and tok == r.eos_token_id) or \
                     len(r.generated) >= r.max_new_tokens:

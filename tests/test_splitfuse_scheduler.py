@@ -111,15 +111,16 @@ def test_budget_respected(served):
                  max_new_tokens=2)
     sched.submit(1, rng.integers(0, cfg.vocab_size, 20).astype(np.int32),
                  max_new_tokens=2)
-    # intercept put to check per-round token totals
-    orig_put = engine.put
+    # intercept the shared forward (put and put_sampled both route through
+    # it) to check per-round token totals
+    orig_fwd = engine._forward_device
     totals = []
 
     def spy(uids, chunks):
         totals.append(sum(len(c) for c in chunks))
-        return orig_put(uids, chunks)
+        return orig_fwd(uids, chunks)
 
-    engine.put = spy
+    engine._forward_device = spy
     sched.run_to_completion()
     assert totals and all(t <= 8 for t in totals)
 
