@@ -67,26 +67,30 @@ class QuantizedParameter:
                           num_bits=self.num_bits, group_size=self.group_size,
                           dtype=dtype)
 
-    def matmul(self, x, out_dtype=None):
-        """``x @ dequant(self)`` with the fused Pallas dequant-GEMM when the
-        backend/shape supports it (reference cuda_linear / mixed_gemm slot:
-        HBM reads stay int8-sized), else XLA dequant + matmul.
+    def matmul(self, x, out_dtype=None, impl=None):
+        """``x @ dequant(self)`` through the serving modules registry
+        (reference cuda_linear / mixed_gemm slot): 'fused_dequant' = the
+        Pallas dequant-GEMM kernel (HBM reads stay int8-sized),
+        'dense_dequant' = XLA dequantize-then-matmul. ``impl`` pins a name
+        (raising if it cannot serve this shape); None picks per hardware.
 
         Integration status: this is the serving-layer API for the fused
         path; the v1 engine's dense-dequant proxy remains the default until
         the kernel is validated on hardware (scripts/tpu_kernel_smoke.py)."""
-        from deepspeed_tpu.ops.pallas import quantized_matmul as qm
-        from deepspeed_tpu.ops.registry import pallas_enabled
-        if len(self.shape) == 2 and pallas_enabled():
-            M = int(np.prod(x.shape[:-1]))
+        from deepspeed_tpu.inference.v2.modules.heuristics import (
+            instantiate_linear)
+        M = int(np.prod(x.shape[:-1]))
+        if len(self.shape) == 2:
             K, N = self.shape
-            if qm.is_supported(M, K, N, self.group_size, self.num_bits):
-                from deepspeed_tpu.ops.registry import pallas_interpret
-                out = qm.quantized_matmul(x.reshape(M, K), self.q, self.scale,
-                                          self.group_size,
-                                          out_dtype=out_dtype,
-                                          interpret=pallas_interpret())
-                return out.reshape(x.shape[:-1] + (N,))
+        else:
+            K = N = None
+        name, fn = instantiate_linear(M, K, N, self.group_size,
+                                      self.num_bits, ndim=len(self.shape),
+                                      preference=impl)
+        if name == "fused_dequant":
+            out = fn(x.reshape(M, K), self.q, self.scale, self.group_size,
+                     out_dtype=out_dtype)
+            return out.reshape(x.shape[:-1] + (N,))
         return x @ self.dequantized(out_dtype or x.dtype)
 
     @property

@@ -20,8 +20,18 @@ class KVCacheConfig(DeepSpeedConfigModel):
     cache_dtype = "bf16"
 
 
+class ModulesConfig(DeepSpeedConfigModel):
+    """Per-interface implementation pins (reference ``modules/heuristics.py``
+    chooses per hardware; a named pin here overrides it — see
+    ``modules/module_registry.py``). "auto" = heuristic choice."""
+    attention = "auto"        # "pallas_paged" | "dense"
+    moe = "auto"              # "megablox" | "einsum"
+    linear = "auto"           # "fused_dequant" | "dense_dequant"
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     """Top-level v2 config (reference ``config_v2.py:29``)."""
     tensor_parallel = {"tp_size": 1}
     state_manager = DSStateManagerConfig()
     kv_cache = KVCacheConfig()
+    modules = ModulesConfig()

@@ -50,6 +50,35 @@ class InferenceEngineV2:
                 not getattr(cfg, "scan_layers", True):
             raise ValueError("ragged llama engine requires scan_layers=True params")
         self._ragged_forward = forward_fn
+        # module pins ride the STATIC model config (a frozen dataclass, jit
+        # cache key), so two engines with different pins can never share a
+        # compiled program traced under the other's selection. Names are
+        # validated HERE — a typo'd pin must fail before the KV pool is
+        # allocated, not at the first traced forward.
+        import dataclasses as _dc
+        from deepspeed_tpu.inference.v2.modules import module_registry as _mr
+        from deepspeed_tpu.inference.v2.modules import heuristics  # noqa: F401 (registers rows)
+        pins = tuple(sorted(
+            (iface, name) for iface, name in
+            ((i, getattr(config.modules, i)) for i in
+             ("attention", "moe", "linear")) if name != "auto"))
+        for iface, name in pins:
+            if iface == "linear":
+                # the ragged forwards carry fp weights; the linear interface
+                # is consumed by QuantizedParameter.matmul (v1 quantized
+                # serving). A pin that nothing would read must not pretend.
+                raise _mr.UnsupportedModuleError(
+                    "modules.linear pins apply to the quantized serving "
+                    "path (QuantizedParameter.matmul(impl=...)); the v2 "
+                    "ragged engine has no quantized linear to swap")
+            known = {i.name for i in _mr.registered(iface)}
+            if name not in known:
+                raise _mr.UnknownModuleError(
+                    f"unknown {iface} implementation {name!r} pinned in "
+                    f"config.modules; registered: {sorted(known)}")
+        if pins:
+            cfg = _dc.replace(cfg, serve_modules=pins)
+            self._model_config = cfg
         head_dim = getattr(cfg, "head_dim", None) or \
             cfg.hidden_size // cfg.num_attention_heads
         kv_heads = getattr(cfg, "num_key_value_heads",

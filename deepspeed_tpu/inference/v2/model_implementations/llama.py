@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.llama import rotary_embed
 from deepspeed_tpu.ops.flash_attention import NEG_INF
+from deepspeed_tpu.inference.v2.modules.module_registry import module_preference
 
 
 def _rmsnorm(x, scale, eps):
@@ -52,15 +53,17 @@ def _scatter_kv(k_pool, v_pool, k, v, block_tables, seen, q_len, block_size):
 
 
 def _paged_attention(q, k_pool, v_pool, block_tables, seen, block_size,
-                     q_len=None, window=None):
+                     q_len=None, window=None, prefer=None):
     """Grouped-query attention over per-sequence paged KV: the Pallas
     blocked-flash kernel (ops/pallas/paged_attention.py — O(seen) HBM reads)
     when the heuristics layer selects it, dense gather fallback elsewhere.
-    ``window``: Mistral-style sliding window. q: [S,Q,H,Dh] -> [S,Q,H,Dh]."""
+    ``window``: Mistral-style sliding window. ``prefer``: config pin from
+    the modules registry. q: [S,Q,H,Dh] -> [S,Q,H,Dh]."""
     if q_len is not None:
         from deepspeed_tpu.inference.v2.modules.heuristics import (
             instantiate_attention)
-        impl, fn = instantiate_attention(q.shape, k_pool.shape)
+        impl, fn = instantiate_attention(q.shape, k_pool.shape,
+                                         preference=prefer)
         if impl == "pallas_paged":
             return fn(q, k_pool, v_pool, block_tables, seen, q_len,
                       window=window)
@@ -131,7 +134,8 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
         k = rotary_embed(k, positions, cfg.rope_theta)
         kp, vp = _scatter_kv(kp, vp, k, v, block_tables, seen, q_len, bs)
         out = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len,
-                               window=cfg.sliding_window)
+                               window=cfg.sliding_window,
+                               prefer=module_preference(cfg, "attention"))
         x = x + out.reshape(S, Q, H * Dh) @ attn["o_proj"]["kernel"].astype(cfg.dtype)
         mlp = lp["mlp"]
         h = _rmsnorm(x, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)

@@ -22,9 +22,11 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.llama import rotary_embed
 from deepspeed_tpu.inference.v2.model_implementations.llama import (
     _paged_attention, _rmsnorm, _scatter_kv)
+from deepspeed_tpu.inference.v2.modules.module_registry import module_preference
 
 
-def _moe_ffn(x, gate_wg, w1, w2, w3, *, k, dtype, force_einsum=False):
+def _moe_ffn(x, gate_wg, w1, w2, w3, *, k, dtype, force_einsum=False,
+             prefer=None):
     """Grouped-expert FFN over a flat token batch.
 
     x: [T, D]; gate_wg: [D, E]; w1/w3: [E, D, F]; w2: [E, F, D].
@@ -46,7 +48,7 @@ def _moe_ffn(x, gate_wg, w1, w2, w3, *, k, dtype, force_einsum=False):
     if not force_einsum:
         from deepspeed_tpu.inference.v2.modules.heuristics import (
             instantiate_moe)
-        impl, fn = instantiate_moe(D, w1.shape[-1])
+        impl, fn = instantiate_moe(D, w1.shape[-1], preference=prefer)
         if impl == "megablox":
             return fn(x, top_vals, top_idx, w1, w2, w3, n_experts=E,
                       dtype=dtype)
@@ -91,7 +93,8 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
         q = rotary_embed(q, positions, cfg.rope_theta)
         k = rotary_embed(k, positions, cfg.rope_theta)
         kp, vp = _scatter_kv(kp, vp, k, v, block_tables, seen, q_len, bs)
-        out = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len)
+        out = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len,
+                               prefer=module_preference(cfg, "attention"))
         x = x + out.reshape(S, Q, H * Dh) @ attn["o_proj"]["kernel"].astype(cfg.dtype)
 
         moe = lp["block_sparse_moe"]
@@ -103,7 +106,8 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
                      ex["w2"]["kernel"].astype(cfg.dtype),
                      ex["w3"]["kernel"].astype(cfg.dtype),
                      k=cfg.num_experts_per_tok,
-                     dtype=cfg.dtype)
+                     dtype=cfg.dtype,
+                     prefer=module_preference(cfg, "moe"))
         return x + y.reshape(S, Q, -1), kp, vp
 
     # non-scanned stack: per-layer pools are [L, ...]; loop is unrolled (the

@@ -16,6 +16,7 @@ from deepspeed_tpu.inference.v2.model_implementations.llama import (
     _paged_attention, _scatter_kv)
 from deepspeed_tpu.inference.v2.model_implementations.parallel_block import (
     _layernorm)
+from deepspeed_tpu.inference.v2.modules.module_registry import module_preference
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
@@ -45,7 +46,8 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
         k = lin(at["k_proj"], h).reshape(S, Q, H, Dh)
         v = lin(at["v_proj"], h).reshape(S, Q, H, Dh)
         kp, vp = _scatter_kv(kp, vp, k, v, block_tables, seen, q_len, bs)
-        attn = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len)
+        attn = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len,
+                                prefer=module_preference(cfg, "attention"))
         x = x + lin(at["out_proj"], attn.reshape(S, Q, H * Dh))
         ln2 = lp["final_layer_norm"]
         h = _layernorm(x, ln2["scale"], ln2["bias"], cfg.layer_norm_epsilon)

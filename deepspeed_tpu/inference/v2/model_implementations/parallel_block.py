@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.parallel_block import partial_rotary
 from deepspeed_tpu.inference.v2.model_implementations.llama import (
     _paged_attention, _scatter_kv)
+from deepspeed_tpu.inference.v2.modules.module_registry import module_preference
 
 
 def _layernorm(x, scale, bias, eps):
@@ -61,7 +62,8 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
                              q_len, bs)
         k_pool = k_pool.at[i].set(kp)
         v_pool = v_pool.at[i].set(vp)
-        attn = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len)
+        attn = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len,
+                                prefer=module_preference(cfg, "attention"))
         attn_out = lin(lp["dense"], attn.reshape(S, Q, H * Dh))
         mlp_out = lin(lp["fc2"], jax.nn.gelu(lin(lp["fc1"], h),
                                              approximate=not cfg.gelu_exact))

@@ -2,38 +2,46 @@
 ``inference/v2/modules/heuristics.py:186`` — "pick the best kernel config for
 this hardware").
 
-The reference registry maps module interfaces (attention/embedding/linear/moe)
-to CUDA implementations chosen by heuristics; here the same seam picks between
-the Pallas TPU kernels and the pure-XLA twins. Centralizing the choice keeps
-model implementations free of backend probing.
+The reference maps module interfaces (attention/embedding/linear/moe) to CUDA
+implementations chosen by heuristics over the engine config; here the same
+seam resolves names from ``module_registry`` — Pallas TPU kernels first,
+pure-XLA twins as fallback. Centralizing the choice keeps model
+implementations free of backend probing, and a config pin
+(``modules: {attention: dense}``) overrides the heuristic loudly (unsupported
+pins raise instead of degrading).
 """
 
+from deepspeed_tpu.inference.v2.modules import implementations  # noqa: F401  (registers rows)
+from deepspeed_tpu.inference.v2.modules.module_registry import select
 from deepspeed_tpu.ops.registry import pallas_enabled
 from deepspeed_tpu.utils.logging import logger
 
 _warned = set()
 
 
-def instantiate_attention(q_shape, pool_shape):
-    """-> ('pallas_paged' | 'dense', callable) for ragged paged attention."""
-    from deepspeed_tpu.ops.pallas import paged_attention as pa
-    if pallas_enabled():
-        if pa.is_supported(q_shape, pool_shape):
-            from deepspeed_tpu.ops.registry import pallas_interpret
-            if pallas_interpret():
-                import functools
-                return "pallas_paged", functools.partial(pa.paged_mha,
-                                                         interpret=True)
-            return "pallas_paged", pa.paged_mha
-        if "attention" not in _warned:
-            _warned.add("attention")
-            logger.warning(
-                f"paged attention: shapes q={q_shape} pool={pool_shape} "
-                f"not kernel-compatible; dense fallback (O(max_context))")
-    return "dense", None
+def _warn_fallback(interface, chosen, detail):
+    # only when the Pallas gate is OPEN and shapes still failed — a disabled
+    # backend (CPU, kill-switch) is expected and would make the shape
+    # complaint misleading
+    if pallas_enabled() and interface not in _warned:
+        _warned.add(interface)
+        logger.warning(f"{interface}: {detail}; {chosen} fallback")
 
 
-def instantiate_moe(d_model=None, d_ff=None):
+def instantiate_attention(q_shape, pool_shape, preference=None):
+    """-> ('pallas_paged' | 'dense', callable|None) for ragged paged
+    attention. ``preference``: a registered name pins (raises if it cannot
+    serve these shapes); None/'auto' picks the best supported impl."""
+    name, fn = select("attention", preference=preference,
+                      q_shape=tuple(q_shape), pool_shape=tuple(pool_shape))
+    if name == "dense" and preference in (None, "auto"):
+        _warn_fallback("attention", name,
+                       f"shapes q={tuple(q_shape)} pool={tuple(pool_shape)} "
+                       f"not kernel-compatible (O(max_context) reads)")
+    return name, fn
+
+
+def instantiate_moe(d_model=None, d_ff=None, preference=None):
     """-> ('megablox' | 'einsum', callable|None) for the expert-FFN dispatch.
 
     'megablox': ragged grouped GEMM (ops/pallas/grouped_gemm.py) — tokens
@@ -41,12 +49,18 @@ def instantiate_moe(d_model=None, d_ff=None):
     moe_scatter/gather analog). 'einsum': GShard dense dispatch-combine over
     stacked expert weights (lossless capacity) — the oracle and CPU path.
     """
-    from deepspeed_tpu.ops.pallas import grouped_gemm as gg
-    if pallas_enabled():
-        if gg.is_supported(d_model, d_ff):
-            return "megablox", gg.moe_ffn_gmm
-        if d_model is not None and "moe" not in _warned:
-            _warned.add("moe")
-            logger.warning(f"moe: dims ({d_model}, {d_ff}) not gmm-tileable; "
-                           f"einsum dispatch fallback")
-    return "einsum", None
+    name, fn = select("moe", preference=preference, d_model=d_model,
+                      d_ff=d_ff)
+    if name == "einsum" and d_model is not None and \
+            preference in (None, "auto"):
+        _warn_fallback("moe", name, f"dims ({d_model}, {d_ff}) not "
+                                    f"gmm-tileable")
+    return name, fn
+
+
+def instantiate_linear(m, k, n, group_size, num_bits, ndim=2,
+                       preference=None):
+    """-> ('fused_dequant' | 'dense_dequant', callable|None) for a
+    quantized-weight matmul of shape [M,K] @ [K,N]."""
+    return select("linear", preference=preference, m=m, k=k, n=n,
+                  group_size=group_size, num_bits=num_bits, ndim=ndim)

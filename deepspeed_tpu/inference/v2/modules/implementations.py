@@ -1,0 +1,130 @@
+"""Registered serving-module implementations.
+
+Mirrors the reference's ``inference/v2/modules/implementations/*`` tree
+(attention/moe/linear/embedding/unembed folders of CUDA variants) as
+registry rows over this repo's Pallas kernels and their pure-XLA twins.
+Every row's ``build`` returns a jit-traceable callable (or None where the
+fallback is inlined at the call site); ``supports`` encodes the Mosaic
+tiling constraints that decide kernel eligibility on TPU.
+"""
+
+import functools
+
+from deepspeed_tpu.inference.v2.modules.module_registry import register_module
+from deepspeed_tpu.ops.registry import pallas_enabled, pallas_interpret
+
+
+def _pallas_gate():
+    if not pallas_enabled():
+        return False, "Pallas disabled (DS_TPU_DISABLE_PALLAS or platform)"
+    return True, "ok"
+
+
+# -- attention: ragged paged decode/prefill ---------------------------------
+
+def _paged_supports(q_shape=None, pool_shape=None, **_):
+    ok, why = _pallas_gate()
+    if not ok:
+        return ok, why
+    from deepspeed_tpu.ops.pallas import paged_attention as pa
+    if q_shape is None or pool_shape is None:
+        return False, "no shapes provided"
+    if not pa.is_supported(q_shape, pool_shape):
+        return False, (f"shapes q={tuple(q_shape)} pool={tuple(pool_shape)} "
+                       f"violate kernel tiling (need H%KV==0, Dh<=256, "
+                       f"block_size%8==0)")
+    return True, "ok"
+
+
+@register_module("attention", "pallas_paged", priority=10,
+                 supports=_paged_supports)
+def _build_pallas_paged(q_shape=None, pool_shape=None, **_):
+    """Pallas blocked-flash over paged KV (O(seen) HBM reads via
+    scalar-prefetched block tables) — ``ops/pallas/paged_attention.py``."""
+    from deepspeed_tpu.ops.pallas import paged_attention as pa
+    if pallas_interpret():
+        return functools.partial(pa.paged_mha, interpret=True)
+    return pa.paged_mha
+
+
+@register_module("attention", "dense", priority=0)
+def _build_dense_attention(**_):
+    """Pure-XLA gather-the-whole-table twin (O(max_context) HBM); the
+    fallback is inlined at the call site (``_paged_attention_dense``)."""
+    return None
+
+
+# -- moe: expert-FFN dispatch ----------------------------------------------
+
+def _gmm_supports(d_model=None, d_ff=None, **_):
+    ok, why = _pallas_gate()
+    if not ok:
+        return ok, why
+    from deepspeed_tpu.ops.pallas import grouped_gemm as gg
+    if not gg.is_supported(d_model, d_ff):
+        return False, f"dims ({d_model}, {d_ff}) not 128-tileable for gmm"
+    return True, "ok"
+
+
+@register_module("moe", "megablox", priority=10, supports=_gmm_supports)
+def _build_megablox(**_):
+    """Ragged grouped GEMM, tokens sorted by expert, no capacity dim
+    (cutlass moe_gemm + moe_scatter/gather analog)."""
+    from deepspeed_tpu.ops.pallas import grouped_gemm as gg
+    return gg.moe_ffn_gmm
+
+
+@register_module("moe", "einsum", priority=0)
+def _build_einsum_moe(**_):
+    """GShard dense dispatch-combine over stacked expert weights (lossless
+    capacity) — the numerics oracle and CPU path; inlined at the call site."""
+    return None
+
+
+# -- linear: quantized-weight matmul ---------------------------------------
+
+def _fused_dequant_supports(m=None, k=None, n=None, group_size=None,
+                            num_bits=None, ndim=2, **_):
+    ok, why = _pallas_gate()
+    if not ok:
+        return ok, why
+    if ndim != 2:
+        return False, f"kernel is 2D-weight only, got ndim={ndim}"
+    from deepspeed_tpu.ops.pallas import quantized_matmul as qm
+    if not qm.is_supported(m, k, n, group_size, num_bits):
+        return False, (f"(M={m}, K={k}, N={n}, group={group_size}, "
+                       f"bits={num_bits}) not kernel-tileable")
+    return True, "ok"
+
+
+@register_module("linear", "fused_dequant", priority=10,
+                 supports=_fused_dequant_supports)
+def _build_fused_dequant(**_):
+    """Fused int8 dequant-GEMM Pallas kernel (reference cuda_linear /
+    mixed_gemm slot: HBM reads stay int8-sized)."""
+    from deepspeed_tpu.ops.pallas import quantized_matmul as qm
+    if pallas_interpret():
+        return functools.partial(qm.quantized_matmul, interpret=True)
+    return qm.quantized_matmul
+
+
+@register_module("linear", "dense_dequant", priority=0)
+def _build_dense_dequant(**_):
+    """XLA dequantize-then-matmul twin; inlined at the call site
+    (``QuantizedParameter.dequantized`` + ``@``)."""
+    return None
+
+
+# -- embedding / unembed: single implementations, registered so the
+# interface inventory is complete and pins fail loudly rather than silently
+
+@register_module("embedding", "ragged_gather", priority=0)
+def _build_ragged_embedding(**_):
+    """Token-table gather (the ragged wrapper already flattened tokens)."""
+    return None
+
+
+@register_module("unembed", "last_token_gather", priority=0)
+def _build_unembed(**_):
+    """logits_gather analog: last real token of each sequence @ lm_head."""
+    return None

