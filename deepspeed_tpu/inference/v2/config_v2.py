@@ -23,10 +23,14 @@ class KVCacheConfig(DeepSpeedConfigModel):
 class ModulesConfig(DeepSpeedConfigModel):
     """Per-interface implementation pins (reference ``modules/heuristics.py``
     chooses per hardware; a named pin here overrides it — see
-    ``modules/module_registry.py``). "auto" = heuristic choice."""
+    ``modules/module_registry.py``). "auto" = heuristic choice. Pins the
+    engine's forwards would never read are REJECTED at construction: moe on
+    a dense model, and any non-auto linear (the ragged forwards carry fp
+    weights — quantized-linear pins flow through
+    ``QuantizedParameter.matmul(impl=...)`` instead)."""
     attention = "auto"        # "pallas_paged" | "dense"
-    moe = "auto"              # "megablox" | "einsum"
-    linear = "auto"           # "fused_dequant" | "dense_dequant"
+    moe = "auto"              # "megablox" | "einsum" (Mixtral engines only)
+    linear = "auto"           # must stay "auto" here; see docstring
 
 
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):

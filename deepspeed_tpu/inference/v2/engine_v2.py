@@ -71,6 +71,12 @@ class InferenceEngineV2:
                     "modules.linear pins apply to the quantized serving "
                     "path (QuantizedParameter.matmul(impl=...)); the v2 "
                     "ragged engine has no quantized linear to swap")
+            if iface == "moe" and type(cfg).__name__ != "MixtralConfig":
+                # only the Mixtral forward routes through _moe_ffn; a moe
+                # pin on a dense model would install but never be read
+                raise _mr.UnsupportedModuleError(
+                    f"modules.moe pinned to {name!r} but "
+                    f"{type(cfg).__name__} has no MoE layer to swap")
             known = {i.name for i in _mr.registered(iface)}
             if name not in known:
                 raise _mr.UnknownModuleError(

@@ -184,3 +184,9 @@ def test_engine_linear_pin_rejected(served):
     consume must refuse loudly, not silently no-op."""
     with pytest.raises(mr.UnsupportedModuleError, match="quantized"):
         _engine(served, modules={"linear": "fused_dequant"})
+
+
+def test_engine_moe_pin_rejected_on_dense_model(served):
+    """A moe pin on a model with no MoE layer must refuse at construction."""
+    with pytest.raises(mr.UnsupportedModuleError, match="no MoE layer"):
+        _engine(served, modules={"moe": "megablox"})
