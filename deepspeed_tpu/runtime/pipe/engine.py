@@ -21,6 +21,8 @@ GSPMD outside the rotation.
 
 from typing import Any
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -31,14 +33,87 @@ from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def pipeline_ticks(num_micro, num_stages, virtual_stages=1):
+    """Clock length of the compiled rotation. V=1: M+S-1. V>1: microbatches
+    feed in groups of S, each activation circles the ring V times; the clock
+    ends when the LAST job retires — (M-1)//S full group windows, then the
+    final job's last pass entry (V-1)*S + (M-1)%S, then its S-tick traversal
+    (= M*V + S - 1 when S | M; shorter for a partial final group, where the
+    naive ceil formula would run extra full-compute ticks on masked data)."""
+    M, S, V = num_micro, num_stages, virtual_stages
+    if V == 1:
+        return M + S - 1
+    return ((M - 1) // S) * S * V + (V - 1) * S + (M - 1) % S + S
+
+
+def ideal_bubble_fraction(num_micro, num_stages, virtual_stages=1):
+    """Idle fraction of the schedule. Each stage performs M*V useful
+    chunk-works over ``pipeline_ticks`` ticks, so the bubble is
+    1 - M*V/ticks. V=1 reduces to the classic (S-1)/(M+S-1); interleaving V
+    chunks per device shrinks it toward (S-1)/(M*V) (reference interleaved
+    ``TrainSchedule``, ``runtime/pipe/schedule.py:189``)."""
+    M, S, V = num_micro, num_stages, virtual_stages
+    return 1.0 - (M * V) / pipeline_ticks(M, S, V)
+
+
+def interleaved_schedule(num_micro, num_stages, virtual_stages):
+    """Static per-tick schedule table for the grouped interleaved rotation.
+
+    Jobs are (microbatch m, pass v); stage s processes chunk (s, v) — layers
+    [(v*S+s)*K', ...). Microbatches enter in groups of S: job (m, v) enters
+    stage 0 at tick (m//S)*S*V + v*S + (m%S). Within a group window of S*V
+    ticks the first S ticks feed NEW microbatches; on every other tick slot 0
+    receives the wrap-around from stage S-1 (pass v -> v+1). The job leaving
+    stage S-1 on a feed tick is always at v=V-1 (it retires), so feeds and
+    wrap-arounds never compete — see the validity test
+    (tests/test_pipeline_interleaved.py) which simulates the ring.
+
+    Returns numpy arrays over ticks T = pipeline_ticks(M, S, V):
+      feed [T] bool, feed_idx [T] i32   — slot-0 NEW-microbatch feeds
+      retire [T] bool, retire_idx [T] i32 — out[S-1] finished microbatches
+      vpass [T, S] i32                  — which pass each stage is on
+    """
+    M, S, V = num_micro, num_stages, virtual_stages
+    T = pipeline_ticks(M, S, V)
+    t = np.arange(T)
+    if V == 1:
+        feed_idx = np.clip(t, 0, M - 1)
+        feed = t < M
+        retire_idx = np.clip(t - (S - 1), 0, M - 1)
+        retire = t - (S - 1) >= 0
+        vpass = np.zeros((T, S), np.int32)
+    else:
+        g, r = t // (S * V), t % (S * V)
+        feed_idx = np.clip(g * S + r, 0, M - 1)
+        feed = (r < S) & (g * S + r < M)
+        # job leaving stage S-1 at tick t entered slot 0 at e = t-(S-1)
+        e = t - (S - 1)
+        ge, re = e // (S * V), e % (S * V)
+        ve, ie = re // S, re % S
+        m_e = ge * S + ie
+        retire = (e >= 0) & (ve == V - 1) & (m_e < M)
+        retire_idx = np.clip(m_e, 0, M - 1)
+        # stage s at tick t runs the job that entered at e_s = t - s
+        es = t[:, None] - np.arange(S)[None, :]
+        vpass = ((np.maximum(es, 0) % (S * V)) // S).astype(np.int32)
+    return {"feed": feed, "feed_idx": feed_idx.astype(np.int32),
+            "retire": retire, "retire_idx": retire_idx.astype(np.int32),
+            "vpass": vpass}
+
+
 def collective_pipeline(block_apply, blocks_params, x_micro, mesh, *,
                         num_stages, remat=True, pp_axis="pp", extra=None,
-                        num_layers=None):
+                        num_layers=None, virtual_stages=1):
     """Run M microbatches through the rotated block pipeline — pure GSPMD form.
 
     block_apply: (params_one_layer, x, extra) -> x
     blocks_params: stacked [L, ...] pytree (L = num_layers), pp-sharded on axis 0
     x_micro: [M, ...activation shape] (dp/sp shardings compose automatically)
+    virtual_stages: V>1 = interleaved schedule (reference ``TrainSchedule``,
+        ``runtime/pipe/schedule.py:189``): each device holds V non-contiguous
+        layer chunks and every activation circles the ring V times with 1/V
+        the per-tick compute, shrinking the fill/drain bubble from
+        (S-1)/(M+S-1) toward (S-1)/(M*V) at the cost of V× more rotations.
     Returns: [M, ...] outputs after all L layers.
 
     Mechanics: activations live in a stage-stacked buffer [S, ...] whose leading
@@ -48,56 +123,82 @@ def collective_pipeline(block_apply, blocks_params, x_micro, mesh, *,
     sharded axis, which XLA lowers to a collective-permute over ICI. No manual
     region is needed, so tp/sp GSPMD inside the block composes untouched, and
     autodiff of the scan yields the reverse-rotation backward schedule.
+    The schedule itself (feed/retire/pass indices) is a trace-time numpy
+    table (``interleaved_schedule``) threaded through the scan as constants.
     """
     body = jax.checkpoint(block_apply) if remat else block_apply
     S = num_stages
+    V = virtual_stages
     M = x_micro.shape[0]
 
-    # non-uniform partitioning: the stored stack is padded to S x ceil(L/S)
-    # (PipelineModule.init_params) so the pp sharding divides evenly; padded
-    # slots are masked no-ops here. With a homogeneous interior, balanced
-    # partitioning (reference partition_method="parameters") == uniform slots.
+    # non-uniform partitioning: the stored stack is padded to a multiple of
+    # S*V (PipelineModule.init_params) so the pp sharding divides evenly;
+    # padded slots are masked no-ops here. With a homogeneous interior,
+    # balanced partitioning (reference partition_method="parameters") ==
+    # uniform slots.
     total = jax.tree.leaves(blocks_params)[0].shape[0]
-    assert total % S == 0, f"padded layer stack {total} must divide stages {S}"
-    K = total // S
+    assert total % (S * V) == 0, (
+        f"padded layer stack {total} must divide stages*virtual {S}*{V}")
+    K = total // (S * V)          # layers per chunk
     L = num_layers if num_layers is not None else total
-    valid = (jnp.arange(S * K) < L).reshape(S, K)
 
-    blocks = jax.tree.map(
-        lambda a: a.reshape((S, K) + a.shape[1:]), blocks_params)
+    if V == 1:
+        valid = (jnp.arange(S * K) < L).reshape(S, 1, K)
+        blocks = jax.tree.map(
+            lambda a: a.reshape((S, 1, K) + a.shape[1:]), blocks_params)
+    else:
+        # chunk (s, v) holds layers [(v*S+s)*K, (v*S+s+1)*K): device s's
+        # chunks are STRIDED in layer order, so permute the stacked axis at
+        # trace time (static indices; XLA reshards once per step, amortized
+        # over the V*M rotation ticks)
+        perm = ((np.arange(V)[None, :, None] * S +
+                 np.arange(S)[:, None, None]) * K +
+                np.arange(K)[None, None, :])          # [S, V, K]
+        valid = jnp.asarray(perm < L)
+        blocks = jax.tree.map(
+            lambda a: jnp.take(a, perm.reshape(-1), axis=0).reshape(
+                (S, V, K) + a.shape[1:]), blocks_params)
     blocks = jax.tree.map(
         lambda a: jax.lax.with_sharding_constraint(
             a, jax.NamedSharding(mesh, P(pp_axis))), blocks)
 
-    def apply_stage(stage_blocks, stage_valid, x):
+    sched = interleaved_schedule(M, S, V)
+
+    def apply_stage(stage_blocks, stage_valid, v, x):
+        # [V, K, ...] chunk stack; this tick runs pass v's K layers
+        chunk = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+            (stage_blocks, stage_valid))
+        cb, cv = chunk
+
         def layer(h, pv):
-            p, v = pv
+            p, vv = pv
             out = body(p, h, extra)
             # padded slot -> identity (out from zero params stays finite for
             # standard blocks, so the where-grad is clean)
-            return jnp.where(v, out, h), None
-        out, _ = lax.scan(layer, x, (stage_blocks, stage_valid))
+            return jnp.where(vv, out, h), None
+        out, _ = lax.scan(layer, x, (cb, cv))
         return out
 
-    stage_vmap = jax.vmap(apply_stage, in_axes=(0, 0, 0), out_axes=0)
+    stage_vmap = jax.vmap(apply_stage, in_axes=(0, 0, 0, 0), out_axes=0)
     buf_spec = P(pp_axis)
 
-    def tick(carry, t):
+    def tick(carry, xs):
         buf, outputs = carry  # buf: [S, ...] pp-sharded
-        feed_idx = jnp.clip(t, 0, M - 1)
+        feed_on, feed_idx, retire_on, retire_idx, vpass = xs
         feed = lax.dynamic_index_in_dim(x_micro, feed_idx, 0, keepdims=False)
-        feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
-        buf = buf.at[0].set(feed)
-        out = stage_vmap(blocks, valid, buf)
+        # non-feed ticks keep the wrap-around (pass v -> v+1) that jnp.roll
+        # already placed in slot 0; V=1 always feeds (or zeros in the drain)
+        slot0 = jnp.where(feed_on, feed,
+                          buf[0] if V > 1 else jnp.zeros_like(feed))
+        buf = buf.at[0].set(slot0)
+        out = stage_vmap(blocks, valid, vpass, buf)
         out = jax.lax.with_sharding_constraint(
             out, jax.NamedSharding(mesh, buf_spec))
-        # collect the last stage's result for microbatch t-(S-1)
-        oidx = jnp.clip(t - (S - 1), 0, M - 1)
-        out_ready = t - (S - 1) >= 0
-        cur = lax.dynamic_index_in_dim(outputs, oidx, 0, keepdims=False)
+        cur = lax.dynamic_index_in_dim(outputs, retire_idx, 0, keepdims=False)
         outputs = lax.dynamic_update_index_in_dim(
-            outputs, jnp.where(out_ready, out[S - 1], cur), oidx, 0)
-        # rotate stages: s -> s+1 (slot 0 is overwritten by the next feed)
+            outputs, jnp.where(retire_on, out[S - 1], cur), retire_idx, 0)
+        # rotate stages: s -> s+1 (slot 0 is fed or wrapped next tick)
         buf = jnp.roll(out, 1, axis=0)
         return (buf, outputs), None
 
@@ -105,7 +206,10 @@ def collective_pipeline(block_apply, blocks_params, x_micro, mesh, *,
     init_buf = jax.device_put(init_buf, jax.NamedSharding(mesh, buf_spec)) \
         if not isinstance(init_buf, jax.core.Tracer) else init_buf
     init_out = jnp.zeros_like(x_micro)
-    (_, outputs), _ = lax.scan(tick, (init_buf, init_out), jnp.arange(M + S - 1))
+    xs = (jnp.asarray(sched["feed"]), jnp.asarray(sched["feed_idx"]),
+          jnp.asarray(sched["retire"]), jnp.asarray(sched["retire_idx"]),
+          jnp.asarray(sched["vpass"]))
+    (_, outputs), _ = lax.scan(tick, (init_buf, init_out), xs)
     return outputs
 
 
@@ -146,7 +250,8 @@ class PipelineEngine(DeepSpeedEngine):
                 block_apply, params["blocks"], embed, self.mesh,
                 num_stages=self.topology.pp_size,
                 remat=self.config.activation_checkpointing.policy != "nothing",
-                num_layers=pipe.num_layers)
+                num_layers=pipe.num_layers,
+                virtual_stages=pipe.virtual_stages)
             if pipe.tied_head_fn is not None:
                 # tied embedding head: reads params["embed"], so autodiff
                 # accumulates embed+unembed grads into one leaf (the

@@ -86,7 +86,8 @@ class PipelineModule:
     def __init__(self, embed=None, block=None, head=None, num_layers=None,
                  num_stages=None, partition_method="uniform",
                  block_args: tuple = (), loss_fn=None,
-                 activation_checkpoint_interval=0, tied_head_fn=None):
+                 activation_checkpoint_interval=0, tied_head_fn=None,
+                 virtual_stages=1):
         """``tied_head_fn(embed_module, embed_params, acts, batch) -> loss``:
         the tied-embedding head (reference TiedLayerSpec, pipe/module.py:77).
         The head reads the *embed* parameters, so autodiff accumulates the
@@ -105,6 +106,12 @@ class PipelineModule:
         self.num_layers = num_layers
         self.num_stages = num_stages
         self.partition_method = partition_method
+        # V>1: interleaved schedule — each stage holds V non-contiguous layer
+        # chunks and activations circle the ring V times (reference
+        # TrainSchedule, runtime/pipe/schedule.py:189); shrinks the pipeline
+        # bubble from (S-1)/(M+S-1) toward (S-1)/(M*V)
+        assert virtual_stages >= 1
+        self.virtual_stages = int(virtual_stages)
         self.block_args = block_args
         self.loss_fn = loss_fn
         self.activation_checkpoint_interval = activation_checkpoint_interval
@@ -158,11 +165,12 @@ class PipelineModule:
                               loss_fn=loss_fn, **kw)
 
     def padded_layers(self):
-        """Stored stack length: num_layers padded up to a multiple of the
-        stage count (masked no-op slots; see __init__)."""
+        """Stored stack length: num_layers padded up to a multiple of
+        stages×virtual_stages (masked no-op slots; see __init__)."""
         if not self.num_stages:
             return self.num_layers
-        return self.num_stages * (-(-self.num_layers // self.num_stages))
+        unit = self.num_stages * self.virtual_stages
+        return unit * (-(-self.num_layers // unit))
 
     # --- parameter init -------------------------------------------------
     def init_params(self, rng, sample_batch):
