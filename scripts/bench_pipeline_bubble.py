@@ -79,7 +79,11 @@ def main():
     ndev = len(jax.devices())
     assert ndev >= S, f"need >= {S} devices, have {ndev}"
     mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
-    L = S * max(vs) * 2     # divisible by S*V for every V in the sweep
+    import math
+    lcm = 1
+    for v in vs:
+        lcm = lcm * v // math.gcd(lcm, v)
+    L = S * lcm * 2         # divisible by S*V for every V in the sweep
 
     report = {"stages": S, "dim": args.dim, "layers": L, "sweeps": {}}
     for V in vs:
@@ -109,15 +113,17 @@ def main():
             r["ideal_efficiency"] = 1.0 - r["ideal_bubble"]
         report["sweeps"][f"V{V}"] = {"per_tick_cost_s": c, "rows": rows}
         for r in rows:
+            ov = (f"{r['overhead_vs_model']*100:+.1f}%"
+                  if r["overhead_vs_model"] is not None else "n/a (c<=0)")
             print(f"S={S} V={V} M={r['M']:3d}: {r['time_s']*1e3:8.2f} ms  "
                   f"ticks={r['ticks']:3d}  ideal_eff={r['ideal_efficiency']:.3f}  "
                   f"realized_eff={r['realized_efficiency']:.3f}  "
-                  f"overhead={r['overhead_vs_model']*100:+.1f}%", flush=True)
+                  f"overhead={ov}", flush=True)
 
     # the VERDICT gate: overhead at M=2S under the classic schedule
     gate = next((r for r in report["sweeps"].get("V1", {}).get("rows", [])
                  if r["M"] == 2 * S), None)
-    if gate:
+    if gate and gate["overhead_vs_model"] is not None:
         print(f"\noverhead at M=2S (V=1): {gate['overhead_vs_model']*100:+.1f}% "
               f"(gate: 15% -> interleaved schedule justified)")
         if len(vs) > 1:
