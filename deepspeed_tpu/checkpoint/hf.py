@@ -29,7 +29,7 @@ from deepspeed_tpu.utils.logging import logger
 LLAMA_FAMILY = ("llama", "mistral", "qwen2")
 SUPPORTED = LLAMA_FAMILY + ("gpt2", "opt", "mixtral", "falcon", "phi", "bloom",
                             "gpt_neox", "gptj", "bert", "roberta",
-                            "distilbert")
+                            "distilbert", "qwen", "internlm")
 
 
 class UnsupportedModelError(ValueError):
@@ -145,7 +145,8 @@ def llama_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
                 "k_proj": {"kernel": lin(p + "self_attn.k_proj.weight", KV)},
                 "v_proj": {"kernel": lin(p + "self_attn.v_proj.weight")},
                 "o_proj": {"kernel": lin(p + "self_attn.o_proj.weight")}}
-        for nm, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", None)):
+        for nm, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", None),
+                          ("o_proj", None)):   # o bias: InternLM family
             b = bias(p + f"self_attn.{nm}.bias", heads)
             if b is not None:
                 attn[nm]["bias"] = b
@@ -200,7 +201,8 @@ def llama_from_flax(params, cfg, dtype=np.float32):
             at["k_proj"]["kernel"], KV, Dh, inverse=True).T
         sd[p + "self_attn.v_proj.weight"] = at["v_proj"]["kernel"].T
         sd[p + "self_attn.o_proj.weight"] = at["o_proj"]["kernel"].T
-        for nm, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", None)):
+        for nm, heads in (("q_proj", H), ("k_proj", KV), ("v_proj", None),
+                          ("o_proj", None)):
             if "bias" in at[nm]:
                 b = at[nm]["bias"]
                 if heads is not None:
@@ -231,6 +233,129 @@ def llama_config_from_hf(hf_cfg, **overrides):
                             or hf_cfg.model_type == "qwen2"),
         sliding_window=getattr(hf_cfg, "sliding_window", None)
         if getattr(hf_cfg, "use_sliding_window", True) else None,
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# qwen (v1) — the ORIGINAL Qwen architecture (QWenLMHeadModel, model_type
+# "qwen", shipped via trust_remote_code). Llama-shaped with: fused biased
+# c_attn (no GQA), unbiased c_proj attention output, and a swapped-gate MLP
+# (intermediate = w1(x) * silu(w2(x)), i.e. gate_proj = w2, up_proj = w1,
+# down_proj = c_proj, ff width = intermediate_size // 2). Reference policy:
+# ``deepspeed/module_inject/containers/qwen.py`` (DS_QWenContainer).
+# ---------------------------------------------------------------------------
+
+def qwen_to_flax(sd, cfg, scan_layers=True, dtype=np.float32):
+    """Qwen-v1 HF state dict -> our LlamaForCausalLM tree."""
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    L = cfg.num_hidden_layers
+    D = cfg.hidden_size
+
+    def g(name):
+        return sd[name].astype(dtype)
+
+    def layer(i):
+        p = f"transformer.h.{i}."
+        # c_attn: [3D, D] rows = q|k|v; Qwen applies rotate_half like HF
+        # llama, so the same qk permutation maps to our interleaved rotary
+        w = g(p + "attn.c_attn.weight")
+        b = g(p + "attn.c_attn.bias")
+        qw, kw, vw = (w[j * D:(j + 1) * D].T for j in range(3))
+        qb, kb, vb = (b[j * D:(j + 1) * D] for j in range(3))
+        attn = {
+            "q_proj": {"kernel": _permute_qk_out(qw, H, Dh),
+                       "bias": _permute_qk_out(qb, H, Dh)},
+            "k_proj": {"kernel": _permute_qk_out(kw, H, Dh),
+                       "bias": _permute_qk_out(kb, H, Dh)},
+            "v_proj": {"kernel": vw, "bias": vb},
+            "o_proj": {"kernel": g(p + "attn.c_proj.weight").T},
+        }
+        return {
+            "input_layernorm": {"scale": g(p + "ln_1.weight")},
+            "post_attention_layernorm": {"scale": g(p + "ln_2.weight")},
+            "self_attn": attn,
+            "mlp": {"gate_proj": {"kernel": g(p + "mlp.w2.weight").T},
+                    "up_proj": {"kernel": g(p + "mlp.w1.weight").T},
+                    "down_proj": {"kernel": g(p + "mlp.c_proj.weight").T}},
+        }
+
+    tree = {"embed_tokens": g("transformer.wte.weight"),
+            "norm": {"scale": g("transformer.ln_f.weight")},
+            "lm_head": g("lm_head.weight")}
+    layers = [layer(i) for i in range(L)]
+    if scan_layers:
+        import jax
+        tree["layers"] = {"block": jax.tree.map(lambda *xs: _stack(xs), *layers)}
+    else:
+        for i, l in enumerate(layers):
+            tree[f"layers_{i}"] = l
+    return tree
+
+
+def qwen_from_flax(params, cfg, dtype=np.float32):
+    """Inverse of :func:`qwen_to_flax` -> Qwen-v1-named state dict."""
+    import jax
+    H, Dh = cfg.num_attention_heads, cfg.head_dim
+    L = cfg.num_hidden_layers
+    params = jax.tree.map(lambda x: np.asarray(x, dtype=dtype), params)
+
+    def layer_tree(i):
+        if "layers" in params:
+            return jax.tree.map(lambda x: x[i], params["layers"]["block"])
+        return params[f"layers_{i}"]
+
+    sd = {"transformer.wte.weight": params["embed_tokens"],
+          "transformer.ln_f.weight": params["norm"]["scale"],
+          "lm_head.weight": params["lm_head"]}
+    for i in range(L):
+        l = layer_tree(i)
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = l["input_layernorm"]["scale"]
+        sd[p + "ln_2.weight"] = l["post_attention_layernorm"]["scale"]
+        at = l["self_attn"]
+        qw = _permute_qk_out(at["q_proj"]["kernel"], H, Dh, inverse=True).T
+        kw = _permute_qk_out(at["k_proj"]["kernel"], H, Dh, inverse=True).T
+        vw = at["v_proj"]["kernel"].T
+        sd[p + "attn.c_attn.weight"] = np.concatenate([qw, kw, vw], axis=0)
+        qb = _permute_qk_out(at["q_proj"]["bias"], H, Dh, inverse=True)
+        kb = _permute_qk_out(at["k_proj"]["bias"], H, Dh, inverse=True)
+        sd[p + "attn.c_attn.bias"] = np.concatenate(
+            [qb, kb, at["v_proj"]["bias"]], axis=0)
+        sd[p + "attn.c_proj.weight"] = at["o_proj"]["kernel"].T
+        sd[p + "mlp.w2.weight"] = l["mlp"]["gate_proj"]["kernel"].T
+        sd[p + "mlp.w1.weight"] = l["mlp"]["up_proj"]["kernel"].T
+        sd[p + "mlp.c_proj.weight"] = l["mlp"]["down_proj"]["kernel"].T
+    return sd
+
+
+def qwen_config_from_json(raw, **overrides):
+    """Qwen-v1 config.json dict -> our LlamaConfig. NTK/log-n attention
+    extrapolation (use_dynamic_ntk / use_logn_attn) is identity within the
+    native seq_length window, which is what max_position_embeddings is set
+    to; beyond-window extrapolation is not represented."""
+    from deepspeed_tpu.models.llama import LlamaConfig
+    if not raw.get("no_bias", True):
+        raise UnsupportedModelError(
+            "qwen with no_bias=false (biased c_proj/mlp) not represented")
+    if raw.get("use_dynamic_ntk") or raw.get("use_logn_attn"):
+        logger.warning(
+            "qwen: use_dynamic_ntk/use_logn_attn are identity within the "
+            "native seq_length window; beyond-window extrapolation is not "
+            "represented (max_position_embeddings capped at seq_length)")
+    kw = dict(
+        vocab_size=raw["vocab_size"],
+        hidden_size=raw["hidden_size"],
+        intermediate_size=raw["intermediate_size"] // 2,
+        num_hidden_layers=raw["num_hidden_layers"],
+        num_attention_heads=raw["num_attention_heads"],
+        num_key_value_heads=raw["num_attention_heads"],
+        max_position_embeddings=raw.get("seq_length", 2048),
+        rms_norm_eps=raw.get("layer_norm_epsilon", 1e-6),
+        rope_theta=raw.get("rotary_emb_base", 10000.0),
+        head_dim=raw.get("kv_channels", None),
+        attention_bias=True,
     )
     kw.update(overrides)
     return LlamaConfig(**kw)
@@ -1067,6 +1192,38 @@ def load_pretrained(model_dir, dtype=np.float32, scan_layers=True):
 
     The model family is detected from ``config.json``; returns one of the
     in-tree flax models configured to match, with weights converted."""
+    # remote-code families (no transformers config class registered): read
+    # config.json directly — AutoConfig would demand trust_remote_code
+    raw_mt = detect_model_type(model_dir)
+    if raw_mt in ("qwen", "internlm"):
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        with open(os.path.join(model_dir, "config.json")) as f:
+            raw = json.load(f)
+        sd = load_state_dict(model_dir)
+        if raw_mt == "qwen":
+            cfg = qwen_config_from_json(raw, scan_layers=scan_layers)
+            return (LlamaForCausalLM(cfg),
+                    qwen_to_flax(sd, cfg, scan_layers=scan_layers, dtype=dtype))
+        # internlm (v1): llama naming with bias=True on q/k/v/o (reference
+        # container: deepspeed/module_inject/containers/internlm.py)
+        bias = bool(raw.get("bias", True))
+        from deepspeed_tpu.models.llama import LlamaConfig
+        cfg = LlamaConfig(
+            vocab_size=raw["vocab_size"], hidden_size=raw["hidden_size"],
+            intermediate_size=raw["intermediate_size"],
+            num_hidden_layers=raw["num_hidden_layers"],
+            num_attention_heads=raw["num_attention_heads"],
+            num_key_value_heads=raw.get("num_key_value_heads",
+                                        raw["num_attention_heads"]),
+            max_position_embeddings=raw.get("max_position_embeddings", 2048),
+            rms_norm_eps=raw.get("rms_norm_eps", 1e-6),
+            rope_theta=raw.get("rope_theta", 10000.0),
+            head_dim=raw.get("head_dim", None),  # export_pretrained writes
+            # this for nonstandard head dims; reload must honor it
+            attention_bias=bias, attention_out_bias=bias,
+            scan_layers=scan_layers)
+        return (LlamaForCausalLM(cfg),
+                llama_to_flax(sd, cfg, scan_layers=scan_layers, dtype=dtype))
     import transformers
     hf_cfg = transformers.AutoConfig.from_pretrained(model_dir)
     sd = load_state_dict(model_dir)
@@ -1308,6 +1465,9 @@ def export_pretrained(params, cfg, save_dir, dtype=np.float32):
         # attention would silently diverge past the window), qkv-bias => qwen2
         if cfg.sliding_window:
             mt, arch = "mistral", "MistralForCausalLM"
+        elif cfg.attention_out_bias:
+            # q/k/v/o all biased => InternLM lineage (remote-code family)
+            mt, arch = "internlm", "InternLMForCausalLM"
         elif cfg.attention_bias:
             mt, arch = "qwen2", "Qwen2ForCausalLM"
         else:
@@ -1326,7 +1486,9 @@ def export_pretrained(params, cfg, save_dir, dtype=np.float32):
                                   np.dtype(dtype), "bfloat16")}
         if cfg.sliding_window:
             hf["sliding_window"] = int(cfg.sliding_window)
-        if mt != "qwen2":
+        if mt == "internlm":
+            hf["bias"] = True
+        elif mt != "qwen2":
             hf["attention_bias"] = cfg.attention_bias
         if cfg.head_dim != cfg.hidden_size // cfg.num_attention_heads:
             hf["head_dim"] = int(cfg.head_dim)

@@ -14,7 +14,8 @@ from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.utils.logging import logger
 
 SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral", "falcon", "phi",
-                      "opt")
+                      "opt", "qwen", "internlm")  # qwen(v1)/internlm load as
+                                                  # llama trees (hf.py)
 
 
 def build_hf_engine(path, engine_config=None, dtype=None):

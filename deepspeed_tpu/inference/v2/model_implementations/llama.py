@@ -136,7 +136,10 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
         out = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len,
                                window=cfg.sliding_window,
                                prefer=module_preference(cfg, "attention"))
-        x = x + out.reshape(S, Q, H * Dh) @ attn["o_proj"]["kernel"].astype(cfg.dtype)
+        o = out.reshape(S, Q, H * Dh) @ attn["o_proj"]["kernel"].astype(cfg.dtype)
+        if "bias" in attn["o_proj"]:   # InternLM-family o bias
+            o = o + attn["o_proj"]["bias"].astype(cfg.dtype)
+        x = x + o
         mlp = lp["mlp"]
         h = _rmsnorm(x, lp["post_attention_layernorm"]["scale"], cfg.rms_norm_eps)
         gate = jax.nn.silu(h @ mlp["gate_proj"]["kernel"].astype(cfg.dtype))

@@ -1,0 +1,159 @@
+"""Diffusers UNet building blocks, TPU-native (VERDICT r4 #9).
+
+Capability analog of the reference's diffusers serving path
+(``deepspeed/model_implementations/diffusers/unet.py`` wraps the torch UNet
+with cuda-graph replay; the spatial CUDA kernels live in ``csrc/spatial`` and
+``deepspeed/ops/transformer/inference/bias_add.py``). Here the blocks are
+pure JAX functions over a diffusers-layout parameter dict:
+
+- ``resnet_block_2d`` — GroupNorm→SiLU→Conv3x3→(+time emb)→GroupNorm→SiLU→
+  Conv3x3 + skip, via the fused spatial ops (``ops/spatial.py``:
+  ``bias_groupnorm``/``nhwc_bias_add`` — XLA fuses the elementwise chains the
+  reference hand-writes in CUDA).
+- ``basic_transformer_block`` / ``transformer_2d`` — diffusers
+  BasicTransformerBlock/Transformer2DModel: LayerNorm → self-attention
+  (through ``ops/flash_attention.mha``, non-causal) → optional
+  cross-attention → GEGLU feed-forward (``ops/spatial.bias_geglu``).
+
+Weights use the DIFFUSERS state-dict naming and layouts (conv kernels OIHW,
+linear [out, in]); ``convert_diffusers_weights`` maps them to the NHWC/HWIO
+forms these functions consume, so a real UNet block's tensors drop in. Data
+layout is NHWC throughout — the TPU-native convolution layout.
+"""
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.flash_attention import mha
+from deepspeed_tpu.ops.spatial import bias_geglu, bias_groupnorm, nhwc_bias_add
+
+
+# ---------------------------------------------------------------- weights
+
+def convert_diffusers_weights(sd, prefix="") -> Dict[str, Any]:
+    """Torch diffusers state dict (numpy arrays) -> NHWC/HWIO param dict.
+
+    Conv weights [O, I, kH, kW] -> [kH, kW, I, O]; linear weights [out, in]
+    -> [in, out]; biases/norm affines pass through. Keys keep the diffusers
+    dotted names (e.g. ``conv1.weight``) so block code reads naturally.
+    """
+    out = {}
+    for k, v in sd.items():
+        if prefix and not k.startswith(prefix):
+            continue
+        name = k[len(prefix):]
+        v = np.asarray(v, np.float32)
+        if name.endswith(".weight") and v.ndim == 4:
+            v = v.transpose(2, 3, 1, 0)          # OIHW -> HWIO
+        elif name.endswith(".weight") and v.ndim == 2:
+            v = v.T                               # [out,in] -> [in,out]
+        out[name] = jnp.asarray(v)
+    return out
+
+
+def _conv(x, w, b, stride=1):
+    pad = (w.shape[0] - 1) // 2
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return nhwc_bias_add(y, b)
+
+
+# ---------------------------------------------------------------- resnet
+
+def resnet_block_2d(p, x, temb, groups=32, eps=1e-5):
+    """Diffusers ResnetBlock2D forward. x: [N, H, W, C_in], temb: [N, T].
+
+    Weight keys (diffusers naming): norm1/conv1/time_emb_proj/norm2/conv2
+    [+ conv_shortcut when C_in != C_out].
+    """
+    h = bias_groupnorm(x, p["norm1.weight"], p["norm1.bias"], groups, eps)
+    h = _conv(jax.nn.silu(h), p["conv1.weight"], p["conv1.bias"])
+    if temb is not None and "time_emb_proj.weight" in p:
+        t = jax.nn.silu(temb) @ p["time_emb_proj.weight"] + \
+            p["time_emb_proj.bias"]
+        h = h + t[:, None, None, :]
+    h = bias_groupnorm(h, p["norm2.weight"], p["norm2.bias"], groups, eps)
+    h = _conv(jax.nn.silu(h), p["conv2.weight"], p["conv2.bias"])
+    if "conv_shortcut.weight" in p:
+        x = _conv(x, p["conv_shortcut.weight"], p["conv_shortcut.bias"])
+    return x + h
+
+
+# ---------------------------------------------------------------- attention
+
+def _layernorm(x, w, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def _attention(p, prefix, x, context, heads):
+    """Diffusers Attention: to_q/to_k/to_v (no bias) + to_out.0 (bias);
+    non-causal, through the shared mha op (flash kernel when eligible)."""
+    ctx = x if context is None else context
+    B, Tq, D = x.shape
+    Tk = ctx.shape[1]
+    dh = D // heads
+    q = (x @ p[prefix + "to_q.weight"]).reshape(B, Tq, heads, dh)
+    k = (ctx @ p[prefix + "to_k.weight"]).reshape(B, Tk, heads, dh)
+    v = (ctx @ p[prefix + "to_v.weight"]).reshape(B, Tk, heads, dh)
+    out = mha(q, k, v, causal=False).reshape(B, Tq, D)
+    return out @ p[prefix + "to_out.0.weight"] + p[prefix + "to_out.0.bias"]
+
+
+def basic_transformer_block(p, x, context=None, heads=8):
+    """Diffusers BasicTransformerBlock: norm1→attn1 (self), norm2→attn2
+    (cross; attends to x when context is None, as diffusers does), norm3→
+    GEGLU ff (ff.net.0.proj + ff.net.2)."""
+    h = _layernorm(x, p["norm1.weight"], p["norm1.bias"])
+    x = x + _attention(p, "attn1.", h, None, heads)
+    if "attn2.to_q.weight" in p:
+        h = _layernorm(x, p["norm2.weight"], p["norm2.bias"])
+        x = x + _attention(p, "attn2.", h, context, heads)
+    h = _layernorm(x, p["norm3.weight"], p["norm3.bias"])
+    h = bias_geglu(h @ p["ff.net.0.proj.weight"], p["ff.net.0.proj.bias"])
+    return x + (h @ p["ff.net.2.weight"] + p["ff.net.2.bias"])
+
+
+def transformer_2d(p, x, context=None, heads=8, groups=32, eps=1e-6,
+                   num_layers=1):
+    """Diffusers Transformer2DModel (linear-projection variant): GroupNorm →
+    proj_in → spatial tokens → blocks → proj_out + residual.
+    x: [N, H, W, C]."""
+    N, H, W, C = x.shape
+    res = x
+    h = bias_groupnorm(x, p["norm.weight"], p["norm.bias"], groups, eps)
+    h = h.reshape(N, H * W, C)
+    h = h @ p["proj_in.weight"] + p["proj_in.bias"]
+    for i in range(num_layers):
+        blk = {k[len(f"transformer_blocks.{i}."):]: v for k, v in p.items()
+               if k.startswith(f"transformer_blocks.{i}.")}
+        h = basic_transformer_block(blk, h, context=context, heads=heads)
+    h = h @ p["proj_out.weight"] + p["proj_out.bias"]
+    return h.reshape(N, H, W, C) + res
+
+
+# ---------------------------------------------------------------- unet block
+
+def unet_down_block(p, x, temb, context=None, *, heads=8, groups=32,
+                    num_resnets=1, has_attention=True):
+    """One diffusers CrossAttnDownBlock2D-style step: resnet(s) + spatial
+    transformer(s). ``context``: encoder hidden states ([N, Tctx, Dctx]) for
+    the blocks' cross-attention (attn2); None = self-attention configuration.
+    Parameter keys: resnets.{i}.*, attentions.{i}.*."""
+    for i in range(num_resnets):
+        rp = {k[len(f"resnets.{i}."):]: v for k, v in p.items()
+              if k.startswith(f"resnets.{i}.")}
+        x = resnet_block_2d(rp, x, temb, groups=groups)
+        if has_attention:
+            ap = {k[len(f"attentions.{i}."):]: v for k, v in p.items()
+                  if k.startswith(f"attentions.{i}.")}
+            x = transformer_2d(ap, x, context=context, heads=heads,
+                               groups=groups)
+    return x

@@ -31,6 +31,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     attention_bias: bool = False      # qkv bias (Qwen2-family)
+    attention_out_bias: bool = False  # o_proj bias too (InternLM-family)
     sliding_window: Any = None        # local-window attention (Mistral-family)
     # None/"flash": the Pallas flash kernel (XLA fallback). "ring": blockwise
     # context parallelism over the sp mesh axis (ops/ring_attention.py) — K/V
@@ -191,7 +192,7 @@ class LlamaAttention(nn.Module):
             out = mha(q, k, v, causal=True,
                       window=cfg.sliding_window or None)
         out = out.reshape(B, T, H * Dh)
-        return dense(D, "o_proj")(out)
+        return dense(D, "o_proj", cfg.attention_out_bias)(out)
 
 
 class LlamaMLP(nn.Module):

@@ -1,0 +1,197 @@
+"""Diffusers UNet block parity (VERDICT r4 #9): the JAX NHWC blocks in
+models/diffusion.py must reproduce a hand-rolled torch NCHW implementation
+of the same diffusers modules (ResnetBlock2D, BasicTransformerBlock,
+Transformer2DModel) from the SAME diffusers-layout state dict — the oracle
+covers the OIHW->HWIO / [out,in]->[in,out] conversions, GroupNorm semantics,
+GEGLU, and the attention head layout in one shot."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from deepspeed_tpu.models.diffusion import (  # noqa: E402
+    convert_diffusers_weights, resnet_block_2d, transformer_2d,
+    unet_down_block)
+
+
+def _t(v):
+    return torch.from_numpy(np.asarray(v, np.float32))
+
+
+# ------------------------------------------------------------ torch oracle
+
+def torch_resnet(sd, pre, x, temb, groups, eps=1e-5):
+    h = F.group_norm(x, groups, _t(sd[pre + "norm1.weight"]),
+                     _t(sd[pre + "norm1.bias"]), eps)
+    h = F.conv2d(F.silu(h), _t(sd[pre + "conv1.weight"]),
+                 _t(sd[pre + "conv1.bias"]), padding=1)
+    t = F.linear(F.silu(temb), _t(sd[pre + "time_emb_proj.weight"]),
+                 _t(sd[pre + "time_emb_proj.bias"]))
+    h = h + t[:, :, None, None]
+    h = F.group_norm(h, groups, _t(sd[pre + "norm2.weight"]),
+                     _t(sd[pre + "norm2.bias"]), eps)
+    h = F.conv2d(F.silu(h), _t(sd[pre + "conv2.weight"]),
+                 _t(sd[pre + "conv2.bias"]), padding=1)
+    if pre + "conv_shortcut.weight" in sd:
+        x = F.conv2d(x, _t(sd[pre + "conv_shortcut.weight"]),
+                     _t(sd[pre + "conv_shortcut.bias"]))
+    return x + h
+
+
+def torch_attention(sd, pre, x, ctx, heads):
+    B, T, D = x.shape
+    dh = D // heads
+    ctx = x if ctx is None else ctx
+    q = F.linear(x, _t(sd[pre + "to_q.weight"]))
+    k = F.linear(ctx, _t(sd[pre + "to_k.weight"]))
+    v = F.linear(ctx, _t(sd[pre + "to_v.weight"]))
+    q = q.reshape(B, -1, heads, dh).transpose(1, 2)
+    k = k.reshape(B, -1, heads, dh).transpose(1, 2)
+    v = v.reshape(B, -1, heads, dh).transpose(1, 2)
+    o = F.scaled_dot_product_attention(q, k, v)
+    o = o.transpose(1, 2).reshape(B, T, D)
+    return F.linear(o, _t(sd[pre + "to_out.0.weight"]),
+                    _t(sd[pre + "to_out.0.bias"]))
+
+
+def torch_block(sd, pre, x, ctx, heads):
+    h = F.layer_norm(x, (x.shape[-1],), _t(sd[pre + "norm1.weight"]),
+                     _t(sd[pre + "norm1.bias"]))
+    x = x + torch_attention(sd, pre + "attn1.", h, None, heads)
+    h = F.layer_norm(x, (x.shape[-1],), _t(sd[pre + "norm2.weight"]),
+                     _t(sd[pre + "norm2.bias"]))
+    x = x + torch_attention(sd, pre + "attn2.", h, ctx, heads)
+    h = F.layer_norm(x, (x.shape[-1],), _t(sd[pre + "norm3.weight"]),
+                     _t(sd[pre + "norm3.bias"]))
+    h = F.linear(h, _t(sd[pre + "ff.net.0.proj.weight"]),
+                 _t(sd[pre + "ff.net.0.proj.bias"]))
+    lin, gate = h.chunk(2, dim=-1)
+    h = lin * F.gelu(gate, approximate="tanh")
+    return x + F.linear(h, _t(sd[pre + "ff.net.2.weight"]),
+                        _t(sd[pre + "ff.net.2.bias"]))
+
+
+def torch_transformer2d(sd, pre, x, ctx, heads, groups, eps=1e-6):
+    N, C, H, W = x.shape
+    res = x
+    h = F.group_norm(x, groups, _t(sd[pre + "norm.weight"]),
+                     _t(sd[pre + "norm.bias"]), eps)
+    h = h.permute(0, 2, 3, 1).reshape(N, H * W, C)
+    h = F.linear(h, _t(sd[pre + "proj_in.weight"]),
+                 _t(sd[pre + "proj_in.bias"]))
+    h = torch_block(sd, pre + "transformer_blocks.0.", h, ctx, heads)
+    h = F.linear(h, _t(sd[pre + "proj_out.weight"]),
+                 _t(sd[pre + "proj_out.bias"]))
+    return h.reshape(N, H, W, C).permute(0, 3, 1, 2) + res
+
+
+# ------------------------------------------------------------ state dicts
+
+def make_resnet_sd(rng, pre, cin, cout, temb_dim):
+    n = lambda *s: rng.normal(0, 0.1, s).astype(np.float32)
+    sd = {pre + "norm1.weight": 1 + 0.1 * n(cin), pre + "norm1.bias": n(cin),
+          pre + "conv1.weight": n(cout, cin, 3, 3), pre + "conv1.bias": n(cout),
+          pre + "time_emb_proj.weight": n(cout, temb_dim),
+          pre + "time_emb_proj.bias": n(cout),
+          pre + "norm2.weight": 1 + 0.1 * n(cout), pre + "norm2.bias": n(cout),
+          pre + "conv2.weight": n(cout, cout, 3, 3), pre + "conv2.bias": n(cout)}
+    if cin != cout:
+        sd[pre + "conv_shortcut.weight"] = n(cout, cin, 1, 1)
+        sd[pre + "conv_shortcut.bias"] = n(cout)
+    return sd
+
+
+def make_attn_sd(rng, pre, d, dctx, ff_mult=2):
+    n = lambda *s: rng.normal(0, 0.1, s).astype(np.float32)
+    sd = {}
+    for a, src in (("attn1.", d), ("attn2.", dctx)):
+        sd.update({pre + a + "to_q.weight": n(d, d),
+                   pre + a + "to_k.weight": n(d, src),
+                   pre + a + "to_v.weight": n(d, src),
+                   pre + a + "to_out.0.weight": n(d, d),
+                   pre + a + "to_out.0.bias": n(d)})
+    for i in (1, 2, 3):
+        sd[pre + f"norm{i}.weight"] = 1 + 0.1 * n(d)
+        sd[pre + f"norm{i}.bias"] = n(d)
+    sd[pre + "ff.net.0.proj.weight"] = n(2 * ff_mult * d, d)
+    sd[pre + "ff.net.0.proj.bias"] = n(2 * ff_mult * d)
+    sd[pre + "ff.net.2.weight"] = n(d, ff_mult * d)
+    sd[pre + "ff.net.2.bias"] = n(d)
+    return sd
+
+
+def make_t2d_sd(rng, pre, c, dctx, heads):
+    n = lambda *s: rng.normal(0, 0.1, s).astype(np.float32)
+    sd = {pre + "norm.weight": 1 + 0.1 * n(c), pre + "norm.bias": n(c),
+          pre + "proj_in.weight": n(c, c), pre + "proj_in.bias": n(c),
+          pre + "proj_out.weight": n(c, c), pre + "proj_out.bias": n(c)}
+    sd.update(make_attn_sd(rng, pre + "transformer_blocks.0.", c, dctx))
+    return sd
+
+
+# ----------------------------------------------------------------- tests
+
+def test_resnet_block_matches_torch():
+    rng = np.random.default_rng(0)
+    cin, cout, groups, temb_dim = 8, 16, 4, 12
+    sd = make_resnet_sd(rng, "", cin, cout, temb_dim)
+    x = rng.normal(size=(2, cin, 6, 6)).astype(np.float32)     # NCHW
+    temb = rng.normal(size=(2, temb_dim)).astype(np.float32)
+    want = torch_resnet(sd, "", _t(x), _t(temb), groups).numpy()
+    p = convert_diffusers_weights(sd)
+    got = np.asarray(resnet_block_2d(
+        p, jnp.asarray(x.transpose(0, 2, 3, 1)), jnp.asarray(temb),
+        groups=groups))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("cross", [False, True])
+def test_transformer2d_matches_torch(cross):
+    """cross=False: attn2 attends to hidden states (cross_attention_dim is
+    the model dim, context None — diffusers' self-only configuration);
+    cross=True: real encoder context of a different width."""
+    rng = np.random.default_rng(1)
+    c, heads, groups = 16, 4, 4
+    dctx = 24 if cross else c
+    sd = make_t2d_sd(rng, "", c, dctx, heads)
+    x = rng.normal(size=(2, c, 4, 4)).astype(np.float32)
+    context = rng.normal(size=(2, 5, dctx)).astype(np.float32) if cross \
+        else None
+    p = convert_diffusers_weights(sd)
+    tctx = None if context is None else _t(context)
+    want = torch_transformer2d(sd, "", _t(x), tctx, heads, groups).numpy()
+    jctx = None if context is None else jnp.asarray(context)
+    got = np.asarray(transformer_2d(
+        p, jnp.asarray(x.transpose(0, 2, 3, 1)), context=jctx,
+        heads=heads, groups=groups))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_unet_down_block_end_to_end():
+    """resnet + spatial transformer chained — the UNet down-block shape —
+    against the composed torch oracle."""
+    rng = np.random.default_rng(2)
+    c, heads, groups, temb_dim = 16, 4, 4, 12
+    sd = {}
+    sd.update(make_resnet_sd(rng, "resnets.0.", c, c, temb_dim))
+    # attn2 in self-configuration (cross dim == model dim, no context)
+    sd.update(make_t2d_sd(rng, "attentions.0.", c, c, heads))
+    x = rng.normal(size=(1, c, 8, 8)).astype(np.float32)
+    temb = rng.normal(size=(1, temb_dim)).astype(np.float32)
+
+    h = torch_resnet(sd, "resnets.0.", _t(x), _t(temb), groups)
+    want = torch_transformer2d(sd, "attentions.0.", h, None, heads,
+                               groups).numpy()
+
+    p = convert_diffusers_weights(sd)
+    got = np.asarray(unet_down_block(
+        p, jnp.asarray(x.transpose(0, 2, 3, 1)), jnp.asarray(temb),
+        heads=heads, groups=groups))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               atol=3e-4, rtol=1e-3)

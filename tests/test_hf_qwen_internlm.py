@@ -1,0 +1,265 @@
+"""Qwen-v1 + InternLM interop (VERDICT r4 #9).
+
+Both are trust_remote_code families — no transformers model class exists in
+this image — so the logits oracle is a compact hand-rolled torch
+implementation of each architecture (matching the public modeling_qwen.py /
+modeling_internlm.py math: RMSNorm, rotate_half rotary, causal attention,
+Qwen's swapped-gate MLP w1(x)*silu(w2(x)), InternLM's biased q/k/v/o).
+Reference policies: deepspeed/module_inject/containers/{qwen,internlm}.py.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.checkpoint import hf as hf_interop
+
+
+def _rms(x, w, eps):
+    v = x.pow(2).mean(-1, keepdim=True)
+    return x * torch.rsqrt(v + eps) * w
+
+
+def _rotate_half(x):
+    h = x.shape[-1] // 2
+    return torch.cat([-x[..., h:], x[..., :h]], dim=-1)
+
+
+def _rope(q, k, base):
+    # [B, T, H, Dh] neox-style rotate_half, matching HF llama / qwen-v1
+    Dh = q.shape[-1]
+    T = q.shape[1]
+    inv = 1.0 / (base ** (torch.arange(0, Dh, 2).float() / Dh))
+    freqs = torch.outer(torch.arange(T).float(), inv)
+    emb = torch.cat([freqs, freqs], dim=-1)
+    cos, sin = emb.cos()[None, :, None, :], emb.sin()[None, :, None, :]
+    return q * cos + _rotate_half(q) * sin, k * cos + _rotate_half(k) * sin
+
+
+def _causal_attention(q, k, v):
+    B, T, H, Dh = q.shape
+    att = torch.einsum("bqhd,bkhd->bhqk", q, k) / (Dh ** 0.5)
+    mask = torch.triu(torch.ones(T, T, dtype=torch.bool), 1)
+    att = att.masked_fill(mask, float("-inf")).softmax(-1)
+    return torch.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, H * Dh)
+
+
+def _write_ckpt(tmp_path, sd, cfg_json):
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    hf_interop.save_safetensors(
+        {k: np.asarray(v, np.float32) for k, v in sd.items()}, str(d))
+    (d / "config.json").write_text(json.dumps(cfg_json))
+    return str(d)
+
+
+# ---------------------------------------------------------------- qwen v1
+
+def _qwen_reference(sd, cfg, ids):
+    D, H = cfg["hidden_size"], cfg["num_attention_heads"]
+    Dh = D // H
+    eps, base = cfg["layer_norm_epsilon"], cfg["rotary_emb_base"]
+    t = {k: torch.from_numpy(np.asarray(v, np.float32)) for k, v in sd.items()}
+    x = t["transformer.wte.weight"][torch.from_numpy(ids).long()]
+    B, T = ids.shape
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"transformer.h.{i}."
+        h = _rms(x, t[p + "ln_1.weight"], eps)
+        qkv = h @ t[p + "attn.c_attn.weight"].T + t[p + "attn.c_attn.bias"]
+        q, k, v = (s.reshape(B, T, H, Dh) for s in qkv.split(D, dim=-1))
+        q, k = _rope(q, k, base)
+        x = x + _causal_attention(q, k, v) @ t[p + "attn.c_proj.weight"].T
+        h = _rms(x, t[p + "ln_2.weight"], eps)
+        a1 = h @ t[p + "mlp.w1.weight"].T
+        a2 = h @ t[p + "mlp.w2.weight"].T
+        x = x + (a1 * torch.nn.functional.silu(a2)) @ t[p + "mlp.c_proj.weight"].T
+    x = _rms(x, t["transformer.ln_f.weight"], eps)
+    return (x @ t["lm_head.weight"].T).numpy()
+
+
+def _qwen_ckpt(rng, V=97, D=32, H=4, L=2, FF=64):
+    cfg = {"model_type": "qwen", "vocab_size": V, "hidden_size": D,
+           "num_attention_heads": H, "num_hidden_layers": L,
+           "intermediate_size": FF * 2, "layer_norm_epsilon": 1e-6,
+           "rotary_emb_base": 10000.0, "seq_length": 64, "no_bias": True}
+    n = lambda *s: rng.normal(0, 0.1, s).astype(np.float32)
+    sd = {"transformer.wte.weight": n(V, D),
+          "transformer.ln_f.weight": 1 + 0.1 * n(D),
+          "lm_head.weight": n(V, D)}
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        sd.update({p + "ln_1.weight": 1 + 0.1 * n(D),
+                   p + "ln_2.weight": 1 + 0.1 * n(D),
+                   p + "attn.c_attn.weight": n(3 * D, D),
+                   p + "attn.c_attn.bias": n(3 * D),
+                   p + "attn.c_proj.weight": n(D, D),
+                   p + "mlp.w1.weight": n(FF, D),
+                   p + "mlp.w2.weight": n(FF, D),
+                   p + "mlp.c_proj.weight": n(D, FF)})
+    return sd, cfg
+
+
+def test_qwen_v1_exact_logits(tmp_path):
+    rng = np.random.default_rng(0)
+    sd, cfg = _qwen_ckpt(rng)
+    d = _write_ckpt(tmp_path, sd, cfg)
+    model, params = hf_interop.load_pretrained(d)
+    assert model.config.attention_bias and not model.config.attention_out_bias
+    assert model.config.intermediate_size == 64    # ff = intermediate // 2
+    fcfg = type(model.config)(**{**model.config.__dict__,
+                                 "dtype": jnp.float32, "remat": False})
+    ids = rng.integers(0, cfg["vocab_size"], size=(2, 12)).astype(np.int32)
+    ours = np.asarray(type(model)(fcfg).apply({"params": params},
+                                              {"input_ids": ids}), np.float32)
+    ref = _qwen_reference(sd, cfg, ids)
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=1e-3)
+
+
+def test_qwen_v1_roundtrip_exact(tmp_path):
+    rng = np.random.default_rng(1)
+    sd, cfg = _qwen_ckpt(rng)
+    d = _write_ckpt(tmp_path, sd, cfg)
+    model, params = hf_interop.load_pretrained(d)
+    back = hf_interop.qwen_from_flax(params, model.config)
+    assert set(back) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(back[k], sd[k], err_msg=k)
+
+
+# ---------------------------------------------------------------- internlm
+
+def _internlm_reference(sd, cfg, ids):
+    D, H = cfg["hidden_size"], cfg["num_attention_heads"]
+    Dh = D // H
+    eps = cfg["rms_norm_eps"]
+    t = {k: torch.from_numpy(np.asarray(v, np.float32)) for k, v in sd.items()}
+    x = t["model.embed_tokens.weight"][torch.from_numpy(ids).long()]
+    B, T = ids.shape
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        h = _rms(x, t[p + "input_layernorm.weight"], eps)
+        lin = lambda nm: h @ t[p + nm + ".weight"].T + t[p + nm + ".bias"]
+        q = lin("self_attn.q_proj").reshape(B, T, H, Dh)
+        k = lin("self_attn.k_proj").reshape(B, T, H, Dh)
+        v = lin("self_attn.v_proj").reshape(B, T, H, Dh)
+        q, k = _rope(q, k, 10000.0)
+        o = _causal_attention(q, k, v)
+        x = x + o @ t[p + "self_attn.o_proj.weight"].T + \
+            t[p + "self_attn.o_proj.bias"]
+        h = _rms(x, t[p + "post_attention_layernorm.weight"], eps)
+        gate = torch.nn.functional.silu(h @ t[p + "mlp.gate_proj.weight"].T)
+        up = h @ t[p + "mlp.up_proj.weight"].T
+        x = x + (gate * up) @ t[p + "mlp.down_proj.weight"].T
+    x = _rms(x, t["model.norm.weight"], eps)
+    return (x @ t["lm_head.weight"].T).numpy()
+
+
+def _internlm_ckpt(rng, V=97, D=32, H=4, L=2, FF=64):
+    cfg = {"model_type": "internlm", "vocab_size": V, "hidden_size": D,
+           "num_attention_heads": H, "num_hidden_layers": L,
+           "intermediate_size": FF, "rms_norm_eps": 1e-6, "bias": True,
+           "max_position_embeddings": 64}
+    n = lambda *s: rng.normal(0, 0.1, s).astype(np.float32)
+    sd = {"model.embed_tokens.weight": n(V, D),
+          "model.norm.weight": 1 + 0.1 * n(D),
+          "lm_head.weight": n(V, D)}
+    for i in range(L):
+        p = f"model.layers.{i}."
+        # HF llama q/k weights are stored in rotate_half layout; the
+        # permuted import handles that — our synthetic dict IS that layout
+        sd.update({p + "input_layernorm.weight": 1 + 0.1 * n(D),
+                   p + "post_attention_layernorm.weight": 1 + 0.1 * n(D)})
+        for nm in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[p + f"self_attn.{nm}.weight"] = n(D, D)
+            sd[p + f"self_attn.{nm}.bias"] = n(D)
+        sd.update({p + "mlp.gate_proj.weight": n(FF, D),
+                   p + "mlp.up_proj.weight": n(FF, D),
+                   p + "mlp.down_proj.weight": n(D, FF)})
+    return sd, cfg
+
+
+def test_internlm_exact_logits(tmp_path):
+    rng = np.random.default_rng(2)
+    sd, cfg = _internlm_ckpt(rng)
+    d = _write_ckpt(tmp_path, sd, cfg)
+    model, params = hf_interop.load_pretrained(d)
+    assert model.config.attention_bias and model.config.attention_out_bias
+    fcfg = type(model.config)(**{**model.config.__dict__,
+                                 "dtype": jnp.float32, "remat": False})
+    ids = rng.integers(0, cfg["vocab_size"], size=(2, 12)).astype(np.int32)
+    ours = np.asarray(type(model)(fcfg).apply({"params": params},
+                                              {"input_ids": ids}), np.float32)
+    ref = _internlm_reference(sd, cfg, ids)
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=1e-3)
+
+
+def test_internlm_export_roundtrip(tmp_path):
+    """Our tree -> internlm layout -> reload -> identical logits; config
+    carries model_type internlm + bias."""
+    rng = np.random.default_rng(3)
+    sd, cfg = _internlm_ckpt(rng)
+    d = _write_ckpt(tmp_path, sd, cfg)
+    model, params = hf_interop.load_pretrained(d)
+
+    out = tmp_path / "export"
+    hf_interop.export_pretrained(params, model.config, str(out))
+    with open(out / "config.json") as f:
+        exported = json.load(f)
+    assert exported["model_type"] == "internlm" and exported["bias"] is True
+
+    model2, params2 = hf_interop.load_pretrained(str(out))
+    ids = rng.integers(0, cfg["vocab_size"], size=(1, 9)).astype(np.int32)
+    fcfg = type(model.config)(**{**model.config.__dict__,
+                                 "dtype": jnp.float32, "remat": False})
+    a = np.asarray(type(model)(fcfg).apply({"params": params},
+                                           {"input_ids": ids}), np.float32)
+    b = np.asarray(type(model)(fcfg).apply({"params": params2},
+                                           {"input_ids": ids}), np.float32)
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_internlm_serves_through_v2(tmp_path):
+    """The ragged engine applies the o_proj bias (InternLM path): last-token
+    serving logits match the training forward."""
+    rng = np.random.default_rng(4)
+    sd, cfg = _internlm_ckpt(rng)
+    d = _write_ckpt(tmp_path, sd, cfg)
+    model, params = hf_interop.load_pretrained(d)
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    fcfg = type(model.config)(**{**model.config.__dict__,
+                                 "dtype": jnp.float32, "remat": False})
+    fmodel = type(model)(fcfg)
+    engine = InferenceEngineV2(fmodel, params, config={
+        "state_manager": {"max_ragged_sequence_count": 2,
+                          "max_ragged_batch_size": 16,
+                          "max_context": 64, "num_kv_blocks": 32},
+        "kv_cache": {"block_size": 8, "cache_dtype": "fp32"}})
+    prompt = rng.integers(0, cfg["vocab_size"], size=12).astype(np.int32)
+    served = engine.put([0], [prompt])[0]
+    train = np.asarray(fmodel.apply(
+        {"params": params}, {"input_ids": prompt[None]}), np.float32)[0, -1]
+    np.testing.assert_allclose(served, train, atol=1e-3, rtol=1e-3)
+
+
+def test_internlm_through_factory(tmp_path):
+    """build_hf_engine must accept the new families (factory gate)."""
+    rng = np.random.default_rng(5)
+    sd, cfg = _internlm_ckpt(rng)
+    d = _write_ckpt(tmp_path, sd, cfg)
+    from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+    engine = build_hf_engine(d, engine_config={
+        "state_manager": {"max_ragged_sequence_count": 2,
+                          "max_ragged_batch_size": 16,
+                          "max_context": 64, "num_kv_blocks": 32},
+        "kv_cache": {"block_size": 8, "cache_dtype": "fp32"}},
+        dtype=np.float32)
+    prompt = rng.integers(0, cfg["vocab_size"], size=7).astype(np.int32)
+    logits = engine.put([0], [prompt])
+    assert logits.shape == (1, cfg["vocab_size"])
+    assert np.isfinite(logits).all()
