@@ -1,0 +1,250 @@
+"""Chip-free real-Mosaic compile validation + compile-cache prewarm
+(VERDICT r4 #2/#3).
+
+``jax.experimental.topologies.get_topology_desc("v5e:2x2")`` exposes the
+REAL XLA:TPU + Mosaic compiler for "TPU v5 lite" locally — no chip, no axon
+tunnel. This script compiles every Pallas kernel at the on-chip smoke's
+exact shapes (``scripts/tpu_kernel_smoke.py``) plus the flagship train
+steps, which:
+
+1. catches the whole lowering-failure class interpret-mode tests miss —
+   round 2's (8,128)-tiling violations only surfaced on silicon; now they
+   surface here, with the chip untouched;
+2. measures true compile times per program, calibrating the on-chip smoke's
+   per-kernel timeout (round 4's wedge was an axe set below flash-bwd's
+   real compile time);
+3. writes the executables into JAX_COMPILATION_CACHE_DIR (default: the
+   repo's .jax_cache, the same directory ``onchip_sequence.sh`` exports) —
+   when the live backend's cache key matches (same libtpu target config),
+   on-chip runs load instead of compiling and never hold the chip through
+   a cold Mosaic compile.
+
+Usage:
+    python scripts/aot_tpu_check.py [--full]   # --full adds train steps
+Output: one JSON line + onchip_results/aot_check.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # host platform; compiles target TPU
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def _topology():
+    from jax.experimental import topologies
+    return topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+
+
+def kernel_programs():
+    """(name, build() -> (fn, abstract_args)) at the smoke's exact shapes."""
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_mha
+
+    B, T, H, Dh = 2, 512, 4, 64
+    qkv = tuple(jax.ShapeDtypeStruct((B, T, H, Dh), jnp.bfloat16)
+                for _ in range(3))
+
+    def flash_fwd():
+        return (lambda q, k, v: flash_mha(q, k, v, causal=True)), qkv
+
+    def flash_bwd():
+        def loss(q, k, v):
+            return jnp.sum(flash_mha(q, k, v, causal=True)
+                           .astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2)), qkv
+
+    def flash_window_fwd():
+        return (lambda q, k, v: flash_mha(q, k, v, causal=True,
+                                          window=128)), qkv
+
+    def flash_window_bwd():
+        def loss(q, k, v):
+            return jnp.sum(flash_mha(q, k, v, causal=True, window=128)
+                           .astype(jnp.float32) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2)), qkv
+
+    def flash_segments_fwd():
+        seg = jax.ShapeDtypeStruct((B, T), jnp.int32)
+        return (lambda q, k, v, s: flash_mha(q, k, v, causal=True,
+                                             segment_ids=(s, s))), qkv + (seg,)
+
+    def paged():
+        from deepspeed_tpu.ops.pallas.paged_attention import paged_mha
+        S, Q, H, KV, Dh, NB, bs, MB = 3, 2, 4, 2, 64, 10, 16, 4
+        args = (jax.ShapeDtypeStruct((S, Q, H, Dh), jnp.bfloat16),
+                jax.ShapeDtypeStruct((NB, KV, bs, Dh), jnp.bfloat16),
+                jax.ShapeDtypeStruct((NB, KV, bs, Dh), jnp.bfloat16),
+                jax.ShapeDtypeStruct((S, MB), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32))
+        return paged_mha, args
+
+    def block_sparse():
+        from deepspeed_tpu.ops.pallas.block_sparse_attention import sparse_mha
+        B, H, S, D, block = 2, 4, 1024, 64, 128
+        nq = S // block
+        rng = np.random.default_rng(2)
+        layout = ((rng.random((H, nq, nq)) < 0.4)
+                  | np.eye(nq, dtype=bool)[None]).astype(np.int32)
+        args = tuple(jax.ShapeDtypeStruct((B, H, S, D), jnp.bfloat16)
+                     for _ in range(3))
+        return (lambda q, k, v: sparse_mha(q, k, v, layout, block,
+                                           causal=True)), args
+
+    def grouped_gemm():
+        from deepspeed_tpu.ops.pallas.grouped_gemm import moe_ffn_gmm
+        T, D, F, E, k = 40, 128, 256, 4, 2
+        args = (jax.ShapeDtypeStruct((T, D), jnp.bfloat16),
+                jax.ShapeDtypeStruct((T, k), jnp.float32),
+                jax.ShapeDtypeStruct((T, k), jnp.int32),
+                jax.ShapeDtypeStruct((E, D, F), jnp.bfloat16),
+                jax.ShapeDtypeStruct((E, F, D), jnp.bfloat16),
+                jax.ShapeDtypeStruct((E, D, F), jnp.bfloat16))
+        return (lambda x, tv, ti, w1, w2, w3: moe_ffn_gmm(
+            x, tv, ti, w1, w2, w3, n_experts=E, dtype=jnp.bfloat16)), args
+
+    def quantized():
+        from deepspeed_tpu.ops.pallas.quantized_matmul import quantized_matmul
+        # scale layout is [K, N//G] (QuantizedParameter.from_array)
+        args = (jax.ShapeDtypeStruct((16, 512), jnp.bfloat16),
+                jax.ShapeDtypeStruct((512, 256), jnp.int8),
+                jax.ShapeDtypeStruct((512, 256 // 128), jnp.float32))
+        return (lambda x, q, s: quantized_matmul(x, q, s, 128)), args
+
+    return [("flash_fwd", flash_fwd), ("flash_bwd", flash_bwd),
+            ("flash_window_fwd", flash_window_fwd),
+            ("flash_window_bwd", flash_window_bwd),
+            ("flash_segments_fwd", flash_segments_fwd),
+            ("paged_mha", paged), ("block_sparse", block_sparse),
+            ("grouped_gemm", grouped_gemm), ("quantized_matmul", quantized)]
+
+
+def train_programs():
+    """Flagship fwd+bwd steps at the bench's exact on-chip shapes (program
+    bodies only — optimizer fusion differs per engine config, but the model
+    fwd+bwd dominates compile time and covers every kernel in context)."""
+
+    def gpt2_step():
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        cfg = GPT2Config.small()
+        model = GPT2LMHeadModel(cfg)
+        B, T = 32, 1024
+        batch = {"input_ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+                 "labels": jax.ShapeDtypeStruct((B, T), jnp.int32)}
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               {"input_ids": jnp.zeros((1, 8), jnp.int32)}))
+
+        def loss_fn(params, b):
+            # the models return the LM loss when the batch carries labels
+            return model.apply({"params": params}, b)
+
+        return jax.value_and_grad(loss_fn), (shapes["params"], batch)
+
+    def llama_step():
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=2,
+                          max_position_embeddings=2048)
+        model = LlamaForCausalLM(cfg)
+        B, T = 8, 2048
+        batch = {"input_ids": jax.ShapeDtypeStruct((B, T), jnp.int32),
+                 "labels": jax.ShapeDtypeStruct((B, T), jnp.int32)}
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               {"input_ids": jnp.zeros((1, 8), jnp.int32)}))
+
+        def loss_fn(params, b):
+            return model.apply({"params": params}, b)
+
+        return jax.value_and_grad(loss_fn), (shapes["params"], batch)
+
+    return [("gpt2_small_fwd_bwd_b32", gpt2_step),
+            ("llama_0p5b_fwd_bwd_b8", llama_step)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="also compile the flagship train steps")
+    ap.add_argument("--only", default="", help="comma list of program names")
+    args = ap.parse_args()
+
+    topo = _topology()
+    mesh = Mesh(np.array(topo.devices[:1]), ("d",))
+    shard = NamedSharding(mesh, P())
+    target = topo.devices[0].device_kind
+
+    programs = kernel_programs() + (train_programs() if args.full else [])
+    if args.only:
+        keep = set(args.only.split(","))
+        programs = [p for p in programs if p[0] in keep]
+
+    results, failed = [], []
+    for name, build in programs:
+        t0 = time.perf_counter()
+        try:
+            fn, abstract = build()
+            jitted = jax.jit(
+                fn, in_shardings=jax.tree.map(lambda _: shard, abstract),
+                out_shardings=None)
+            compiled = jitted.lower(*abstract).compile()
+            dt = time.perf_counter() - t0
+            mem = compiled.memory_analysis()
+            results.append({"name": name, "ok": True,
+                            "compile_s": round(dt, 2),
+                            "code_bytes": mem.generated_code_size_in_bytes,
+                            "temp_bytes": mem.temp_size_in_bytes})
+            print(f"PASS {name}: compiled for {target} in {dt:.1f}s "
+                  f"(code {mem.generated_code_size_in_bytes//1024}KB)",
+                  flush=True)
+        except Exception as e:
+            dt = time.perf_counter() - t0
+            failed.append(name)
+            results.append({"name": name, "ok": False,
+                            "compile_s": round(dt, 2),
+                            "error": f"{type(e).__name__}: {str(e)[:500]}"})
+            print(f"FAIL {name} after {dt:.1f}s: {type(e).__name__}: "
+                  f"{str(e)[:300]}", flush=True)
+            traceback.print_exc(limit=3)
+
+    out = {"target": target, "cache_dir": os.environ["JAX_COMPILATION_CACHE_DIR"],
+           "full": bool(args.full), "only": args.only or None,
+           "results": results, "FAILED": failed}
+    os.makedirs("onchip_results", exist_ok=True)
+    # a filtered debug run must never clobber the canonical artifact the
+    # sequence/judge read — partial reports go to their own file
+    fname = ("onchip_results/aot_check.json" if args.full and not args.only
+             else "onchip_results/aot_check_partial.json")
+    with open(fname, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"metric": "aot_mosaic_compile_pass",
+                      "value": len(results) - len(failed),
+                      "unit": f"programs (of {len(results)})",
+                      "vs_baseline": 1.0 if not failed else 0.0,
+                      "extra": {"failed": failed, "target": target}}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

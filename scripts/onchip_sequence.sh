@@ -86,6 +86,31 @@ run_leg() {
   return $rc
 }
 
+# leg 0 (CHIP-FREE): validate every Pallas kernel + flagship step against
+# the real Mosaic/XLA:TPU compiler via the local v5e topology, and prewarm
+# the persistent compile cache. Lowering failures surface HERE, with the
+# chip untouched, instead of mid-smoke while holding it (the r2-r4 wedge
+# class). Runs before the probe on purpose — it needs no accelerator.
+echo "leg aot_prewarm start $(date)" >> "$LOG"
+# same wedge-proofing as run_leg (setsid group + --kill-after) — a compile
+# hung in native threads must not survive into the chip legs — but a leg-0
+# timeout does NOT abort the sequence: this leg never touches the chip
+setsid timeout --kill-after=30 3000 python scripts/aot_tpu_check.py --full \
+  > "$OUT/aot_prewarm.json" 2> "$OUT/aot_prewarm.err" &
+AOT_PID=$!
+LEG_PGIDS="$LEG_PGIDS $AOT_PID"
+wait "$AOT_PID"
+AOT_RC=$?
+LEG_PGIDS=$(printf '%s' "$LEG_PGIDS" | sed "s/ $AOT_PID\b//")
+echo "leg aot_prewarm rc=$AOT_RC $(date)" >> "$LOG"
+# verdict from THIS run's output (the persistent aot_check.json could be a
+# stale artifact if the run died before writing it)
+if [ "$AOT_RC" -eq 0 ] && grep -q '"failed": \[\]' "$OUT/aot_prewarm.json"; then
+  echo "aot prewarm clean: all programs lower for the TPU target" >> "$LOG"
+else
+  echo "aot prewarm rc=$AOT_RC or failures; smoke will exercise fallbacks" >> "$LOG"
+fi
+
 if ! probe >> "$LOG" 2>&1; then
   abort "initial chip probe failed"
 fi
