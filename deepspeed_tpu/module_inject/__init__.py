@@ -13,6 +13,8 @@ so this package provides the same capability as pure functions:
 """
 
 from deepspeed_tpu.module_inject.auto_tp import AutoTP, infer_tp_specs  # noqa: F401
+from deepspeed_tpu.module_inject.replace_policy import (  # noqa: F401
+    TransformerPolicy, policy_for, registered_families, tp_specs_from_policy)
 
 
 def replace_transformer_layer(orig_layer_impl, model, checkpoint_dict=None,

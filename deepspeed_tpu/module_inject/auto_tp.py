@@ -93,8 +93,18 @@ class AutoTP:
 
     @staticmethod
     def get_policy(model, params):
-        """Prefer the model's exact ``param_specs``; fall back to name
-        inference (the reference's graph-walk role)."""
+        """Precedence mirrors the reference (replace policies outrank the
+        graph-walk AutoTP, ``replace_module.py``):
+        1. the model's exact ``param_specs`` (ground truth for in-tree models)
+        2. a registered per-family injection policy
+           (``replace_policy.policy_for`` by config class)
+        3. global name heuristics (``infer_tp_specs``)."""
         if hasattr(model, "param_specs"):
             return model.param_specs(params)
+        from deepspeed_tpu.module_inject.replace_policy import (
+            policy_for, tp_specs_from_policy)
+        cfg = getattr(model, "config", model)
+        pol = policy_for(cfg) if cfg is not None else None
+        if pol is not None:
+            return tp_specs_from_policy(pol, params)
         return infer_tp_specs(params)

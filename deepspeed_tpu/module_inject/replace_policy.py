@@ -1,0 +1,214 @@
+"""Per-family injection policies (reference ``module_inject/policy.py``
+``TransformerPolicy`` + the ``containers/`` tree: one class per HF family
+declaring how to find qkv/dense/MLP/norm parameters and how to split them
+for tensor parallelism).
+
+The torch reference needs a class per family because it must *surgically
+replace* ``nn.Module`` objects; on TPU the same knowledge is declarative —
+a policy is a frozen record of the family's parameter roles, and GSPMD does
+the splitting from the PartitionSpecs derived here. ``auto_tp.AutoTP``
+consults this registry FIRST (exact per-family knowledge) and only falls
+back to the global name heuristics (``infer_tp_specs``) for unknown
+architectures — the same precedence the reference gives replace policies
+over its graph-walk AutoTP (``replace_module.py``).
+
+Coverage mirrors the reference's containers: llama/llama2 (+ mistral, qwen2,
+internlm — same tree), qwen v1, gpt2, opt, bloom, falcon (gptneox-style
+fused qkv), phi, gptj, gpt_neox, mixtral, bert (+ roberta, distilbert),
+megatron-GPT (via the gpt2 policy — same tree after ``initialize(mpu=...)``
+interop), and the diffusers unet/vae containers map to
+``models/diffusion.py`` (spatial blocks carry no TP policy — the reference
+serves them replicated too).
+"""
+
+import dataclasses
+import re
+from typing import Callable, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.module_inject.auto_tp import _SCAN_RE
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerPolicy:
+    """Declarative analog of reference ``TransformerPolicy`` subclasses.
+
+    Name fragments are matched against '/'-joined param paths. ``fused_qkv``
+    names a column-parallel leaf holding q|k|v stacked on the OUTPUT dim
+    (reference ``fusedqkv_utils.py`` handles the interleavings; our model
+    trees keep fused qkv only in gpt2/falcon/neox layouts).
+    """
+    family: str                       # model_type(s), comma-joined
+    orig_layer_class: str             # reference container's torch class name
+    column_parallel: Tuple[str, ...]  # output-dim split, no inbound collective
+    row_parallel: Tuple[str, ...]     # input-dim split, psum on the way out
+    vocab_parallel: Tuple[str, ...] = ("embed_tokens", "wte", "lm_head",
+                                       "word_embeddings", "embed_in",
+                                       "embed_out")
+    fused_qkv: Optional[str] = None
+    mlp_act: str = "gelu"             # reference ActivationFuncType analog
+    norm_type: str = "layernorm"      # reference NormType analog
+    pre_attn_norm: bool = True
+    config_cls: str = ""              # our flax config class name
+    # column biases normally split with the output dim; families whose
+    # fused-qkv output layout is HEAD-INTERLEAVED (bloom/falcon/neox) keep
+    # biases replicated — an interleaved split would scatter head fragments
+    split_column_bias: bool = True
+    # expert-stacked [E, ...] leaves whose leading dim shards over "ep"
+    expert_parallel: Tuple[str, ...] = ()
+    # disambiguator when several policies share config_cls (falcon vs phi
+    # both use ParallelBlockConfig): first registered policy whose predicate
+    # accepts the config wins — deterministic, unlike set iteration
+    config_predicate: Optional[Callable] = None
+
+
+_REGISTRY = {}
+_ORDERED = []    # registration order: deterministic config-object lookup
+
+
+def register_policy(policy):
+    for fam in policy.family.split(","):
+        _REGISTRY[fam.strip()] = policy
+    _ORDERED.append(policy)
+    return policy
+
+
+register_policy(TransformerPolicy(
+    family="llama,llama2,mistral,qwen2,internlm",
+    orig_layer_class="LlamaDecoderLayer",
+    column_parallel=("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"),
+    row_parallel=("o_proj", "down_proj"),
+    mlp_act="silu-glu", norm_type="rmsnorm", config_cls="LlamaConfig"))
+
+register_policy(TransformerPolicy(
+    family="qwen",                    # v1: same flax tree as llama (hf.py
+    orig_layer_class="QWenBlock",     # maps c_attn/w1/w2 onto it)
+    column_parallel=("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"),
+    row_parallel=("o_proj", "down_proj"),
+    mlp_act="silu-glu", norm_type="rmsnorm", config_cls="LlamaConfig"))
+
+register_policy(TransformerPolicy(
+    family="gpt2,megatron-gpt",
+    orig_layer_class="GPT2Block",
+    column_parallel=("c_fc",), row_parallel=("c_proj", "mlp/c_proj"),
+    fused_qkv="c_attn", mlp_act="gelu-new", config_cls="GPT2Config"))
+
+register_policy(TransformerPolicy(
+    family="opt",
+    orig_layer_class="OPTDecoderLayer",
+    column_parallel=("q_proj", "k_proj", "v_proj", "fc1"),
+    row_parallel=("out_proj", "fc2"),
+    mlp_act="relu", config_cls="OPTConfig"))
+
+register_policy(TransformerPolicy(
+    family="bloom",
+    orig_layer_class="BloomBlock",
+    column_parallel=("dense_h_to_4h",),
+    row_parallel=("dense_4h_to_h", "dense"),
+    fused_qkv="query_key_value", config_cls="BloomConfig",
+    split_column_bias=False))
+
+register_policy(TransformerPolicy(
+    family="falcon,gpt_neox",
+    orig_layer_class="FalconDecoderLayer",
+    column_parallel=("fc1",), row_parallel=("dense", "fc2"),
+    fused_qkv="query_key_value", config_cls="ParallelBlockConfig",
+    split_column_bias=False,
+    config_predicate=lambda c: bool(getattr(c, "fused_qkv", True))))
+
+register_policy(TransformerPolicy(
+    family="phi,gptj",
+    orig_layer_class="PhiDecoderLayer",
+    column_parallel=("q_proj", "k_proj", "v_proj", "fc1"),
+    row_parallel=("fc2", "dense"),
+    config_cls="ParallelBlockConfig", split_column_bias=False,
+    config_predicate=lambda c: not getattr(c, "fused_qkv", True)))
+
+register_policy(TransformerPolicy(
+    family="mixtral",
+    orig_layer_class="MixtralDecoderLayer",
+    column_parallel=("q_proj", "k_proj", "v_proj", "w1", "w3"),
+    row_parallel=("o_proj", "w2"),
+    mlp_act="silu-glu", norm_type="rmsnorm", config_cls="MixtralConfig",
+    expert_parallel=("w1", "w2", "w3")))
+
+register_policy(TransformerPolicy(
+    family="bert,roberta,distilbert",
+    orig_layer_class="BertLayer",
+    column_parallel=("query", "key", "value", "intermediate"),
+    row_parallel=("attn_out", "output"),
+    pre_attn_norm=False, config_cls="BertConfig",
+    split_column_bias=False))
+
+
+def policy_for(model_type_or_config):
+    """Look up the policy by HF model_type string or by our config object.
+
+    Config-object lookup walks policies in REGISTRATION order and applies
+    each policy's ``config_predicate`` (when set) so families sharing a
+    config class (falcon vs phi on ParallelBlockConfig) resolve
+    deterministically by config content, never by hash order."""
+    if isinstance(model_type_or_config, str):
+        return _REGISTRY.get(model_type_or_config)
+    cfg = model_type_or_config
+    name = type(cfg).__name__
+    for pol in _ORDERED:
+        if pol.config_cls != name:
+            continue
+        if pol.config_predicate is None or pol.config_predicate(cfg):
+            return pol
+    return None
+
+
+def registered_families():
+    return sorted(_REGISTRY)
+
+
+def tp_specs_from_policy(policy, params, axis="tp"):
+    """PartitionSpec pytree from a family policy — the declarative form of
+    the reference container's ``attention()``/``mlp()`` split methods."""
+    def kind_of(name):
+        for frag in policy.vocab_parallel:
+            if re.search(frag + r"\b", name):
+                return "vocab"
+        for frag in policy.row_parallel:
+            if re.search(frag + r"\b", name):
+                return "row"
+        cols = policy.column_parallel + \
+            ((policy.fused_qkv,) if policy.fused_qkv else ())
+        for frag in cols:
+            if re.search(frag + r"\b", name):
+                return "column"
+        return None
+
+    def spec_for(path, leaf):
+        name = "/".join(str(getattr(p, "key", getattr(p, "name", "")))
+                        for p in path)
+        kind = kind_of(name)
+        if kind is None:
+            return None
+        expert = leaf.ndim == 3 and any(
+            re.search(frag + r"\b", name) for frag in policy.expert_parallel)
+        stacked = expert or bool(_SCAN_RE.search(name)) or \
+            (leaf.ndim == 3 and kind in ("column", "row"))
+        lead = ("ep",) if expert else ((None,) if stacked else ())
+        base_ndim = leaf.ndim - (1 if stacked else 0)
+        if base_ndim == 1 and kind == "column":
+            # column-parallel BIAS: output dim is split, so the bias splits
+            # with it (a row-parallel bias stays replicated — it is added
+            # once after the psum); head-interleaved fused layouts opt out
+            if not policy.split_column_bias:
+                return None
+            return P(*(lead + (axis,)))
+        if base_ndim != 2:
+            return None
+        spec = {"vocab": (axis, None), "row": (axis, None),
+                "column": (None, axis)}[kind]
+        return P(*(lead + spec))
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    specs = [spec_for(p, l) for p, l in flat]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), specs)
