@@ -366,15 +366,17 @@ def gpt2_candidates(on_tpu):
         pol = os.environ["DS_BENCH_REMAT"]
         pairs = [(32, pol), (16, pol), (8, pol)] if on_tpu else [(2, pol)]
     else:
-        # "nothing" (save ALL activations, zero recompute) first: recompute-
-        # free backward is the single biggest MFU lever (r2's 32% was measured
-        # under FULL recompute). Activation arithmetic at seq 1024 bf16:
-        # ~1.2GB/layer-pass per 64-batch -> bs64 save-all (~14GB) cannot fit
-        # 16GB HBM next to 1.8GB of states, bs32 (~7GB) can. The KNOWN-GOOD
-        # (32, dots) sits second so a surprise OOM costs one attempt, not the
-        # ladder deadline.
-        pairs = ([(32, "nothing"), (32, "dots"), (64, "dots"),
-                  (16, "dots"), (32, "everything"), (8, "everything")]
+        # Order is COMPILER-CALIBRATED (scripts/aot_ladder_calibration.py,
+        # onchip_results/ladder_calibration_gpt2.json — the real XLA:TPU
+        # memory assignment, not hand activation-arithmetic): (32, nothing)
+        # OOMs at 26.2GB and (64, dots) needs 18.3GB, both over the 15.75GB
+        # HBM the compiler reports, so neither gets chip time. (32, dots)
+        # fits at 10.0GB program bytes (+1.8GB optimizer states) and is the
+        # known-good measured config; per the same analysis it is
+        # COMPUTE-bound (t_mem 27ms vs t_flops 143ms), so save-all would
+        # not have been the MFU lever the old comment hoped anyway.
+        pairs = ([(32, "dots"), (16, "dots"), (32, "everything"),
+                  (8, "everything")]
                  if on_tpu else [(2, "dots")])
     return expand_fused(pairs)
 

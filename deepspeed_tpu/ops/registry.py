@@ -31,8 +31,11 @@ class OpBuilder:
             plat = jax.devices()[0].platform
         except Exception:
             return False
-        ok = self.pallas_available() and (plat in ("tpu", "axon")
-                                          or pallas_interpret())
+        # platform/interpret/assume-tpu gating already happened in
+        # pallas_enabled() above — re-deriving it here would be exactly the
+        # drift its docstring forbids; the only remaining question is
+        # whether this builder's kernel imports
+        ok = self.pallas_available()
         has_pallas_slot = type(self).pallas_impl is not OpBuilder.pallas_impl
         if (not ok and plat in ("tpu", "axon") and has_pallas_slot
                 and self.NAME not in OpBuilder._warned_fallback):
@@ -78,11 +81,15 @@ def pallas_enabled():
     """True when Pallas fast paths may be used: a TPU backend is live and the
     DS_TPU_DISABLE_PALLAS kill-switch is off. THE shared gate — heuristics
     and op wrappers must not re-implement platform probing.
-    DS_TPU_PALLAS_INTERPRET forces True on any platform (interpret mode)."""
+    DS_TPU_PALLAS_INTERPRET forces True on any platform (interpret mode).
+    DS_TPU_ASSUME_TPU forces True WITHOUT interpret: for AOT topology
+    compiles (scripts/aot_tpu_check.py) where the host platform is CPU but
+    the compile target is a real TPU — traced programs must be byte-for-byte
+    the on-chip programs, flash kernels included."""
     import os
     if os.environ.get("DS_TPU_DISABLE_PALLAS"):
         return False
-    if pallas_interpret():
+    if pallas_interpret() or os.environ.get("DS_TPU_ASSUME_TPU"):
         return True
     try:
         import jax

@@ -37,6 +37,10 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(os.path.dirname(os.path.dirname(
                           os.path.abspath(__file__))), ".jax_cache"))
 
+os.environ.setdefault("DS_TPU_ASSUME_TPU", "1")  # traced programs must take
+# the TPU fast paths (flash kernel etc.) even though the HOST platform is CPU
+# — the compile target is the real v5e
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")  # host platform; compiles target TPU

@@ -25,7 +25,15 @@ def ladder(args, on_tpu):
     elif args.remat:
         pairs = [(16, args.remat), (8, args.remat), (4, args.remat)]
     else:
-        pairs = ([(16, "dots"), (8, "dots"), (8, "everything"),
+        # COMPILER-CALIBRATED for the SINGLE-chip bench (scripts/
+        # aot_ladder_calibration.py --model llama,
+        # onchip_results/ladder_calibration_llama.json): b16 OOMs at
+        # 16.8-46GB program bytes; b8-dots fits the bare program (14.0GB)
+        # but not next to ~6GB UNSHARDED optimizer state (world 1); b4-dots
+        # (9.3GB) is the largest batch with headroom. Lead with it; keep
+        # (8, dots) as a discovery rung — on multi-chip deployments the
+        # states shard and it likely fits (one bounded OOM attempt here).
+        pairs = ([(4, "dots"), (8, "dots"), (8, "everything"),
                   (4, "everything")] if on_tpu else [(2, "dots")])
     return bench.expand_fused(pairs)
 
