@@ -187,6 +187,80 @@ def train_programs():
             ("llama_0p5b_fwd_bwd_b8", llama_step)]
 
 
+def bench_leg_programs():
+    """The longctx and serving bench legs' exact programs — compile-validated
+    chip-free so legs 4-5 of onchip_sequence.sh never discover a lowering
+    problem while holding the chip."""
+
+    def longctx_step(seq):
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=2048 * 4 // 2 * 2,
+                          num_hidden_layers=8, num_attention_heads=16,
+                          num_key_value_heads=4, max_position_embeddings=seq,
+                          scan_layers=True, remat=True)
+        model = LlamaForCausalLM(cfg)
+        batch = {"input_ids": jax.ShapeDtypeStruct((1, seq), jnp.int32),
+                 "labels": jax.ShapeDtypeStruct((1, seq), jnp.int32)}
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               {"input_ids": jnp.zeros((1, 8), jnp.int32)}))
+
+        def loss_fn(p, b):
+            return model.apply({"params": p}, b)
+
+        return jax.value_and_grad(loss_fn), (shapes["params"], batch)
+
+    def serving_forward():
+        # bench_serving on-TPU shapes: 8 requests, prompt 512 + 64 new,
+        # budget 256 tokens, block 32
+        import ml_dtypes
+        from deepspeed_tpu.models.llama import LlamaConfig
+        from deepspeed_tpu.inference.v2.model_implementations.llama import (
+            ragged_forward)
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=512 + 64 + 64, remat=False)
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        model = LlamaForCausalLM(cfg)
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               {"input_ids": jnp.zeros((1, 8), jnp.int32)}))
+        S, budget, block = 8, 256, 32
+        max_ctx = 512 + 64 + 32
+        MB = -(-max_ctx // block)
+        NB = max(64, (max_ctx // block + 2) * 8) + 1   # + trash block
+        L, KV, Dh = cfg.num_hidden_layers, 4, 64
+        bf16 = jnp.bfloat16
+        args = (shapes["params"],
+                jax.ShapeDtypeStruct((L, NB, KV, block, Dh), bf16),
+                jax.ShapeDtypeStruct((L, NB, KV, block, Dh), bf16),
+                jax.ShapeDtypeStruct((S, budget // S), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S, MB), jnp.int32))
+        return (lambda p, kp, vp, t, ql, sn, bt: ragged_forward(
+            cfg, p, kp, vp, t, ql, sn, bt)), args
+
+    def device_sampler():
+        from deepspeed_tpu.inference.v2.sampling import sample_rows
+        S, V = 8, 32000
+        args = (jax.ShapeDtypeStruct((S, V), jnp.float32),
+                jax.ShapeDtypeStruct((S,), jnp.float32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.float32),
+                jax.ShapeDtypeStruct((S,), jnp.int32),
+                jax.ShapeDtypeStruct((S,), jnp.int32))
+        return (lambda l, t, k, p, sd, ps: sample_rows(l, t, k, p, sd, ps)), \
+            args
+
+    return [("longctx_4k_fwd_bwd", lambda: longctx_step(4096)),
+            ("longctx_8k_fwd_bwd", lambda: longctx_step(8192)),
+            ("serving_ragged_forward", serving_forward),
+            ("serving_device_sampler", device_sampler)]
+
+
 def multichip_programs(topo):
     """Sharded train step compiled for the REAL 2x2 v5e topology: validates
     that the flash kernel + GSPMD partitioning + ICI collectives (param
@@ -251,7 +325,8 @@ def main():
 
     programs = kernel_programs()
     if args.full:
-        programs += train_programs() + multichip_programs(topo)
+        programs += (train_programs() + bench_leg_programs()
+                     + multichip_programs(topo))
     if args.only:
         keep = set(args.only.split(","))
         programs = [p for p in programs if p[0] in keep]
