@@ -98,6 +98,13 @@ class CompressionState:
     """Per-leaf technique plan + frozen pruning masks."""
 
     def __init__(self, config, params):
+        aq = config.techniques.get("activation_quantization")
+        if aq is not None and aq.enabled:
+            raise ValueError(
+                "compression: activation_quantization.enabled is set, but "
+                "activation quantization is not implemented in deepspeed_tpu "
+                "— refusing to silently ignore it. Remove the section (or "
+                "set enabled: false) until an implementation lands.")
         self.config = config
         self.plans = {}   # keystr -> list of (technique, params dict)
         self.masks = {}   # keystr -> mask array (pruning techniques)
@@ -106,7 +113,7 @@ class CompressionState:
             key = jax.tree_util.keystr(path)
             plan = []
             for tname, tcfg in config.techniques.items():
-                if not tcfg.enabled or tname == "activation_quantization":
+                if not tcfg.enabled:
                     continue
                 group = tcfg.group_for(key)
                 if group is None or (not hasattr(leaf, "ndim")) or leaf.ndim < 2:

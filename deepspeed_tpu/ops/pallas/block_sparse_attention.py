@@ -172,7 +172,15 @@ def sparse_mha(q, k, v, layout, block, causal=False, softmax_scale=None,
         return vjp(g)
 
     run.defvjp(run_fwd, run_bwd)
-    return run(q, k, v)
+    # SPMD: batch-only sharding over the active mesh's data axes. Heads stay
+    # replicated — the compacted layout (cols/counts) is a closed-over
+    # host-side constant indexed by GLOBAL head, so slicing it per TP shard
+    # would need a head-offset plumbed into the kernel; batch sharding is
+    # exact and covers the data-parallel axes that dominate the mesh.
+    from deepspeed_tpu.ops.registry import sharded_kernel_call
+    return sharded_kernel_call(
+        run, [q, k, v], [("data", None, None, None)] * 3,
+        ("data", None, None, None))
 
 
 def is_supported(q_shape, block):

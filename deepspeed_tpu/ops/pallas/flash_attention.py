@@ -155,7 +155,9 @@ def _mask_logits(s, iq, ik, qseg_ref, kseg_ref, *, causal, window, bq, bk, off):
     (kv: [SUBLANES, bk]) so the comparison lowers to cheap VPU broadcasts."""
     mask = None
     if qseg_ref is not None:
-        qs = jnp.tile(qseg_ref[0], (1, bk // LANES))       # [bq, bk]
+        # pltpu.repeat, not jnp.tile: tile lowers through a shape cast that
+        # older Mosaic rejects ("unsupported shape cast")
+        qs = pltpu.repeat(qseg_ref[0], bk // LANES, 1)     # [bq, bk]
         ks = kseg_ref[0][:1, :]                            # [1, bk]
         mask = qs == ks
     if causal or window is not None:
@@ -589,4 +591,43 @@ def flash_mha(q, k, v, bias=None, causal=True, softmax_scale=None,
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
     window = None if window is None else int(window)
     seg = None if segment_ids is None else tuple(segment_ids)
-    return _flash(q, k, v, bias, seg, causal, float(scale), window, interpret)
+    return _dispatch_flash(q, k, v, bias, seg, causal, float(scale), window,
+                           interpret)
+
+
+def _dispatch_flash(q, k, v, bias, seg, causal, scale, window, interpret):
+    """Route ``_flash`` through the SPMD kernel dispatcher: batch over the
+    active mesh's data axes, heads over the TP axis (k/v carry KV heads, so
+    the head axis must divide KV — GQA sharding keeps whole KV groups
+    together). Per-device shapes keep the kernel's own invariants: the seq
+    dims are untouched and ``_pick_blocks`` re-derives blocks from them."""
+    from deepspeed_tpu.ops.registry import sharded_kernel_call
+
+    args = [q, k, v]
+    in_roles = [("data", None, "head", None), ("data", None, "head", None),
+                ("data", None, "head", None)]
+    if bias is not None:
+        args.append(bias)
+        in_roles.append(("data" if bias.shape[0] > 1 else None,
+                         "head" if bias.shape[1] > 1 else None, None, None))
+    if seg is not None:
+        args.extend(seg)
+        in_roles.extend([("data", None), ("data", None)])
+
+    def call(*ts):
+        q_, k_, v_ = ts[:3]
+        i = 3
+        b_ = None
+        if bias is not None:
+            b_ = ts[i]
+            i += 1
+        s_ = None if seg is None else (ts[i], ts[i + 1])
+        return _flash(q_, k_, v_, b_, s_, causal, scale, window, interpret)
+
+    def accept(shard_shapes):
+        # per-shard GQA ratio must stay integral (H and KV shrink together)
+        (_, _, h, _), (_, _, kv, _) = shard_shapes[0], shard_shapes[1]
+        return kv >= 1 and h % kv == 0
+
+    return sharded_kernel_call(call, args, in_roles,
+                               ("data", None, "head", None), accept=accept)

@@ -44,7 +44,30 @@ def moe_ffn_gmm(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
     by precomputed (top_vals, top_idx) from :func:`topk_router`.
 
     x [T, D]; w1/w3 [E, D, F]; w2 [E, F, D] -> [T, D].
+
+    SPMD: tokens shard over the active mesh's data axes (dp AND ep — under
+    expert parallelism the token batch is split across the expert world, the
+    reference's expert groups carved out of DP); the scatter→gmm→gather chain
+    is per-token exact, so each shard grouping only its own tokens gives
+    bitwise-identical rows. Expert weights stay replicated in the spec — if
+    the caller holds them ep-sharded, GSPMD all-gathers at entry.
     """
+    from deepspeed_tpu.ops.registry import sharded_kernel_call
+
+    def call(x_, tv_, ti_, w1_, w2_, w3_):
+        return _moe_ffn_gmm_local(x_, tv_, ti_, w1_, w2_, w3_,
+                                  n_experts=n_experts, dtype=dtype,
+                                  interpret=interpret)
+
+    wr = (None, None, None)
+    return sharded_kernel_call(
+        call, [x, top_vals, top_idx, w1, w2, w3],
+        [("data", None), ("data", None), ("data", None), wr, wr, wr],
+        ("data", None))
+
+
+def _moe_ffn_gmm_local(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
+                       interpret=False):
     from jax.experimental.pallas.ops.tpu.megablox import gmm
 
     T, D = x.shape

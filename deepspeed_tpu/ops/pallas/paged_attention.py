@@ -88,7 +88,34 @@ def _kernel(bt_ref, seen_ref, qlen_ref, jcap_ref, q_ref, k_ref, v_ref, o_ref,
 
 def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
               softmax_scale=None, window=None, interpret=False):
-    """Blocked-flash attention over paged KV. See module docstring for shapes."""
+    """Blocked-flash attention over paged KV. See module docstring for shapes.
+
+    SPMD: routed through the kernel dispatcher — sequences (the ``S`` batch
+    dim of q/block_tables/seen/q_len) shard over the active mesh's data axes;
+    KV heads (and with them the grouped query heads) shard over the TP axis,
+    which slices the pools' ``KV`` dim while the block pool itself (``NB``)
+    stays replicated so global block-table indices remain valid per shard.
+    """
+    from deepspeed_tpu.ops.registry import sharded_kernel_call
+
+    def call(q_, kp_, vp_, bt_, sn_, ql_):
+        return _paged_mha_local(q_, kp_, vp_, bt_, sn_, ql_,
+                                softmax_scale=softmax_scale, window=window,
+                                interpret=interpret)
+
+    def accept(shard_shapes):
+        (_, _, h, _), (_, kv, _, _) = shard_shapes[0], shard_shapes[1]
+        return kv >= 1 and h % kv == 0
+
+    return sharded_kernel_call(
+        call, [q, k_pool, v_pool, block_tables, seen, q_len],
+        [("data", None, "head", None), (None, "head", None, None),
+         (None, "head", None, None), ("data", None), ("data",), ("data",)],
+        ("data", None, "head", None), accept=accept)
+
+
+def _paged_mha_local(q, k_pool, v_pool, block_tables, seen, q_len, *,
+                     softmax_scale=None, window=None, interpret=False):
     S, Q, H, Dh = q.shape
     NB, KV, bs, _ = k_pool.shape
     MB = block_tables.shape[1]

@@ -62,7 +62,34 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc, *, nk, bn, group_size):
 
 def quantized_matmul(x, q, scale, group_size, out_dtype=None,
                      interpret=False):
-    """x [M, K] @ dequant(q [K, N] int8, scale [K, N//G]) -> [M, N]."""
+    """x [M, K] @ dequant(q [K, N] int8, scale [K, N//G]) -> [M, N].
+
+    SPMD: rows (``M``) shard over the active mesh's data axes and output
+    features (``N``, with the matching ``N//G`` scale columns) over the TP
+    axis — the classic column-parallel layout, K replicated so no cross-shard
+    reduction is needed. Sharding is vetoed unless the per-shard dims still
+    satisfy the kernel's block constraints (``is_supported``'s rules).
+    """
+    from deepspeed_tpu.ops.registry import sharded_kernel_call
+
+    def call(x_, q_, s_):
+        return _quantized_matmul_local(x_, q_, s_, group_size,
+                                       out_dtype=out_dtype,
+                                       interpret=interpret)
+
+    def accept(shard_shapes):
+        (m, k), (_, n), _ = shard_shapes
+        return (m % 8 == 0 and (m <= BM or m % BM == 0)
+                and k % BK == 0 and n % BN == 0)
+
+    return sharded_kernel_call(
+        call, [x, q, scale],
+        [("data", None), (None, "head"), (None, "head")],
+        ("data", "head"), accept=accept)
+
+
+def _quantized_matmul_local(x, q, scale, group_size, out_dtype=None,
+                            interpret=False):
     M, K = x.shape
     _, N = q.shape
     out_dtype = out_dtype or x.dtype

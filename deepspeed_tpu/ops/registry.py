@@ -98,6 +98,95 @@ def pallas_enabled():
         return False
 
 
+# ---------------------------------------------------------------------------
+# SPMD kernel dispatch — the op-layer half of the Pallas shard_map bridge
+# (topology half: ``parallel/topology.py:use_kernel_mesh`` and friends).
+#
+# GSPMD auto-partitioning stops at Mosaic custom calls: a Pallas kernel traced
+# under a multi-device jit fails to compile with "Mosaic kernels cannot be
+# automatically partitioned. Please wrap the call in a shard_map." Every
+# Pallas kernel wrapper therefore routes its invocation through
+# ``sharded_kernel_call``, which wraps the call in a ``shard_map`` over the
+# active mesh's data (batch/token/expert) and head (TP) axes — and degrades
+# to a plain call whenever sharding is impossible or pointless, so
+# single-device behavior and the pure-XLA twins are untouched.
+# ---------------------------------------------------------------------------
+
+
+def sharded_kernel_call(fn, args, in_roles, out_roles, accept=None):
+    """Invoke kernel ``fn(*args)``, shard_map-wrapped over the active mesh.
+
+    ``in_roles``/``out_roles``: per-dimension role tags, one tuple per
+    argument / output — each entry ``"data"`` (shard over the mesh's
+    batch-like axes), ``"head"`` (shard over the TP axis) or ``None``
+    (replicate). ``out_roles`` may be a single tuple (one output) or a list
+    of tuples (tuple output).
+
+    A role is only honored when every dimension tagged with it divides
+    evenly by the corresponding axis product; otherwise that role is dropped
+    (those dims stay replicated). ``accept(shard_shapes)`` — per-shard shapes
+    after the division — lets kernels veto sharding that violates their
+    block/tile constraints. Falls back to a direct ``fn(*args)`` when no mesh
+    is active, the mesh is trivial, or no role survives the checks.
+
+    The mesh binds at TRACE time: jax trace caches (including inner ``jit``
+    wrappers around callers of this, keyed on shapes only) will replay a
+    previously captured shard_map even after the active mesh changed.
+    Processes that flip meshes between traces of the same shapes (AOT
+    sweeps, tests) must ``jax.clear_caches()`` in between.
+    """
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.parallel import topology
+
+    mesh = topology.active_kernel_mesh()
+    if mesh is None or mesh.size == 1:
+        return fn(*args)
+    roles = topology.kernel_partition_axes(mesh)
+    shape = dict(mesh.shape)
+    factor = {"data": 1, "head": 1}
+    if roles["data"]:
+        f = 1
+        for a in roles["data"]:
+            f *= shape[a]
+        factor["data"] = f
+    if roles["head"]:
+        factor["head"] = shape[roles["head"]]
+
+    # a role survives only if every dim tagged with it divides evenly
+    tagged = {"data": [], "head": []}
+    for arg, r in zip(args, in_roles):
+        for d, role in enumerate(r):
+            if role is not None:
+                tagged[role].append(arg.shape[d])
+    live = {}
+    for role in ("data", "head"):
+        if tagged[role] and factor[role] > 1 and \
+                all(s % factor[role] == 0 for s in tagged[role]):
+            live[role] = roles["data"] if role == "data" else roles["head"]
+    if not live:
+        return fn(*args)
+    if accept is not None:
+        shard_shapes = [
+            tuple(s // factor[role] if (role := r[d]) in live else s
+                  for d, s in enumerate(arg.shape))
+            for arg, r in zip(args, in_roles)]
+        if not accept(shard_shapes):
+            return fn(*args)
+
+    def spec(r):
+        return P(*[live.get(role) for role in r])
+
+    in_specs = tuple(spec(r) for r in in_roles)
+    if isinstance(out_roles, list):
+        out_specs = tuple(spec(r) for r in out_roles)
+    else:
+        out_specs = spec(out_roles)
+    from deepspeed_tpu.utils import jax_compat
+    wrapped = jax_compat.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=False)
+    return wrapped(*args)
+
+
 def register_op_builder(cls):
     assert cls.NAME is not None
     _REGISTRY[cls.NAME] = cls

@@ -159,6 +159,98 @@ class MeshTopology:
                 ", ".join(f"{a}={self._sizes[a]}" for a in shown) + ")")
 
 
+# ---------------------------------------------------------------------------
+# Active kernel mesh — the topology half of the Pallas SPMD dispatch layer.
+#
+# GSPMD cannot auto-partition Mosaic (Pallas TPU) kernels: compiling a traced
+# kernel under a >1-device sharding fails with "Mosaic kernels cannot be
+# automatically partitioned. Please wrap the call in a shard_map." The op
+# layer (``ops/registry.py:sharded_kernel_call``) therefore wraps each kernel
+# invocation in a ``shard_map`` over the *active* mesh. This registry answers
+# two questions for it:
+#
+#   1. which mesh is active?  — an explicit ``use_kernel_mesh(mesh)`` context
+#      wins; otherwise the globally installed ``groups`` topology (engines
+#      install it at construction) is used.
+#   2. which mesh axes play which kernel role?  — "data" axes shard the
+#      batch/token dimension (the reference's DP/expert/replica worlds);
+#      the "head" axis shards attention heads / output features (TP).
+#
+# Axes already bound as *manual* in an enclosing shard_map (e.g. the engine's
+# qgZ step or an explicit Ulysses shard_map) are excluded: the kernel is
+# already running per-shard along them, and nesting a second shard_map over
+# the same names is invalid.
+# ---------------------------------------------------------------------------
+
+import contextlib
+
+# mesh axis names recognized per kernel role. "data"/"batch"/"model" cover
+# ad-hoc meshes built by scripts and tests; the canonical names are AXIS_ORDER.
+DATA_AXIS_NAMES = ("dpr", "dp", "ep", "data", "batch")
+HEAD_AXIS_NAMES = ("tp", "model")
+
+_KERNEL_MESH_STACK = []
+
+
+@contextlib.contextmanager
+def use_kernel_mesh(mesh):
+    """Make ``mesh`` the active kernel-dispatch mesh within the context.
+
+    Pass a ``jax.sharding.Mesh`` (or a ``MeshTopology``, whose ``.mesh`` is
+    taken) to route Pallas kernels through ``shard_map`` over it; pass
+    ``None`` to explicitly disable kernel sharding (e.g. the single-device
+    parity arm of an A/B test) even when a global topology is installed.
+    """
+    if isinstance(mesh, MeshTopology):
+        mesh = mesh.mesh
+    _KERNEL_MESH_STACK.append(mesh)
+    try:
+        yield mesh
+    finally:
+        _KERNEL_MESH_STACK.pop()
+
+
+def active_kernel_mesh():
+    """The mesh Pallas kernels should shard over, or None.
+
+    Resolution order: innermost ``use_kernel_mesh`` context (a ``None`` entry
+    disables dispatch), else the global ``groups`` topology's mesh if one has
+    been initialized (without building one as a side effect).
+    """
+    if _KERNEL_MESH_STACK:
+        return _KERNEL_MESH_STACK[-1]
+    from deepspeed_tpu.parallel import groups
+    topo = getattr(groups, "_TOPOLOGY", None)
+    return topo.mesh if topo is not None else None
+
+
+def _manual_axis_names(mesh):
+    """Mesh axes already mapped by an enclosing shard_map at trace time."""
+    try:
+        from jax._src import core as _jcore
+        env = _jcore.get_axis_env()
+        return {a for a in mesh.axis_names if env.axis_exists(a)}
+    except Exception:
+        return set()
+
+
+def kernel_partition_axes(mesh):
+    """Map ``mesh``'s axes onto kernel roles.
+
+    Returns ``{"data": tuple_of_axes, "head": axis_or_None}`` — only axes of
+    size > 1 that are not already manual in an enclosing shard_map. ``data``
+    may name several mesh axes (sharded jointly, like ``batch_spec``);
+    ``head`` is at most one.
+    """
+    manual = _manual_axis_names(mesh)
+    shape = dict(mesh.shape)
+    data = tuple(a for a in DATA_AXIS_NAMES
+                 if shape.get(a, 1) > 1 and a not in manual)
+    head = next((a for a in HEAD_AXIS_NAMES
+                 if shape.get(a, 1) > 1 and a not in manual), None)
+    return {"data": data, "head": head}
+
+
 def build_topology(config=None, devices=None):
     """Build a MeshTopology from a DeepSpeedConfig-like object (or defaults)."""
     pp = ep = sp = tp = 1

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deepspeed_tpu.utils import jax_compat  # noqa: F401  installs lax.axis_size on old jax
+
 from deepspeed_tpu.ops.quantizer import dequantize, quantize
 
 

@@ -1,0 +1,75 @@
+"""Version-portable ``shard_map``.
+
+The repo targets the modern ``jax.shard_map`` API (keyword ``check_vma`` /
+``axis_names``); older jax (< 0.6) only ships
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` / ``auto``
+spelling and no top-level ``jax.shard_map`` attribute. This module exposes one
+``shard_map`` callable that translates between the two so the rest of the
+codebase (and tests importing ``jax.shard_map`` directly) run on either.
+
+Translation rules (old-API backend):
+  - ``check_vma=<bool>``        -> ``check_rep=<bool>``
+  - ``axis_names={...}``        -> ``auto = mesh.axis_names - axis_names``
+    (modern API names the *manual* axes; the legacy API names the *auto* ones)
+
+``install()`` additionally patches ``jax.shard_map`` when the attribute is
+missing, so third-party-style call sites keep working unmodified. It is
+invoked on import.
+"""
+
+import jax
+
+__all__ = ["shard_map", "install", "HAS_NATIVE_SHARD_MAP"]
+
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+if HAS_NATIVE_SHARD_MAP:
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, *,
+                  check_vma=None, check_rep=None, axis_names=None,
+                  auto=None, **kw):
+        if check_vma is None and check_rep is not None:
+            check_vma = check_rep
+        if axis_names is None and auto is not None:
+            axis_names = frozenset(mesh.axis_names) - frozenset(auto)
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, *,
+                  check_vma=None, check_rep=None, axis_names=None,
+                  auto=None, **kw):
+        if check_rep is None:
+            check_rep = True if check_vma is None else check_vma
+        if auto is None and axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = frozenset(auto)
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_rep,
+                                 **kw)
+
+
+def axis_size(axis_name):
+    """Static size of a bound mesh axis (modern ``jax.lax.axis_size``)."""
+    from jax._src import core as _jcore
+    return _jcore.get_axis_env().axis_size(axis_name)
+
+
+def install():
+    """Give ``jax`` a top-level ``shard_map`` (and ``lax.axis_size``) when
+    the running version lacks them — call sites and tests written against
+    the modern API then work unmodified."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = axis_size
+
+
+install()

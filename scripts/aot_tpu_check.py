@@ -13,14 +13,22 @@ steps, which:
 2. measures true compile times per program, calibrating the on-chip smoke's
    per-kernel timeout (round 4's wedge was an axe set below flash-bwd's
    real compile time);
-3. writes the executables into JAX_COMPILATION_CACHE_DIR (default: the
-   repo's .jax_cache, the same directory ``onchip_sequence.sh`` exports) —
-   when the live backend's cache key matches (same libtpu target config),
-   on-chip runs load instead of compiling and never hold the chip through
-   a cold Mosaic compile.
+3. exercises the persistent-cache key path against JAX_COMPILATION_CACHE_DIR
+   (default: the repo's .jax_cache, the same directory ``onchip_sequence.sh``
+   exports). CAVEAT, pinned by tests/test_compile_cache_key.py: on the
+   current jax/jaxlib the compile-only topology client computes correct,
+   process-stable cache keys but CANNOT serialize executables
+   (``serialize_executable`` rejects ``CompileOnlyPyClient``), so no cache
+   entries are actually written — the prewarm is key-validation only, and
+   on-chip runs still pay the cold compile. The keys also fold in the cache
+   dir path itself, so prewarm and live run must export the same
+   JAX_COMPILATION_CACHE_DIR.
 
 Usage:
-    python scripts/aot_tpu_check.py [--full]   # --full adds train steps
+    python scripts/aot_tpu_check.py [--full]
+    # default lane: every Pallas kernel + the multichip (tp2xdp2 train,
+    # sp2 Ulysses, ep2 grouped-GEMM MoE, tp2 serving) sharded legs
+    # --full adds the flagship train steps and bench legs
 Output: one JSON line + onchip_results/aot_check.json
 """
 
@@ -40,6 +48,10 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 os.environ.setdefault("DS_TPU_ASSUME_TPU", "1")  # traced programs must take
 # the TPU fast paths (flash kernel etc.) even though the HOST platform is CPU
 # — the compile target is the real v5e
+
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")  # chip-free host: libtpu
+# must not probe the GCP instance-metadata server for topology env vars (30
+# HTTP retries per variable -> multi-minute hang before the first compile)
 
 import jax  # noqa: E402
 
@@ -262,11 +274,18 @@ def bench_leg_programs():
 
 
 def multichip_programs(topo):
-    """Sharded train step compiled for the REAL 2x2 v5e topology: validates
-    that the flash kernel + GSPMD partitioning + ICI collectives (param
-    all-gathers, grad reduce-scatters) all lower for actual TPU hardware —
-    one level beyond the CPU-mesh dryrun (same semantics, emulated
-    collectives) in ``__graft_entry__.dryrun_multichip``."""
+    """Sharded programs compiled for the REAL 2x2 v5e topology: validate that
+    the Pallas kernels + GSPMD partitioning + ICI collectives (param
+    all-gathers, grad reduce-scatters, Ulysses all-to-alls) all lower for
+    actual TPU hardware — one level beyond the CPU-mesh dryrun (same
+    semantics, emulated collectives) in ``__graft_entry__.dryrun_multichip``.
+
+    GSPMD cannot auto-partition Mosaic kernels, so every leg here depends on
+    the SPMD kernel dispatch layer (``ops/registry.sharded_kernel_call`` over
+    ``parallel/topology.use_kernel_mesh``) wrapping the kernel invocations in
+    shard_map. These legs run in the DEFAULT lane: they are the cheap,
+    load-bearing proof that the multi-chip flagship compiles at all."""
+    from deepspeed_tpu.parallel.topology import use_kernel_mesh
 
     def llama_tp2_dp2():
         from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -302,19 +321,127 @@ def multichip_programs(topo):
                  "labels": jax.ShapeDtypeStruct((8, 1024), jnp.int32)}
 
         def loss_fn(p, b):
-            return model.apply({"params": p}, b)
+            # the active kernel mesh (read at trace time) makes flash_mha
+            # dispatch through shard_map over (dp, tp)
+            with use_kernel_mesh(mesh):
+                return model.apply({"params": p}, b)
 
         fn = jax.value_and_grad(loss_fn)
         return fn, (params, batch), in_shardings
 
-    return [("llama_tp2xdp2_zero_fwd_bwd", llama_tp2_dp2)]
+    def flash_ulysses_sp2():
+        # Ulysses: seq-sharded q/k/v, all-to-all to head-sharded inside an
+        # explicit shard_map, flash kernel on the full local sequence. The
+        # active kernel mesh is deliberately set too: inside the shard_map
+        # both axes are already manual, so the dispatcher must detect that
+        # and NOT double-wrap.
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_mha
+        from deepspeed_tpu.sequence.layer import DistributedAttention
+        from deepspeed_tpu.utils import jax_compat
+
+        mesh = Mesh(np.array(topo.devices).reshape(2, 2), ("dp", "sp"))
+        B, T, H, Dh = 2, 1024, 8, 64
+        attn = DistributedAttention(
+            lambda q, k, v: flash_mha(q, k, v, causal=True), "sp")
+        sharded = jax_compat.shard_map(
+            lambda q, k, v: attn(q, k, v), mesh=mesh,
+            in_specs=(P("dp", "sp"),) * 3, out_specs=P("dp", "sp"),
+            check_vma=False)
+
+        def loss(q, k, v):
+            with use_kernel_mesh(mesh):
+                return jnp.sum(sharded(q, k, v).astype(jnp.float32) ** 2)
+
+        sh = NamedSharding(mesh, P("dp", "sp"))
+        abstract = tuple(jax.ShapeDtypeStruct((B, T, H, Dh), jnp.bfloat16)
+                         for _ in range(3))
+        return jax.grad(loss, argnums=(0, 1, 2)), abstract, (sh, sh, sh)
+
+    def moe_gmm_ep2():
+        from deepspeed_tpu.ops.pallas.grouped_gemm import moe_ffn_gmm
+
+        mesh = Mesh(np.array(topo.devices).reshape(2, 2), ("dp", "ep"))
+        T, D, F, E, k = 64, 256, 512, 4, 2
+
+        def fn(x, tv, ti, w1, w2, w3):
+            # tokens shard over dp x ep (the expert world is carved out of
+            # DP); the dispatcher shard_maps the scatter->gmm->gather chain
+            with use_kernel_mesh(mesh):
+                return moe_ffn_gmm(x, tv, ti, w1, w2, w3, n_experts=E,
+                                   dtype=jnp.bfloat16)
+
+        abstract = (jax.ShapeDtypeStruct((T, D), jnp.bfloat16),
+                    jax.ShapeDtypeStruct((T, k), jnp.float32),
+                    jax.ShapeDtypeStruct((T, k), jnp.int32),
+                    jax.ShapeDtypeStruct((E, D, F), jnp.bfloat16),
+                    jax.ShapeDtypeStruct((E, F, D), jnp.bfloat16),
+                    jax.ShapeDtypeStruct((E, D, F), jnp.bfloat16))
+        tok = NamedSharding(mesh, P(("dp", "ep")))
+        rep = NamedSharding(mesh, P())
+        return fn, abstract, (tok, tok, tok, rep, rep, rep)
+
+    def serving_ragged_tp2():
+        # FastGen TP serving: the bench_serving ragged decode step under
+        # tp=2 x dp=2 — paged_mha (inside lax.scan over layers) must
+        # shard_map over sequences (dp) and KV heads (tp)
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from deepspeed_tpu.inference.v2.model_implementations.llama import (
+            ragged_forward)
+
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=512 + 64 + 64, remat=False)
+        model = LlamaForCausalLM(cfg)
+        mesh = Mesh(np.array(topo.devices).reshape(2, 2), ("dp", "tp"))
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               {"input_ids": jnp.zeros((1, 8), jnp.int32)}))
+        params = shapes["params"]
+        tp_specs = model.param_specs(params)
+
+        def shard_param(spec, leaf):
+            spec = spec if spec is not None else P()
+            entries = list(spec) + [None] * (len(leaf.shape) - len(spec))
+            return NamedSharding(mesh, P(*entries))
+
+        S, budget, block = 8, 256, 32
+        max_ctx = 512 + 64 + 32
+        MB = -(-max_ctx // block)
+        NB = max(64, (max_ctx // block + 2) * 8) + 1
+        L, KV, Dh = cfg.num_hidden_layers, 4, 64
+        bf16 = jnp.bfloat16
+        abstract = (params,
+                    jax.ShapeDtypeStruct((L, NB, KV, block, Dh), bf16),
+                    jax.ShapeDtypeStruct((L, NB, KV, block, Dh), bf16),
+                    jax.ShapeDtypeStruct((S, budget // S), jnp.int32),
+                    jax.ShapeDtypeStruct((S,), jnp.int32),
+                    jax.ShapeDtypeStruct((S,), jnp.int32),
+                    jax.ShapeDtypeStruct((S, MB), jnp.int32))
+        pool = NamedSharding(mesh, P(None, None, "tp"))
+        seq = NamedSharding(mesh, P("dp"))
+        in_shardings = (
+            jax.tree.map(shard_param, tp_specs, params,
+                         is_leaf=lambda x: x is None or isinstance(x, P)),
+            pool, pool, seq, seq, seq, seq)
+
+        def fn(p, kp, vp, t, ql, sn, bt):
+            with use_kernel_mesh(mesh):
+                return ragged_forward(cfg, p, kp, vp, t, ql, sn, bt)
+
+        return fn, abstract, in_shardings
+
+    return [("llama_tp2xdp2_zero_fwd_bwd", llama_tp2_dp2),
+            ("flash_ulysses_sp2_fwd_bwd", flash_ulysses_sp2),
+            ("moe_gmm_ep2_fwd", moe_gmm_ep2),
+            ("serving_ragged_tp2", serving_ragged_tp2)]
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
                     help="also compile the flagship train steps and the "
-                         "multichip tp2xdp2 step")
+                         "longctx/serving bench legs")
     ap.add_argument("--only", default="", help="comma list of program names")
     args = ap.parse_args()
 
@@ -323,10 +450,12 @@ def main():
     shard = NamedSharding(mesh, P())
     target = topo.devices[0].device_kind
 
-    programs = kernel_programs()
+    # multichip legs are default-lane: they are the cheap proof that the
+    # Pallas kernels partition at all (the historical red leg), and CI pins
+    # them green (tests/test_aot_tpu_lowering.py)
+    programs = kernel_programs() + multichip_programs(topo)
     if args.full:
-        programs += (train_programs() + bench_leg_programs()
-                     + multichip_programs(topo))
+        programs += train_programs() + bench_leg_programs()
     if args.only:
         keep = set(args.only.split(","))
         programs = [p for p in programs if p[0] in keep]
@@ -362,6 +491,17 @@ def main():
             print(f"FAIL {name} after {dt:.1f}s: {type(e).__name__}: "
                   f"{str(e)[:300]}", flush=True)
             traceback.print_exc(limit=3)
+        finally:
+            # engine-building legs install a global groups topology; drop it
+            # so the SPMD kernel dispatcher never wraps a LATER single-device
+            # program in a stale multi-device shard_map. clear_caches too:
+            # the kernel mesh binds at TRACE time, and inner-jit traces
+            # (e.g. the jitted ragged_forward, shared between the tp2 leg
+            # and the single-device bench leg) are cached by shapes only —
+            # a cached trace would smuggle the previous leg's mesh across
+            from deepspeed_tpu.parallel import groups
+            groups.reset()
+            jax.clear_caches()
 
     out = {"target": target, "cache_dir": os.environ["JAX_COMPILATION_CACHE_DIR"],
            "full": bool(args.full), "only": args.only or None,

@@ -16,6 +16,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# tests target the modern jax.shard_map API; on older jax the compat module
+# installs a translating shim (check_vma -> check_rep, axis_names -> auto)
+from deepspeed_tpu.utils import jax_compat  # noqa: E402,F401
+
 import pytest  # noqa: E402
 
 _SLOW_LIST = os.path.join(os.path.dirname(__file__), "slow_tests.txt")

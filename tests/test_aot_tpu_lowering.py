@@ -29,10 +29,21 @@ def test_all_pallas_kernels_lower_for_v5e(tmp_path):
     assert proc.returncode == 0, (
         f"AOT Mosaic lowering failed:\n{proc.stdout[-3000:]}\n"
         f"{proc.stderr[-2000:]}")
-    with open(tmp_path / "onchip_results" / "aot_check.json") as f:
+    # the default lane writes the partial artifact; the canonical
+    # aot_check.json is reserved for --full runs (see aot_tpu_check.main)
+    with open(tmp_path / "onchip_results" / "aot_check_partial.json") as f:
         report = json.load(f)
     assert report["FAILED"] == [], report["FAILED"]
     assert report["target"] == "TPU v5 lite"
     names = {r["name"] for r in report["results"]}
     assert {"flash_fwd", "flash_bwd", "paged_mha", "block_sparse",
             "grouped_gemm", "quantized_matmul"} <= names
+    # the multichip legs are pinned green in the default lane: GSPMD cannot
+    # auto-partition Mosaic kernels, so these only compile while the SPMD
+    # kernel dispatch layer (ops/registry.sharded_kernel_call) keeps wrapping
+    # every Pallas call in shard_map — the historical red leg
+    # llama_tp2xdp2_zero_fwd_bwd must never regress to
+    # "NotImplementedError: Mosaic kernels cannot be automatically
+    # partitioned"
+    assert {"llama_tp2xdp2_zero_fwd_bwd", "flash_ulysses_sp2_fwd_bwd",
+            "moe_gmm_ep2_fwd", "serving_ragged_tp2"} <= names

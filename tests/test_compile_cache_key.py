@@ -1,0 +1,165 @@
+"""Offline compile-cache verification: are AOT topology compiles addressable
+by the live backend's cache, without silicon?
+
+``scripts/aot_tpu_check.py`` claims (module docstring, payoff #3) that its
+chip-free v5e compiles prewarm ``.jax_cache`` so on-chip runs load instead of
+compiling. That claim has two checkable halves:
+
+1. KEY ADDRESSABILITY — the persistent-cache key (``jax._src.cache_key.get``:
+   a hash over the HLO module, device/topology fingerprint, compile options
+   + XLA flags, and compiler version) must be deterministic across fresh
+   lowerings AND across processes, and must be sensitive to the things that
+   make an executable non-portable (different topology/device assignment,
+   different compiler flags, CPU backend vs TPU topology). Proven below by
+   recording the keys the cache layer actually computes.
+
+2. ARTIFACT WRITE — the compile must actually serialize into the cache dir.
+   On this jax/jaxlib the topology path DISPROVES the payoff: the
+   compile-only client cannot serialize executables
+   (``serialize_executable(): incompatible function arguments`` from
+   ``CompileOnlyPyClient``), so AOT runs compute correct keys but write NO
+   entries — prewarming currently only validates lowering, it does not save
+   the chip a cold compile. This test pins that fact; if a jax upgrade fixes
+   serialization, the pinned count below fails and the docs should flip.
+
+Runs topology compiles in subprocesses because the compile-only TPU topology
+client and the test session's CPU backend must not share process-global
+backend state (same reason as tests/test_aot_tpu_lowering.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_PROBE = r"""
+import json, os, sys
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+cache_dir = sys.argv[1]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax._src import cache_key as _ck
+
+recorded = []
+_orig = _ck.get
+
+def _wrapper(module, devices, compile_options, backend, *a, **k):
+    key = _orig(module, devices, compile_options, backend, *a, **k)
+    recorded.append({
+        "key": key,
+        "platform": backend.platform,
+        # the compiler-version half of the key's inputs
+        "platform_version": str(backend.platform_version),
+        # the topology-fingerprint half
+        "n_devices": int(np.asarray(devices).size),
+        "num_partitions": compile_options.num_partitions,
+    })
+    return key
+
+_ck.get = _wrapper
+
+from jax.experimental import topologies
+topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2")
+x = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+
+def mk():
+    return lambda x: jnp.sin(x) @ jnp.cos(x).T
+
+def compile_on(mesh_shape, compiler_options=None):
+    mesh = Mesh(np.array(topo.devices).reshape(*mesh_shape), ("dp", "tp"))
+    jax.clear_caches()   # force a fresh lowering -> a fresh cache-key probe
+    lowered = jax.jit(mk(), in_shardings=NamedSharding(mesh, P("dp", "tp"))).lower(x)
+    if compiler_options is None:
+        lowered.compile()
+    else:
+        lowered.compile(compiler_options=compiler_options)
+    return recorded[-1]
+
+r1 = compile_on((2, 2))
+r2 = compile_on((2, 2))                       # same program, fresh lowering
+r_topo = compile_on((4, 1))                   # different device assignment
+r_flags = compile_on((2, 2), {"xla_embed_ir_in_executable": True})
+# the config hash covers jax_compilation_cache_dir itself on this jax:
+# prewarm and live run must point at the SAME cache path or keys diverge
+jax.config.update("jax_compilation_cache_dir", cache_dir + "_alt")
+r_dir = compile_on((2, 2))
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.clear_caches()
+cpu_mesh = Mesh(np.array(jax.devices("cpu")[:1]).reshape(1, 1), ("dp", "tp"))
+jax.jit(mk(), in_shardings=NamedSharding(cpu_mesh, P())).lower(x).compile()
+r_cpu = recorded[-1]
+
+print("PROBE_JSON " + json.dumps({
+    "same1": r1, "same2": r2, "topo_change": r_topo,
+    "flags_change": r_flags, "dir_change": r_dir, "cpu": r_cpu,
+    "cache_entries": sorted(os.listdir(cache_dir))
+                     if os.path.isdir(cache_dir) else [],
+}))
+"""
+
+
+def _run_probe(tmp_path, tag):
+    # NOTE: the cache-dir path is shared between probe runs on purpose — the
+    # config hash folds jax_compilation_cache_dir into the key (see
+    # dir_change below), so cross-process key equality requires it fixed,
+    # exactly as onchip_sequence.sh fixes .jax_cache for prewarm + live run.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache = tmp_path / "cache_shared"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    proc = subprocess.run([sys.executable, "-c", _PROBE, str(cache)],
+                          env=env, capture_output=True, text=True,
+                          timeout=600, cwd=repo)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("PROBE_JSON ")]
+    assert line, proc.stdout[-2000:]
+    return json.loads(line[0][len("PROBE_JSON "):])
+
+
+def test_aot_topology_cache_key_inputs(tmp_path):
+    a = _run_probe(tmp_path, "a")
+    b = _run_probe(tmp_path, "b")
+
+    # determinism: same program + topology + flags -> same key, within a
+    # process across fresh lowerings AND across processes (the property that
+    # makes prewarmed entries addressable by a later live-backend run at all)
+    assert a["same1"]["key"] == a["same2"]["key"]
+    assert a["same1"]["key"] == b["same1"]["key"]
+
+    # sensitivity: every non-portability axis must change the key —
+    # device assignment (topology fingerprint), compiler flags, and the
+    # live-CPU-backend arm (different platform + compiler version)
+    keys = {a["same1"]["key"], a["topo_change"]["key"],
+            a["flags_change"]["key"], a["dir_change"]["key"],
+            a["cpu"]["key"]}
+    assert len(keys) == 5, keys
+
+    # the recorded key inputs explain WHY the cpu arm can never hit a
+    # TPU-prewarmed entry: different platform and compiler version string
+    assert a["same1"]["platform"] != a["cpu"]["platform"]
+    assert a["same1"]["platform_version"] != a["cpu"]["platform_version"]
+    assert a["same1"]["num_partitions"] == 4
+    assert a["cpu"]["num_partitions"] == 1
+
+    # artifact write — PINNED CURRENT BEHAVIOR: the topology (compile-only)
+    # client computes keys but cannot serialize executables on this
+    # jax/jaxlib, so the ONLY cache entry is the live-CPU compile's. The
+    # prewarm payoff claimed by aot_tpu_check.py is therefore currently
+    # key-validation only. If this assert fails after a jax upgrade,
+    # serialization got fixed: flip the docs (README "AOT validation" and
+    # scripts/aot_tpu_check.py payoff #3) and strengthen this to == 5.
+    cpu_key = a["cpu"]["key"]
+    entries = a["cache_entries"]
+    assert all(cpu_key.split("-")[-1] in e or a["same1"]["key"] not in e
+               for e in entries)
+    tpu_keys = {a["same1"]["key"], a["topo_change"]["key"],
+                a["flags_change"]["key"], a["dir_change"]["key"]}
+    assert not any(k in e for e in entries for k in tpu_keys), (
+        "topology compiles started writing cache entries — prewarm works "
+        "now; update README/aot_tpu_check docs and this pin")

@@ -57,6 +57,21 @@ def test_weight_quant_qat_trains():
     assert losses[-1] < losses[0], "QAT training must still converge"
 
 
+def test_activation_quantization_enabled_raises():
+    """activation_quantization is unimplemented: enabling it must be a loud
+    ValueError at init, never a silent no-op (the old behavior skipped the
+    technique while the user believed it was training quantization-aware)."""
+    from deepspeed_tpu.compression.compress import init_compression
+    comp = {"compression_training": {"activation_quantization": {
+        "shared_parameters": {"enabled": True, "quantization_type": "symmetric",
+                              "activation_bits": 8},
+        "different_groups": {"aq1": {"params": {"bits": 8},
+                                     "modules": ["kernel"]}}}}}
+    params = {"layer": {"kernel": jnp.zeros((8, 8)), "bias": jnp.zeros((8,))}}
+    with pytest.raises(ValueError, match="activation_quantization"):
+        init_compression(params, comp)
+
+
 def test_sparse_pruning_masks_apply():
     comp = {"compression_training": {"sparse_pruning": {
         "shared_parameters": {"enabled": True, "schedule_offset": 0,
