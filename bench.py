@@ -464,6 +464,16 @@ def run_bench():
     on_tpu = devs[0].platform in ("tpu", "axon")
     print(f"bench: {n_chips}x {kind}", file=sys.stderr)
 
+    # DS_TPU_TELEMETRY=1 folds the unified-telemetry summary (span stats,
+    # comm bytes/bandwidth, kernel-dispatch outcomes) into payload["extra"].
+    # Off by default: sample_sync would serialize the async dispatch the
+    # bench is measuring. docs/OBSERVABILITY.md has the schema.
+    from deepspeed_tpu import telemetry
+    if os.environ.get("DS_TPU_TELEMETRY") == "1":
+        telemetry.configure(enabled=True, sample_sync=False,
+                            chrome_trace_path=os.environ.get(
+                                "DS_TPU_TELEMETRY_TRACE", ""))
+
     seq = 1024 if on_tpu else 128
     cfg = GPT2Config.small() if on_tpu else GPT2Config.tiny()
     cfg = type(cfg)(**{**cfg.__dict__, "n_positions": max(cfg.n_positions, seq),
@@ -593,6 +603,8 @@ def run_bench():
                   "remat_policy": remat_policy, "fused_step": fused,
                   "gas": gas, "loss": float(jax.device_get(loss))},
     }
+    if telemetry.enabled():
+        payload["extra"]["telemetry"] = telemetry.summary()
     if on_tpu:
         record_last_good(payload)
     emit(payload)

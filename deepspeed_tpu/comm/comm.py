@@ -64,19 +64,55 @@ def get_comms_logger():
 
 
 def _in_trace(x):
+    if isinstance(x, (list, tuple)):
+        return any(_in_trace(t) for t in x)
     return isinstance(x, jax.core.Tracer)
 
 
+def _nbytes(x):
+    """Message size in bytes; list verbs (all_to_all, coalesced) sum their
+    leaves. Works for concrete arrays AND tracers (aval shape/dtype)."""
+    if isinstance(x, (list, tuple)):
+        return sum(_nbytes(t) for t in x)
+    try:
+        return int(x.size) * x.dtype.itemsize
+    except Exception:
+        return 0
+
+
 def timed_op(fn):
-    """Profiling wrapper (reference ``comm/comm.py:101``). In-trace calls are
-    never timed (they compile into the program); host-level calls are timed when
-    the comms logger is enabled."""
+    """Profiling wrapper (reference ``comm/comm.py:101``).
+
+    Host-level calls are timed with ``block_until_ready`` and fed to the
+    comms logger when it is enabled. In-trace calls (inside jit/shard_map)
+    compile into the program, so their device latency cannot be observed
+    here — but the message size and mesh axis are known at trace time, so
+    when telemetry is on each traced collective is recorded (tagged
+    ``traced=True``, duration = host trace-emission time) giving per-op
+    per-axis byte totals even for fully-jitted training loops."""
+    import inspect
+    try:
+        _axis_default = inspect.signature(fn).parameters["axis_name"].default
+    except Exception:
+        _axis_default = None
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        from deepspeed_tpu import telemetry
         log = _comms_logger
         tensor = args[0] if args else kwargs.get("tensor")
-        if log is None or not log.enabled or _in_trace(tensor):
+        axis = kwargs.get("axis_name", _axis_default)
+        tm_on = telemetry.enabled()
+        if _in_trace(tensor):
+            if not tm_on:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            result = fn(*args, **kwargs)
+            telemetry.record_comm(fn.__name__, _nbytes(tensor),
+                                  time.perf_counter() - t0, axis=axis,
+                                  traced=True)
+            return result
+        if (log is None or not log.enabled) and not tm_on:
             return fn(*args, **kwargs)
         t0 = time.perf_counter()
         result = fn(*args, **kwargs)
@@ -85,12 +121,12 @@ def timed_op(fn):
         except Exception:
             pass
         elapsed = time.perf_counter() - t0
-        nbytes = 0
-        try:
-            nbytes = tensor.size * tensor.dtype.itemsize
-        except Exception:
-            pass
-        log.append(fn.__name__, kwargs.get("log_name", fn.__name__), elapsed, nbytes)
+        nbytes = _nbytes(tensor)
+        if log is not None and log.enabled:
+            log.append(fn.__name__, kwargs.get("log_name", fn.__name__),
+                       elapsed, nbytes)
+        if tm_on:
+            telemetry.record_comm(fn.__name__, nbytes, elapsed, axis=axis)
         return result
 
     return wrapper
@@ -420,5 +456,11 @@ monitored_barrier = barrier
 
 
 def log_summary(show_straggler=False):
-    """Print the comms-log summary (reference ``comm/comm.py`` log_summary)."""
-    get_comms_logger().log_all()
+    """Print the comms-log summary (reference ``comm/comm.py`` log_summary).
+    When telemetry is enabled its per-axis comm table (which also covers
+    traced in-jit collectives) is printed alongside the host-level one."""
+    out = get_comms_logger().log_all()
+    from deepspeed_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.log_summary()
+    return out

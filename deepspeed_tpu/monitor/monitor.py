@@ -47,6 +47,10 @@ class CsvMonitor(Monitor):
 
 
 class TensorBoardMonitor(Monitor):
+    """Optional-dependency writer: torch (for SummaryWriter) may be absent on
+    a TPU host. A missing or broken import disables the writer cleanly at
+    construction — enabling TB in the config without torch installed must
+    degrade to a one-line warning, never an ImportError mid-training."""
 
     def __init__(self, config):
         super().__init__(config)
@@ -54,10 +58,15 @@ class TensorBoardMonitor(Monitor):
         if self.enabled:
             try:
                 from torch.utils.tensorboard import SummaryWriter
+            except Exception as e:  # ImportError or a broken torch install
+                logger.warning(f"tensorboard unavailable ({e}); disabling TB monitor")
+                self.enabled = False
+                return
+            try:
                 path = os.path.join(config.output_path or "tensorboard_output", config.job_name)
                 self.writer = SummaryWriter(log_dir=path)
             except Exception as e:
-                logger.warning(f"tensorboard unavailable ({e}); disabling TB monitor")
+                logger.warning(f"tensorboard writer failed ({e}); disabling TB monitor")
                 self.enabled = False
 
     def write_events(self, event_list):
@@ -69,28 +78,39 @@ class TensorBoardMonitor(Monitor):
 
 
 class WandbMonitor(Monitor):
+    """Optional-dependency writer (same guard contract as TB): keeps the
+    imported module handle so ``write_events`` never re-imports."""
 
     def __init__(self, config):
         super().__init__(config)
         self.run = None
+        self._wandb = None
         if self.enabled:
             try:
                 import wandb
-                self.run = wandb.init(project=config.project, group=config.group)
             except Exception as e:
                 logger.warning(f"wandb unavailable ({e}); disabling wandb monitor")
                 self.enabled = False
+                return
+            try:
+                self.run = wandb.init(project=config.project, group=config.group)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb init failed ({e}); disabling wandb monitor")
+                self.enabled = False
 
     def write_events(self, event_list):
-        if self.run is None:
+        if self.run is None or self._wandb is None:
             return
-        import wandb
         for name, value, step in event_list:
-            wandb.log({name: value}, step=step)
+            self._wandb.log({name: value}, step=step)
 
 
 class MonitorMaster(Monitor):
-    """reference ``monitor/monitor.py:29``."""
+    """reference ``monitor/monitor.py:29`` — the fan-out hub. The engine's
+    ``write_events`` lands here and is forwarded to every enabled backend;
+    one backend failing (full disk, dead wandb session) disables that backend
+    with a warning instead of killing the training loop."""
 
     def __init__(self, ds_config):
         self.writers = []
@@ -104,5 +124,12 @@ class MonitorMaster(Monitor):
 
     def write_events(self, event_list):
         for w in self.writers:
-            if w.enabled:
+            if not w.enabled:
+                continue
+            try:
                 w.write_events(event_list)
+            except Exception as e:
+                logger.warning(f"{type(w).__name__}.write_events failed ({e}); "
+                               f"disabling this backend")
+                w.enabled = False
+        self.enabled = any(w.enabled for w in self.writers)

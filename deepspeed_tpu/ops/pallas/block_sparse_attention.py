@@ -180,7 +180,7 @@ def sparse_mha(q, k, v, layout, block, causal=False, softmax_scale=None,
     from deepspeed_tpu.ops.registry import sharded_kernel_call
     return sharded_kernel_call(
         run, [q, k, v], [("data", None, None, None)] * 3,
-        ("data", None, None, None))
+        ("data", None, None, None), name="sparse_mha")
 
 
 def is_supported(q_shape, block):

@@ -630,4 +630,5 @@ def _dispatch_flash(q, k, v, bias, seg, causal, scale, window, interpret):
         return kv >= 1 and h % kv == 0
 
     return sharded_kernel_call(call, args, in_roles,
-                               ("data", None, "head", None), accept=accept)
+                               ("data", None, "head", None), accept=accept,
+                               name="flash_mha")

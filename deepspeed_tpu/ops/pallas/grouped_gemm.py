@@ -63,7 +63,7 @@ def moe_ffn_gmm(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
     return sharded_kernel_call(
         call, [x, top_vals, top_idx, w1, w2, w3],
         [("data", None), ("data", None), ("data", None), wr, wr, wr],
-        ("data", None))
+        ("data", None), name="moe_ffn_gmm")
 
 
 def _moe_ffn_gmm_local(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,

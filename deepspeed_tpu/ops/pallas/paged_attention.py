@@ -111,7 +111,7 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
         call, [q, k_pool, v_pool, block_tables, seen, q_len],
         [("data", None, "head", None), (None, "head", None, None),
          (None, "head", None, None), ("data", None), ("data",), ("data",)],
-        ("data", None, "head", None), accept=accept)
+        ("data", None, "head", None), accept=accept, name="paged_mha")
 
 
 def _paged_mha_local(q, k_pool, v_pool, block_tables, seen, q_len, *,

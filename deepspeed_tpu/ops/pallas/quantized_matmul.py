@@ -85,7 +85,7 @@ def quantized_matmul(x, q, scale, group_size, out_dtype=None,
     return sharded_kernel_call(
         call, [x, q, scale],
         [("data", None), (None, "head"), (None, "head")],
-        ("data", "head"), accept=accept)
+        ("data", "head"), accept=accept, name="quantized_matmul")
 
 
 def _quantized_matmul_local(x, q, scale, group_size, out_dtype=None,

@@ -113,7 +113,7 @@ def pallas_enabled():
 # ---------------------------------------------------------------------------
 
 
-def sharded_kernel_call(fn, args, in_roles, out_roles, accept=None):
+def sharded_kernel_call(fn, args, in_roles, out_roles, accept=None, name=None):
     """Invoke kernel ``fn(*args)``, shard_map-wrapped over the active mesh.
 
     ``in_roles``/``out_roles``: per-dimension role tags, one tuple per
@@ -129,6 +129,11 @@ def sharded_kernel_call(fn, args, in_roles, out_roles, accept=None):
     block/tile constraints. Falls back to a direct ``fn(*args)`` when no mesh
     is active, the mesh is trivial, or no role survives the checks.
 
+    ``name`` labels the telemetry dispatch counter (default: ``fn.__name__``).
+    Every decision — sharded, fallback, veto — is recorded with a reason code
+    (docs/OBSERVABILITY.md) when telemetry is enabled, so a silent XLA
+    fallback becomes a visible metric instead of a perf mystery.
+
     The mesh binds at TRACE time: jax trace caches (including inner ``jit``
     wrappers around callers of this, keyed on shapes only) will replay a
     previously captured shard_map even after the active mesh changed.
@@ -136,10 +141,17 @@ def sharded_kernel_call(fn, args, in_roles, out_roles, accept=None):
     sweeps, tests) must ``jax.clear_caches()`` in between.
     """
     from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu import telemetry
     from deepspeed_tpu.parallel import topology
 
+    kname = name or getattr(fn, "__name__", "kernel")
     mesh = topology.active_kernel_mesh()
-    if mesh is None or mesh.size == 1:
+    if mesh is None:
+        telemetry.record_dispatch(kname, "fallback", "no_mesh")
+        return fn(*args)
+    if mesh.size == 1:
+        telemetry.record_dispatch(kname, "fallback", "trivial_mesh",
+                                  mesh_size=1)
         return fn(*args)
     roles = topology.kernel_partition_axes(mesh)
     shape = dict(mesh.shape)
@@ -164,6 +176,8 @@ def sharded_kernel_call(fn, args, in_roles, out_roles, accept=None):
                 all(s % factor[role] == 0 for s in tagged[role]):
             live[role] = roles["data"] if role == "data" else roles["head"]
     if not live:
+        telemetry.record_dispatch(kname, "fallback", "no_live_role",
+                                  mesh_size=mesh.size)
         return fn(*args)
     if accept is not None:
         shard_shapes = [
@@ -171,6 +185,8 @@ def sharded_kernel_call(fn, args, in_roles, out_roles, accept=None):
                   for d, s in enumerate(arg.shape))
             for arg, r in zip(args, in_roles)]
         if not accept(shard_shapes):
+            telemetry.record_dispatch(kname, "veto", "accept_veto",
+                                      mesh_size=mesh.size)
             return fn(*args)
 
     def spec(r):
@@ -182,6 +198,9 @@ def sharded_kernel_call(fn, args, in_roles, out_roles, accept=None):
     else:
         out_specs = spec(out_roles)
     from deepspeed_tpu.utils import jax_compat
+    telemetry.record_dispatch(kname, "sharded",
+                              "+".join(sorted(live)) or "ok",
+                              mesh_size=mesh.size)
     wrapped = jax_compat.shard_map(fn, mesh=mesh, in_specs=in_specs,
                                    out_specs=out_specs, check_vma=False)
     return wrapped(*args)

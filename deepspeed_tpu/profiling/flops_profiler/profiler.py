@@ -121,6 +121,62 @@ def _fmt(n):
     return str(int(n))
 
 
+# ---------------------------------------------------------------------------
+# Closed-form FLOP formulas for the Pallas kernel entry points (ops/kernels).
+# XLA cost analysis cannot see inside a pallas_call, so per-kernel counts come
+# from these analytic expressions instead. Conventions: one MAC = 2 FLOPs;
+# attention counts QK^T + PV (the two big GEMMs), softmax is ignored as O(n)
+# next to the O(n*d) matmuls — matching the reference flops profiler.
+# ---------------------------------------------------------------------------
+def _flash_mha_flops(batch, heads, q_len, kv_len, head_dim, causal=False):
+    """QK^T (2*Sq*Skv*D) + PV (2*Sq*Skv*D) per head; causal masks half the
+    score matrix."""
+    f = 4.0 * batch * heads * q_len * kv_len * head_dim
+    return int(f * (0.5 if causal else 1.0))
+
+
+def _paged_mha_flops(num_seqs, heads, q_len, kv_len, head_dim):
+    """Decode-style attention over paged KV: same two GEMMs per sequence."""
+    return int(4.0 * num_seqs * heads * q_len * kv_len * head_dim)
+
+
+def _sparse_mha_flops(batch, heads, q_len, kv_len, head_dim, density=1.0):
+    """Block-sparse attention only computes the live fraction of blocks."""
+    return int(4.0 * batch * heads * q_len * kv_len * head_dim * density)
+
+
+def _moe_ffn_gmm_flops(tokens, d_model, d_ff, topk=1):
+    """Grouped GEMM expert FFN: up-proj (2*d_model*d_ff) + down-proj
+    (2*d_ff*d_model) per routed token-copy."""
+    return int(4.0 * tokens * topk * d_model * d_ff)
+
+
+def _quantized_matmul_flops(m, n, k):
+    """Int8/int4 GEMM still does m*n*k MACs (dequant epilogue is O(m*n))."""
+    return int(2.0 * m * n * k)
+
+
+KERNEL_FLOPS = {
+    "flash_mha": _flash_mha_flops,
+    "paged_mha": _paged_mha_flops,
+    "sparse_mha": _sparse_mha_flops,
+    "moe_ffn_gmm": _moe_ffn_gmm_flops,
+    "quantized_matmul": _quantized_matmul_flops,
+}
+
+
+def register_kernel_flops(name, formula):
+    """Register/override the closed-form FLOP formula for a kernel name (the
+    same names ``ops/registry.sharded_kernel_call`` dispatches under)."""
+    KERNEL_FLOPS[name] = formula
+
+
+def kernel_flops(name, **dims):
+    """FLOPs for one named Pallas kernel invocation from its dimensions.
+    Raises KeyError for unknown kernels so typos fail loudly."""
+    return KERNEL_FLOPS[name](**dims)
+
+
 class FlopsProfiler:
     """Engine-attached profiler (reference FlopsProfiler class + the engine's
     ``flops_profiler`` config flow): at ``profile_step`` it analyzes the

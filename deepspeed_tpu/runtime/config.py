@@ -93,6 +93,20 @@ class CommsLoggerConfig(DeepSpeedConfigModel):
     debug = False
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """``telemetry`` section — the unified observability pipeline
+    (deepspeed_tpu/telemetry). Disabled by default: every telemetry entry
+    point is then a constant-time no-op (no block_until_ready, no file I/O).
+    See docs/OBSERVABILITY.md."""
+    enabled = False
+    jsonl_path = ""          # "" disables the JSON-lines metrics export
+    chrome_trace_path = ""   # "" disables the chrome://tracing span export
+    sample_sync = True       # block_until_ready on span tokens when sampling
+    jax_annotations = False  # mirror spans into jax.profiler annotations
+    monitor = True           # fan aggregates through MonitorMaster at
+    #                          steps_per_print cadence
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled = False
     recompute_fwd_factor = 0.0
@@ -167,6 +181,7 @@ KNOWN_TOP_LEVEL_KEYS = {
     C.ACTIVATION_CHECKPOINTING, C.PIPELINE, C.TENSOR_PARALLEL,
     C.SEQUENCE_PARALLEL_SIZE, C.EXPERT_PARALLEL_SIZE, C.COMMS_LOGGER,
     C.MONITOR_TENSORBOARD, C.MONITOR_CSV, C.MONITOR_WANDB, C.FLOPS_PROFILER,
+    C.TELEMETRY,
     C.ELASTICITY, C.AUTOTUNING, C.CHECKPOINT, C.COMPILE,
     "moe", "seed", "hybrid_engine", "curriculum_learning", "data_efficiency",
     "compression_training", "eigenvalue", "progressive_layer_drop",
@@ -287,6 +302,7 @@ class DeepSpeedConfig:
         self.monitor_config_csv = MonitorWriterConfig(pd.get(C.MONITOR_CSV, {}))
         self.monitor_config_wandb = WandbConfig(pd.get(C.MONITOR_WANDB, {}))
         self.flops_profiler_config = FlopsProfilerConfig(pd.get(C.FLOPS_PROFILER, {}))
+        self.telemetry_config = TelemetryConfig(pd.get(C.TELEMETRY, {}))
         self.checkpoint_config = CheckpointConfig(pd.get(C.CHECKPOINT, {}))
         self.elasticity_config = ElasticityConfig(pd.get(C.ELASTICITY, {}))
         self.compile_config = CompileConfig(pd.get(C.COMPILE, {}))

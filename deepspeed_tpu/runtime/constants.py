@@ -44,6 +44,7 @@ MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_CSV = "csv_monitor"
 MONITOR_WANDB = "wandb"
 FLOPS_PROFILER = "flops_profiler"
+TELEMETRY = "telemetry"
 ELASTICITY = "elasticity"
 AUTOTUNING = "autotuning"
 CHECKPOINT = "checkpoint"

@@ -285,6 +285,15 @@ class DeepSpeedEngine:
         import deepspeed_tpu.comm as dist
         dist.configure(comms_config=self.config.comms_config)
 
+        # unified telemetry (docs/OBSERVABILITY.md): configure the
+        # process-global pipeline ONLY when this config enables it — a
+        # disabled section must not clobber a pipeline another caller
+        # (tests, benches) already switched on
+        from deepspeed_tpu import telemetry
+        if self.config.telemetry_config.enabled:
+            telemetry.configure(config=self.config.telemetry_config)
+        self._telemetry_monitor = bool(self.config.telemetry_config.monitor)
+
         # remat policy for model blocks (models read it at trace time)
         from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
         checkpointing.configure(deepspeed_config=self.config)
@@ -1393,6 +1402,8 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         self.tput_timer.start()
+        from deepspeed_tpu import telemetry
+        _span = telemetry.span_begin(FORWARD_GLOBAL_TIMER)
         batch = self._shard_batch(batch)
         if self._guards is not None and self._guards["checkify_on_overflow"]:
             self._last_guard_batch = batch  # for overflow localization
@@ -1413,6 +1424,7 @@ class DeepSpeedEngine:
             else:
                 self._loss_accum = self._loss_accum + loss
                 self._loss_accum_n += 1
+        _span.end(token=loss)
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).stop(token=loss)
         return loss
@@ -1421,10 +1433,15 @@ class DeepSpeedEngine:
 
     def backward(self, loss=None, retain_graph=False):
         """API-parity shim: gradient computation/reduction already ran fused
-        inside ``forward`` (see note there)."""
+        inside ``forward`` (see note there). The ``bwd`` telemetry span
+        therefore measures the wait for the in-flight fused program (its
+        token sync), not a separate grad pass."""
         assert self._staged_loss is not None, "backward() called before forward()"
-        staged_loss = self._staged_loss
-        self._staged_loss = None
+        from deepspeed_tpu import telemetry
+        with telemetry.span(BACKWARD_GLOBAL_TIMER) as _sp:
+            staged_loss = self._staged_loss
+            self._staged_loss = None
+            _sp.token = staged_loss
         return staged_loss
 
     def is_gradient_accumulation_boundary(self):
@@ -1477,6 +1494,8 @@ class DeepSpeedEngine:
     def step(self):
         """Optimizer step at the gradient-accumulation boundary (engine.py:2132)."""
         self._step_applied = False
+        from deepspeed_tpu import telemetry
+        _span = telemetry.span_begin(STEP_GLOBAL_TIMER)
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
@@ -1513,12 +1532,16 @@ class DeepSpeedEngine:
                         self._loss_accum_n
                     events.insert(0, ("Train/Samples/train_loss", mean,
                                       self.global_samples))
+                if self._telemetry_monitor and telemetry.enabled():
+                    events.extend(telemetry.monitor_events(self.global_samples))
                 self.monitor.write_events(events)
             self._loss_accum, self._loss_accum_n = None, 0
         self.micro_steps += 1
         self.global_samples += self.micro_batch_size * self.topology.data_parallel_size
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).stop()
+        _span.end(token=self._last_stats.loss_scale
+                  if (self._step_applied and self._last_stats is not None) else None)
         self.tput_timer.stop(global_step=self._step_applied)
         if self._step_applied and self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps}, skipped={self.skipped_steps}, "
@@ -1578,7 +1601,9 @@ class DeepSpeedEngine:
                 raise RuntimeError(
                     "fused train_batch mid-accumulation-window: finish the "
                     "window with forward/backward/step first")
-            batches = [next(data_iter) for _ in range(gas)]
+            from deepspeed_tpu import telemetry
+            with telemetry.span("dataloader", gas=gas):
+                batches = [next(data_iter) for _ in range(gas)]
             self._ensure_initialized(batches[0])
             self._compiled()
             self.tput_timer.start()
@@ -1599,17 +1624,22 @@ class DeepSpeedEngine:
             mean = losses.mean()
             if self.monitor.enabled and \
                     self.global_steps % self.config.steps_per_print == 0:
-                self.monitor.write_events([
+                events = [
                     ("Train/Samples/train_loss", float(jax.device_get(mean)),
                      self.global_samples),
                     ("Train/Samples/lr", float(stats.lr), self.global_samples),
                     ("Train/Samples/loss_scale", float(stats.loss_scale),
-                     self.global_samples)])
+                     self.global_samples)]
+                if self._telemetry_monitor and telemetry.enabled():
+                    events.extend(telemetry.monitor_events(self.global_samples))
+                self.monitor.write_events(events)
             self.tput_timer.stop(global_step=True)
             return float(jax.device_get(mean))
+        from deepspeed_tpu import telemetry
         losses = []
         for _ in range(gas):
-            batch = next(data_iter)
+            with telemetry.span("dataloader"):
+                batch = next(data_iter)
             loss = self.forward(batch)
             self.backward(loss)
             self.step()
@@ -1619,7 +1649,17 @@ class DeepSpeedEngine:
     def eval_batch(self, batch):
         self._ensure_initialized(batch)
         self._compiled()
-        return self._eval_step_fn(self.state, self._shard_batch(batch))
+        from deepspeed_tpu import telemetry
+        with telemetry.span("eval") as _sp:
+            out = self._eval_step_fn(self.state, self._shard_batch(batch))
+            _sp.token = out
+        return out
+
+    def write_events(self, event_list):
+        """Forward (name, value, step) event tuples to the monitor fan-out
+        (reference ``engine.py:2273``) — the hook telemetry exporters and
+        user code share."""
+        self.monitor.write_events(event_list)
 
     # ------------------------------------------------------------------
     # introspection (reference engine getter surface)

@@ -312,7 +312,9 @@ class PipelineEngine(DeepSpeedEngine):
                 self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
             data_iter = self._data_iterator
         gas = self.gradient_accumulation_steps_value
-        micro_batches = [next(data_iter) for _ in range(gas)]
+        from deepspeed_tpu import telemetry
+        with telemetry.span("dataloader", gas=gas, pipe=True):
+            micro_batches = [next(data_iter) for _ in range(gas)]
         batch = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *micro_batches)
         loss = self.forward(batch)
         self.backward(loss)

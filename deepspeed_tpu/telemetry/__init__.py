@@ -1,0 +1,96 @@
+"""Unified telemetry: step tracing, collective-bandwidth accounting,
+kernel-dispatch counters, compile timing, and Chrome-trace export.
+
+Module-level functions delegate to ONE process-global :class:`Telemetry`
+pipeline so every layer (engine, comm, ops registry, AOT scripts, benches)
+feeds the same sinks::
+
+    from deepspeed_tpu import telemetry
+
+    telemetry.configure(enabled=True, jsonl_path="metrics.jsonl",
+                        chrome_trace_path="trace.json")
+    with telemetry.span("fwd") as sp:
+        loss = step(batch)
+        sp.token = loss          # span end block_until_ready's the token
+    telemetry.record("loss", float(loss), kind="gauge", step=1)
+    print(telemetry.log_summary())
+    telemetry.export_chrome_trace()
+
+Disabled (the default), every call here is a constant-time no-op — no jax
+sync, no file I/O. See docs/OBSERVABILITY.md for config keys, the exporter
+matrix and the dispatch reason-code table.
+"""
+
+from deepspeed_tpu.telemetry.core import Telemetry, _NULL_SPAN  # noqa: F401
+
+_GLOBAL = Telemetry()
+
+
+def get_telemetry():
+    """The process-global pipeline object."""
+    return _GLOBAL
+
+
+def enabled():
+    return _GLOBAL.enabled
+
+
+def configure(config=None, **kwargs):
+    """Configure the global pipeline (see :meth:`Telemetry.configure`)."""
+    _GLOBAL.configure(config=config, **kwargs)
+
+
+def record(name, value, kind="gauge", **tags):
+    _GLOBAL.record(name, value, kind=kind, **tags)
+
+
+def count(name, n=1, **tags):
+    _GLOBAL.count(name, n=n, **tags)
+
+
+def span(name, **tags):
+    return _GLOBAL.span(name, **tags)
+
+
+def span_begin(name, **tags):
+    return _GLOBAL.span_begin(name, **tags)
+
+
+def record_comm(op, nbytes, seconds, axis=None, traced=False):
+    _GLOBAL.record_comm(op, nbytes, seconds, axis=axis, traced=traced)
+
+
+def record_dispatch(kernel, outcome, reason, mesh_size=None):
+    _GLOBAL.record_dispatch(kernel, outcome, reason, mesh_size=mesh_size)
+
+
+def record_compile(program, seconds, topology=None, cache=None):
+    _GLOBAL.record_compile(program, seconds, topology=topology, cache=cache)
+
+
+def summary():
+    return _GLOBAL.summary()
+
+
+def format_summary():
+    return _GLOBAL.format_summary()
+
+
+def log_summary(print_log=True):
+    return _GLOBAL.log_summary(print_log=print_log)
+
+
+def monitor_events(step):
+    return _GLOBAL.monitor_events(step)
+
+
+def export_chrome_trace(path=None):
+    return _GLOBAL.export_chrome_trace(path)
+
+
+def reset():
+    _GLOBAL.reset()
+
+
+def close():
+    _GLOBAL.close()

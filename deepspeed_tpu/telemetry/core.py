@@ -1,0 +1,458 @@
+"""Process-global telemetry pipeline — the unified observability layer.
+
+One object owns every measurement stream the runtime produces:
+
+- **spans** (``span("fwd")`` / ``span_begin``/``end``): wall-clock phases of
+  the train loop. A span may carry a jax array ``token``; when sampling is on
+  the span end calls ``jax.block_until_ready(token)`` so the measured
+  interval covers the device work, not just the async dispatch.
+- **metrics** (``record(name, value, kind, **tags)``): scalar samples,
+  appended to an in-memory list and (when configured) a JSON-lines file.
+- **counters** (``count(name, **tags)``): monotone per-tag counts.
+- **comm** (``record_comm``): per-op per-mesh-axis message bytes, latency and
+  algbw/busbw (``utils/comms_logging.calc_bw_log`` factors).
+- **dispatch** (``record_dispatch``): per-kernel sharded/fallback/veto
+  outcomes with reason codes from ``ops/registry.sharded_kernel_call``.
+- **compile** (``record_compile``): per-program compile seconds + persistent
+  compilation-cache hit/miss from the AOT path.
+
+Exporters: Chrome-trace JSON (``chrome://tracing`` / Perfetto) for spans, a
+JSON-lines metrics file, Monitor fan-out events (``monitor_events``) for the
+CSV/TB/W&B backends, and an optional ``jax.profiler`` trace-annotation
+pass-through so spans also appear in real TPU profiles.
+
+Disabled (the default) every entry point is a constant-time no-op: no
+``block_until_ready``, no file I/O, no allocation beyond the guard check —
+see ``tests/test_telemetry.py::test_disabled_noop_fast_path``.
+
+This module deliberately imports only the standard library at module scope;
+jax is imported lazily inside the enabled-only paths.
+"""
+
+import atexit
+import json
+import os
+import threading
+import time
+
+
+class _NullSpan:
+    """Shared no-op span for the disabled fast path: entering/exiting does
+    nothing and assigning ``token`` is absorbed."""
+
+    __slots__ = ("token",)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def end(self, token=None):
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """A live scoped measurement. Usable as a context manager
+    (``with telemetry.span("fwd") as sp: ...; sp.token = loss``) or via the
+    explicit ``span_begin``/``end`` pair when the scope spans methods."""
+
+    __slots__ = ("_tm", "name", "tags", "token", "_t0", "_annotation")
+
+    def __init__(self, tm, name, tags):
+        self._tm = tm
+        self.name = name
+        self.tags = tags
+        self.token = None
+        self._annotation = None
+        if tm.jax_annotations:
+            try:
+                import jax.profiler
+                self._annotation = jax.profiler.TraceAnnotation(name)
+                self._annotation.__enter__()
+            except Exception:
+                self._annotation = None
+        self._t0 = time.perf_counter()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.end(self.token)
+        return False
+
+    def end(self, token=None):
+        tm = self._tm
+        if tm is None:
+            return 0.0
+        self._tm = None  # ending twice records once
+        if token is None:
+            token = self.token
+        if token is not None and tm.sample_sync:
+            try:
+                import jax
+                jax.block_until_ready(token)
+            except Exception:
+                pass
+        dt = time.perf_counter() - self._t0
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(None, None, None)
+            except Exception:
+                pass
+        tm._end_span(self.name, self._t0, dt, self.tags)
+        return dt
+
+
+class Telemetry:
+    """The process-global telemetry pipeline (one instance per process,
+    module-level singleton in ``deepspeed_tpu/telemetry/__init__.py``)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.enabled = False
+        self._reset_state()
+        # exporter wiring (survives reset() so a reset mid-run keeps sinks)
+        self.sample_sync = True
+        self.jax_annotations = False
+        self.jsonl_path = None
+        self.chrome_trace_path = None
+        self.monitor_prefix = "Telemetry/"
+        self._jsonl_fh = None
+        self._atexit_registered = False
+
+    def _reset_state(self):
+        self._epoch = time.perf_counter()
+        self.trace_events = []    # chrome-trace event dicts
+        self.metrics = []         # every record() sample, in order
+        self.counters = {}        # name -> {tag_key: int}
+        self.span_stats = {}      # name -> [count, total_s]
+        self.comm_stats = {}      # (op, axis) -> [count, bytes, secs, algbw, busbw]
+        self.dispatch_stats = {}  # (kernel, outcome, reason) -> count
+        self.compile_stats = {}   # program -> {seconds, topology, cache}
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+    def configure(self, config=None, enabled=None, jsonl_path=None,
+                  chrome_trace_path=None, sample_sync=None,
+                  jax_annotations=None):
+        """Configure from a ``TelemetryConfig`` (runtime/config.py
+        ``telemetry`` section) and/or explicit overrides. Paths set to ""
+        disable that exporter."""
+        with self._lock:
+            if config is not None:
+                enabled = getattr(config, "enabled", enabled) \
+                    if enabled is None else enabled
+                jsonl_path = getattr(config, "jsonl_path", jsonl_path) \
+                    if jsonl_path is None else jsonl_path
+                chrome_trace_path = getattr(config, "chrome_trace_path",
+                                            chrome_trace_path) \
+                    if chrome_trace_path is None else chrome_trace_path
+                sample_sync = getattr(config, "sample_sync", sample_sync) \
+                    if sample_sync is None else sample_sync
+                jax_annotations = getattr(config, "jax_annotations",
+                                          jax_annotations) \
+                    if jax_annotations is None else jax_annotations
+            if sample_sync is not None:
+                self.sample_sync = bool(sample_sync)
+            if jax_annotations is not None:
+                self.jax_annotations = bool(jax_annotations)
+            if jsonl_path is not None:
+                if self._jsonl_fh is not None and \
+                        jsonl_path != self.jsonl_path:
+                    try:
+                        self._jsonl_fh.close()
+                    except Exception:
+                        pass
+                    self._jsonl_fh = None
+                self.jsonl_path = jsonl_path or None
+            if chrome_trace_path is not None:
+                self.chrome_trace_path = chrome_trace_path or None
+                if self.chrome_trace_path and not self._atexit_registered:
+                    atexit.register(self._atexit_export)
+                    self._atexit_registered = True
+            if enabled is not None:
+                self.enabled = bool(enabled)
+
+    def _atexit_export(self):
+        if self.enabled and self.chrome_trace_path and self.trace_events:
+            try:
+                self.export_chrome_trace()
+            except Exception:
+                pass
+
+    def reset(self):
+        """Drop every accumulated measurement (sink config stays)."""
+        with self._lock:
+            self._reset_state()
+
+    def close(self):
+        with self._lock:
+            if self._jsonl_fh is not None:
+                try:
+                    self._jsonl_fh.close()
+                except Exception:
+                    pass
+                self._jsonl_fh = None
+
+    # ------------------------------------------------------------------
+    # spans
+    # ------------------------------------------------------------------
+    def span(self, name, **tags):
+        """Scoped wall-clock measurement; ``_NULL_SPAN`` when disabled so the
+        off path never allocates or syncs."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, tags or None)
+
+    span_begin = span  # same object, explicit begin/end idiom
+
+    def _end_span(self, name, t0, dt, tags):
+        with self._lock:
+            st = self.span_stats.get(name)
+            if st is None:
+                st = self.span_stats[name] = [0, 0.0]
+            st[0] += 1
+            st[1] += dt
+            ev = {"name": name, "ph": "X", "cat": "span",
+                  "ts": round((t0 - self._epoch) * 1e6, 3),
+                  "dur": round(dt * 1e6, 3),
+                  "pid": os.getpid(), "tid": threading.get_ident() & 0xffff}
+            if tags:
+                ev["args"] = tags
+            self.trace_events.append(ev)
+            self._emit_jsonl({"name": name, "kind": "span", "value": dt,
+                              "unit": "s", "tags": tags or {}})
+
+    # ------------------------------------------------------------------
+    # metrics + counters
+    # ------------------------------------------------------------------
+    def record(self, name, value, kind="gauge", **tags):
+        """Record one scalar sample. ``kind``: "gauge" | "counter" | "bytes"
+        | "seconds" (free-form strings are kept verbatim)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if kind == "counter":
+                per = self.counters.setdefault(name, {})
+                key = tuple(sorted(tags.items()))
+                per[key] = per.get(key, 0) + value
+            self.metrics.append({"name": name, "kind": kind, "value": value,
+                                 "tags": tags or {}})
+            self._emit_jsonl({"name": name, "kind": kind, "value": value,
+                              "tags": tags or {}})
+
+    def count(self, name, n=1, **tags):
+        self.record(name, n, kind="counter", **tags)
+
+    # ------------------------------------------------------------------
+    # layer-specific recorders
+    # ------------------------------------------------------------------
+    def record_comm(self, op, nbytes, seconds, axis=None, traced=False):
+        """One collective: bytes moved, wall seconds (host-level latency, or
+        trace-emission time for in-trace calls), algbw/busbw via the ring
+        correction factors. ``axis`` is the mesh axis (name or tuple)."""
+        if not self.enabled:
+            return
+        from deepspeed_tpu.utils.comms_logging import calc_bw_log
+        n = None
+        try:
+            from jax import lax
+            n = int(lax.axis_size(axis))   # only resolvable in-trace
+        except Exception:
+            pass
+        algbw, busbw = calc_bw_log(op, nbytes, seconds, n=n)
+        axis_key = "/".join(axis) if isinstance(axis, (tuple, list)) \
+            else (axis or "?")
+        with self._lock:
+            st = self.comm_stats.get((op, axis_key))
+            if st is None:
+                st = self.comm_stats[(op, axis_key)] = [0, 0, 0.0, 0.0, 0.0]
+            st[0] += 1
+            st[1] += nbytes
+            st[2] += seconds
+            st[3] += algbw
+            st[4] += busbw
+            ev = {"name": f"comm:{op}", "ph": "X", "cat": "comm",
+                  "ts": round((time.perf_counter() - seconds - self._epoch)
+                              * 1e6, 3),
+                  "dur": round(seconds * 1e6, 3),
+                  "pid": os.getpid(), "tid": threading.get_ident() & 0xffff,
+                  "args": {"bytes": nbytes, "axis": axis_key,
+                           "traced": bool(traced)}}
+            self.trace_events.append(ev)
+            self._emit_jsonl({"name": f"comm/{op}", "kind": "bytes",
+                              "value": nbytes,
+                              "tags": {"axis": axis_key, "seconds": seconds,
+                                       "algbw_gbs": round(algbw, 4),
+                                       "busbw_gbs": round(busbw, 4),
+                                       "traced": bool(traced)}})
+
+    def record_dispatch(self, kernel, outcome, reason, mesh_size=None):
+        """One ``sharded_kernel_call`` decision. ``outcome``: "sharded" |
+        "fallback" | "veto"; ``reason``: see docs/OBSERVABILITY.md table."""
+        if not self.enabled:
+            return
+        with self._lock:
+            key = (kernel, outcome, reason)
+            self.dispatch_stats[key] = self.dispatch_stats.get(key, 0) + 1
+            self._emit_jsonl({"name": f"dispatch/{kernel}", "kind": "counter",
+                              "value": 1,
+                              "tags": {"outcome": outcome, "reason": reason,
+                                       "mesh_size": mesh_size}})
+
+    def record_compile(self, program, seconds, topology=None, cache=None):
+        """One AOT/jit compile: wall seconds + persistent-cache outcome
+        ("hit" | "miss" | "unknown")."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.compile_stats[program] = {
+                "seconds": round(seconds, 3), "topology": topology,
+                "cache": cache or "unknown"}
+            self._emit_jsonl({"name": f"compile/{program}", "kind": "seconds",
+                              "value": seconds,
+                              "tags": {"topology": topology,
+                                       "cache": cache or "unknown"}})
+
+    # ------------------------------------------------------------------
+    # exporters
+    # ------------------------------------------------------------------
+    def _emit_jsonl(self, obj):
+        # callers hold self._lock
+        if not self.jsonl_path:
+            return
+        if self._jsonl_fh is None:
+            d = os.path.dirname(self.jsonl_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._jsonl_fh = open(self.jsonl_path, "a")
+        obj["ts"] = round(time.perf_counter() - self._epoch, 6)
+        self._jsonl_fh.write(json.dumps(obj) + "\n")
+        self._jsonl_fh.flush()
+
+    def export_chrome_trace(self, path=None):
+        """Write accumulated spans as a Chrome-trace file (the
+        ``{"traceEvents": [...]}`` object form — load in ``chrome://tracing``
+        or https://ui.perfetto.dev). Returns the path written."""
+        path = path or self.chrome_trace_path
+        if not path:
+            raise ValueError("no chrome_trace_path configured")
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with self._lock:
+            doc = {"traceEvents": list(self.trace_events),
+                   "displayTimeUnit": "ms",
+                   "otherData": {"producer": "deepspeed_tpu.telemetry"}}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    def summary(self):
+        """One JSON-able dict aggregating every stream — embedded into
+        BENCH_*.json / the AOT artifact (schema:
+        ``deepspeed_tpu/telemetry/summary.schema.json``)."""
+        if not self.enabled:
+            return {"enabled": False}
+        with self._lock:
+            spans = {name: {"count": c, "total_s": round(tot, 6),
+                            "mean_s": round(tot / c, 6) if c else 0.0}
+                     for name, (c, tot) in sorted(self.span_stats.items())}
+            comm = {}
+            total_bytes = 0
+            for (op, axis), (c, nb, secs, algbw, busbw) in \
+                    sorted(self.comm_stats.items()):
+                comm.setdefault(op, {})[axis] = {
+                    "count": c, "bytes": nb, "total_s": round(secs, 6),
+                    "algbw_gbs": round(algbw / c, 4) if c else 0.0,
+                    "busbw_gbs": round(busbw / c, 4) if c else 0.0}
+                total_bytes += nb
+            dispatch = {}
+            for (kernel, outcome, reason), c in \
+                    sorted(self.dispatch_stats.items()):
+                dispatch.setdefault(kernel, {}).setdefault(
+                    outcome, {})[reason] = c
+            compile_sec = dict(self.compile_stats)
+            hits = sum(1 for v in compile_sec.values()
+                       if v.get("cache") == "hit")
+            misses = sum(1 for v in compile_sec.values()
+                         if v.get("cache") == "miss")
+            counters = {name: {",".join(f"{k}={v}" for k, v in key) or "_": n
+                               for key, n in per.items()}
+                        for name, per in sorted(self.counters.items())}
+            return {"enabled": True, "spans": spans,
+                    "comm": {"ops": comm, "total_bytes": total_bytes},
+                    "dispatch": dispatch,
+                    "compile": {"programs": compile_sec,
+                                "cache_hits": hits, "cache_misses": misses},
+                    "counters": counters}
+
+    def format_summary(self):
+        """DeepSpeed-style fixed-width tables over every stream."""
+        s = self.summary()
+        if not s.get("enabled"):
+            return "telemetry disabled"
+        lines = []
+        if s["spans"]:
+            lines.append(f"{'Span':<24}{'Count':<10}{'Total(ms)':<14}"
+                         f"{'Mean(ms)':<14}")
+            for name, st in s["spans"].items():
+                lines.append(f"{name:<24}{st['count']:<10}"
+                             f"{st['total_s']*1e3:<14.2f}"
+                             f"{st['mean_s']*1e3:<14.2f}")
+        if s["comm"]["ops"]:
+            lines.append(f"{'Comm. Op':<20}{'Axis':<10}{'Count':<10}"
+                         f"{'Bytes':<14}{'algbw(GB/s)':<14}{'busbw(GB/s)':<14}")
+            for op, per_axis in s["comm"]["ops"].items():
+                for axis, st in per_axis.items():
+                    lines.append(f"{op:<20}{axis:<10}{st['count']:<10}"
+                                 f"{st['bytes']:<14}{st['algbw_gbs']:<14.2f}"
+                                 f"{st['busbw_gbs']:<14.2f}")
+            lines.append(f"comm total bytes: {s['comm']['total_bytes']}")
+        if s["dispatch"]:
+            lines.append(f"{'Kernel':<24}{'Outcome':<12}{'Reason':<16}"
+                         f"{'Count':<8}")
+            for kernel, outs in s["dispatch"].items():
+                for outcome, reasons in outs.items():
+                    for reason, c in reasons.items():
+                        lines.append(f"{kernel:<24}{outcome:<12}"
+                                     f"{reason:<16}{c:<8}")
+        if s["compile"]["programs"]:
+            lines.append(f"{'Program':<32}{'Compile(s)':<12}{'Cache':<10}")
+            for name, st in s["compile"]["programs"].items():
+                lines.append(f"{name:<32}{st['seconds']:<12}"
+                             f"{st['cache']:<10}")
+        return "\n".join(lines) if lines else "telemetry: no samples"
+
+    def log_summary(self, print_log=True):
+        out = self.format_summary()
+        if print_log:
+            from deepspeed_tpu.utils.logging import logger
+            logger.info("\n" + out)
+        return out
+
+    def monitor_events(self, step):
+        """Aggregates as Monitor event tuples (name, value, step) — the
+        MonitorMaster fan-out bridge, drained by the engine at its
+        steps_per_print cadence."""
+        if not self.enabled:
+            return []
+        s = self.summary()
+        p = self.monitor_prefix
+        events = []
+        for name, st in s["spans"].items():
+            events.append((f"{p}Span/{name}_mean_ms",
+                           st["mean_s"] * 1e3, step))
+        if s["comm"]["total_bytes"]:
+            events.append((f"{p}Comm/total_bytes",
+                           s["comm"]["total_bytes"], step))
+        for kernel, outs in s["dispatch"].items():
+            for outcome, reasons in outs.items():
+                events.append((f"{p}Dispatch/{kernel}/{outcome}",
+                               sum(reasons.values()), step))
+        return events

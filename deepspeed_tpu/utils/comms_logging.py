@@ -78,7 +78,9 @@ class CommsLogger:
                         f"msg size: {msg_size} | algbw (Gbps): {algbw*8:.2f} | "
                         f"busbw (Gbps): {busbw*8:.2f}")
 
-    def log_all(self, print_log=True, show_straggler=False):
+    def format_summary(self):
+        """The summary table as a string (stable format — pinned by the
+        golden-output test in tests/test_aux_subsystems.py)."""
         lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
                  f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}"
                  f"{'tput_avg (GB/s)':<20}{'busbw_avg (GB/s)':<20}"]
@@ -87,7 +89,9 @@ class CommsLogger:
                 lines.append(f"{record_name:<20}{size:<20}{count:<10}"
                              f"{total_ms:<20.2f}{total_ms/max(count,1):<20.2f}"
                              f"{algbw/max(count,1):<20.2f}{busbw/max(count,1):<20.2f}")
-        out = "\n".join(lines)
+        return "\n".join(lines)
+
+    def log_all(self, print_log=True, show_straggler=False):
         if print_log:
-            logger.info("\n" + out)
+            logger.info("\n" + self.format_summary())
         return self.comms_dict

@@ -29,8 +29,9 @@ def _sync(token=None):
 
 class _Timer:
 
-    def __init__(self, name):
+    def __init__(self, name, clock=time.perf_counter):
         self.name_ = name
+        self.clock = clock
         self.started_ = False
         self.elapsed_ = 0.0
         self.records = []
@@ -38,13 +39,13 @@ class _Timer:
 
     def start(self):
         assert not self.started_, f"{self.name_} timer has already been started"
-        self.start_time = time.perf_counter()
+        self.start_time = self.clock()
         self.started_ = True
 
     def stop(self, record=True, token=None):
         assert self.started_, f"{self.name_} timer is not started"
         _sync(token)
-        dt = time.perf_counter() - self.start_time
+        dt = self.clock() - self.start_time
         self.elapsed_ += dt
         if record:
             self.records.append(dt)
@@ -55,14 +56,21 @@ class _Timer:
         self.elapsed_ = 0.0
 
     def elapsed(self, reset=True):
-        started = self.started_
-        if started:
-            self.stop(record=False)
+        """Cumulative elapsed seconds, including the in-flight interval of a
+        running timer. Reading while running must NOT stop/restart the timer:
+        the old stop(record=False)/reset()/start() dance dropped the running
+        interval from a later ``stop(record=True)``'s record (corrupting
+        ``mean()``) and rewrote ``start_time``. Now a running timer is only
+        observed; ``reset=True`` zeroes the banked total and rebases the
+        in-flight interval at "now" without touching ``started_``/records."""
+        now = self.clock()
         e = self.elapsed_
+        if self.started_:
+            e += now - self.start_time
         if reset:
-            self.reset()
-        if started:
-            self.start()
+            self.elapsed_ = 0.0
+            if self.started_:
+                self.start_time = now
         return e
 
     def mean(self):
@@ -116,12 +124,20 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + TFLOPS reporting (reference ``utils/timer.py:198``)."""
+    """Samples/sec + TFLOPS reporting (reference ``utils/timer.py:198``).
 
-    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+    ``clock`` is injectable for deterministic tests; ``flops_per_sample``
+    (model FLOPs for ONE sample, e.g. from the flops profiler) enables the
+    achieved-TFLOPS readout."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50,
+                 monitor_memory=False, logging_fn=None,
+                 clock=time.perf_counter, flops_per_sample=0):
         self.start_time = 0
         self.end_time = 0
         self.started = False
+        self.clock = clock
+        self.flops_per_sample = flops_per_sample
         self.batch_size = max(1, batch_size)
         self.start_step = start_step
         self.epoch_count = 0
@@ -145,7 +161,7 @@ class ThroughputTimer:
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            self.start_time = time.perf_counter()
+            self.start_time = self.clock()
 
     def stop(self, global_step=False, report_speed=True, token=None):
         if not self.started:
@@ -156,7 +172,7 @@ class ThroughputTimer:
             self.global_step_count += 1
         if self.start_time > 0:
             _sync(token)
-            self.end_time = time.perf_counter()
+            self.end_time = self.clock()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
@@ -175,3 +191,11 @@ class ThroughputTimer:
             samples = self.batch_size * (self.global_step_count - self.start_step)
             return samples / self.total_elapsed_time
         return float("-inf")
+
+    def avg_tflops(self):
+        """Achieved TFLOPS from the running samples/sec average; 0.0 until
+        ``flops_per_sample`` is set and warmup (start_step) has passed."""
+        sps = self.avg_samples_per_sec()
+        if self.flops_per_sample <= 0 or sps <= 0:
+            return 0.0
+        return sps * self.flops_per_sample / 1e12

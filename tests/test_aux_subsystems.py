@@ -81,6 +81,173 @@ def test_synchronized_timer_and_throughput():
     assert tput.global_step_count == 1
 
 
+def test_timer_elapsed_while_running_observe_only():
+    """Reading elapsed(reset=True) mid-interval must not stop/restart the
+    timer or pollute records: the interval recorded by the eventual
+    stop(record=True) is only the post-reset remainder, and mean() sees
+    exactly one record."""
+    from deepspeed_tpu.utils.timer import _Timer
+    t = [100.0]
+    tm = _Timer("x", clock=lambda: t[0])
+    tm.start()
+    t[0] = 101.0
+    assert tm.elapsed(reset=True) == pytest.approx(1.0)
+    assert tm.started_, "elapsed() must not stop a running timer"
+    assert tm.records == [], "elapsed() must not record"
+    t[0] = 101.5
+    tm.stop(record=True)
+    assert tm.records == [pytest.approx(0.5)]
+    assert tm.mean() == pytest.approx(0.5)
+    # and a plain read on a stopped timer returns the banked total
+    assert tm.elapsed(reset=False) == pytest.approx(0.5)
+
+
+def test_throughput_timer_fake_clock_sps_and_tflops():
+    """Deterministic samples/sec and TFLOPS from an injected clock:
+    warmup (start_step=2) excluded, then 4 samples in 0.5s -> 8 samples/s;
+    2 TFLOPs/sample -> 16 achieved TFLOPS."""
+    from deepspeed_tpu.utils.timer import ThroughputTimer
+    t = [100.0]
+    tput = ThroughputTimer(batch_size=4, start_step=2, steps_per_output=10**6,
+                           clock=lambda: t[0], flops_per_sample=2e12)
+    assert tput.avg_tflops() == 0.0  # before any step (sps is -inf)
+    for _ in range(3):
+        tput.start()
+        t[0] += 0.5
+        tput.stop(global_step=True)
+    # steps 1-2 are warmup; only step 3's 0.5s counts
+    assert tput.total_elapsed_time == pytest.approx(0.5)
+    assert tput.avg_samples_per_sec() == pytest.approx(8.0)
+    assert tput.avg_tflops() == pytest.approx(16.0)
+
+
+def test_calc_bw_log_ring_factors():
+    """Hand-computed algbw/busbw: 1 GB in 1 s on an 8-way ring."""
+    from deepspeed_tpu.utils.comms_logging import calc_bw_log
+    GB = 1e9
+    alg, bus = calc_bw_log("all_reduce", GB, 1.0, n=8)
+    assert alg == pytest.approx(1.0)
+    assert bus == pytest.approx(2 * 7 / 8)       # 2(n-1)/n
+    alg, bus = calc_bw_log("all_gather", GB, 1.0, n=8)
+    assert bus == pytest.approx(7 / 8)           # (n-1)/n
+    alg, bus = calc_bw_log("reduce_scatter", GB, 1.0, n=8)
+    assert bus == pytest.approx(7 / 8)
+    alg, bus = calc_bw_log("all_to_all", GB, 1.0, n=4)
+    assert bus == pytest.approx(3 / 4)
+    alg, bus = calc_bw_log("broadcast", GB, 1.0, n=8)
+    assert bus == pytest.approx(1.0)             # pt2pt-style: no correction
+    assert calc_bw_log("all_reduce", GB, 0.0) == (0.0, 0.0)
+
+
+def test_comms_logger_format_summary_golden():
+    """Pin the summary-table format (header + one parseable row)."""
+    from deepspeed_tpu.utils.comms_logging import CommsLogger, calc_bw_log
+    log = CommsLogger()
+    log.configure(enabled=True, prof_all=True)
+    log.append("all_reduce", "all_reduce", 0.001, 1 << 20)
+    log.append("all_reduce", "all_reduce", 0.003, 1 << 20)
+    out = log.format_summary()
+    lines = out.splitlines()
+    assert lines[0].startswith("Comm. Op")
+    for col in ("Message Size", "Count", "Total Latency(ms)",
+                "Avg Latency(ms)", "tput_avg (GB/s)", "busbw_avg (GB/s)"):
+        assert col in lines[0]
+    row = lines[1].split()
+    assert row[0] == "all_reduce"
+    assert row[1] == str(1 << 20)
+    assert row[2] == "2"
+    assert float(row[3]) == pytest.approx(4.0)   # 1ms + 3ms
+    assert float(row[4]) == pytest.approx(2.0)   # avg
+    alg1, bus1 = calc_bw_log("all_reduce", 1 << 20, 0.001)
+    alg2, bus2 = calc_bw_log("all_reduce", 1 << 20, 0.003)
+    assert float(row[5]) == pytest.approx((alg1 + alg2) / 2, abs=0.01)
+    assert float(row[6]) == pytest.approx((bus1 + bus2) / 2, abs=0.01)
+    # log_all keeps returning the raw dict (back-compat)
+    assert log.log_all(print_log=False) is log.comms_dict
+
+
+def test_monitor_import_guards_missing_deps(tmp_path, monkeypatch):
+    """A missing optional backend dep (tensorboard blocked via sys.modules
+    here; wandb genuinely absent in this image) must degrade the writer to
+    disabled-with-warning, never raise, and MonitorMaster must still serve
+    the csv backend."""
+    import sys
+    from deepspeed_tpu.monitor.monitor import (MonitorMaster,
+                                               TensorBoardMonitor,
+                                               WandbMonitor)
+    # None in sys.modules makes `from torch.utils.tensorboard import ...`
+    # raise ImportError — the exact missing-dep failure mode
+    monkeypatch.setitem(sys.modules, "torch.utils.tensorboard", None)
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "tensorboard": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "tb"},
+        "wandb": {"enabled": True},
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "job"}})
+    tb = TensorBoardMonitor(cfg.monitor_config_tb)
+    assert not tb.enabled
+    tb.write_events([("x", 1.0, 1)])  # no-op, no raise
+    wb = WandbMonitor(cfg.monitor_config_wandb)
+    assert not wb.enabled
+    wb.write_events([("x", 1.0, 1)])
+    mon = MonitorMaster(cfg)
+    assert mon.enabled, "csv backend must survive the dead TB/wandb writers"
+    mon.write_events([("Guard/val", 3.5, 7)])
+    rows = [r for root, _, fs in os.walk(tmp_path) for f in fs
+            if f.endswith(".csv")
+            for r in csv.reader(open(os.path.join(root, f)))]
+    assert any("3.5" in " ".join(r) for r in rows)
+
+
+def test_monitor_master_disables_failing_backend(tmp_path):
+    """One backend raising mid-run is disabled with a warning instead of
+    killing the training loop; healthy backends keep writing."""
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "job"}})
+    mon = MonitorMaster(cfg)
+
+    class _Boom:
+        enabled = True
+
+        def write_events(self, events):
+            raise OSError("disk full")
+
+    mon.writers.insert(0, _Boom())
+    mon.write_events([("A/b", 1.0, 1)])
+    assert not mon.writers[0].enabled, "failing backend must be disabled"
+    assert mon.enabled, "csv writer is still healthy"
+    files = [f for root, _, fs in os.walk(tmp_path) for f in fs
+             if f.endswith(".csv")]
+    assert files, "healthy backend must still have written"
+
+
+def test_engine_write_events_fanout_csv_roundtrip(tmp_path):
+    """engine.write_events forwards tuples to MonitorMaster and the csv
+    schema round-trips: header [step, name] then (step, value) rows."""
+    import deepspeed_tpu
+    from tests.simple_model import SimpleModel, random_batches
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                                "job_name": "rt"}})
+    engine.write_events([("Custom/metric", 0.125, 3), ("Custom/metric", 0.25, 4)])
+    path = next(os.path.join(root, f) for root, _, fs in os.walk(tmp_path)
+                for f in fs if "Custom_metric" in f)
+    rows = list(csv.reader(open(path)))
+    assert rows[0] == ["step", "Custom/metric"]
+    parsed = [(int(s), float(v)) for s, v in rows[1:]]
+    assert parsed == [(3, 0.125), (4, 0.25)]
+
+
 def test_engine_writes_train_loss_event(tmp_path):
     """The engine emits Train/Samples/train_loss at monitor cadence
     (reference engine.py:1961)."""
