@@ -30,6 +30,7 @@ from jax import lax
 
 from deepspeed_tpu.utils import jax_compat  # noqa: F401  installs lax.axis_size on old jax
 
+from deepspeed_tpu.resilience import faults as _faults
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -112,6 +113,8 @@ def timed_op(fn):
                                   time.perf_counter() - t0, axis=axis,
                                   traced=True)
             return result
+        # host-level (non-traced) collective: where real comm faults strike
+        _faults.maybe_fail("comm.collective", detail=fn.__name__)
         if (log is None or not log.enabled) and not tm_on:
             return fn(*args, **kwargs)
         t0 = time.perf_counter()
@@ -410,6 +413,9 @@ def init_distributed(dist_backend=None,
     global _initialized
     if _initialized:
         return
+    # worker-startup fault point: lets drills kill a worker exactly where a
+    # bad host dies in production (before joining the gang)
+    _faults.maybe_fail("worker.exit")
     coordinator, num_proc, proc_id = discover_process_env()
     # the launcher's env contract (launcher/runner.py node_env) carries the port
     distributed_port = int(os.environ.get("MASTER_PORT", distributed_port))
@@ -423,9 +429,18 @@ def init_distributed(dist_backend=None,
         if verbose:
             logger.info(f"init_distributed: coordinator={coordinator}:{distributed_port} "
                         f"process {proc_id}/{num_proc}")
-        jax.distributed.initialize(coordinator_address=f"{coordinator}:{distributed_port}",
-                                   num_processes=num_proc,
-                                   process_id=proc_id)
+        # coordinator bring-up races with worker starts across the gang —
+        # absorb transient connect failures with the shared backoff policy
+        from deepspeed_tpu.utils.retry import retry_call
+        retry_call(
+            jax.distributed.initialize, retries=3, base_delay=1.0,
+            max_delay=15.0, retry_on=(RuntimeError, OSError, ValueError),
+            on_retry=lambda a, e, d: logger.warning(
+                f"init_distributed attempt {a} failed ({e}); "
+                f"retrying in {d:.1f}s"),
+            coordinator_address=f"{coordinator}:{distributed_port}",
+            num_processes=num_proc,
+            process_id=proc_id)
     _initialized = True
 
 

@@ -25,18 +25,29 @@ from deepspeed_tpu.elasticity.elasticity import (ElasticityError,
                                                  compute_elastic_config)
 from deepspeed_tpu.launcher.runner import (build_ssh_command, node_env,
                                            parse_hostfile)
+from deepspeed_tpu.resilience import EXIT_CLEAN_PREEMPTION
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.retry import BackoffPolicy, retry_call
 
 _LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
 
 
 class DSElasticAgent:
-    """Supervise an elastic multi-host gang (reference elastic_agent.py:32)."""
+    """Supervise an elastic multi-host gang (reference elastic_agent.py:32).
+
+    Exit-code contract (docs/RESILIENCE.md): a worker exiting with
+    :data:`EXIT_CLEAN_PREEMPTION` (83) performed a clean preemption
+    hand-off — state is checkpointed — so the relaunch does NOT count
+    against ``max_restarts``. Any other non-zero exit is a failure and
+    burns restart budget. Relaunch delays follow the shared exponential
+    backoff + full jitter policy (utils/retry.py) instead of a fixed sleep,
+    so a flapping resource isn't hammered in lock-step.
+    """
 
     def __init__(self, user_script, user_args=(), ds_config=None,
                  hostfile=None, hosts=None, master_addr="127.0.0.1",
                  master_port=29500, max_restarts=3, launcher="local",
-                 restart_backoff=1.0):
+                 restart_backoff=1.0, backoff=None):
         assert (hostfile is None) != (hosts is None), \
             "pass exactly one of hostfile / hosts"
         self.user_script = user_script
@@ -49,7 +60,14 @@ class DSElasticAgent:
         self.max_restarts = max_restarts
         self.launcher = launcher
         self.restart_backoff = restart_backoff
-        self.restarts = 0
+        # restart_backoff seeds the exponential ladder's base so existing
+        # callers keep their knob; tests inject a jitter-free policy
+        self.backoff = backoff if backoff is not None else BackoffPolicy(
+            base=restart_backoff, factor=2.0,
+            max_delay=max(restart_backoff, 30.0), jitter="full")
+        self.restarts = 0       # failures charged against max_restarts
+        self.preemptions = 0    # clean preemptions (budget-free relaunches)
+        self.restart_reasons = []
         self.world_history = []
 
     # -- membership ------------------------------------------------------
@@ -85,10 +103,14 @@ class DSElasticAgent:
                 # remote worker — otherwise a relaunched gang collides with
                 # survivors of the old one (port/TPU lock already held)
                 cmd.insert(1, "-tt")
-                procs.append(subprocess.Popen(cmd))
+                spawn = lambda c=cmd: subprocess.Popen(c)
             else:
-                procs.append(subprocess.Popen(
-                    program, env=dict(os.environ, **env)))
+                spawn = lambda e=env: subprocess.Popen(
+                    program, env=dict(os.environ, **e))
+            # the ssh/exec itself can fail transiently (host still
+            # rebooting after preemption) — retry with backoff+jitter
+            procs.append(retry_call(spawn, retries=2, base_delay=0.5,
+                                    max_delay=5.0, retry_on=(OSError,)))
         return procs
 
     @staticmethod
@@ -119,26 +141,61 @@ class DSElasticAgent:
                         f"resolved={resolved})")
             procs = self._spawn(hosts, resolved)
 
-            failed = False
+            bad = []
             while True:
                 alive = [p for p in procs if p.poll() is None]
                 done = [p for p in procs if p.poll() is not None]
-                if any(p.returncode != 0 for p in done):
-                    failed = True
+                bad = [p.returncode for p in done if p.returncode != 0]
+                if bad:
                     break
                 if not alive:
                     return 0  # clean gang exit
                 time.sleep(0.2)
 
             self._kill(procs)
+            # exit-code contract: a gang where every failing worker exited
+            # EXIT_CLEAN_PREEMPTION checkpointed before dying — relaunch
+            # for free; anything else burns restart budget
+            preempted = all(rc == EXIT_CLEAN_PREEMPTION for rc in bad)
+            reason = "preemption" if preempted else f"worker_exit_{bad[0]}"
+            self.restart_reasons.append(reason)
+            self._record_restart(reason, len(hosts))
+            if preempted:
+                self.preemptions += 1
+                if self.preemptions > max(10, 3 * self.max_restarts):
+                    logger.error("elastic agent: too many consecutive "
+                                 "preemptions; giving up")
+                    return 1
+                logger.warning(
+                    f"elastic agent: clean preemption (exit "
+                    f"{EXIT_CLEAN_PREEMPTION}); relaunching without "
+                    f"consuming restart budget "
+                    f"({self.restarts}/{self.max_restarts} used)")
+                time.sleep(self.backoff.delay(1))
+                continue
             self.restarts += 1
             if self.restarts > self.max_restarts:
                 logger.error("elastic agent: restart budget exhausted")
                 return 1
+            delay = self.backoff.delay(self.restarts)
             logger.warning(
-                f"elastic agent: worker failure; re-reading membership and "
-                f"relaunching ({self.restarts}/{self.max_restarts})")
-            time.sleep(self.restart_backoff)
+                f"elastic agent: worker failure ({reason}); re-reading "
+                f"membership and relaunching "
+                f"({self.restarts}/{self.max_restarts}) after {delay:.2f}s")
+            time.sleep(delay)
+
+    def _record_restart(self, reason, n_hosts):
+        """Restart count + reason through telemetry (lazy import: the agent
+        must stay usable on a host without jax — telemetry is stdlib-only
+        but lives under the deepspeed_tpu namespace)."""
+        try:
+            from deepspeed_tpu import telemetry
+            telemetry.record("Fault/worker", 1, kind="counter", reason=reason,
+                             hosts=n_hosts, restarts=self.restarts,
+                             preemptions=self.preemptions)
+            telemetry.count("elastic/restart", reason=reason)
+        except Exception:
+            pass
 
 
 def main(args=None):

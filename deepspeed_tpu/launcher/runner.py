@@ -36,7 +36,8 @@ from deepspeed_tpu.utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
 EXPORT_ENVS = ("PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "XLA_FLAGS",
-               "LIBTPU_INIT_ARGS", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST_BOUNDS")
+               "LIBTPU_INIT_ARGS", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST_BOUNDS",
+               "DS_TPU_FAULTS", "DS_TPU_FAULT_SEED")
 
 
 def parse_hostfile(path):

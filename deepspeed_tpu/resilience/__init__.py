@@ -1,0 +1,52 @@
+"""Resilient training runtime — fault injection, verified checkpoints,
+preemption-aware save, and a step watchdog (docs/RESILIENCE.md).
+
+Four layers, all testable on CPU:
+
+- :mod:`~deepspeed_tpu.resilience.faults` — process-global registry of
+  named fault points (``ckpt.write``, ``ckpt.publish``, ``comm.collective``,
+  ``io.host``, ``step.hang``, ``worker.exit``) armed via the ``resilience``
+  config section or ``DS_TPU_FAULTS``.
+- crash-consistent, checksum-verified checkpoints — implemented in
+  ``runtime/checkpoint_engine/native_engine.py`` (tmp + fsync + atomic
+  ``os.replace``; SHA-256 manifest; :class:`CorruptCheckpointError` on
+  load; the engine quarantines corrupt tags and falls back).
+- :mod:`~deepspeed_tpu.resilience.preemption` — SIGTERM/SIGINT →
+  emergency checkpoint at the next step boundary, then exit
+  :data:`EXIT_CLEAN_PREEMPTION` (doesn't burn elastic restart budget).
+- :mod:`~deepspeed_tpu.resilience.watchdog` — heartbeat thread that flags
+  stalls, dumps all-thread stacks + the telemetry summary, and optionally
+  aborts with :data:`EXIT_WATCHDOG_ABORT` for the elastic agent.
+
+This package imports only the standard library at module scope so the
+elastic agent and launcher can use it without pulling in jax.
+"""
+
+from deepspeed_tpu.resilience import faults  # noqa: F401
+from deepspeed_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector, InjectedFault, KNOWN_POINTS, maybe_fail, parse_spec)
+from deepspeed_tpu.resilience.preemption import (  # noqa: F401
+    EXIT_CLEAN_PREEMPTION, PreemptionHandler)
+from deepspeed_tpu.resilience.watchdog import (  # noqa: F401
+    EXIT_WATCHDOG_ABORT, StepWatchdog, format_all_stacks)
+
+
+class CorruptCheckpointError(IOError):
+    """A checkpoint failed integrity verification (missing/truncated file,
+    checksum mismatch, bad manifest, leaf-count drift). Carries ``path``
+    (the tag directory) and ``file`` (which member failed).
+
+    Raised by ``NativeCheckpointEngine.load``; ``engine.load_checkpoint``
+    reacts by quarantining the tag (rename to ``<tag>.corrupt``) and
+    falling back to the newest prior valid tag."""
+
+    def __init__(self, path, file=None, reason=""):
+        msg = f"corrupt checkpoint at {path}"
+        if file:
+            msg += f" (file {file})"
+        if reason:
+            msg += f": {reason}"
+        super().__init__(msg)
+        self.path = path
+        self.file = file
+        self.reason = reason

@@ -1,0 +1,182 @@
+"""Step watchdog — hang detection for the training loop.
+
+A daemon thread fed heartbeats from the engine's step boundary. A stall is
+"no beat within ``hang_factor`` × the rolling median step time" (floored at
+``min_interval_s`` so compile/warmup steps don't false-positive). On
+detection it dumps every thread's stack plus the telemetry summary, emits a
+``Fault/hang`` telemetry event, and — when ``abort`` is set — hard-exits
+with a distinct code so the elastic agent can restart the gang
+(docs/RESILIENCE.md exit-code contract).
+
+The clock is injectable and the detector core (``check()``) is callable
+directly, so tests pin the trigger math without real sleeps.
+"""
+
+import collections
+import os
+import statistics
+import sys
+import threading
+import time
+import traceback
+
+#: exit code for a watchdog-initiated abort (see docs/RESILIENCE.md)
+EXIT_WATCHDOG_ABORT = 85
+
+
+def format_all_stacks():
+    """Every live thread's current stack, watchdog thread included —
+    the ``py-spy dump`` a preempted-in-CI run never got."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        lines.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        lines.extend(ln.rstrip("\n")
+                     for ln in traceback.format_stack(frame))
+    return "\n".join(lines)
+
+
+class StepWatchdog:
+    """Heartbeat-driven stall detector.
+
+    Usage (what the engine does when ``resilience.watchdog.enabled``)::
+
+        wd = StepWatchdog(hang_factor=10.0, min_interval_s=60.0)
+        wd.start()
+        for batch in loader:
+            train_step(batch)
+            wd.beat()          # step boundary = heartbeat
+        wd.stop()
+
+    ``beat()`` with no argument uses the inter-beat interval as the step
+    time sample, so the rolling median tracks the full loop cadence
+    (forward+backward+step+data), which is what a hang interrupts.
+    """
+
+    def __init__(self, hang_factor=10.0, min_interval_s=60.0,
+                 poll_interval_s=1.0, window=32, abort=False,
+                 exit_code=EXIT_WATCHDOG_ABORT, on_hang=None,
+                 clock=time.monotonic, dump_file=None):
+        if hang_factor <= 0 or min_interval_s <= 0 or poll_interval_s <= 0:
+            raise ValueError("watchdog intervals/factor must be positive")
+        self.hang_factor = float(hang_factor)
+        self.min_interval_s = float(min_interval_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.abort = bool(abort)
+        self.exit_code = int(exit_code)
+        self.on_hang = on_hang
+        self.dump_file = dump_file
+        self._clock = clock
+        self._samples = collections.deque(maxlen=int(window))
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._last_beat = None
+        self._beat_seq = 0
+        self._fired_seq = -1   # fire at most once per stall (re-arm on beat)
+        self.fired = 0
+        self.last_report = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._last_beat = self._clock()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ds-tpu-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5 * self.poll_interval_s)
+
+    # -- heartbeat -------------------------------------------------------
+    def beat(self, step_seconds=None):
+        now = self._clock()
+        with self._lock:
+            if step_seconds is None and self._last_beat is not None:
+                step_seconds = now - self._last_beat
+            if step_seconds is not None and step_seconds > 0:
+                self._samples.append(step_seconds)
+            self._last_beat = now
+            self._beat_seq += 1
+
+    def threshold(self):
+        """Current stall threshold in seconds."""
+        with self._lock:
+            if not self._samples:
+                return self.min_interval_s
+            med = statistics.median(self._samples)
+        return max(self.min_interval_s, self.hang_factor * med)
+
+    # -- detection -------------------------------------------------------
+    def check(self):
+        """One detector pass; returns the report if a stall fired. Called
+        from the poll thread, callable directly in tests."""
+        with self._lock:
+            if self._last_beat is None or self._fired_seq == self._beat_seq:
+                return None
+            idle = self._clock() - self._last_beat
+        thr = self.threshold()
+        if idle <= thr:
+            return None
+        with self._lock:
+            if self._fired_seq == self._beat_seq:
+                return None
+            self._fired_seq = self._beat_seq
+        return self._fire(idle, thr)
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.check()
+            except Exception:  # the watchdog must outlive its own bugs
+                from deepspeed_tpu.utils.logging import logger
+                logger.exception("watchdog check failed")
+
+    def _fire(self, idle, thr):
+        from deepspeed_tpu.utils.logging import logger
+        report = [f"step watchdog: no step progress for {idle:.2f}s "
+                  f"(threshold {thr:.2f}s = max(min_interval "
+                  f"{self.min_interval_s}s, hang_factor {self.hang_factor} "
+                  f"x median step)); dumping stacks",
+                  format_all_stacks()]
+        try:
+            from deepspeed_tpu import telemetry
+            if telemetry.enabled():
+                report.append("--- telemetry summary ---")
+                report.append(telemetry.format_summary())
+            telemetry.record("Fault/hang", 1, kind="counter",
+                             idle_s=round(idle, 3),
+                             threshold_s=round(thr, 3))
+        except Exception:
+            pass
+        report = "\n".join(report)
+        self.fired += 1
+        self.last_report = report
+        logger.error(report)
+        if self.dump_file:
+            try:
+                with open(self.dump_file, "w") as f:
+                    f.write(report)
+            except OSError:
+                logger.exception(f"watchdog: cannot write {self.dump_file}")
+        if self.on_hang is not None:
+            try:
+                self.on_hang(report)
+            except Exception:
+                logger.exception("watchdog on_hang callback failed")
+        if self.abort:
+            logger.error(f"watchdog: aborting process (exit "
+                         f"{self.exit_code}) so the elastic agent can "
+                         f"restart the gang")
+            # flush what we can; _exit skips atexit (the process is wedged —
+            # a SystemExit in THIS thread would not unwedge the main thread)
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os._exit(self.exit_code)
+        return report

@@ -6,14 +6,30 @@ Mirrors the reference's pluggable ``CheckpointEngine`` interface
 pytree (including engine TrainState) to a directory of .npz shards + a JSON
 manifest, gathering sharded arrays to host. Multi-host / async engines slot in
 behind the same interface (the Nebula-engine analog).
+
+Crash consistency + integrity (docs/RESILIENCE.md): every save builds the
+tag in a ``<path>.tmp.<pid>`` directory, fsyncs, and atomically
+``os.replace``s it into place — a crash at ANY instant leaves either the
+old complete tag or the new complete tag, never a torn mix. The manifest
+carries per-file SHA-256 checksums and the leaf count; ``load`` verifies
+them and raises :class:`~deepspeed_tpu.resilience.CorruptCheckpointError`
+(instead of bare ``KeyError``/``FileNotFoundError``) so the engine can
+quarantine the tag and fall back. Fault points ``ckpt.write`` /
+``ckpt.publish`` / ``io.host`` make every crash window drillable on CPU.
 """
 
+import hashlib
 import json
 import os
 import pickle
+import shutil
+import zipfile
 
 import jax
 import numpy as np
+
+from deepspeed_tpu.resilience import CorruptCheckpointError, InjectedFault, faults
+from deepspeed_tpu.utils.retry import retry_call
 
 
 class CheckpointEngine:
@@ -37,6 +53,89 @@ def _flatten(tree):
     return flat, treedef
 
 
+# ---------------------------------------------------------------------------
+# durable host I/O helpers
+# ---------------------------------------------------------------------------
+
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    """Make a rename/create durable: fsync the containing directory."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without O_RDONLY dirs; rename still atomic
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _host_write(write_fn):
+    """Run one host-side checkpoint write through the ``io.host`` fault
+    point and the shared retry policy (utils/retry.py) — transient blips
+    (NFS/GCS hiccups, injected once-faults) are absorbed; persistent
+    failures surface after the retries as RetryError."""
+    def attempt():
+        faults.maybe_fail("io.host")
+        return write_fn()
+    return retry_call(attempt, retries=2, base_delay=0.05, max_delay=0.5,
+                      retry_on=(OSError, InjectedFault))
+
+
+def _sha256_file(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def atomic_write_text(path, text):
+    """Crash-consistent small-file write (the 'latest' tag pointer): tmp in
+    the same directory + fsync + atomic ``os.replace`` + dir fsync, so a
+    crash never leaves a truncated/empty file at ``path``."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(d)
+
+
+def _publish_dir(tmp, path):
+    """Atomically swap a fully-written ``tmp`` directory into ``path``.
+    Never destroys the existing durable checkpoint before the new one is in
+    place: move aside (atomic rename), swap in, reap; restore on failure."""
+    faults.maybe_fail("ckpt.publish")
+    parent = os.path.dirname(os.path.abspath(path))
+    old = None
+    if os.path.isdir(path):
+        old = f"{path}.old.{os.getpid()}"
+        os.replace(path, old)
+    try:
+        os.replace(tmp, path)
+    except Exception:
+        if old is not None:
+            os.replace(old, path)
+        raise
+    _fsync_dir(parent)
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
+
+
 class NativeCheckpointEngine(CheckpointEngine):
     """Two buckets: ``state`` (array pytree, loaded against a structure
     template) and ``meta`` (free-form counters/client state, loaded verbatim)."""
@@ -45,56 +144,162 @@ class NativeCheckpointEngine(CheckpointEngine):
     META = "meta.json"
     AUX = "aux.pkl"
     FREE = "meta_state.pkl"
+    FORMAT_VERSION = 2  # 2 = checksummed manifest; 1 loads unverified
 
-    def save(self, state_dict, path, meta=None):
-        os.makedirs(path, exist_ok=True)
-        if meta is not None:
-            with open(os.path.join(path, self.FREE), "wb") as f:
-                pickle.dump(meta, f)
-        flat, treedef = _flatten(state_dict)
-        arrays, aux, kinds, dtypes = {}, [], [], []
-        for i, leaf in enumerate(flat):
-            if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
-                arr = np.asarray(jax.device_get(leaf))
-                dtypes.append(arr.dtype.name)
-                if arr.dtype.kind == "V" or arr.dtype.name in ("bfloat16",) or \
-                        arr.dtype.name.startswith("float8"):
-                    # numpy can't round-trip ml_dtypes through savez; store raw bytes
-                    arr = arr.view(np.uint8 if arr.dtype.itemsize == 1 else np.uint16)
-                arrays[f"a{i}"] = arr
-                kinds.append("array")
-                aux.append(None)
-            else:
-                kinds.append("aux")
-                dtypes.append(None)
-                aux.append(leaf)
-        np.savez(os.path.join(path, self.ARRAYS), **arrays)
-        with open(os.path.join(path, self.AUX), "wb") as f:
-            pickle.dump(aux, f)
-        with open(os.path.join(path, self.META), "w") as f:
-            json.dump({"num_leaves": len(flat), "kinds": kinds, "dtypes": dtypes,
-                       "format_version": 1}, f)
+    def save(self, state_dict, path, meta=None, extra_writer=None,
+             _publish=True):
+        """``extra_writer(dir)`` adds extra in-checkpoint files before the
+        manifest is sealed, so they are covered by the checksums and by the
+        atomic publish. ``_publish=False`` writes directly into ``path``
+        for a caller that owns its own tmp-dir + swap (the async engine's
+        worker) — the data is still fsynced and checksummed."""
+        if _publish:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            shutil.rmtree(tmp, ignore_errors=True)  # stale crash leftovers
+        else:
+            tmp = path
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            if meta is not None:
+                def _write_free():
+                    with open(os.path.join(tmp, self.FREE), "wb") as f:
+                        pickle.dump(meta, f)
+                _host_write(_write_free)
+            flat, treedef = _flatten(state_dict)
+            arrays, aux, kinds, dtypes = {}, [], [], []
+            for i, leaf in enumerate(flat):
+                if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+                    arr = np.asarray(jax.device_get(leaf))
+                    dtypes.append(arr.dtype.name)
+                    if arr.dtype.kind == "V" or arr.dtype.name in ("bfloat16",) or \
+                            arr.dtype.name.startswith("float8"):
+                        # numpy can't round-trip ml_dtypes through savez; store raw bytes
+                        arr = arr.view(np.uint8 if arr.dtype.itemsize == 1 else np.uint16)
+                    arrays[f"a{i}"] = arr
+                    kinds.append("array")
+                    aux.append(None)
+                else:
+                    kinds.append("aux")
+                    dtypes.append(None)
+                    aux.append(leaf)
+            _host_write(
+                lambda: np.savez(os.path.join(tmp, self.ARRAYS), **arrays))
+            # the crash-mid-save window: shards on disk, manifest not yet
+            faults.maybe_fail("ckpt.write")
+            def _write_aux():
+                with open(os.path.join(tmp, self.AUX), "wb") as f:
+                    pickle.dump(aux, f)
+            _host_write(_write_aux)
+            if extra_writer is not None:
+                extra_writer(tmp)
+            # seal: checksum every file written so far, then the manifest
+            checksums = {name: _sha256_file(os.path.join(tmp, name))
+                         for name in sorted(os.listdir(tmp))
+                         if os.path.isfile(os.path.join(tmp, name))}
+            def _write_meta():
+                with open(os.path.join(tmp, self.META), "w") as f:
+                    json.dump({"num_leaves": len(flat), "kinds": kinds,
+                               "dtypes": dtypes, "checksums": checksums,
+                               "format_version": self.FORMAT_VERSION}, f)
+            _host_write(_write_meta)
+            for name in os.listdir(tmp):
+                p = os.path.join(tmp, name)
+                if os.path.isfile(p):
+                    _fsync_file(p)
+            _fsync_dir(tmp)
+            if _publish:
+                _publish_dir(tmp, path)
+        except BaseException:
+            if _publish:
+                shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    # -- integrity -------------------------------------------------------
+    def _read_manifest(self, path):
+        meta_p = os.path.join(path, self.META)
+        if not os.path.isdir(path):
+            raise CorruptCheckpointError(path,
+                                         reason="checkpoint directory missing")
+        try:
+            with open(meta_p) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            raise CorruptCheckpointError(path, self.META,
+                                         "manifest missing") from None
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise CorruptCheckpointError(
+                path, self.META, f"manifest unreadable: {e}") from e
+
+    def verify(self, path, meta=None):
+        """Checksum + leaf-count verification against the manifest. Raises
+        :class:`CorruptCheckpointError` naming the failing file; returns the
+        parsed manifest. Format-1 checkpoints (no checksums) pass through
+        unverified."""
+        meta = meta if meta is not None else self._read_manifest(path)
+        if len(meta.get("kinds", [])) != meta.get("num_leaves"):
+            raise CorruptCheckpointError(
+                path, self.META,
+                f"manifest leaf count {meta.get('num_leaves')} != "
+                f"{len(meta.get('kinds', []))} recorded kinds")
+        for name, want in meta.get("checksums", {}).items():
+            p = os.path.join(path, name)
+            if not os.path.isfile(p):
+                raise CorruptCheckpointError(path, name,
+                                             "file missing from checkpoint")
+            got = _sha256_file(p)
+            if got != want:
+                raise CorruptCheckpointError(
+                    path, name, f"checksum mismatch (manifest {want[:12]}…, "
+                                f"disk {got[:12]}…)")
+        return meta
 
     def load_meta(self, path):
         p = os.path.join(path, self.FREE)
         if not os.path.exists(p):
             return {}
-        with open(p, "rb") as f:
-            return pickle.load(f)
+        try:
+            with open(p, "rb") as f:
+                return pickle.load(f)
+        except (pickle.UnpicklingError, EOFError, OSError) as e:
+            raise CorruptCheckpointError(
+                path, self.FREE, f"client state unreadable: {e}") from e
 
     def load(self, path, template=None, map_location=None):
-        with open(os.path.join(path, self.META)) as f:
-            meta = json.load(f)
-        data = np.load(os.path.join(path, self.ARRAYS), allow_pickle=False)
-        with open(os.path.join(path, self.AUX), "rb") as f:
-            aux = pickle.load(f)
+        meta = self.verify(path)
+        try:
+            data = np.load(os.path.join(path, self.ARRAYS),
+                           allow_pickle=False)
+        except FileNotFoundError:
+            raise CorruptCheckpointError(path, self.ARRAYS,
+                                         "array shards missing") from None
+        except (zipfile.BadZipFile, OSError, ValueError) as e:
+            raise CorruptCheckpointError(
+                path, self.ARRAYS, f"array shards unreadable "
+                f"(truncated write?): {e}") from e
+        try:
+            with open(os.path.join(path, self.AUX), "rb") as f:
+                aux = pickle.load(f)
+        except FileNotFoundError:
+            raise CorruptCheckpointError(path, self.AUX,
+                                         "aux leaves missing") from None
+        except (pickle.UnpicklingError, EOFError) as e:
+            raise CorruptCheckpointError(
+                path, self.AUX, f"aux leaves unreadable: {e}") from e
         import ml_dtypes
         flat = []
         for i, kind in enumerate(meta["kinds"]):
             if kind != "array":
                 flat.append(aux[i])
                 continue
-            arr = data[f"a{i}"]
+            try:
+                arr = data[f"a{i}"]
+            except KeyError:
+                raise CorruptCheckpointError(
+                    path, self.ARRAYS,
+                    f"shard a{i} missing ({meta['num_leaves']} leaves in "
+                    f"manifest)") from None
             want = meta.get("dtypes", [None] * len(meta["kinds"]))[i]
             if want is not None and arr.dtype.name != want:
                 arr = arr.view(np.dtype(getattr(ml_dtypes, want, want)))
@@ -144,12 +349,13 @@ class AsyncCheckpointEngine(CheckpointEngine):
     def save(self, state_dict, path, meta=None, extra_writer=None,
              on_published=None, publish_key=None):
         """``extra_writer(tmp_path)`` runs in the worker before the atomic
-        publish (extra in-checkpoint files); ``on_published()`` runs after it
-        (e.g. updating the 'latest' tag — never before the data is durable).
-        ``publish_key`` scopes the out-of-order-completion guard: among saves
-        sharing a key (e.g. the same save_dir), only the newest one's
-        ``on_published`` runs; saves to unrelated targets don't suppress each
-        other. Defaults to ``path``'s parent directory."""
+        publish (extra in-checkpoint files — sealed into the checksum
+        manifest); ``on_published()`` runs after it (e.g. updating the
+        'latest' tag — never before the data is durable). ``publish_key``
+        scopes the out-of-order-completion guard: among saves sharing a key
+        (e.g. the same save_dir), only the newest one's ``on_published``
+        runs; saves to unrelated targets don't suppress each other.
+        Defaults to ``path``'s parent directory."""
         import copy
         import threading
         self._drain(self._max_inflight)
@@ -166,12 +372,15 @@ class AsyncCheckpointEngine(CheckpointEngine):
         tmp = f"{path}.tmp.{os.getpid()}.{seq}"
 
         def work():
-            import shutil
             old = None
             try:
-                self._inner.save(host_state, tmp, meta=meta)
-                if extra_writer is not None:
-                    extra_writer(tmp)
+                # the worker owns tmp-dir atomicity here (_publish=False):
+                # data + extras + sealed manifest land in tmp, fsynced
+                self._inner.save(host_state, tmp, meta=meta,
+                                 extra_writer=extra_writer, _publish=False)
+                # the crash window the fault drill kills the writer in:
+                # a complete tmp exists but the live tag is untouched
+                faults.maybe_fail("ckpt.publish")
                 # the swap runs under the lock: (a) workers finishing out of
                 # order must not let an OLDER save clobber a newer one's data
                 # at the same path; (b) concurrent renames of the same path
@@ -192,6 +401,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
                             os.replace(old, path)
                             old = None
                         raise
+                    _fsync_dir(os.path.dirname(os.path.abspath(path)))
                     # 'latest'-tag callback must never move backwards either
                     publish = seq > self._published_seq.get(key, -1)
                     if publish:

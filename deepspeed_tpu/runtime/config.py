@@ -107,6 +107,44 @@ class TelemetryConfig(DeepSpeedConfigModel):
     #                          steps_per_print cadence
 
 
+class PreemptionConfig(DeepSpeedConfigModel):
+    """``resilience.preemption`` — SIGTERM/SIGINT → emergency checkpoint at
+    the next step boundary, then exit with ``exit_code`` (the elastic
+    agent's "clean preemption" contract, docs/RESILIENCE.md)."""
+    enabled = False
+    save_dir = ""       # "" -> the last save_checkpoint dir this run used
+    tag = "emergency"
+    exit_code = 83      # resilience.EXIT_CLEAN_PREEMPTION
+
+
+class WatchdogConfig(DeepSpeedConfigModel):
+    """``resilience.watchdog`` — step-heartbeat stall detector
+    (resilience/watchdog.py). A stall is no step progress within
+    ``hang_factor`` × rolling-median step time (floored at
+    ``min_interval_s``); on trip it dumps all-thread stacks + the telemetry
+    summary and, with ``abort``, hard-exits with ``exit_code`` so the
+    elastic agent restarts the gang."""
+    enabled = False
+    hang_factor = 10.0
+    min_interval_s = 60.0
+    poll_interval_s = 1.0
+    window = 32         # rolling step-time samples for the median
+    abort = False
+    exit_code = 85      # resilience.EXIT_WATCHDOG_ABORT
+    dump_file = ""      # also write the hang report here ("" = log only)
+
+
+class ResilienceConfig(DeepSpeedConfigModel):
+    """``resilience`` section — fault injection, preemption-aware save and
+    the step watchdog (deepspeed_tpu/resilience, docs/RESILIENCE.md).
+    ``faults`` takes the DS_TPU_FAULTS grammar
+    (``"point:mode[@stepA[-B]][!action]"``); the env var layers on top."""
+    faults = ""
+    fault_seed = 0
+    preemption = PreemptionConfig()
+    watchdog = WatchdogConfig()
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled = False
     recompute_fwd_factor = 0.0
@@ -181,7 +219,7 @@ KNOWN_TOP_LEVEL_KEYS = {
     C.ACTIVATION_CHECKPOINTING, C.PIPELINE, C.TENSOR_PARALLEL,
     C.SEQUENCE_PARALLEL_SIZE, C.EXPERT_PARALLEL_SIZE, C.COMMS_LOGGER,
     C.MONITOR_TENSORBOARD, C.MONITOR_CSV, C.MONITOR_WANDB, C.FLOPS_PROFILER,
-    C.TELEMETRY,
+    C.TELEMETRY, C.RESILIENCE,
     C.ELASTICITY, C.AUTOTUNING, C.CHECKPOINT, C.COMPILE,
     "moe", "seed", "hybrid_engine", "curriculum_learning", "data_efficiency",
     "compression_training", "eigenvalue", "progressive_layer_drop",
@@ -303,6 +341,7 @@ class DeepSpeedConfig:
         self.monitor_config_wandb = WandbConfig(pd.get(C.MONITOR_WANDB, {}))
         self.flops_profiler_config = FlopsProfilerConfig(pd.get(C.FLOPS_PROFILER, {}))
         self.telemetry_config = TelemetryConfig(pd.get(C.TELEMETRY, {}))
+        self.resilience_config = ResilienceConfig(pd.get(C.RESILIENCE, {}))
         self.checkpoint_config = CheckpointConfig(pd.get(C.CHECKPOINT, {}))
         self.elasticity_config = ElasticityConfig(pd.get(C.ELASTICITY, {}))
         self.compile_config = CompileConfig(pd.get(C.COMPILE, {}))

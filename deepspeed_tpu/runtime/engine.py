@@ -30,6 +30,7 @@ import optax
 
 from deepspeed_tpu.utils import jax_compat  # noqa: F401  installs jax.shard_map on old jax
 from deepspeed_tpu.ops.adam import build_optimizer, set_lr
+from deepspeed_tpu.resilience import CorruptCheckpointError, faults as _faults
 from deepspeed_tpu.parallel import groups
 from deepspeed_tpu.parallel.topology import MeshTopology
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
@@ -293,6 +294,27 @@ class DeepSpeedEngine:
         if self.config.telemetry_config.enabled:
             telemetry.configure(config=self.config.telemetry_config)
         self._telemetry_monitor = bool(self.config.telemetry_config.monitor)
+
+        # resilience (docs/RESILIENCE.md): fault injection, preemption-aware
+        # save, step watchdog. Fault arming is config-driven here; the
+        # DS_TPU_FAULTS env arms lazily even without a config section.
+        rcfg = self.config.resilience_config
+        if rcfg.faults:
+            _faults.configure(rcfg.faults, seed=rcfg.fault_seed)
+        self._last_save_dir = None
+        self._preemption = None
+        if rcfg.preemption.enabled:
+            from deepspeed_tpu.resilience import PreemptionHandler
+            self._preemption = PreemptionHandler().install()
+        self._watchdog = None
+        if rcfg.watchdog.enabled:
+            from deepspeed_tpu.resilience import StepWatchdog
+            wd = rcfg.watchdog
+            self._watchdog = StepWatchdog(
+                hang_factor=wd.hang_factor, min_interval_s=wd.min_interval_s,
+                poll_interval_s=wd.poll_interval_s, window=wd.window,
+                abort=wd.abort, exit_code=wd.exit_code,
+                dump_file=wd.dump_file or None).start()
 
         # remat policy for model blocks (models read it at trace time)
         from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
@@ -1494,6 +1516,8 @@ class DeepSpeedEngine:
     def step(self):
         """Optimizer step at the gradient-accumulation boundary (engine.py:2132)."""
         self._step_applied = False
+        _faults.set_step(self.global_steps)
+        _faults.maybe_fail("step.hang")
         from deepspeed_tpu import telemetry
         _span = telemetry.span_begin(STEP_GLOBAL_TIMER)
         if self.wall_clock_breakdown:
@@ -1546,6 +1570,35 @@ class DeepSpeedEngine:
         if self._step_applied and self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps}, skipped={self.skipped_steps}, "
                      f"lr={self.get_lr()}, loss_scale={self.cur_scale}", ranks=[0])
+        self._resilience_step_boundary()
+
+    def _resilience_step_boundary(self):
+        """Post-step resilience hooks (docs/RESILIENCE.md): feed the
+        watchdog heartbeat, and honor a pending preemption request — save
+        an emergency checkpoint, then exit with the clean-preemption code
+        the elastic agent does not count against its restart budget."""
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        pre = self._preemption
+        if pre is None or not pre.requested():
+            return
+        from deepspeed_tpu import telemetry
+        cfg = self.config.resilience_config.preemption
+        telemetry.record("Fault/preemption", 1, kind="counter",
+                         signum=pre.signal_received, step=self.global_steps)
+        save_dir = cfg.save_dir or self._last_save_dir
+        if save_dir:
+            with telemetry.span("recovery/emergency_save",
+                                step=self.global_steps):
+                path = self.save_checkpoint(save_dir, tag=cfg.tag)
+            logger.warning(f"preemption (signal {pre.signal_received}): "
+                           f"emergency checkpoint {path}; exiting "
+                           f"{cfg.exit_code} (clean preemption)")
+        else:
+            logger.warning(f"preemption (signal {pre.signal_received}): no "
+                           f"save_dir configured or used yet — exiting "
+                           f"{cfg.exit_code} WITHOUT an emergency checkpoint")
+        raise SystemExit(int(cfg.exit_code))
 
     def _run_guards(self, old_state, stats):
         """Boundary-time correctness guards (runtime/guards.py): donation
@@ -1634,6 +1687,7 @@ class DeepSpeedEngine:
                     events.extend(telemetry.monitor_events(self.global_samples))
                 self.monitor.write_events(events)
             self.tput_timer.stop(global_step=True)
+            self._resilience_step_boundary()
             return float(jax.device_get(mean))
         from deepspeed_tpu import telemetry
         losses = []
@@ -1828,8 +1882,9 @@ class DeepSpeedEngine:
         analog): training resumes after the device->host fetch; call
         ``commit_checkpoints()`` (or the next save/load) to join writes."""
         from deepspeed_tpu.runtime.checkpoint_engine.native_engine import (
-            AsyncCheckpointEngine, NativeCheckpointEngine)
+            AsyncCheckpointEngine, NativeCheckpointEngine, atomic_write_text)
         tag = tag or f"global_step{self.global_steps}"
+        self._last_save_dir = save_dir  # emergency-save target on preemption
         if async_save:
             if self._async_ckpt_engine is None:
                 self._async_ckpt_engine = AsyncCheckpointEngine()
@@ -1879,22 +1934,26 @@ class DeepSpeedEngine:
 
             def after_publish():
                 if save_latest:
-                    with open(os.path.join(save_dir, "latest"), "w") as f:
-                        f.write(str(tag))
+                    atomic_write_text(os.path.join(save_dir, "latest"),
+                                      str(tag))
 
             engine.save(self.state, path, meta=meta, extra_writer=in_dir,
                         on_published=after_publish)
             log_dist(f"async checkpoint {path} scheduled", ranks=[0])
             return path
-        engine.save(self.state, path, meta=meta)
-        if self._offload is not None:
-            self._offload.save(os.path.join(path, "host_optimizer_states.npz"))
-        if self._param_store is not None:
-            np.savez(os.path.join(path, "host_param_tier.npz"),
-                     **self._param_store.state_dict())
+
+        def in_dir_sync(p):
+            # host-tier blobs land inside the tmp dir so the checksum
+            # manifest covers them and the publish stays all-or-nothing
+            if self._offload is not None:
+                self._offload.save(os.path.join(p, "host_optimizer_states.npz"))
+            if self._param_store is not None:
+                np.savez(os.path.join(p, "host_param_tier.npz"),
+                         **self._param_store.state_dict())
+
+        engine.save(self.state, path, meta=meta, extra_writer=in_dir_sync)
         if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+            atomic_write_text(os.path.join(save_dir, "latest"), str(tag))
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return path
 
@@ -1905,9 +1964,51 @@ class DeepSpeedEngine:
             return self._async_ckpt_engine.commit(None)
         return True
 
+    @staticmethod
+    def _checkpoint_tags(load_dir):
+        """Candidate checkpoint tags in ``load_dir``, newest first.
+        Numbered tags (trailing integer, e.g. ``global_step12``) order by
+        step and rank above unnumbered ones, which order by mtime.
+        Quarantined (``.corrupt``) and in-flight (``.tmp.``/``.old.``)
+        directories are never candidates."""
+        import re
+        out = []
+        for name in os.listdir(load_dir):
+            p = os.path.join(load_dir, name)
+            if not os.path.isdir(p) or ".corrupt" in name \
+                    or ".tmp." in name or ".old." in name:
+                continue
+            if not os.path.exists(os.path.join(p, "meta.json")):
+                continue
+            m = re.search(r"(\d+)$", name)
+            key = (1, int(m.group(1))) if m else (0, os.path.getmtime(p))
+            out.append((key, name))
+        return [n for _, n in sorted(out, reverse=True)]
+
+    @staticmethod
+    def _quarantine(path):
+        """Move a corrupt tag aside to ``<tag>.corrupt`` (never deleted —
+        it is forensic evidence) so tag listings skip it."""
+        dst = f"{path}.corrupt"
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{path}.corrupt.{n}"
+        try:
+            os.replace(path, dst)
+        except OSError:
+            return None
+        return dst
+
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
-        from deepspeed_tpu.runtime.checkpoint_engine.native_engine import NativeCheckpointEngine
+        """Load a checkpoint; on :class:`CorruptCheckpointError` the corrupt
+        tag is quarantined (renamed ``<tag>.corrupt``) and the load falls
+        back to the newest prior valid tag automatically
+        (docs/RESILIENCE.md recovery matrix)."""
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.runtime.checkpoint_engine.native_engine import (
+            NativeCheckpointEngine, atomic_write_text)
         self.commit_checkpoints()  # never read a tag with writes in flight
         if tag is None:
             latest = os.path.join(load_dir, "latest")
@@ -1916,11 +2017,39 @@ class DeepSpeedEngine:
                 return None, {}
             with open(latest) as f:
                 tag = f.read().strip()
-        path = os.path.join(load_dir, str(tag))
         engine = NativeCheckpointEngine()
         assert self.state is not None, "engine state must be initialized before load"
-        new_state = engine.load(path, template=self.state)
-        meta = engine.load_meta(path)
+        attempted, _rec_span = [], None
+        while True:
+            path = os.path.join(load_dir, str(tag))
+            try:
+                new_state = engine.load(path, template=self.state)
+                meta = engine.load_meta(path)
+                break
+            except CorruptCheckpointError as e:
+                if _rec_span is None:  # fault→recovery interval in the trace
+                    _rec_span = telemetry.span_begin("recovery/ckpt_fallback")
+                attempted.append(str(tag))
+                telemetry.record("Fault/ckpt_corrupt", 1, kind="counter",
+                                 tag=str(tag), file=e.file or "")
+                q = self._quarantine(path) if os.path.isdir(path) else None
+                logger.error(f"checkpoint {path} corrupt: {e}"
+                             + (f" — quarantined to {q}" if q else ""))
+                candidates = [t for t in self._checkpoint_tags(load_dir)
+                              if t not in attempted]
+                if not candidates:
+                    logger.error(f"no prior valid checkpoint tag left in "
+                                 f"{load_dir} (tried {attempted})")
+                    raise
+                tag = candidates[0]
+                logger.warning(f"falling back to checkpoint tag {tag!r}")
+        if attempted:
+            # repair 'latest' so the NEXT restart goes straight to the tag
+            # that actually loads
+            atomic_write_text(os.path.join(load_dir, "latest"), str(tag))
+            telemetry.record("Recovery/ckpt_fallback", 1, kind="counter",
+                             tag=str(tag), skipped=len(attempted))
+            _rec_span.end()
         if load_module_only or not load_optimizer_states:
             new_state = self.state._replace(params=new_state.params, master=new_state.master)
         # restore device placement/shardings

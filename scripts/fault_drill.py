@@ -1,0 +1,268 @@
+"""End-to-end fault drill (docs/RESILIENCE.md) — kill/resume on CPU.
+
+Four drills, each exercising a real process boundary (SIGKILL/SIGTERM on a
+live training subprocess), pinning the acceptance behaviors the unit suite
+(tests/test_resilience.py) checks in-process:
+
+1. ``kill-async-save``  SIGKILL the trainer while an async checkpoint
+   worker is inside the publish window (held open by a ``ckpt.publish``
+   sleep fault). The live tag must remain loadable — the atomic
+   tmp+rename publish means a crash at ANY instant leaves a complete tag.
+2. ``bitflip``          flip one byte in the newest tag's array shard; the
+   checksum manifest must catch it, quarantine the tag, and the load must
+   transparently fall back to the prior tag (and repair ``latest``).
+3. ``preemption``       real SIGTERM to a training process with the
+   preemption handler enabled: it writes an emergency checkpoint at the
+   next step boundary and exits 83 (clean preemption — budget-free for the
+   elastic agent); a fresh engine then resumes from the emergency tag.
+4. ``watchdog``         inject a ``step.hang`` stall into a process running
+   the watchdog with ``abort`` on; the watchdog must dump stacks and
+   hard-exit 85 within one heartbeat.
+
+Usage:  python scripts/fault_drill.py [--drill NAME] [--keep]
+Exit 0 iff every selected drill passes.
+"""
+
+import argparse
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+EXIT_CLEAN_PREEMPTION = 83
+EXIT_WATCHDOG_ABORT = 85
+
+# one trainer template, parameterized by the resilience config and loop
+# behavior — every drill runs this as a real subprocess
+TRAINER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import deepspeed_tpu
+from tests.simple_model import SimpleModel, random_batches
+
+out = sys.argv[1]
+model = SimpleModel()
+batch = random_batches(1, 8)[0]
+params = model.init(jax.random.PRNGKey(0), batch)["params"]
+engine, _, _, _ = deepspeed_tpu.initialize(
+    model=model, model_parameters=params, config={config})
+batches = random_batches(4, 8)
+{body}
+"""
+
+
+def _write_trainer(workdir, config, body):
+    p = os.path.join(workdir, "trainer.py")
+    with open(p, "w") as f:
+        f.write(TRAINER.format(repo=REPO, config=config,
+                               body=textwrap.dedent(body)))
+    return p
+
+
+def _spawn(trainer, out, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
+    return subprocess.Popen([sys.executable, trainer, out], env=env)
+
+
+def _wait_for(path, proc, timeout=180, desc="marker"):
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"trainer exited {proc.returncode} before {desc}")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError(f"timed out waiting for {desc}")
+        time.sleep(0.05)
+
+
+def _fresh_engine():
+    import jax
+    import deepspeed_tpu
+    from tests.simple_model import SimpleModel, random_batches
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    return engine
+
+
+BASE_CFG = {"train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}}
+
+
+# ---------------------------------------------------------------------------
+# drills
+# ---------------------------------------------------------------------------
+
+def drill_kill_async_save(workdir):
+    """SIGKILL mid-async-save: the publish window is held open by a sleep
+    fault, the process dies inside it, and 'latest' must still load."""
+    out = os.path.join(workdir, "ckpt")
+    cfg = dict(BASE_CFG)
+    # the async worker stalls 120s between finishing the tmp dir and the
+    # atomic publish — the deterministic SIGKILL window. n2: the first
+    # publish hit is the durable sync save, the second is the async worker
+    cfg["resilience"] = {"faults": "ckpt.publish:n2!sleep120"}
+    trainer = _write_trainer(workdir, cfg, """
+        loss = engine(batches[0]); engine.backward(loss); engine.step()
+        engine.save_checkpoint(out)                       # durable tag
+        loss = engine(batches[1]); engine.backward(loss); engine.step()
+        engine.save_checkpoint(out, async_save=True)      # stalls in publish
+        import time
+        time.sleep(1.0)  # let the worker reach the fault point
+        open(os.path.join(out, "armed"), "w").close()
+        time.sleep(600)  # parent SIGKILLs us here
+    """)
+    p = _spawn(trainer, out)
+    try:
+        _wait_for(os.path.join(out, "armed"), p, desc="publish-window marker")
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    latest = os.path.join(out, "latest")
+    assert os.path.exists(latest), "no 'latest' after SIGKILL"
+    tag = open(latest).read().strip()
+    assert tag == "global_step1", f"latest moved to unpublished tag: {tag}"
+    engine = _fresh_engine()
+    path, _ = engine.load_checkpoint(out)
+    assert engine.global_steps == 1, engine.global_steps
+    print(f"  latest={tag!r} loads, resumed at step {engine.global_steps}")
+
+
+def drill_bitflip(workdir):
+    """Bit-flip in the newest tag: checksum catches it, loader quarantines
+    and falls back to the prior tag, repairing 'latest'."""
+    out = os.path.join(workdir, "ckpt")
+    engine = _fresh_engine()
+    from tests.simple_model import random_batches
+    for i, b in enumerate(random_batches(2, 8)):
+        loss = engine(b); engine.backward(loss); engine.step()
+        engine.save_checkpoint(out)
+    shard = os.path.join(out, "global_step2", "arrays.npz")
+    raw = bytearray(open(shard, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(shard, "wb").write(bytes(raw))
+    path, _ = engine.load_checkpoint(out)
+    assert path.endswith("global_step1"), path
+    assert os.path.isdir(os.path.join(out, "global_step2.corrupt"))
+    assert open(os.path.join(out, "latest")).read().strip() == "global_step1"
+    print("  bit-flip caught; fell back to global_step1; latest repaired")
+
+
+def drill_preemption(workdir):
+    """Real SIGTERM → emergency checkpoint → exit 83 → resume."""
+    out = os.path.join(workdir, "ckpt")
+    cfg = dict(BASE_CFG)
+    cfg["resilience"] = {"preemption": {
+        "enabled": True, "save_dir": out, "tag": "emergency"}}
+    trainer = _write_trainer(workdir, cfg, """
+        i = 0
+        while True:
+            b = batches[i % 4]; i += 1
+            loss = engine(b); engine.backward(loss); engine.step()
+            open(os.path.join(out, "ready"), "w").close()
+    """)
+    os.makedirs(out, exist_ok=True)
+    p = _spawn(trainer, out)
+    try:
+        _wait_for(os.path.join(out, "ready"), p, desc="first step")
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=120)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert rc == EXIT_CLEAN_PREEMPTION, f"exit {rc}, want 83"
+    assert open(os.path.join(out, "latest")).read().strip() == "emergency"
+    engine = _fresh_engine()
+    path, _ = engine.load_checkpoint(out)
+    assert path.endswith("emergency")
+    print(f"  SIGTERM → exit {rc}; emergency tag resumed at step "
+          f"{engine.global_steps}")
+
+
+def drill_watchdog(workdir):
+    """Injected step.hang + watchdog abort: the process must self-terminate
+    with exit 85 (and dump stacks) instead of wedging forever."""
+    out = os.path.join(workdir, "ckpt")
+    dump = os.path.join(workdir, "hang_dump.txt")
+    cfg = dict(BASE_CFG)
+    cfg["resilience"] = {
+        "faults": "step.hang:once@step2!sleep600",
+        "watchdog": {"enabled": True, "min_interval_s": 1.0,
+                     "poll_interval_s": 0.2, "hang_factor": 1e-3,
+                     "abort": True, "dump_file": dump},
+    }
+    trainer = _write_trainer(workdir, cfg, """
+        for b in batches:
+            loss = engine(b); engine.backward(loss); engine.step()
+    """)
+    os.makedirs(out, exist_ok=True)
+    p = _spawn(trainer, out)
+    try:
+        rc = p.wait(timeout=180)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert rc == EXIT_WATCHDOG_ABORT, f"exit {rc}, want 85"
+    assert os.path.exists(dump), "watchdog wrote no stack dump"
+    report = open(dump).read()
+    assert "no step progress" in report and "--- thread" in report
+    print(f"  hang flagged; aborted with exit {rc}; stack dump "
+          f"({len(report)} bytes) written")
+
+
+DRILLS = {
+    "kill-async-save": drill_kill_async_save,
+    "bitflip": drill_bitflip,
+    "preemption": drill_preemption,
+    "watchdog": drill_watchdog,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--drill", choices=sorted(DRILLS), default=None,
+                    help="run one drill (default: all)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directories for inspection")
+    args = ap.parse_args(argv)
+    names = [args.drill] if args.drill else list(DRILLS)
+    failures = []
+    for name in names:
+        workdir = tempfile.mkdtemp(prefix=f"fault_drill_{name}_")
+        print(f"drill {name} ({workdir})")
+        t0 = time.monotonic()
+        try:
+            DRILLS[name](workdir)
+            print(f"  PASS ({time.monotonic() - t0:.1f}s)")
+        except Exception as e:
+            failures.append(name)
+            print(f"  FAIL: {type(e).__name__}: {e}")
+        finally:
+            if not args.keep:
+                shutil.rmtree(workdir, ignore_errors=True)
+    if failures:
+        print(f"fault drill FAILED: {failures}")
+        return 1
+    print(f"fault drill: all {len(names)} drills passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

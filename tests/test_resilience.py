@@ -1,0 +1,690 @@
+"""Resilience pins (docs/RESILIENCE.md): fault-injection grammar and
+triggers, retry/backoff policy, crash-consistent verified checkpoints,
+corrupt-tag quarantine + fallback, preemption-aware save with the
+clean-preemption exit code, the step watchdog, and the elastic agent's
+budget-free preemption relaunch. Everything runs on CPU — the fault
+points make every TPU failure mode drillable in-process."""
+
+import hashlib
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.resilience import (EXIT_CLEAN_PREEMPTION,
+                                      EXIT_WATCHDOG_ABORT,
+                                      CorruptCheckpointError, InjectedFault,
+                                      PreemptionHandler, StepWatchdog, faults)
+from deepspeed_tpu.runtime.checkpoint_engine.native_engine import (
+    AsyncCheckpointEngine, NativeCheckpointEngine, atomic_write_text)
+from deepspeed_tpu.utils.retry import (BackoffPolicy, RetryError, retry_call,
+                                       retryable)
+from tests.simple_model import SimpleModel, random_batches
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault spec grammar + triggers
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_full_grammar():
+    rules = faults.parse_spec(
+        "ckpt.write:once@step3; comm.collective:p0.25 ,"
+        "step.hang:n2@step1-9!sleep2.5;worker.exit:always!exit7")
+    by_point = {r.point: r for r in rules}
+    assert set(by_point) == {"ckpt.write", "comm.collective", "step.hang",
+                             "worker.exit"}
+    r = by_point["ckpt.write"]
+    assert (r.mode, r.lo, r.hi, r.action) == ("once", 3, 3, "raise")
+    r = by_point["comm.collective"]
+    assert (r.mode, r.prob, r.lo) == ("prob", 0.25, None)
+    r = by_point["step.hang"]
+    assert (r.mode, r.nth, r.lo, r.hi, r.action, r.arg) == \
+        ("nth", 2, 1, 9, "sleep", 2.5)
+    r = by_point["worker.exit"]
+    assert (r.mode, r.action, r.arg) == ("always", "exit", 7)
+
+
+def test_parse_spec_default_actions():
+    """step.hang stalls and worker.exit crashes even without an !action."""
+    by_point = {r.point: r for r in faults.parse_spec(
+        "step.hang:once;worker.exit:once;ckpt.write:once")}
+    assert by_point["step.hang"].action == "sleep"
+    assert by_point["worker.exit"].action == "exit"
+    assert by_point["ckpt.write"].action == "raise"
+
+
+@pytest.mark.parametrize("bad", [
+    "ckpt.write",                # no mode
+    "nope.nope:once",            # unknown point must not silently disarm
+    "ckpt.write:n0",             # n<K> is 1-based
+    "ckpt.write:p1.5",           # probability out of range
+    "ckpt.write:once@step5-3",   # empty window
+    "ckpt.write:oops",           # unknown mode
+    "ckpt.write:once!boom",      # unknown action
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_once_and_nth_triggers():
+    inj = faults.FaultInjector()
+    inj.configure("ckpt.write:once;ckpt.publish:n3")
+    with pytest.raises(InjectedFault):
+        inj.maybe_fail("ckpt.write")
+    inj.maybe_fail("ckpt.write")  # once means once
+    assert inj.trip_count("ckpt.write") == 1
+    inj.maybe_fail("ckpt.publish")
+    inj.maybe_fail("ckpt.publish")
+    with pytest.raises(InjectedFault):
+        inj.maybe_fail("ckpt.publish")  # 3rd hit
+    inj.maybe_fail("ckpt.publish")      # and only the 3rd
+    assert inj.trip_count("ckpt.publish") == 1
+    inj.maybe_fail("io.host")  # unarmed point is a no-op
+
+
+def test_step_window_gating():
+    inj = faults.FaultInjector()
+    inj.configure("ckpt.write:always@step2-4")
+    inj.maybe_fail("ckpt.write")  # step unknown: window can't match
+    inj.set_step(1)
+    inj.maybe_fail("ckpt.write")
+    inj.set_step(3)
+    with pytest.raises(InjectedFault):
+        inj.maybe_fail("ckpt.write")
+    inj.set_step(5)
+    inj.maybe_fail("ckpt.write")
+    assert inj.trip_count() == 1
+
+
+def test_probability_trigger_is_seeded():
+    def trips(seed):
+        inj = faults.FaultInjector()
+        inj.configure("comm.collective:p0.5", seed=seed)
+        fired = []
+        for i in range(64):
+            try:
+                inj.maybe_fail("comm.collective")
+                fired.append(0)
+            except InjectedFault:
+                fired.append(1)
+        return fired
+    a, b = trips(7), trips(7)
+    assert a == b, "same seed must reproduce the same fault schedule"
+    assert 10 < sum(a) < 54  # p=0.5 over 64 hits, loose bounds
+    assert trips(8) != a
+
+
+def test_sleep_action_stalls_then_continues():
+    inj = faults.FaultInjector()
+    inj.configure("step.hang:once!sleep0.05")
+    t0 = time.monotonic()
+    inj.maybe_fail("step.hang")  # no raise — stalls and returns
+    assert time.monotonic() - t0 >= 0.05
+    assert inj.trip_count("step.hang") == 1
+
+
+def test_env_arming_and_explicit_config_precedence(monkeypatch):
+    monkeypatch.setenv(faults.ENV_SPEC, "io.host:once")
+    inj = faults.FaultInjector()
+    with pytest.raises(InjectedFault):
+        inj.maybe_fail("io.host")  # env spec armed lazily on first use
+    inj2 = faults.FaultInjector()
+    inj2.configure("ckpt.write:once")  # explicit config wins over the env
+    inj2.maybe_fail("io.host")
+    with pytest.raises(InjectedFault):
+        inj2.maybe_fail("ckpt.write")
+
+
+def test_env_typo_fails_loud(monkeypatch):
+    monkeypatch.setenv(faults.ENV_SPEC, "ckpt.wirte:once")
+    inj = faults.FaultInjector()
+    with pytest.raises(ValueError, match="unknown fault point"):
+        inj.maybe_fail("ckpt.write")
+
+
+def test_module_singleton_reset():
+    faults.configure("ckpt.write:always")
+    assert faults.armed()
+    with pytest.raises(InjectedFault):
+        faults.maybe_fail("ckpt.write")
+    faults.reset()
+    assert not faults.armed()
+    faults.maybe_fail("ckpt.write")
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff
+# ---------------------------------------------------------------------------
+
+def test_backoff_policy_ladder_and_jitter():
+    p = BackoffPolicy(base=0.5, factor=2.0, max_delay=3.0, jitter="none")
+    assert [p.cap(a) for a in (1, 2, 3, 4, 5)] == [0.5, 1.0, 2.0, 3.0, 3.0]
+    assert p.delay(2) == 1.0  # jitter=none → deterministic ladder
+    import random
+    pj = BackoffPolicy(base=0.5, factor=2.0, max_delay=3.0, jitter="full",
+                       rng=random.Random(0))
+    for a in range(1, 8):
+        assert 0.0 <= pj.delay(a) <= pj.cap(a)
+    with pytest.raises(ValueError):
+        BackoffPolicy(factor=0.5)
+    with pytest.raises(ValueError):
+        BackoffPolicy(jitter="half")
+    with pytest.raises(ValueError):
+        p.cap(0)
+
+
+def test_retry_eventually_succeeds_with_backoff():
+    calls, slept = [], []
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("blip")
+        return "ok"
+    assert retry_call(flaky, retries=3, base_delay=0.5, jitter="none",
+                      sleep=slept.append) == "ok"
+    assert len(calls) == 3
+    assert slept == [0.5, 1.0]
+
+
+def test_retry_exhaustion_chains_last_error():
+    def always():
+        raise OSError("down")
+    with pytest.raises(RetryError) as ei:
+        retry_call(always, retries=2, base_delay=0.0, sleep=lambda s: None)
+    assert ei.value.attempts == 3  # first attempt + 2 retries
+    assert isinstance(ei.value.last, OSError)
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_retry_deadline_refuses_to_oversleep():
+    t = [0.0]
+    def always():
+        t[0] += 1.0  # each attempt costs 1s of fake time
+        raise OSError("down")
+    with pytest.raises(RetryError, match="deadline"):
+        retry_call(always, retries=10, base_delay=4.0, jitter="none",
+                   deadline=3.0, clock=lambda: t[0], sleep=lambda s: None)
+
+
+def test_retry_non_matching_exception_propagates():
+    def boom():
+        raise ValueError("not transient")
+    with pytest.raises(ValueError):
+        retry_call(boom, retries=5, retry_on=(OSError,),
+                   sleep=lambda s: None)
+
+
+def test_retryable_decorator_and_on_retry_hook():
+    seen = []
+    calls = []
+
+    @retryable(retries=2, base_delay=0.0, sleep=lambda s: None,
+               on_retry=lambda a, e, d: seen.append((a, type(e).__name__)))
+    def flaky(x):
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("blip")
+        return x * 2
+
+    assert flaky(21) == 42
+    assert seen == [(1, "OSError")]
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent verified checkpoints
+# ---------------------------------------------------------------------------
+
+def _tree(seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {"w": jax.random.normal(k, (4, 4), dtype=jnp.float32),
+            "b": np.arange(3, dtype=np.float32) + seed, "step": 7 + seed}
+
+
+def _dir_hashes(path):
+    out = {}
+    for name in sorted(os.listdir(path)):
+        p = os.path.join(path, name)
+        if os.path.isfile(p):
+            out[name] = hashlib.sha256(open(p, "rb").read()).hexdigest()
+    return out
+
+
+def _assert_tree_equal(got, want):
+    for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_native_save_verify_load_roundtrip(tmp_path):
+    eng = NativeCheckpointEngine()
+    path = str(tmp_path / "tag")
+    eng.save(_tree(), path, meta={"note": "v1"})
+    manifest = eng.verify(path)
+    assert manifest["format_version"] == NativeCheckpointEngine.FORMAT_VERSION
+    assert set(manifest["checksums"]) >= {"arrays.npz", "aux.pkl",
+                                          "meta_state.pkl"}
+    _assert_tree_equal(eng.load(path, template=_tree()), _tree())
+    assert eng.load_meta(path) == {"note": "v1"}
+
+
+def test_crash_mid_write_leaves_previous_tag_intact(tmp_path):
+    eng = NativeCheckpointEngine()
+    path = str(tmp_path / "tag")
+    eng.save(_tree(0), path)
+    before = _dir_hashes(path)
+    faults.configure("ckpt.write:once")
+    with pytest.raises(InjectedFault):
+        eng.save(_tree(1), path)
+    # crash window cleanup: no tmp litter, old tag byte-identical and loadable
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+    assert _dir_hashes(path) == before
+    _assert_tree_equal(eng.load(path, template=_tree()), _tree(0))
+
+
+@pytest.mark.parametrize("kind", ["native", "async"])
+def test_crash_at_publish_previous_tag_byte_identical(tmp_path, kind):
+    """The drill's kill window: a complete new tmp exists, the publish dies.
+    The live tag must remain byte-for-byte the pre-crash checkpoint."""
+    path = str(tmp_path / "tag")
+    if kind == "native":
+        eng = NativeCheckpointEngine()
+        eng.save(_tree(0), path)
+        before = _dir_hashes(path)
+        faults.configure("ckpt.publish:once")
+        with pytest.raises(InjectedFault):
+            eng.save(_tree(1), path)
+    else:
+        eng = AsyncCheckpointEngine()
+        eng.save(_tree(0), path)
+        eng.commit(None)
+        before = _dir_hashes(path)
+        faults.configure("ckpt.publish:once")
+        eng.save(_tree(1), path)  # background worker hits the fault
+        with pytest.raises(IOError, match="InjectedFault"):
+            eng.commit(None)
+    assert _dir_hashes(path) == before
+    loaded = NativeCheckpointEngine().load(path, template=_tree())
+    _assert_tree_equal(loaded, _tree(0))
+
+
+def test_bitflip_caught_by_checksum_and_named(tmp_path):
+    eng = NativeCheckpointEngine()
+    path = str(tmp_path / "tag")
+    eng.save(_tree(), path)
+    shard = os.path.join(path, "arrays.npz")
+    raw = bytearray(open(shard, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(shard, "wb").write(bytes(raw))
+    with pytest.raises(CorruptCheckpointError) as ei:
+        eng.verify(path)
+    assert ei.value.file == "arrays.npz"
+    assert "checksum" in ei.value.reason
+    with pytest.raises(CorruptCheckpointError):
+        eng.load(path, template=_tree())
+
+
+def test_missing_pieces_raise_typed_errors(tmp_path):
+    eng = NativeCheckpointEngine()
+    path = str(tmp_path / "tag")
+    eng.save(_tree(), path)
+    # a missing directory is CorruptCheckpointError, not FileNotFoundError
+    err = pytest.raises(CorruptCheckpointError,
+                        eng.load, str(tmp_path / "ghost"), template=_tree())
+    assert isinstance(err.value, IOError)
+    os.remove(os.path.join(path, "meta.json"))
+    with pytest.raises(CorruptCheckpointError, match="manifest"):
+        eng.load(path, template=_tree())
+
+
+def test_truncated_unverified_checkpoint_wrapped(tmp_path):
+    """Format-1 manifests (no checksums) skip verification — a truncated
+    shard must still surface as CorruptCheckpointError, not BadZipFile."""
+    import json
+    eng = NativeCheckpointEngine()
+    path = str(tmp_path / "tag")
+    eng.save(_tree(), path)
+    meta_p = os.path.join(path, "meta.json")
+    meta = json.load(open(meta_p))
+    meta.pop("checksums")
+    meta["format_version"] = 1
+    json.dump(meta, open(meta_p, "w"))
+    shard = os.path.join(path, "arrays.npz")
+    raw = open(shard, "rb").read()
+    open(shard, "wb").write(raw[:len(raw) // 2])
+    with pytest.raises(CorruptCheckpointError):
+        eng.load(path, template=_tree())
+
+
+def test_io_host_fault_absorbed_by_retry(tmp_path):
+    """A transient host-I/O blip (one injected failure) is retried away —
+    the save still succeeds and the trip is accounted."""
+    eng = NativeCheckpointEngine()
+    path = str(tmp_path / "tag")
+    faults.configure("io.host:once")
+    eng.save(_tree(), path)
+    assert faults.trip_count("io.host") == 1
+    _assert_tree_equal(eng.load(path, template=_tree()), _tree())
+
+
+def test_atomic_write_text(tmp_path):
+    p = str(tmp_path / "latest")
+    atomic_write_text(p, "global_step1")
+    atomic_write_text(p, "global_step2")
+    assert open(p).read() == "global_step2"
+    assert [n for n in os.listdir(tmp_path) if n != "latest"] == []
+
+
+def test_comm_collective_fault_point():
+    from deepspeed_tpu.comm import comm
+    faults.configure("comm.collective:once")
+    with pytest.raises(InjectedFault, match="all_reduce"):
+        comm.all_reduce(np.ones(4, dtype=np.float32))
+    assert faults.trip_count("comm.collective") == 1
+
+
+# ---------------------------------------------------------------------------
+# engine: quarantine + fallback, atomic latest, preemption, watchdog
+# ---------------------------------------------------------------------------
+
+def make_engine(config_extra=None, seed=0):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(config_extra or {})
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(seed), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg)
+    return engine
+
+
+def train_steps(engine, n, seed=0):
+    for b in random_batches(n, 8, seed=seed):
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+
+
+def _bitflip(path):
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+
+
+def test_engine_latest_is_atomic(tmp_path):
+    engine = make_engine()
+    train_steps(engine, 1)
+    engine.save_checkpoint(str(tmp_path))
+    assert (tmp_path / "latest").read_text() == "global_step1"
+    assert not [n for n in os.listdir(tmp_path) if n.startswith("latest.tmp")]
+
+
+def test_engine_corrupt_tag_quarantined_and_fallback(tmp_path):
+    """Acceptance pin: a bit-flip in the newest tag is caught by the
+    checksum, the tag is quarantined, the load transparently falls back to
+    the prior tag, and 'latest' is repaired to the tag that loads."""
+    engine = make_engine()
+    train_steps(engine, 1)
+    engine.save_checkpoint(str(tmp_path))           # global_step1
+    train_steps(engine, 1, seed=1)
+    engine.save_checkpoint(str(tmp_path))           # global_step2
+    assert (tmp_path / "latest").read_text() == "global_step2"
+    _bitflip(str(tmp_path / "global_step2" / "arrays.npz"))
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path.endswith("global_step1")
+    assert engine.global_steps == 1
+    assert (tmp_path / "global_step2.corrupt").is_dir()  # forensic evidence
+    assert not (tmp_path / "global_step2").exists()
+    assert (tmp_path / "latest").read_text() == "global_step1"
+
+
+def test_engine_all_tags_corrupt_raises_typed(tmp_path):
+    engine = make_engine()
+    train_steps(engine, 1)
+    engine.save_checkpoint(str(tmp_path))
+    _bitflip(str(tmp_path / "global_step1" / "arrays.npz"))
+    with pytest.raises(CorruptCheckpointError):
+        engine.load_checkpoint(str(tmp_path))
+    assert (tmp_path / "global_step1.corrupt").is_dir()
+
+
+def test_preemption_emergency_save_and_exit_code(tmp_path):
+    """Acceptance pin: preemption request → emergency checkpoint at the next
+    step boundary → SystemExit with the clean-preemption code (83)."""
+    engine = make_engine({"resilience": {"preemption": {
+        "enabled": True, "save_dir": str(tmp_path), "tag": "emergency"}}})
+    try:
+        assert engine._preemption is not None
+        train_steps(engine, 1)
+        engine._preemption.request()  # the metadata-watcher path
+        with pytest.raises(SystemExit) as ei:
+            train_steps(engine, 1, seed=1)
+        assert ei.value.code == EXIT_CLEAN_PREEMPTION
+        assert (tmp_path / "emergency" / "meta.json").exists()
+        assert (tmp_path / "latest").read_text() == "emergency"
+    finally:
+        engine._preemption.uninstall()
+    # the emergency tag must actually resume a fresh engine
+    engine2 = make_engine()
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path.endswith("emergency")
+    assert engine2.global_steps == 2
+
+
+def test_preemption_handler_catches_sigterm_in_process():
+    h = PreemptionHandler().install()
+    try:
+        assert h.installed and not h.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not h.requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert h.requested()
+        assert h.signal_received == signal.SIGTERM
+        h.clear()
+        assert not h.requested()
+    finally:
+        h.uninstall()
+
+
+def test_real_sigterm_subprocess_exits_clean_preemption(tmp_path):
+    """End-to-end: a real training process gets a real SIGTERM and must exit
+    with the clean-preemption code after writing the emergency tag."""
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        import deepspeed_tpu
+        from tests.simple_model import SimpleModel, random_batches
+
+        out = sys.argv[1]
+        model = SimpleModel()
+        batch = random_batches(1, 8)[0]
+        params = model.init(jax.random.PRNGKey(0), batch)["params"]
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config={{
+                "train_batch_size": 8,
+                "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+                "resilience": {{"preemption": {{
+                    "enabled": True, "save_dir": out, "tag": "emergency"}}}},
+            }})
+        batches = random_batches(4, 8)
+        i = 0
+        while True:
+            b = batches[i % 4]; i += 1
+            loss = engine(b)
+            engine.backward(loss)
+            engine.step()
+            open(os.path.join(out, "ready"), "w").close()
+    """)
+    worker = tmp_path / "worker.py"
+    worker.write_text(script)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen([sys.executable, str(worker), str(tmp_path)],
+                         env=env)
+    try:
+        deadline = time.monotonic() + 180
+        while not (tmp_path / "ready").exists():
+            assert p.poll() is None, "worker died before first step"
+            assert time.monotonic() < deadline, "worker never reached a step"
+            time.sleep(0.1)
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=120)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert rc == EXIT_CLEAN_PREEMPTION
+    assert (tmp_path / "emergency" / "meta.json").exists()
+    assert (tmp_path / "latest").read_text() == "emergency"
+
+
+def test_watchdog_threshold_fire_and_relatch():
+    t = [0.0]
+    wd = StepWatchdog(hang_factor=3.0, min_interval_s=0.1,
+                      poll_interval_s=0.05, window=8, clock=lambda: t[0])
+    wd._last_beat = t[0]  # drive check() directly; no poll thread
+    for _ in range(5):
+        t[0] += 0.2
+        wd.beat()
+    assert wd.threshold() == pytest.approx(0.6)  # 3.0 x median(0.2)
+    t[0] += 0.5
+    assert wd.check() is None                    # idle 0.5 <= 0.6
+    t[0] += 0.2
+    report = wd.check()                          # idle 0.7 > 0.6
+    assert report is not None and wd.fired == 1
+    assert "no step progress" in report and "--- thread" in report
+    assert wd.check() is None                    # latched until the next beat
+    wd.beat()
+    t[0] += 5.0
+    assert wd.check() is not None                # re-armed
+    assert wd.fired == 2
+
+
+def test_watchdog_on_hang_and_dump_file(tmp_path):
+    t = [0.0]
+    hangs = []
+    dump = str(tmp_path / "hang.txt")
+    wd = StepWatchdog(hang_factor=2.0, min_interval_s=0.1, window=4,
+                      clock=lambda: t[0], on_hang=hangs.append,
+                      dump_file=dump)
+    wd._last_beat = t[0]
+    wd.beat(step_seconds=0.05)
+    t[0] += 1.0
+    assert wd.check() is not None
+    assert len(hangs) == 1
+    assert "no step progress" in open(dump).read()
+
+
+def test_exit_code_contract_is_distinct():
+    codes = {0, 1, EXIT_CLEAN_PREEMPTION, EXIT_WATCHDOG_ABORT}
+    assert len(codes) == 4
+    assert EXIT_CLEAN_PREEMPTION == 83 and EXIT_WATCHDOG_ABORT == 85
+
+
+def test_watchdog_flags_injected_hang(tmp_path):
+    """Acceptance pin: an injected step.hang stall is flagged within one
+    heartbeat. hang_factor is tiny so min_interval_s (0.3s) dominates the
+    threshold regardless of compile-time step samples."""
+    engine = make_engine({"resilience": {
+        "faults": "step.hang:once@step2!sleep1.2",
+        "watchdog": {"enabled": True, "min_interval_s": 0.3,
+                     "poll_interval_s": 0.05, "hang_factor": 1e-3},
+    }})
+    try:
+        train_steps(engine, 3)
+        assert engine._watchdog.fired >= 1
+        assert "no step progress" in engine._watchdog.last_report
+        assert faults.trip_count("step.hang") == 1
+    finally:
+        engine._watchdog.stop()
+
+
+# ---------------------------------------------------------------------------
+# elastic agent: preemption is budget-free, failures are accounted
+# ---------------------------------------------------------------------------
+
+def _write_worker(tmp_path, body):
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_elastic_agent_preemption_budget_free(tmp_path):
+    """Exit 83 relaunches without consuming max_restarts (here: 0)."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    w = _write_worker(tmp_path, f"""
+        import os, sys
+        out = sys.argv[1]
+        flag = os.path.join(out, "preempted_once")
+        if not os.path.exists(flag):
+            open(flag, "w").close()
+            sys.exit({EXIT_CLEAN_PREEMPTION})
+        open(os.path.join(out, "done"), "w").close()
+    """)
+    agent = DSElasticAgent(w, [str(tmp_path)], hosts=["localhost"],
+                           max_restarts=0,
+                           backoff=BackoffPolicy(base=0.01, jitter="none"))
+    assert agent.run() == 0
+    assert agent.restarts == 0
+    assert agent.preemptions == 1
+    assert agent.restart_reasons == ["preemption"]
+    assert (tmp_path / "done").exists()
+
+
+def test_elastic_agent_failure_reason_recorded(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    w = _write_worker(tmp_path, """
+        import os, sys
+        out = sys.argv[1]
+        flag = os.path.join(out, "failed_once")
+        if not os.path.exists(flag):
+            open(flag, "w").close()
+            sys.exit(5)
+        open(os.path.join(out, "done"), "w").close()
+    """)
+    agent = DSElasticAgent(w, [str(tmp_path)], hosts=["localhost"],
+                           max_restarts=1,
+                           backoff=BackoffPolicy(base=0.01, jitter="none"))
+    assert agent.run() == 0
+    assert agent.restarts == 1
+    assert agent.preemptions == 0
+    assert agent.restart_reasons == ["worker_exit_5"]
+
+
+def test_resilience_config_section():
+    from deepspeed_tpu.runtime.config import ResilienceConfig
+    cfg = ResilienceConfig({
+        "faults": "ckpt.write:once@step3", "fault_seed": 11,
+        "preemption": {"enabled": True, "save_dir": "/tmp/x"},
+        "watchdog": {"enabled": True, "hang_factor": 4.0, "abort": True},
+    })
+    assert cfg.faults == "ckpt.write:once@step3" and cfg.fault_seed == 11
+    assert cfg.preemption.enabled and cfg.preemption.exit_code == \
+        EXIT_CLEAN_PREEMPTION
+    assert cfg.watchdog.abort and cfg.watchdog.exit_code == \
+        EXIT_WATCHDOG_ABORT
+    dflt = ResilienceConfig({})
+    assert not dflt.preemption.enabled and not dflt.watchdog.enabled
