@@ -582,15 +582,21 @@ def run_bench():
         os.execv(sys.executable, [sys.executable] + sys.argv)
 
     n_steps = 10 if on_tpu else 3
+    fpt = gpt2_flops_per_token(cfg, seq)
+    tokens_per_step = batch * max(n_chips, 1) * seq * gas
+    # feed the telemetry goodput ledger the same FLOP model the ad-hoc MFU
+    # below uses, so extra.mfu and extra.telemetry.ledger.mfu_rolling agree
+    telemetry.set_model_flops(flops_per_step=fpt * tokens_per_step,
+                              peak_flops=peak_flops(kind) * max(n_chips, 1))
     t0 = time.perf_counter()
-    for _ in range(n_steps):
+    for i in range(n_steps):
         loss = step()
+        telemetry.ledger_step(step=i)  # no-op when telemetry is off
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    tokens = batch * max(n_chips, 1) * seq * n_steps * gas
+    tokens = tokens_per_step * n_steps
     tok_per_sec_chip = tokens / dt / max(n_chips, 1)
-    fpt = gpt2_flops_per_token(cfg, seq)
     mfu = tok_per_sec_chip * fpt / peak_flops(kind)
 
     payload = {
@@ -604,7 +610,13 @@ def run_bench():
                   "gas": gas, "loss": float(jax.device_get(loss))},
     }
     if telemetry.enabled():
-        payload["extra"]["telemetry"] = telemetry.summary()
+        hbm = telemetry.sample_memory("bench_end") or {}
+        summ = telemetry.summary()
+        payload["extra"]["telemetry"] = summ
+        payload["extra"]["peak_hbm_bytes"] = max(
+            int(hbm.get("peak_bytes_in_use", 0) or 0),
+            int(summ.get("memory", {}).get("peak_bytes", 0)))
+        payload["extra"]["goodput_ledger"] = summ.get("ledger", {})
     if on_tpu:
         record_last_good(payload)
     emit(payload)
@@ -635,13 +647,24 @@ def main():
     except Exception as e:
         tb = traceback.format_exc(limit=6)
         print(tb, file=sys.stderr)
+        wedged = "UNAVAILABLE" in str(e) or "initialize backend" in str(e)
         extra = {"error": f"{type(e).__name__}: {e}"[:500],
                  "diagnosis": ("TPU backend unavailable after retries — chip may be "
-                               "held by a stale process" if "UNAVAILABLE" in str(e)
-                               or "initialize backend" in str(e) else "runtime error")}
+                               "held by a stale process" if wedged
+                               else "runtime error")}
         holders = getattr(e, "bench_holders", None)
         if holders:
             extra["holders"] = holders[:8]
+        if wedged:
+            # a wedged chip is a FAULT, not just a JSON tail note — put it on
+            # the telemetry Fault/* stream so trace_merge/perf_gate see it
+            from deepspeed_tpu import telemetry
+            if not telemetry.enabled():
+                telemetry.configure(enabled=True, sample_sync=False)
+            telemetry.count("Fault/backend_unavailable",
+                            error=f"{type(e).__name__}: {e}"[:200])
+            extra["fault"] = "backend_unavailable"
+            extra["telemetry"] = telemetry.summary()
         last = load_last_good()
         if last is not None:
             # prior on-hardware measurement, labeled as such — diagnostic

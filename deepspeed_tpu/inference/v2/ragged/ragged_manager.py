@@ -29,13 +29,13 @@ class DSStateManager:
         """Size the pool from device memory (the reference derives block count
         from a reserved memory fraction, ``ragged_manager.py`` memory_config):
         ~60% of the device's memory limit, fallback 1 GiB when unknown."""
-        import jax
         import numpy as np
         itemsize = np.dtype("float32" if kv.cache_dtype == "fp32" else "uint16").itemsize
         bytes_per_block = (2 * num_layers * kv.block_size * num_kv_heads
                            * head_dim * itemsize)  # K + V pools
         try:
-            stats = jax.local_devices()[0].memory_stats() or {}
+            from deepspeed_tpu import telemetry
+            stats = telemetry.sample_memory("kv_cache_budget") or {}
             budget = int(stats.get("bytes_limit", 0) * 0.6)
         except Exception:
             budget = 0

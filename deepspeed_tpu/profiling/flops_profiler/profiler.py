@@ -230,6 +230,11 @@ class FlopsProfiler:
             int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(
                 eng.state.params) if hasattr(l, "shape"))
         self.profiled = True
+        # feed the MFU numerator: one micro-step's FLOPs times the GAS
+        # window is the model work per optimizer step
+        from deepspeed_tpu import telemetry
+        gas = getattr(eng, "gradient_accumulation_steps_value", 1) or 1
+        telemetry.set_model_flops(flops_per_step=self.flops * gas)
         self.print_model_profile(profile_step=eng.global_steps,
                                  output_file=self.config.output_file
                                  if self.config else None)

@@ -148,8 +148,15 @@ class StepWatchdog:
         try:
             from deepspeed_tpu import telemetry
             if telemetry.enabled():
+                # a stall dump without the HBM picture is half a diagnosis —
+                # wedged steps are frequently allocation-retry livelocks
+                stats = telemetry.sample_memory("watchdog_stall",
+                                                idle_s=round(idle, 3))
+                if stats:
+                    report.append(f"--- hbm snapshot ---\n{stats}")
                 report.append("--- telemetry summary ---")
                 report.append(telemetry.format_summary())
+                telemetry.ledger_add("stall", idle)
             telemetry.record("Fault/hang", 1, kind="counter",
                              idle_s=round(idle, 3),
                              threshold_s=round(thr, 3))

@@ -105,6 +105,12 @@ class TelemetryConfig(DeepSpeedConfigModel):
     jax_annotations = False  # mirror spans into jax.profiler annotations
     monitor = True           # fan aggregates through MonitorMaster at
     #                          steps_per_print cadence
+    memory = True            # HBM memory stream (record_memory samples at
+    #                          step boundaries, OOM post-mortem)
+    flops_per_step = 0       # model FLOPs per optimizer step for the MFU
+    #                          gauge (0 -> flops profiler fills it in)
+    peak_flops = 0           # aggregate peak FLOP/s denominator (0 -> per
+    #                          device-kind table)
 
 
 class PreemptionConfig(DeepSpeedConfigModel):

@@ -1429,14 +1429,18 @@ class DeepSpeedEngine:
         batch = self._shard_batch(batch)
         if self._guards is not None and self._guards["checkify_on_overflow"]:
             self._last_guard_batch = batch  # for overflow localization
-        if getattr(self, "_fused_step_fn", None) is not None:
-            # fused_step config: grads + optimizer apply in ONE jit (GAS=1).
-            # The update is applied HERE; step() consumes the staged stats.
-            lr = self._schedule_fn(self.global_steps)
-            self.state, loss, stats = self._fused_step_fn(self.state, batch, lr)
-            self._pending_fused_stats = stats
-        else:
-            self.state, loss = self._micro_step_fn(self.state, batch)
+        try:
+            if getattr(self, "_fused_step_fn", None) is not None:
+                # fused_step config: grads + optimizer apply in ONE jit (GAS=1).
+                # The update is applied HERE; step() consumes the staged stats.
+                lr = self._schedule_fn(self.global_steps)
+                self.state, loss, stats = self._fused_step_fn(self.state, batch, lr)
+                self._pending_fused_stats = stats
+            else:
+                self.state, loss = self._micro_step_fn(self.state, batch)
+        except Exception as e:
+            telemetry.maybe_oom_postmortem(e)
+            raise
         self._staged_loss = loss
         # device-side running mean across the GAS window (reference averages
         # micro-step losses before the train_loss event; no host sync here)
@@ -1535,7 +1539,11 @@ class DeepSpeedEngine:
                 stats = self._offload_step(self._schedule_fn(self.global_steps))
             else:
                 lr = self._schedule_fn(self.global_steps)
-                self.state, stats = self._apply_step_fn(self.state, lr)
+                try:
+                    self.state, stats = self._apply_step_fn(self.state, lr)
+                except Exception as e:
+                    telemetry.maybe_oom_postmortem(e)
+                    raise
             if self._guards is not None:
                 self._run_guards(old_state, stats)
             self._last_stats = stats
@@ -1566,6 +1574,10 @@ class DeepSpeedEngine:
             self.timers(STEP_GLOBAL_TIMER).stop()
         _span.end(token=self._last_stats.loss_scale
                   if (self._step_applied and self._last_stats is not None) else None)
+        if self._step_applied and telemetry.enabled():
+            # goodput/MFU ledger mark + HBM sample, once per optimizer step
+            telemetry.ledger_step(step=self.global_steps)
+            telemetry.record_memory("step", step=self.global_steps)
         self.tput_timer.stop(global_step=self._step_applied)
         if self._step_applied and self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps}, skipped={self.skipped_steps}, "
@@ -1881,6 +1893,18 @@ class DeepSpeedEngine:
         """``async_save=True`` uses the background-writer engine (the Nebula
         analog): training resumes after the device->host fetch; call
         ``commit_checkpoints()`` (or the next save/load) to join writes."""
+        from deepspeed_tpu import telemetry
+        with telemetry.span("ckpt/save", tag=str(tag) if tag else None,
+                            async_save=async_save):
+            path = self._save_checkpoint(save_dir, tag=tag,
+                                         client_state=client_state,
+                                         save_latest=save_latest,
+                                         async_save=async_save)
+        telemetry.record_memory("ckpt/save", step=self.global_steps)
+        return path
+
+    def _save_checkpoint(self, save_dir, tag=None, client_state=None,
+                         save_latest=True, async_save=False):
         from deepspeed_tpu.runtime.checkpoint_engine.native_engine import (
             AsyncCheckpointEngine, NativeCheckpointEngine, atomic_write_text)
         tag = tag or f"global_step{self.global_steps}"
@@ -2006,6 +2030,19 @@ class DeepSpeedEngine:
         tag is quarantined (renamed ``<tag>.corrupt``) and the load falls
         back to the newest prior valid tag automatically
         (docs/RESILIENCE.md recovery matrix)."""
+        from deepspeed_tpu import telemetry
+        with telemetry.span("ckpt/load", tag=str(tag) if tag else None):
+            out = self._load_checkpoint(
+                load_dir, tag=tag,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only)
+        telemetry.record_memory("ckpt/load", step=self.global_steps)
+        return out
+
+    def _load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                         load_lr_scheduler_states=True,
+                         load_module_only=False):
         from deepspeed_tpu import telemetry
         from deepspeed_tpu.runtime.checkpoint_engine.native_engine import (
             NativeCheckpointEngine, atomic_write_text)

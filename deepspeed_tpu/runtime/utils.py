@@ -64,10 +64,11 @@ def count_parameters(tree):
 
 
 def see_memory_usage(message, force=False):
-    """reference utils.py:762 — PJRT per-device memory stats."""
-    from deepspeed_tpu.accelerator import get_accelerator
-    acc = get_accelerator()
-    stats = acc.memory_stats()
+    """reference utils.py:762 — PJRT per-device memory stats. Reads go
+    through the telemetry memory stream so every HBM sample lands in one
+    place (docs/OBSERVABILITY.md)."""
+    from deepspeed_tpu import telemetry
+    stats = telemetry.sample_memory("see_memory_usage", message=message) or {}
     gb = 1024**3
     logger.info(f"{message} | MA {stats.get('bytes_in_use', 0)/gb:.2f} GB | "
                 f"Max_MA {stats.get('peak_bytes_in_use', 0)/gb:.2f} GB | "

@@ -1,5 +1,6 @@
 """Unified telemetry: step tracing, collective-bandwidth accounting,
-kernel-dispatch counters, compile timing, and Chrome-trace export.
+kernel-dispatch counters, compile timing, HBM memory accounting, a
+goodput/MFU wall-time ledger, and Chrome-trace export.
 
 Module-level functions delegate to ONE process-global :class:`Telemetry`
 pipeline so every layer (engine, comm, ops registry, AOT scripts, benches)
@@ -64,8 +65,43 @@ def record_dispatch(kernel, outcome, reason, mesh_size=None):
     _GLOBAL.record_dispatch(kernel, outcome, reason, mesh_size=mesh_size)
 
 
-def record_compile(program, seconds, topology=None, cache=None):
-    _GLOBAL.record_compile(program, seconds, topology=topology, cache=cache)
+def record_compile(program, seconds, topology=None, cache=None, memory=None):
+    _GLOBAL.record_compile(program, seconds, topology=topology, cache=cache,
+                           memory=memory)
+
+
+def record_memory(point, stats=None, device_index=0, **tags):
+    """Record one HBM occupancy sample (no-op + None when disabled)."""
+    return _GLOBAL.record_memory(point, stats=stats,
+                                 device_index=device_index, **tags)
+
+
+def sample_memory(point, device_index=0, **tags):
+    """Read accelerator memory stats (always) and record them (when
+    enabled). Returns the stats dict."""
+    return _GLOBAL.sample_memory(point, device_index=device_index, **tags)
+
+
+def maybe_oom_postmortem(exc, top_n=10):
+    """Dump an OOM post-mortem if ``exc`` is an HBM-exhaustion error."""
+    return _GLOBAL.maybe_oom_postmortem(exc, top_n=top_n)
+
+
+def oom_postmortem(error=None, top_n=10):
+    return _GLOBAL.oom_postmortem(error=error, top_n=top_n)
+
+
+def set_model_flops(flops_per_step=None, peak_flops=None):
+    _GLOBAL.set_model_flops(flops_per_step=flops_per_step,
+                            peak_flops=peak_flops)
+
+
+def ledger_add(category, seconds):
+    _GLOBAL.ledger_add(category, seconds)
+
+
+def ledger_step(step=None, flops=None):
+    return _GLOBAL.ledger_step(step=step, flops=flops)
 
 
 def summary():

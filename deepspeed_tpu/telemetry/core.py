@@ -14,7 +14,21 @@ One object owns every measurement stream the runtime produces:
 - **dispatch** (``record_dispatch``): per-kernel sharded/fallback/veto
   outcomes with reason codes from ``ops/registry.sharded_kernel_call``.
 - **compile** (``record_compile``): per-program compile seconds + persistent
-  compilation-cache hit/miss from the AOT path.
+  compilation-cache hit/miss (and AOT ``memory_analysis`` byte breakdown)
+  from the AOT path.
+- **memory** (``record_memory`` / ``sample_memory``): HBM occupancy samples
+  from ``accelerator.memory_stats()`` — per-point stream, process peak
+  watermark, Chrome-trace counter track, and (on ``RESOURCE_EXHAUSTED``)
+  an OOM post-mortem listing the top live buffers by size.
+- **goodput ledger** (``ledger_step`` + span/comm/compile classification):
+  every wall-second of the run bucketed into
+  ``compute / comm / compile / ckpt / stall / idle``, joined with the
+  model's per-step FLOPs (``set_model_flops``) into per-step and rolling
+  ``mfu`` and ``goodput`` gauges.
+
+Every JSON-lines record is stamped with ``(host, pid, run_id)`` so
+``scripts/trace_merge.py`` can fold N per-host streams into one Chrome trace
+with per-host tracks and a straggler report.
 
 Exporters: Chrome-trace JSON (``chrome://tracing`` / Perfetto) for spans, a
 JSON-lines metrics file, Monitor fan-out events (``monitor_events``) for the
@@ -32,8 +46,81 @@ jax is imported lazily inside the enabled-only paths.
 import atexit
 import json
 import os
+import socket
 import threading
 import time
+
+#: goodput-ledger taxonomy (docs/OBSERVABILITY.md). Every wall-second of an
+#: enabled run lands in exactly one bucket; ``idle`` is the unattributed
+#: remainder (wall − sum of the others, floored at 0).
+LEDGER_CATEGORIES = ("compute", "comm", "compile", "ckpt", "stall", "idle")
+
+_COMPUTE_SPANS = frozenset({"fwd", "bwd", "step", "eval"})
+
+#: per-chip peak bf16 FLOP/s for the MFU denominator when the caller does not
+#: pass one to ``set_model_flops`` (same public specs bench.py uses; "cpu" is
+#: a nominal figure so CPU-mesh tests produce nonzero, comparable gauges)
+_PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "cpu": 1e12,
+}
+
+
+def _ledger_category(span_name):
+    """Ledger bucket for a span name, or None for container/unclassified
+    spans. ``recovery/*`` spans deliberately map to None: they WRAP the
+    ``ckpt/*`` spans that do the work, and charging both would double-count
+    the interval."""
+    if span_name in _COMPUTE_SPANS:
+        return "compute"
+    if span_name.startswith("ckpt"):
+        return "ckpt"
+    if span_name == "dataloader":
+        return "stall"
+    return None
+
+
+def _default_peak_flops():
+    """Peak FLOP/s of one local device from its device_kind (0.0 when no
+    backend is reachable — MFU then reports 0 rather than raising)."""
+    try:
+        import jax
+        kind = jax.local_devices()[0].device_kind
+    except Exception:
+        return 0.0
+    for k, v in _PEAK_BF16_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return _PEAK_BF16_FLOPS["TPU v5e"]
+
+
+# --- atexit export hook: registered AT MOST ONCE per process ---------------
+# configure()/reset() cycles (tests re-init the pipeline dozens of times) and
+# even multiple Telemetry instances must not stack export hooks — each extra
+# hook would re-export (and with multiple instances, clobber) the trace file.
+_ATEXIT_LOCK = threading.Lock()
+_ATEXIT_REGISTERED = False
+_ATEXIT_INSTANCES = []
+
+
+def _register_atexit(instance):
+    global _ATEXIT_REGISTERED
+    with _ATEXIT_LOCK:
+        if instance not in _ATEXIT_INSTANCES:
+            _ATEXIT_INSTANCES.append(instance)
+        if not _ATEXIT_REGISTERED:
+            atexit.register(_atexit_export_all)
+            _ATEXIT_REGISTERED = True
+
+
+def _atexit_export_all():
+    for inst in list(_ATEXIT_INSTANCES):
+        inst._atexit_export()
 
 
 class _NullSpan:
@@ -122,7 +209,18 @@ class Telemetry:
         self.chrome_trace_path = None
         self.monitor_prefix = "Telemetry/"
         self._jsonl_fh = None
-        self._atexit_registered = False
+        # multi-host identity: stamped onto every JSONL record so
+        # scripts/trace_merge.py can attribute streams (survives reset)
+        try:
+            self.host = socket.gethostname()
+        except Exception:
+            self.host = "localhost"
+        self.run_id = os.environ.get("DS_TPU_HARNESS_RUN_ID") or \
+            f"{os.getpid()}-{int(time.time())}"
+        # goodput-ledger model parameters (survive reset, like sinks)
+        self.memory_enabled = True
+        self._flops_per_step = 0.0
+        self._peak_flops = 0.0
 
     def _reset_state(self):
         self._epoch = time.perf_counter()
@@ -133,13 +231,25 @@ class Telemetry:
         self.comm_stats = {}      # (op, axis) -> [count, bytes, secs, algbw, busbw]
         self.dispatch_stats = {}  # (kernel, outcome, reason) -> count
         self.compile_stats = {}   # program -> {seconds, topology, cache}
+        # memory stream
+        self.memory_samples = []  # {"point", "bytes_in_use", "peak_...", ...}
+        self.memory_peak = 0      # process-level HBM watermark (bytes)
+        self.last_oom_report = None
+        # goodput ledger (seconds per category; idle derived at summary time)
+        self.ledger_secs = {c: 0.0 for c in LEDGER_CATEGORIES if c != "idle"}
+        self._ledger_epoch = self._epoch
+        self._ledger_last_step_ts = None
+        self._ledger_steps = 0
+        self._mfu_last = 0.0
+        self._mfu_roll = 0.0
 
     # ------------------------------------------------------------------
     # configuration
     # ------------------------------------------------------------------
     def configure(self, config=None, enabled=None, jsonl_path=None,
                   chrome_trace_path=None, sample_sync=None,
-                  jax_annotations=None):
+                  jax_annotations=None, memory=None, flops_per_step=None,
+                  peak_flops=None):
         """Configure from a ``TelemetryConfig`` (runtime/config.py
         ``telemetry`` section) and/or explicit overrides. Paths set to ""
         disable that exporter."""
@@ -157,10 +267,23 @@ class Telemetry:
                 jax_annotations = getattr(config, "jax_annotations",
                                           jax_annotations) \
                     if jax_annotations is None else jax_annotations
+                memory = getattr(config, "memory", memory) \
+                    if memory is None else memory
+                flops_per_step = getattr(config, "flops_per_step",
+                                         flops_per_step) \
+                    if flops_per_step is None else flops_per_step
+                peak_flops = getattr(config, "peak_flops", peak_flops) \
+                    if peak_flops is None else peak_flops
             if sample_sync is not None:
                 self.sample_sync = bool(sample_sync)
             if jax_annotations is not None:
                 self.jax_annotations = bool(jax_annotations)
+            if memory is not None:
+                self.memory_enabled = bool(memory)
+            if flops_per_step:
+                self._flops_per_step = float(flops_per_step)
+            if peak_flops:
+                self._peak_flops = float(peak_flops)
             if jsonl_path is not None:
                 if self._jsonl_fh is not None and \
                         jsonl_path != self.jsonl_path:
@@ -172,11 +295,16 @@ class Telemetry:
                 self.jsonl_path = jsonl_path or None
             if chrome_trace_path is not None:
                 self.chrome_trace_path = chrome_trace_path or None
-                if self.chrome_trace_path and not self._atexit_registered:
-                    atexit.register(self._atexit_export)
-                    self._atexit_registered = True
+                if self.chrome_trace_path:
+                    _register_atexit(self)
             if enabled is not None:
+                was = self.enabled
                 self.enabled = bool(enabled)
+                if self.enabled and not was:
+                    # ledger wall time starts when measurement starts, not
+                    # at the (possibly much earlier) import of this module
+                    self._ledger_epoch = time.perf_counter()
+                    self._ledger_last_step_ts = None
 
     def _atexit_export(self):
         if self.enabled and self.chrome_trace_path and self.trace_events:
@@ -218,6 +346,9 @@ class Telemetry:
                 st = self.span_stats[name] = [0, 0.0]
             st[0] += 1
             st[1] += dt
+            cat = _ledger_category(name)
+            if cat is not None:
+                self.ledger_secs[cat] += dt
             ev = {"name": name, "ph": "X", "cat": "span",
                   "ts": round((t0 - self._epoch) * 1e6, 3),
                   "dur": round(dt * 1e6, 3),
@@ -277,6 +408,10 @@ class Telemetry:
             st[2] += seconds
             st[3] += algbw
             st[4] += busbw
+            if not traced:
+                # traced collectives report trace-emission time and run
+                # INSIDE a compute span — charging them would double-count
+                self.ledger_secs["comm"] += seconds
             ev = {"name": f"comm:{op}", "ph": "X", "cat": "comm",
                   "ts": round((time.perf_counter() - seconds - self._epoch)
                               * 1e6, 3),
@@ -305,19 +440,201 @@ class Telemetry:
                               "tags": {"outcome": outcome, "reason": reason,
                                        "mesh_size": mesh_size}})
 
-    def record_compile(self, program, seconds, topology=None, cache=None):
+    def record_compile(self, program, seconds, topology=None, cache=None,
+                       memory=None):
         """One AOT/jit compile: wall seconds + persistent-cache outcome
-        ("hit" | "miss" | "unknown")."""
+        ("hit" | "miss" | "unknown"). ``memory`` is the optional
+        ``compiled.memory_analysis()`` byte breakdown (argument/output/temp/
+        generated-code bytes)."""
         if not self.enabled:
             return
         with self._lock:
-            self.compile_stats[program] = {
-                "seconds": round(seconds, 3), "topology": topology,
-                "cache": cache or "unknown"}
+            entry = {"seconds": round(seconds, 3), "topology": topology,
+                     "cache": cache or "unknown"}
+            if memory:
+                entry["memory"] = {k: int(v) for k, v in memory.items()
+                                   if v is not None}
+            self.compile_stats[program] = entry
+            self.ledger_secs["compile"] += seconds
+            tags = {"topology": topology, "cache": cache or "unknown"}
+            if memory:
+                tags["memory"] = entry["memory"]
             self._emit_jsonl({"name": f"compile/{program}", "kind": "seconds",
-                              "value": seconds,
-                              "tags": {"topology": topology,
-                                       "cache": cache or "unknown"}})
+                              "value": seconds, "tags": tags})
+
+    # ------------------------------------------------------------------
+    # memory stream
+    # ------------------------------------------------------------------
+    def record_memory(self, point, stats=None, device_index=0, **tags):
+        """Record one HBM occupancy sample at a named ``point`` ("step",
+        "ckpt/save", "watchdog_stall", ...). When ``stats`` is None the
+        accelerator is sampled (one ``memory_stats()`` call — enabled path
+        only; disabled is a single boolean check with zero device syncs).
+        Returns the stats dict recorded, or None when disabled/off."""
+        if not self.enabled or not self.memory_enabled:
+            return None
+        if stats is None:
+            stats = self._read_memory_stats(device_index)
+        if not stats:
+            return None
+        in_use = int(stats.get("bytes_in_use", 0) or 0)
+        peak = int(stats.get("peak_bytes_in_use", in_use) or in_use)
+        with self._lock:
+            sample = {"point": point, "bytes_in_use": in_use,
+                      "peak_bytes_in_use": peak,
+                      "bytes_limit": int(stats.get("bytes_limit", 0) or 0)}
+            if tags:
+                sample["tags"] = tags
+            self.memory_samples.append(sample)
+            if peak > self.memory_peak:
+                self.memory_peak = peak
+            # Chrome counter track: one "C" event per sample
+            self.trace_events.append(
+                {"name": "hbm_bytes_in_use", "ph": "C", "cat": "memory",
+                 "ts": round((time.perf_counter() - self._epoch) * 1e6, 3),
+                 "pid": os.getpid(),
+                 "args": {"bytes_in_use": in_use}})
+            self._emit_jsonl({"name": f"memory/{point}", "kind": "bytes",
+                              "value": in_use,
+                              "tags": {**(tags or {}),
+                                       "peak_bytes_in_use": peak}})
+        return stats
+
+    def sample_memory(self, point, device_index=0, **tags):
+        """Read accelerator memory stats and return them, recording through
+        the memory stream when enabled. Unlike ``record_memory`` this ALWAYS
+        reads the device (callers like ``see_memory_usage`` and the ragged
+        KV-cache budget need the numbers even with telemetry off)."""
+        stats = self._read_memory_stats(device_index)
+        if self.enabled and self.memory_enabled and stats:
+            self.record_memory(point, stats=stats,
+                               device_index=device_index, **tags)
+        return stats
+
+    @staticmethod
+    def _read_memory_stats(device_index=0):
+        try:
+            from deepspeed_tpu.accelerator import get_accelerator
+            return get_accelerator().memory_stats(device_index) or {}
+        except Exception:
+            return {}
+
+    def maybe_oom_postmortem(self, exc, top_n=10):
+        """If ``exc`` looks like an HBM exhaustion error, dump an OOM
+        post-mortem (top-N live buffers by size) through the Fault/* path.
+        Returns the report dict, or None when not an OOM / disabled."""
+        if not self.enabled:
+            return None
+        msg = str(exc)
+        name = type(exc).__name__
+        if "RESOURCE_EXHAUSTED" not in msg and \
+                "ResourceExhausted" not in name and \
+                "out of memory" not in msg.lower():
+            return None
+        return self.oom_postmortem(error=msg, top_n=top_n)
+
+    def oom_postmortem(self, error=None, top_n=10):
+        """Unconditional OOM post-mortem: snapshot HBM stats and the top-N
+        ``jax.live_arrays()`` by size (shape/dtype/nbytes/sharding)."""
+        if not self.enabled:
+            return None
+        buffers = []
+        try:
+            import jax
+            arrs = sorted(jax.live_arrays(),
+                          key=lambda a: getattr(a, "nbytes", 0),
+                          reverse=True)
+            for a in arrs[:top_n]:
+                try:
+                    buffers.append({
+                        "shape": list(getattr(a, "shape", ()) or ()),
+                        "dtype": str(getattr(a, "dtype", "?")),
+                        "nbytes": int(getattr(a, "nbytes", 0) or 0),
+                        "sharding": str(getattr(a, "sharding", None))})
+                except Exception:
+                    continue
+        except Exception:
+            pass
+        stats = self._read_memory_stats()
+        report = {"error": error,
+                  "live_buffer_count": len(buffers),
+                  "live_bytes_total": sum(b["nbytes"] for b in buffers),
+                  "top_buffers": buffers,
+                  "memory_stats": stats}
+        with self._lock:
+            self.last_oom_report = report
+        self.count("Fault/oom", error=(error or "")[:200],
+                   live_buffers=len(buffers))
+        if stats:
+            self.record_memory("oom", stats=stats)
+        return report
+
+    # ------------------------------------------------------------------
+    # goodput / MFU ledger
+    # ------------------------------------------------------------------
+    def set_model_flops(self, flops_per_step=None, peak_flops=None):
+        """Set the MFU numerator (model FLOPs per optimizer step across all
+        chips) and denominator (aggregate peak FLOP/s). The flops profiler
+        calls this automatically from ``profile_engine_step``; the peak
+        defaults to a per-device-kind table when unset."""
+        with self._lock:
+            if flops_per_step is not None:
+                self._flops_per_step = float(flops_per_step)
+            if peak_flops is not None:
+                self._peak_flops = float(peak_flops)
+
+    def ledger_add(self, category, seconds):
+        """Charge ``seconds`` of wall time to a ledger category directly —
+        used by non-span sources (watchdog stall idle time)."""
+        if not self.enabled or seconds <= 0:
+            return
+        if category not in self.ledger_secs:
+            return
+        with self._lock:
+            self.ledger_secs[category] += seconds
+
+    def ledger_step(self, step=None, flops=None):
+        """Mark one optimizer-step boundary: computes the per-step interval,
+        updates the per-step and rolling ``mfu``/``goodput`` gauges and
+        records them. Returns (mfu, goodput) or None when disabled."""
+        if not self.enabled:
+            return None
+        now = time.perf_counter()
+        if flops is None:
+            flops = self._flops_per_step
+        peak = self._peak_flops or _default_peak_flops()
+        with self._lock:
+            last = self._ledger_last_step_ts
+            self._ledger_last_step_ts = now
+            self._ledger_steps += 1
+            if last is not None and flops and peak:
+                dt = now - last
+                if dt > 0:
+                    self._mfu_last = flops / dt / peak
+            wall = now - self._ledger_epoch
+            if wall > 0 and flops and peak and self._ledger_steps > 0:
+                self._mfu_roll = flops * self._ledger_steps / wall / peak
+            goodput = (self.ledger_secs["compute"] / wall) if wall > 0 else 0.0
+            mfu, roll = self._mfu_last, self._mfu_roll
+        self.record("mfu", round(mfu, 6), kind="gauge",
+                    rolling=round(roll, 6), step=step)
+        self.record("goodput", round(goodput, 6), kind="gauge", step=step)
+        return mfu, goodput
+
+    def _ledger_summary(self):
+        # caller holds self._lock
+        wall = max(time.perf_counter() - self._ledger_epoch, 0.0)
+        secs = {k: round(v, 6) for k, v in self.ledger_secs.items()}
+        accounted = sum(secs.values())
+        secs["idle"] = round(max(wall - accounted, 0.0), 6)
+        goodput = (self.ledger_secs["compute"] / wall) if wall > 0 else 0.0
+        return {"wall_s": round(wall, 6), "seconds": secs,
+                "steps": self._ledger_steps,
+                "flops_per_step": self._flops_per_step,
+                "peak_flops": self._peak_flops or _default_peak_flops(),
+                "mfu": round(self._mfu_last, 6),
+                "mfu_rolling": round(self._mfu_roll, 6),
+                "goodput": round(goodput, 6)}
 
     # ------------------------------------------------------------------
     # exporters
@@ -332,6 +649,10 @@ class Telemetry:
                 os.makedirs(d, exist_ok=True)
             self._jsonl_fh = open(self.jsonl_path, "a")
         obj["ts"] = round(time.perf_counter() - self._epoch, 6)
+        # multi-host identity for scripts/trace_merge.py
+        obj["host"] = self.host
+        obj["pid"] = os.getpid()
+        obj["run_id"] = self.run_id
         self._jsonl_fh.write(json.dumps(obj) + "\n")
         self._jsonl_fh.flush()
 
@@ -346,9 +667,13 @@ class Telemetry:
         if d:
             os.makedirs(d, exist_ok=True)
         with self._lock:
-            doc = {"traceEvents": list(self.trace_events),
+            meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+                     "args": {"name": f"{self.host}:{os.getpid()}"}}]
+            doc = {"traceEvents": meta + list(self.trace_events),
                    "displayTimeUnit": "ms",
-                   "otherData": {"producer": "deepspeed_tpu.telemetry"}}
+                   "otherData": {"producer": "deepspeed_tpu.telemetry",
+                                 "host": self.host,
+                                 "run_id": self.run_id}}
         with open(path, "w") as f:
             json.dump(doc, f)
         return path
@@ -385,12 +710,20 @@ class Telemetry:
             counters = {name: {",".join(f"{k}={v}" for k, v in key) or "_": n
                                for key, n in per.items()}
                         for name, per in sorted(self.counters.items())}
+            memory = {"peak_bytes": int(self.memory_peak),
+                      "sample_count": len(self.memory_samples),
+                      "last_bytes_in_use": int(
+                          self.memory_samples[-1]["bytes_in_use"])
+                      if self.memory_samples else 0,
+                      "oom": self.last_oom_report is not None}
             return {"enabled": True, "spans": spans,
                     "comm": {"ops": comm, "total_bytes": total_bytes},
                     "dispatch": dispatch,
                     "compile": {"programs": compile_sec,
                                 "cache_hits": hits, "cache_misses": misses},
-                    "counters": counters}
+                    "counters": counters,
+                    "memory": memory,
+                    "ledger": self._ledger_summary()}
 
     def format_summary(self):
         """DeepSpeed-style fixed-width tables over every stream."""
@@ -427,6 +760,21 @@ class Telemetry:
             for name, st in s["compile"]["programs"].items():
                 lines.append(f"{name:<32}{st['seconds']:<12}"
                              f"{st['cache']:<10}")
+        led = s["ledger"]
+        if led["wall_s"] > 0:
+            lines.append(f"{'Ledger':<14}{'Seconds':<12}{'Share':<8}")
+            for cat in LEDGER_CATEGORIES:
+                sec = led["seconds"].get(cat, 0.0)
+                share = sec / led["wall_s"] if led["wall_s"] else 0.0
+                lines.append(f"{cat:<14}{sec:<12.3f}{share:<8.1%}")
+            lines.append(f"wall: {led['wall_s']:.3f}s  steps: {led['steps']}"
+                         f"  mfu: {led['mfu_rolling']:.4f}"
+                         f"  goodput: {led['goodput']:.4f}")
+        mem = s["memory"]
+        if mem["sample_count"]:
+            lines.append(f"hbm peak: {mem['peak_bytes']} bytes"
+                         f"  ({mem['sample_count']} samples"
+                         f"{', OOM observed' if mem['oom'] else ''})")
         return "\n".join(lines) if lines else "telemetry: no samples"
 
     def log_summary(self, print_log=True):
@@ -455,4 +803,11 @@ class Telemetry:
             for outcome, reasons in outs.items():
                 events.append((f"{p}Dispatch/{kernel}/{outcome}",
                                sum(reasons.values()), step))
+        if s["memory"]["peak_bytes"]:
+            events.append((f"{p}Memory/peak_hbm_bytes",
+                           s["memory"]["peak_bytes"], step))
+        led = s["ledger"]
+        if led["steps"]:
+            events.append((f"{p}Ledger/mfu", led["mfu_rolling"], step))
+            events.append((f"{p}Ledger/goodput", led["goodput"], step))
         return events

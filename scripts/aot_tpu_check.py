@@ -494,12 +494,18 @@ def main():
             mem = compiled.memory_analysis()
             cache = ("miss" if _cache_files() - cache_before else
                      ("hit" if cache_before else "unknown"))
-            telemetry.record_compile(name, dt, topology="v5e:2x2", cache=cache)
+            mem_bytes = {
+                "argument_bytes": mem.argument_size_in_bytes,
+                "output_bytes": mem.output_size_in_bytes,
+                "temp_bytes": mem.temp_size_in_bytes,
+                "code_bytes": mem.generated_code_size_in_bytes,
+            }
+            telemetry.record_compile(name, dt, topology="v5e:2x2",
+                                     cache=cache, memory=mem_bytes)
             results.append({"name": name, "ok": True,
                             "compile_s": round(dt, 2),
                             "cache": cache,
-                            "code_bytes": mem.generated_code_size_in_bytes,
-                            "temp_bytes": mem.temp_size_in_bytes})
+                            **mem_bytes})
             print(f"PASS {name}: compiled for {target} in {dt:.1f}s "
                   f"(code {mem.generated_code_size_in_bytes//1024}KB)",
                   flush=True)

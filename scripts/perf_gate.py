@@ -1,0 +1,245 @@
+"""Automated perf gate: fail loudly on throughput/MFU/HBM/compile regression.
+
+Compares a CANDIDATE measurement (a ``BENCH_*.json`` payload, a
+``telemetry.summary()`` dict, or a ``BASELINE.json``-style doc) against a
+BASELINE of any of the same shapes, with configurable relative thresholds:
+
+    python scripts/perf_gate.py --baseline BASELINE.json \
+        --candidate BENCH_r07.json \
+        --max-tokens-drop 0.10 --max-mfu-drop 0.10 \
+        --max-hbm-growth 0.10 --max-compile-growth 0.50
+
+Only metrics present on BOTH sides are compared (an empty baseline —
+``BASELINE.json`` before any published number — passes with a warning, so
+the gate can be wired into CI before the first on-hardware run). Exit codes:
+
+    0  pass (no compared metric regressed beyond its threshold)
+    2  malformed input (unreadable file, schema violation, no JSON)
+    3  regression (at least one metric beyond threshold)
+
+``--dry-run`` validates inputs only — parses both docs and, when the
+candidate embeds a telemetry summary, validates it against
+``telemetry/summary.schema.json`` — and exits 0/2 without comparing. The
+tier-1 lane runs ``--dry-run`` against the repo's own BASELINE.json so a
+malformed baseline or summary fails fast on CPU (docs/OBSERVABILITY.md).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA_PATH = os.path.join(REPO_ROOT, "deepspeed_tpu", "telemetry",
+                           "summary.schema.json")
+
+#: metric -> (direction, threshold flag); "down" = lower candidate is a
+#: regression, "up" = higher candidate is a regression
+GATES = {
+    "tokens_per_sec": ("down", "max_tokens_drop"),
+    "mfu": ("down", "max_mfu_drop"),
+    "goodput": ("down", "max_goodput_drop"),
+    "peak_hbm_bytes": ("up", "max_hbm_growth"),
+    "compile_seconds": ("up", "max_compile_growth"),
+}
+
+
+def load_doc(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: cannot read {path}: {e}", file=sys.stderr)
+        return None
+
+
+def find_summary(doc):
+    """Locate an embedded telemetry summary in any accepted doc shape."""
+    if not isinstance(doc, dict):
+        return None
+    if "enabled" in doc and ("spans" in doc or doc.get("enabled") is False):
+        return doc  # the doc IS a summary
+    extra = doc.get("extra")
+    if isinstance(extra, dict) and isinstance(extra.get("telemetry"), dict):
+        return extra["telemetry"]
+    if isinstance(doc.get("telemetry"), dict):
+        return doc["telemetry"]
+    return None
+
+
+def extract_metrics(doc):
+    """Comparable metrics from any accepted doc shape. Absent metrics are
+    simply not compared."""
+    m = {}
+    if not isinstance(doc, dict):
+        return m
+    # bench payload: {"metric": "...tokens_per_sec...", "value": N, "extra": {}}
+    if "value" in doc and "metric" in doc:
+        try:
+            v = float(doc["value"])
+            if v > 0:
+                m["tokens_per_sec"] = v
+        except (TypeError, ValueError):
+            pass
+    extra = doc.get("extra") if isinstance(doc.get("extra"), dict) else {}
+    for src in (extra, doc):
+        if "mfu" in src and "mfu" not in m:
+            try:
+                v = float(src["mfu"])
+                if v > 0:
+                    m["mfu"] = v
+            except (TypeError, ValueError):
+                pass
+        if "peak_hbm_bytes" in src and "peak_hbm_bytes" not in m:
+            try:
+                v = int(src["peak_hbm_bytes"])
+                if v > 0:
+                    m["peak_hbm_bytes"] = v
+            except (TypeError, ValueError):
+                pass
+    # BASELINE.json: {"published": {metric: value, ...}}
+    pub = doc.get("published")
+    if isinstance(pub, dict):
+        for key, val in pub.items():
+            try:
+                val = float(val)
+            except (TypeError, ValueError):
+                continue
+            for gate in GATES:
+                if gate in key and gate not in m and val > 0:
+                    m[gate] = val
+    # telemetry summary (bare or embedded)
+    s = find_summary(doc)
+    if isinstance(s, dict) and s.get("enabled"):
+        led = s.get("ledger", {})
+        for key in ("mfu_rolling", "mfu"):
+            if led.get(key) and "mfu" not in m:
+                m["mfu"] = float(led[key])
+                break
+        if led.get("goodput") and "goodput" not in m:
+            m["goodput"] = float(led["goodput"])
+        mem = s.get("memory", {})
+        if mem.get("peak_bytes") and "peak_hbm_bytes" not in m:
+            m["peak_hbm_bytes"] = int(mem["peak_bytes"])
+        progs = s.get("compile", {}).get("programs", {})
+        total = sum(p.get("seconds", 0.0) for p in progs.values()
+                    if isinstance(p, dict))
+        if total > 0 and "compile_seconds" not in m:
+            m["compile_seconds"] = total
+    return m
+
+
+def validate_summary(doc):
+    """Schema-validate an embedded summary when jsonschema is available.
+    Returns an error string or None."""
+    s = find_summary(doc)
+    if s is None:
+        return None  # nothing embedded — nothing to validate
+    try:
+        import jsonschema
+    except ImportError:
+        return None
+    try:
+        with open(SCHEMA_PATH) as f:
+            schema = json.load(f)
+        jsonschema.validate(s, schema)
+    except jsonschema.ValidationError as e:
+        return f"summary schema violation: {e.message}"
+    except (OSError, ValueError) as e:
+        return f"cannot load schema {SCHEMA_PATH}: {e}"
+    return None
+
+
+def compare(baseline, candidate, thresholds):
+    """-> (verdicts, regressed). Only metrics on both sides are gated."""
+    verdicts = []
+    regressed = False
+    for name, (direction, flag) in sorted(GATES.items()):
+        if name not in baseline or name not in candidate:
+            continue
+        base, cand = baseline[name], candidate[name]
+        thr = thresholds[flag]
+        if base <= 0:
+            continue
+        delta = (cand - base) / base
+        if direction == "down":
+            bad = delta < -thr
+        else:
+            bad = delta > thr
+        regressed |= bad
+        verdicts.append({"metric": name, "baseline": base,
+                         "candidate": cand, "delta": round(delta, 4),
+                         "threshold": thr, "direction": direction,
+                         "regressed": bad})
+    return verdicts, regressed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", required=True)
+    ap.add_argument("--candidate", default="",
+                    help="candidate doc; optional with --dry-run")
+    ap.add_argument("--summary", default="",
+                    help="optional standalone telemetry summary JSON merged "
+                         "into the candidate metrics")
+    ap.add_argument("--max-tokens-drop", type=float, default=0.10)
+    ap.add_argument("--max-mfu-drop", type=float, default=0.10)
+    ap.add_argument("--max-goodput-drop", type=float, default=0.10)
+    ap.add_argument("--max-hbm-growth", type=float, default=0.10)
+    ap.add_argument("--max-compile-growth", type=float, default=0.50)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="validate inputs (parse + summary schema) only")
+    args = ap.parse_args(argv)
+
+    docs = {"baseline": load_doc(args.baseline)}
+    if args.candidate:
+        docs["candidate"] = load_doc(args.candidate)
+    if args.summary:
+        docs["summary"] = load_doc(args.summary)
+    for label, doc in docs.items():
+        if doc is None:
+            return 2
+        err = validate_summary(doc)
+        if err:
+            print(f"perf_gate: {label}: {err}", file=sys.stderr)
+            return 2
+
+    if args.dry_run:
+        print(json.dumps({"dry_run": True, "inputs_ok": True,
+                          "metrics": {label: extract_metrics(doc)
+                                      for label, doc in docs.items()}}))
+        return 0
+
+    if "candidate" not in docs:
+        print("perf_gate: --candidate is required without --dry-run",
+              file=sys.stderr)
+        return 2
+    base_m = extract_metrics(docs["baseline"])
+    cand_m = extract_metrics(docs["candidate"])
+    if "summary" in docs:
+        for k, v in extract_metrics(docs["summary"]).items():
+            cand_m.setdefault(k, v)
+
+    thresholds = {"max_tokens_drop": args.max_tokens_drop,
+                  "max_mfu_drop": args.max_mfu_drop,
+                  "max_goodput_drop": args.max_goodput_drop,
+                  "max_hbm_growth": args.max_hbm_growth,
+                  "max_compile_growth": args.max_compile_growth}
+    verdicts, regressed = compare(base_m, cand_m, thresholds)
+    result = {"compared": len(verdicts), "regressed": regressed,
+              "verdicts": verdicts,
+              "baseline_metrics": base_m, "candidate_metrics": cand_m}
+    print(json.dumps(result, indent=2))
+    if not verdicts:
+        print("perf_gate: WARNING no overlapping metrics to compare "
+              "(empty baseline?) — passing", file=sys.stderr)
+        return 0
+    if regressed:
+        bad = [v["metric"] for v in verdicts if v["regressed"]]
+        print(f"perf_gate: REGRESSION in {', '.join(bad)}", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""Multi-host trace merge + straggler report (docs/OBSERVABILITY.md).
+
+Folds N per-host telemetry JSONL files (every record is stamped with
+``(host, pid, run_id)`` by ``telemetry/core.py``) into ONE Chrome-trace file
+with a separate track per host, and computes a straggler report: per-step
+cross-host skew measured at matching collective timestamps.
+
+Each host's records map to the merged trace as:
+
+- span records (``kind: "span"``)       -> ``X`` duration events
+- ``comm/*`` records                    -> ``X`` events (cat ``comm``)
+- ``memory/*`` records                  -> a per-host ``hbm_bytes_in_use``
+                                           counter track (``C`` events)
+- ``mfu`` / ``goodput`` gauges          -> per-host counter tracks
+- everything else                       -> instant events (``i``)
+
+Hosts have independent perf_counter epochs, so absolute timestamps are not
+comparable across files. The merge aligns hosts on their FIRST SHARED
+collective: for every host the ts of the first occurrence of the earliest
+``comm/*`` (op, axis) key all hosts share becomes t=0. Skew is then the
+spread of matched k-th occurrences of each collective key across hosts —
+a persistently-late host is a straggler (data loader, thermal throttle,
+failing chip).
+
+Usage:
+    python scripts/trace_merge.py host0.jsonl host1.jsonl ... \
+        --out merged_trace.json --report straggler_report.json
+
+Exit 0 on success, 2 on unreadable/empty input.
+"""
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def load_host_records(path):
+    """Parse one JSONL file -> (host_label, [records]). Malformed lines are
+    skipped (a crashed run can truncate its last line)."""
+    records = []
+    host = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or "ts" not in rec:
+                continue
+            records.append(rec)
+            if host is None and rec.get("host"):
+                host = f"{rec['host']}:{rec.get('pid', '?')}"
+    if host is None:
+        host = os.path.basename(path)
+    return host, records
+
+
+def comm_key(rec):
+    # "comm/all_reduce" + axis -> alignment key
+    return (rec["name"], (rec.get("tags") or {}).get("axis", "?"))
+
+
+def align_offsets(per_host):
+    """Per-host ts offset so matching collectives line up: the first
+    occurrence of the earliest collective key PRESENT ON ALL HOSTS defines
+    each host's t=0. Hosts with no shared collective keep offset = min ts
+    (best effort)."""
+    first_comm = {}   # host -> {key: first ts}
+    for host, records in per_host.items():
+        firsts = {}
+        for rec in records:
+            if rec["name"].startswith("comm/"):
+                k = comm_key(rec)
+                if k not in firsts:
+                    firsts[k] = rec["ts"]
+        first_comm[host] = firsts
+    shared = None
+    for firsts in first_comm.values():
+        keys = set(firsts)
+        shared = keys if shared is None else (shared & keys)
+    offsets = {}
+    anchor = None
+    if shared:
+        # earliest shared key by mean first-ts (deterministic order)
+        anchor = min(sorted(shared),
+                     key=lambda k: sum(f[k] for f in first_comm.values())
+                     / len(first_comm))
+    for host, records in per_host.items():
+        if anchor is not None:
+            offsets[host] = first_comm[host][anchor]
+        else:
+            offsets[host] = min((r["ts"] for r in records), default=0.0)
+    return offsets, anchor
+
+
+def straggler_report(per_host, offsets):
+    """Match the k-th occurrence of each collective key across hosts; skew
+    of one matched set = max - min aligned timestamp. A host that is
+    consistently the max is the straggler."""
+    occ = defaultdict(lambda: defaultdict(list))  # key -> host -> [aligned ts]
+    for host, records in per_host.items():
+        off = offsets[host]
+        for rec in records:
+            if rec["name"].startswith("comm/"):
+                step = (rec.get("tags") or {}).get("step")
+                occ[comm_key(rec)][host].append(
+                    (step, round(rec["ts"] - off, 6)))
+    matches = []
+    worst = defaultdict(int)
+    hosts = sorted(per_host)
+    for key, per in sorted(occ.items()):
+        if set(per) != set(hosts) or len(hosts) < 2:
+            continue
+        n = min(len(v) for v in per.values())
+        for k in range(n):
+            sample = {h: per[h][k] for h in hosts}
+            # prefer explicit step tags for the match label when present
+            steps = {s for s, _ in sample.values() if s is not None}
+            label = steps.pop() if len(steps) == 1 else k
+            ts = {h: t for h, (_, t) in sample.items()}
+            late = max(ts, key=ts.get)
+            skew = round(max(ts.values()) - min(ts.values()), 6)
+            worst[late] += 1
+            matches.append({"collective": list(key), "occurrence": k,
+                            "step": label, "skew_s": skew,
+                            "latest_host": late, "aligned_ts": ts})
+    skews = [m["skew_s"] for m in matches]
+    report = {
+        "hosts": hosts,
+        "matched_collectives": len(matches),
+        "max_skew_s": max(skews) if skews else 0.0,
+        "mean_skew_s": round(sum(skews) / len(skews), 6) if skews else 0.0,
+        "late_counts": dict(sorted(worst.items())),
+        "straggler": max(worst, key=worst.get) if worst else None,
+        "matches": matches,
+    }
+    return report
+
+
+def merged_trace_events(per_host, offsets):
+    """Chrome events with one synthetic pid per host (per-host tracks)."""
+    events = []
+    for chrome_pid, host in enumerate(sorted(per_host), start=1):
+        events.append({"name": "process_name", "ph": "M", "pid": chrome_pid,
+                       "args": {"name": host}})
+        off = offsets[host]
+        for rec in per_host[host]:
+            ts_us = round((rec["ts"] - off) * 1e6, 3)
+            name, kind = rec["name"], rec.get("kind")
+            tags = rec.get("tags") or {}
+            base = {"pid": chrome_pid, "tid": 0}
+            if kind == "span":
+                # span records emit at END; value = duration in seconds
+                dur = float(rec.get("value", 0.0))
+                events.append({**base, "name": name, "ph": "X", "cat": "span",
+                               "ts": round(ts_us - dur * 1e6, 3),
+                               "dur": round(dur * 1e6, 3), "args": tags})
+            elif name.startswith("comm/"):
+                dur = float(tags.get("seconds", 0.0))
+                events.append({**base, "name": name, "ph": "X", "cat": "comm",
+                               "ts": round(ts_us - dur * 1e6, 3),
+                               "dur": round(dur * 1e6, 3),
+                               "args": {**tags, "bytes": rec.get("value")}})
+            elif name.startswith("memory/"):
+                events.append({**base, "name": "hbm_bytes_in_use", "ph": "C",
+                               "cat": "memory", "ts": ts_us,
+                               "args": {"bytes_in_use": rec.get("value", 0)}})
+            elif name in ("mfu", "goodput"):
+                events.append({**base, "name": name, "ph": "C", "cat": "ledger",
+                               "ts": ts_us,
+                               "args": {name: rec.get("value", 0.0)}})
+            else:
+                events.append({**base, "name": name, "ph": "i", "s": "t",
+                               "ts": ts_us,
+                               "args": {**tags, "value": rec.get("value")}})
+    return events
+
+
+def merge(paths, out_path=None, report_path=None):
+    per_host = {}
+    for path in paths:
+        host, records = load_host_records(path)
+        if not records:
+            print(f"trace_merge: {path}: no parseable records",
+                  file=sys.stderr)
+            return None, None
+        if host in per_host:  # two files from the same host:pid — append
+            per_host[host].extend(records)
+        else:
+            per_host[host] = records
+    offsets, anchor = align_offsets(per_host)
+    events = merged_trace_events(per_host, offsets)
+    report = straggler_report(per_host, offsets)
+    report["alignment_anchor"] = list(anchor) if anchor else None
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"producer": "deepspeed_tpu.scripts.trace_merge",
+                         "hosts": sorted(per_host)}}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f)
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return doc, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", nargs="+", help="per-host telemetry JSONL files")
+    ap.add_argument("--out", default="merged_trace.json",
+                    help="merged Chrome-trace output path")
+    ap.add_argument("--report", default="",
+                    help="straggler-report JSON output path ('' = stdout only)")
+    args = ap.parse_args(argv)
+    doc, report = merge(args.jsonl, out_path=args.out,
+                        report_path=args.report or None)
+    if doc is None:
+        return 2
+    brief = {k: v for k, v in report.items() if k != "matches"}
+    print(json.dumps(brief, indent=2))
+    print(f"trace_merge: {len(doc['traceEvents'])} events from "
+          f"{len(brief['hosts'])} host(s) -> {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

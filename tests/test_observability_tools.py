@@ -1,0 +1,293 @@
+"""Memory + goodput observability acceptance (docs/OBSERVABILITY.md).
+
+Pins the PR-4 tentpole end to end on the 8-device CPU mesh:
+
+- the engine train loop produces memory samples (CPU-synthesized from
+  ``jax.live_arrays()``) and a goodput ledger whose categories sum to wall
+  time within 5%, with nonzero ``mfu``/``goodput`` gauges;
+- ``scripts/trace_merge.py`` folds two per-host JSONL streams into one
+  Chrome trace with per-host memory counter tracks + a straggler report;
+- the OOM post-mortem lists the top live buffers with shape/dtype/sharding;
+- ``scripts/perf_gate.py`` exits 0 on a self-comparison, nonzero on an
+  injected 20% throughput regression, and 0 on ``--dry-run`` against the
+  repo's own BASELINE.json (the tier-1 wiring).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu import telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_MERGE = os.path.join(REPO_ROOT, "scripts", "trace_merge.py")
+PERF_GATE = os.path.join(REPO_ROOT, "scripts", "perf_gate.py")
+SCHEMA_PATH = os.path.join(REPO_ROOT, "deepspeed_tpu", "telemetry",
+                           "summary.schema.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.configure(enabled=False, jsonl_path="", chrome_trace_path="",
+                        sample_sync=True, jax_annotations=False)
+    yield
+    telemetry.close()
+    telemetry.reset()
+    telemetry.configure(enabled=False, jsonl_path="", chrome_trace_path="",
+                        sample_sync=True, jax_annotations=False)
+
+
+def _run(cmd):
+    return subprocess.run([sys.executable] + cmd, capture_output=True,
+                          text=True, cwd=REPO_ROOT)
+
+
+# ---------------------------------------------------------------------------
+# memory stream
+# ---------------------------------------------------------------------------
+
+def test_cpu_memory_stats_synthesized_from_live_arrays():
+    """CPU PJRT backends expose no memory_stats; the accelerator synthesizes
+    bytes_in_use from the live-array set (tagged) so CPU-mesh runs still get
+    an occupancy stream and a peak watermark."""
+    from deepspeed_tpu.accelerator import get_accelerator
+    pin = jnp.ones((256, 256), jnp.float32)  # ≥256KB on device 0
+    jax.block_until_ready(pin)
+    stats = get_accelerator().memory_stats(0)
+    assert stats.get("synthesized") is True
+    assert stats["bytes_in_use"] >= pin.nbytes
+    assert stats["peak_bytes_in_use"] >= stats["bytes_in_use"]
+    del pin
+
+
+def test_record_memory_stream_and_counter_track(tmp_path):
+    jl = tmp_path / "m.jsonl"
+    tr = tmp_path / "t.json"
+    telemetry.configure(enabled=True, jsonl_path=str(jl),
+                        chrome_trace_path=str(tr))
+    pin = jnp.ones((128, 128), jnp.float32)
+    jax.block_until_ready(pin)
+    stats = telemetry.sample_memory("step", step=1)
+    assert stats["bytes_in_use"] > 0
+    telemetry.record_memory("ckpt/save",
+                            stats={"bytes_in_use": 7, "peak_bytes_in_use": 9})
+    s = telemetry.summary()
+    assert s["memory"]["sample_count"] == 2
+    assert s["memory"]["peak_bytes"] >= stats["peak_bytes_in_use"]
+    telemetry.export_chrome_trace()
+    doc = json.load(open(tr))
+    counters = [e for e in doc["traceEvents"]
+                if e["ph"] == "C" and e["name"] == "hbm_bytes_in_use"]
+    assert len(counters) == 2
+    telemetry.close()
+    lines = [json.loads(ln) for ln in jl.read_text().splitlines()]
+    mem_lines = [ln for ln in lines if ln["name"].startswith("memory/")]
+    assert {ln["name"] for ln in mem_lines} == {"memory/step",
+                                                "memory/ckpt/save"}
+    assert all("host" in ln and "run_id" in ln for ln in lines)
+
+
+def test_oom_postmortem_lists_top_live_buffers():
+    """The RESOURCE_EXHAUSTED post-mortem names the buffers actually holding
+    HBM — shape/dtype/nbytes/sharding, largest first — and lands on the
+    Fault/* stream."""
+    telemetry.configure(enabled=True)
+    big = jnp.ones((512, 512), jnp.float32)   # 1MB — should rank first
+    small = jnp.ones((8,), jnp.float32)
+    jax.block_until_ready((big, small))
+    report = telemetry.maybe_oom_postmortem(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 123 bytes"))
+    assert report is not None
+    top = report["top_buffers"]
+    assert top and top[0]["nbytes"] >= big.nbytes
+    assert top[0]["shape"] == [512, 512] and "float32" in top[0]["dtype"]
+    assert "sharding" in top[0]
+    assert report["live_bytes_total"] >= big.nbytes
+    s = telemetry.summary()
+    assert s["memory"]["oom"] is True
+    assert any(k.startswith("Fault/oom") for k in s["counters"])
+    # a non-OOM error must NOT trigger a dump
+    assert telemetry.maybe_oom_postmortem(ValueError("shape mismatch")) is None
+    del big, small
+
+
+# ---------------------------------------------------------------------------
+# the 8-device acceptance run: ledger + merge + gate
+# ---------------------------------------------------------------------------
+
+def _train_run(tmp_path, eight_devices):
+    """One engine train run with telemetry on; returns (jsonl, summary)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    import deepspeed_tpu
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.utils import jax_compat
+    from tests.simple_model import SimpleModel, random_batches
+
+    jl = tmp_path / "host0.jsonl"
+    model = SimpleModel()
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "telemetry": {"enabled": True, "jsonl_path": str(jl),
+                              "flops_per_step": 1e9, "peak_flops": 1e12}})
+    # one explicit per-step collective through the comm shim, so the merged
+    # trace has cross-host alignable comm/* records (stage-0 SimpleModel's
+    # grad reduction is GSPMD-internal and invisible to host timing). A
+    # fresh trace per step gives each record its own timestamp — a jitted
+    # shard_map records only once, at trace time.
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+
+    def _collective():
+        ar = jax.jit(jax_compat.shard_map(
+            lambda x: dist.all_reduce(x, axis_name="dp"),
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False))
+        jax.block_until_ready(ar(jnp.ones((8, 4), jnp.float32)))
+
+    for b in random_batches(4, 8):
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+        _collective()
+    summ = telemetry.summary()
+    telemetry.close()
+    return jl, summ
+
+
+def test_train_loop_ledger_and_multihost_merge(eight_devices, tmp_path):
+    jsonschema = pytest.importorskip("jsonschema")
+    jl, s = _train_run(tmp_path, eight_devices)
+
+    # summary passes the extended schema (memory + ledger streams)
+    jsonschema.validate(s, json.load(open(SCHEMA_PATH)))
+
+    # nonzero mfu/goodput gauges + ledger categories sum to wall within 5%
+    led = s["ledger"]
+    assert led["steps"] == 4
+    assert led["mfu"] > 0 and led["mfu_rolling"] > 0
+    assert led["goodput"] > 0
+    assert led["seconds"]["compute"] > 0
+    assert abs(sum(led["seconds"].values()) - led["wall_s"]) \
+        <= 0.05 * led["wall_s"]
+    gauges = {name for name, *_ in telemetry.monitor_events(1)}
+    assert {"Telemetry/Ledger/mfu", "Telemetry/Ledger/goodput"} <= gauges
+
+    # per-step memory samples with a nonzero peak (CPU-synthesized)
+    assert s["memory"]["sample_count"] >= 4
+    assert s["memory"]["peak_bytes"] > 0
+    assert "Telemetry/Memory/peak_hbm_bytes" in gauges
+
+    # ---- multi-host merge: a second host = the same stream re-stamped with
+    # a growing skew, so host1's collectives arrive progressively later ----
+    h1 = tmp_path / "host1.jsonl"
+    records = [json.loads(ln) for ln in jl.read_text().splitlines()]
+    with open(h1, "w") as f:
+        for i, rec in enumerate(records):
+            rec = dict(rec, host="host-b", pid=4242,
+                       ts=rec["ts"] + 3.0 + 0.001 * i)
+            f.write(json.dumps(rec) + "\n")
+
+    merged = tmp_path / "merged_trace.json"
+    report_p = tmp_path / "straggler.json"
+    r = _run([TRACE_MERGE, str(jl), str(h1), "--out", str(merged),
+              "--report", str(report_p)])
+    assert r.returncode == 0, r.stderr
+
+    doc = json.load(open(merged))
+    # per-host tracks: 2 process_name labels, and a memory counter track
+    # under EACH host pid
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(metas) == 2
+    mem_pids = {e["pid"] for e in doc["traceEvents"]
+                if e["ph"] == "C" and e["name"] == "hbm_bytes_in_use"}
+    assert len(mem_pids) == 2, "memory counter track per host"
+    span_names = {e["name"] for e in doc["traceEvents"] if e.get("cat") == "span"}
+    assert {"fwd", "bwd", "step"} <= span_names
+
+    # straggler report: collectives matched across hosts; the growing skew
+    # makes host-b the consistently-late host
+    report = json.loads(r.stdout)
+    assert report["matched_collectives"] > 0
+    assert report["max_skew_s"] > 0
+    assert report["straggler"] == "host-b:4242"
+    assert json.load(open(report_p))["matches"]
+
+    # ---- perf gate on the run's own summary ----
+    summ_p = tmp_path / "summary.json"
+    summ_p.write_text(json.dumps(s))
+    r = _run([PERF_GATE, "--baseline", str(summ_p), "--candidate",
+              str(summ_p)])
+    assert r.returncode == 0, r.stderr
+
+
+# ---------------------------------------------------------------------------
+# perf gate exit-code contract
+# ---------------------------------------------------------------------------
+
+def _bench_payload(value, mfu=0.32, hbm=10 << 30):
+    return {"metric": "gpt2_small_bf16_zero1_tokens_per_sec_per_chip",
+            "value": value, "unit": "tokens/s/chip", "vs_baseline": 1.0,
+            "extra": {"mfu": mfu, "peak_hbm_bytes": hbm}}
+
+
+def test_perf_gate_pass_and_regression(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_bench_payload(1000.0)))
+    # self-comparison passes
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(base)])
+    assert r.returncode == 0, r.stderr
+    verdicts = json.loads(r.stdout)["verdicts"]
+    assert verdicts and not any(v["regressed"] for v in verdicts)
+    # injected 20% throughput drop fails (threshold 10%)
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_bench_payload(800.0)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 3, (r.stdout, r.stderr)
+    bad = [v for v in json.loads(r.stdout)["verdicts"] if v["regressed"]]
+    assert [v["metric"] for v in bad] == ["tokens_per_sec"]
+    # ...but passes with a generous threshold
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand),
+              "--max-tokens-drop", "0.30"])
+    assert r.returncode == 0
+    # HBM growth gates in the OTHER direction
+    fat = tmp_path / "fat.json"
+    fat.write_text(json.dumps(_bench_payload(1000.0, hbm=12 << 30)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(fat)])
+    assert r.returncode == 3
+    # malformed candidate -> 2
+    bad_p = tmp_path / "bad.json"
+    bad_p.write_text("{not json")
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(bad_p)])
+    assert r.returncode == 2
+
+
+def test_perf_gate_dry_run_tier1_wiring():
+    """The tier-1 lane runs the gate in --dry-run against the repo's own
+    BASELINE.json: a malformed baseline or summary schema must fail fast on
+    CPU. The empty published{} baseline is valid (passes with a warning when
+    compared)."""
+    r = _run([PERF_GATE, "--baseline",
+              os.path.join(REPO_ROOT, "BASELINE.json"), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert json.loads(r.stdout)["inputs_ok"] is True
+
+
+def test_perf_gate_rejects_bad_embedded_summary(tmp_path):
+    pytest.importorskip("jsonschema")
+    doc = _bench_payload(1000.0)
+    doc["extra"]["telemetry"] = {"enabled": True, "spans": {}, "bogus": 1}
+    p = tmp_path / "badsum.json"
+    p.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(p), "--dry-run"])
+    assert r.returncode == 2
+    assert "schema violation" in r.stderr

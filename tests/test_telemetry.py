@@ -70,10 +70,53 @@ def test_disabled_noop_fast_path(tmp_path, monkeypatch):
     telemetry.record_dispatch("flash_mha", "sharded", "data")
     telemetry.record_compile("prog", 1.0)
 
+    # the memory/ledger hooks must be no-ops too — zero device reads
+    from deepspeed_tpu.telemetry.core import Telemetry
+
+    def _no_read(*a, **k):
+        raise AssertionError("memory_stats must not be read when disabled")
+    monkeypatch.setattr(Telemetry, "_read_memory_stats",
+                        staticmethod(_no_read))
+    assert telemetry.record_memory("step", step=1) is None
+    assert telemetry.ledger_step(step=1) is None
+    telemetry.ledger_add("stall", 1.0)
+    assert telemetry.maybe_oom_postmortem(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory")) is None
+    assert telemetry.oom_postmortem(error="x") is None
+
     assert not jl.exists(), "disabled record must never open the jsonl sink"
     assert telemetry.summary() == {"enabled": False}
     assert telemetry.monitor_events(1) == []
     assert telemetry.format_summary() == "telemetry disabled"
+
+
+def test_configure_registers_atexit_once(monkeypatch, tmp_path):
+    """configure()/reset() cycles must never stack atexit export hooks —
+    each extra hook would re-export (and with multiple instances, clobber)
+    the trace file."""
+    import atexit
+    from deepspeed_tpu.telemetry import core
+
+    calls = []
+    monkeypatch.setattr(atexit, "register", lambda fn: calls.append(fn))
+    monkeypatch.setattr(core, "_ATEXIT_REGISTERED", False)
+    monkeypatch.setattr(core, "_ATEXIT_INSTANCES", [])
+
+    tr = tmp_path / "trace.json"
+    for _ in range(5):  # repeated init across reset cycles
+        telemetry.configure(enabled=True, chrome_trace_path=str(tr))
+        telemetry.reset()
+    assert len(calls) == 1, "exactly one atexit hook across reconfigures"
+    # even a SECOND instance must not add a second hook
+    other = core.Telemetry()
+    other.configure(enabled=True, chrome_trace_path=str(tr))
+    assert len(calls) == 1
+    assert len(core._ATEXIT_INSTANCES) == 2
+    # the single hook exports every registered instance without raising
+    with telemetry.span("fwd"):
+        pass
+    core._atexit_export_all()
+    assert tr.exists()
 
 
 # ---------------------------------------------------------------------------
@@ -156,7 +199,12 @@ def test_chrome_trace_export(tmp_path):
     comm = by_name["comm:all_reduce"]
     assert comm["cat"] == "comm" and comm["args"]["bytes"] == 4096
     assert comm["dur"] == pytest.approx(2000, rel=0.01)  # 2ms in µs
+    # one process_name metadata event labels the host track for trace_merge
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert len(metas) == 1 and metas[0]["name"] == "process_name"
     for e in evs:
+        if e["ph"] == "M":
+            continue
         assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
 
 
