@@ -532,7 +532,8 @@ def run_bench():
                 window_iter = itertools.repeat(batch_data)
 
                 def step():
-                    # train_batch returns the window-mean loss as a float
+                    # train_batch returns the device-resident window mean;
+                    # the timing loop's block_until_ready pays the sync
                     return jax.numpy.asarray(engine.train_batch(window_iter))
             else:
                 def step():
@@ -609,6 +610,13 @@ def run_bench():
                   "remat_policy": remat_policy, "fused_step": fused,
                   "gas": gas, "loss": float(jax.device_get(loss))},
     }
+    # which block configs actually ran (tuning table vs ladder vs env) and
+    # how many blocking d2h fetches the engine issued — a tuned table with
+    # ladder_fallback sources or a nonzero steady-state sync count is the
+    # 32%→45% MFU gap showing up in the payload (docs/AUTOTUNING.md)
+    from deepspeed_tpu.ops import registry as _kernel_registry
+    payload["extra"]["kernel_configs"] = _kernel_registry.active_kernel_configs()
+    payload["extra"]["host_sync_count"] = engine.host_sync_count
     if telemetry.enabled():
         hbm = telemetry.sample_memory("bench_end") or {}
         summ = telemetry.summary()

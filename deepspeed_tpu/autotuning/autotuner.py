@@ -388,6 +388,164 @@ class Autotuner:
                                  ov["remat_policy"])
         return cfg, best["result"]["metric"]
 
+    # ---- chip-free mode (docs/AUTOTUNING.md) -------------------------
+    # No live TPU required: every candidate's fwd+bwd program is AOT-compiled
+    # against the target topology (jax.experimental.topologies), so Mosaic/
+    # XLA rejection and the compiled memory footprint give real feasibility,
+    # and the XLA cost analysis gives the roofline ranking — the same
+    # machinery as kernel_tuner.chip_free_rank, lifted to engine configs.
+
+    _TARGET_HBM = {  # per-chip HBM, bytes (public TPU specs)
+        "tpu_v4": 32 * (1 << 30),
+        "tpu_v5e": 16 * (1 << 30),
+        "tpu_v5p": 95 * (1 << 30),
+        "tpu_v6e": 32 * (1 << 30),
+    }
+
+    def _loss_grad_program(self, mbs, remat):
+        """(fn, abstract_args) for the candidate's fwd+bwd at micro-batch
+        ``mbs`` under remat policy ``remat`` — the compute body the engine's
+        micro-step runs, minus the optimizer apply (whose state cost is the
+        analytic ``estimate_state_bytes`` term)."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing \
+            import policy_by_name
+        model = self.model
+        if hasattr(model, "apply") and hasattr(model, "init"):
+            def model_fn(params, batch):
+                return model.apply({"params": params}, batch)
+        elif callable(model):
+            def model_fn(params, batch):
+                try:
+                    return model(params, batch, None)
+                except TypeError:
+                    return model(params, batch)
+        else:
+            raise ValueError(f"unsupported model type {type(model)}")
+        if remat != "nothing":
+            model_fn = jax.checkpoint(model_fn,
+                                      policy=policy_by_name(remat))
+
+        def step(params, batch):
+            return jax.grad(lambda p: jnp.asarray(model_fn(p, batch),
+                                                  jnp.float32))(params)
+
+        batch = self.batch_fn(mbs)
+        abstract = (
+            jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                         self.model_parameters),
+            jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                         batch),
+        )
+        return step, abstract
+
+    def tune_chip_free(self, topology_name="v5e:2x2", search="cost",
+                       compile_fn=None, device_kind=None, headroom=0.4):
+        """Rank the pruned config grid WITHOUT a TPU. Returns
+        ``(best_config, ranking)`` where ranking lists every candidate with
+        its feasibility verdict and proxy score (seconds/sample — ordering
+        only, not a latency prediction).
+
+        Feasibility = the analytic prune PLUS: the fwd+bwd program AOT-
+        compiles for ``topology_name`` (Mosaic/XLA rejection is real), and
+        its compiled temp+output bytes + the stage-sharded optimizer-state
+        estimate fit the target chip's HBM under ``headroom``. Score =
+        cost-analysis roofline (flops/peak + bytes/bw) per sample, plus the
+        host-tier PCIe penalty for offload candidates.
+
+        ``compile_fn(fn, abstract) -> (cost_dict, memory_analysis)`` is
+        injectable so CPU tests can rank against a synthetic target without
+        paying AOT compiles."""
+        from deepspeed_tpu.autotuning import kernel_tuner
+        from deepspeed_tpu.autotuning.kernel_table import normalize_device_kind
+
+        self.profile_model_info()
+        if compile_fn is None:
+            compile_fn, device_kind = kernel_tuner.make_aot_compiler(
+                topology_name)
+        slug = normalize_device_kind(device_kind or "tpu v5 lite")
+        # dp world = chip count of the target topology ("v5e:2x2" -> 4)
+        dims = topology_name.split(":")[-1]
+        try:
+            dp_world = 1
+            for d in dims.split("x"):
+                dp_world *= int(d)
+        except ValueError:
+            dp_world = 1
+        hbm = self._TARGET_HBM.get(slug, 16 * (1 << 30))
+        budget = hbm * (1.0 - headroom)
+        peak = kernel_tuner._PEAK_FLOPS.get(
+            slug, kernel_tuner._PEAK_FLOPS["tpu_v5e"])
+        bw = kernel_tuner._HBM_BYTES_PER_S.get(
+            slug, kernel_tuner._HBM_BYTES_PER_S["tpu_v5e"])
+
+        stages = self.space.get("zero_stage") or [0]
+        remats = self.space.get("remat_policy") or ["everything"]
+        offloads = self.space.get("offload") or [None]
+        mbs_list = sorted(self._micro_batch_candidates())
+        grid = list(itertools.product(stages, remats, offloads, mbs_list))
+
+        ranking = []
+        compiled_cache = {}  # (mbs, remat) -> (cost, mem) | exception
+        n_params = self.model_info["num_params"]
+        for stage, remat, offload, mbs in grid[:self.max_trials]:
+            entry = {"zero_stage": stage, "remat_policy": remat,
+                     "offload": offload, "micro_batch_size": mbs,
+                     "feasible": False, "score": None, "reason": None}
+            ranking.append(entry)
+            reason = self.prune(stage, mbs, remat, dp_world,
+                                headroom=headroom, offload=offload)
+            if reason:
+                entry["reason"] = f"pruned: {reason}"
+                continue
+            key = (mbs, remat)
+            if key not in compiled_cache:
+                t0 = time.perf_counter()
+                try:
+                    fn, abstract = self._loss_grad_program(mbs, remat)
+                    compiled_cache[key] = compile_fn(fn, abstract)
+                except Exception as e:  # Mosaic/XLA rejection = infeasible
+                    compiled_cache[key] = e
+                entry["compile_s"] = round(time.perf_counter() - t0, 3)
+            got = compiled_cache[key]
+            if isinstance(got, Exception):
+                entry["reason"] = f"{type(got).__name__}: {got}"
+                continue
+            cost, mem = got
+            temp = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+            out_b = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+            state = self.estimate_state_bytes(stage, dp_world, offload)
+            entry["hbm_bytes"] = temp + out_b + int(state)
+            if entry["hbm_bytes"] > budget:
+                entry["reason"] = (f"compiled {temp + out_b:.0f}B temp+out "
+                                   f"+ {state:.0f}B state > "
+                                   f"{budget:.0f}B budget")
+                continue
+            flops = float(cost.get("flops", 0.0) or 0.0)
+            nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+            t = flops / peak + (nbytes + state) / bw
+            if offload == "optimizer":
+                t += (4 * n_params + 2 * n_params) / dp_world / 16e9
+            elif offload == "param":
+                t += (4 * n_params + 2 * n_params + 4 * n_params) / 16e9
+            entry["feasible"] = True
+            entry["score"] = t / max(mbs, 1)  # seconds/sample proxy
+
+        feasible = [e for e in ranking if e["feasible"]]
+        if not feasible:
+            raise RuntimeError(
+                "chip-free autotuning: no candidate compiles and fits "
+                f"{slug} — see ranking reasons")
+        best = min(feasible, key=lambda e: e["score"])
+        cfg = self._build_config(best["zero_stage"],
+                                 best["micro_batch_size"],
+                                 best["remat_policy"], best["offload"])
+        ranking.sort(key=lambda e: (not e["feasible"],
+                                    e["score"] if e["score"] is not None
+                                    else float("inf")))
+        log_dist(f"chip-free autotuning ({slug}): best {best}", ranks=[0])
+        return cfg, ranking
+
     def summary(self):
         return [(e.overrides, e.metric, e.error) for e in self.experiments]
 

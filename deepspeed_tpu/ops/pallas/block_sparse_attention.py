@@ -177,10 +177,17 @@ def sparse_mha(q, k, v, layout, block, causal=False, softmax_scale=None,
     # host-side constant indexed by GLOBAL head, so slicing it per TP shard
     # would need a head-offset plumbed into the kernel; batch sharding is
     # exact and covers the data-parallel axes that dominate the mesh.
+    # No free block knobs (``block`` is fixed by the caller's sparsity
+    # layout) but the dispatch still routes through the tuning table so
+    # coverage/telemetry treat all five kernels uniformly.
+    from deepspeed_tpu.ops import registry
     from deepspeed_tpu.ops.registry import sharded_kernel_call
+    block_config = registry.resolve_block_config(
+        "sparse_mha", {"s": S, "block": block, "dh": D}, q.dtype)
     return sharded_kernel_call(
         run, [q, k, v], [("data", None, None, None)] * 3,
-        ("data", None, None, None), name="sparse_mha")
+        ("data", None, None, None), name="sparse_mha",
+        block_config=block_config)
 
 
 def is_supported(q_shape, block):

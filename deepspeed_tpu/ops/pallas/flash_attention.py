@@ -44,17 +44,70 @@ def _largest_divisor(n, candidates):
     return None
 
 
-def _pick_blocks(tq, tk):
-    """Default block ladder, overridable via DS_FLASH_BQ / DS_FLASH_BK for
-    on-chip block-size tuning (a forced size must still divide the seq)."""
+_LADDER = (512, 256, 128)
+
+
+def _env_block(var, seq_len, which):
+    """Parse a DS_FLASH_BQ/BK override. Returns the forced block or None
+    (unset / "0" = off). A value that is not an integer or does not divide
+    the sequence raises a ValueError naming the variable — a silently
+    ignored override cost real tuning sessions (docs/AUTOTUNING.md)."""
     import os
-    force_q = int(os.environ.get("DS_FLASH_BQ", "0"))
-    force_k = int(os.environ.get("DS_FLASH_BK", "0"))
-    bq = force_q if force_q and tq % force_q == 0 else \
-        _largest_divisor(tq, (512, 256, 128))
-    bk = force_k if force_k and tk % force_k == 0 else \
-        _largest_divisor(tk, (512, 256, 128))
+    raw = os.environ.get(var, "")
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{var}={raw!r} is not an integer block size")
+    if v == 0:
+        return None
+    if v < 0:
+        raise ValueError(f"{var}={v} must be a positive block size")
+    if seq_len % v != 0:
+        raise ValueError(f"{var}={v} does not divide the {which} sequence "
+                         f"length {seq_len}")
+    return v
+
+
+def _pick_blocks(tq, tk):
+    """Hardcoded block ladder with the DS_FLASH_BQ / DS_FLASH_BK env
+    override on top (a documented escape hatch over the tuning table —
+    see :func:`_resolve_blocks` for the full table-first resolution)."""
+    force_q = _env_block("DS_FLASH_BQ", tq, "query")
+    force_k = _env_block("DS_FLASH_BK", tk, "key")
+    bq = force_q if force_q else _largest_divisor(tq, _LADDER)
+    bk = force_k if force_k else _largest_divisor(tk, _LADDER)
     return bq, bk
+
+
+def _resolve_blocks(tq, tk, dh, dtype):
+    """Resolution order for one dispatch: env override > tuning table >
+    ladder. Returns the ``BlockConfig`` and records the decision (source +
+    a tuned|ladder_fallback|env_override telemetry reason) in the registry."""
+    from deepspeed_tpu.autotuning.kernel_table import BlockConfig
+    from deepspeed_tpu.ops import registry
+
+    force_q = _env_block("DS_FLASH_BQ", tq, "query")
+    force_k = _env_block("DS_FLASH_BK", tk, "key")
+    if force_q or force_k:
+        bq = force_q if force_q else _largest_divisor(tq, _LADDER)
+        bk = force_k if force_k else _largest_divisor(tk, _LADDER)
+        cfg = BlockConfig.make("flash_mha", source="env",
+                               block_q=bq, block_k=bk)
+        return registry.note_block_config("flash_mha", cfg)
+
+    def validate(blocks, dims):
+        return (dims["tq"] % blocks["block_q"] == 0
+                and dims["tk"] % blocks["block_k"] == 0)
+
+    def ladder():
+        return {"block_q": _largest_divisor(tq, _LADDER),
+                "block_k": _largest_divisor(tk, _LADDER)}
+
+    return registry.resolve_block_config(
+        "flash_mha", {"tq": tq, "tk": tk, "dh": dh}, dtype,
+        validate=validate, ladder=ladder)
 
 
 def unsupported_reason(q_shape, k_shape, bias_shape=None, window=None,
@@ -289,11 +342,12 @@ def _bias_spec(bias, bq, bk, order="qk", clamp=None):
     return pl.BlockSpec((1, 1, bq, bk), index)
 
 
-def _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret):
+def _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret,
+         blocks=None):
     B, tq, H, dh = q.shape
     _, tk, KV, _ = k.shape
     rep = H // KV
-    bq, bk = _pick_blocks(tq, tk)
+    bq, bk = blocks if blocks is not None else _pick_blocks(tq, tk)
     nq, nk = tq // bq, tk // bk
 
     # [B, T, H, Dh] -> [B, H, T, Dh] so (T, Dh) are the tiled minor dims
@@ -439,12 +493,12 @@ def _bwd_dkv_kernel(*refs, causal, scale, window, bq, bk, nq, off,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, window, interpret, res, g):
+def _bwd(causal, scale, window, interpret, blocks, res, g):
     q, k, v, bias, segment_ids, out, lse = res
     B, tq, H, dh = q.shape
     _, tk, KV, _ = k.shape
     rep = H // KV
-    bq, bk = _pick_blocks(tq, tk)
+    bq, bk = blocks if blocks is not None else _pick_blocks(tq, tk)
     nq, nk = tq // bq, tk // bk
 
     qt = q.transpose(0, 2, 1, 3)
@@ -549,14 +603,18 @@ def _bwd(causal, scale, window, interpret, res, g):
 # public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, bias, segment_ids, causal, scale, window, interpret):
-    out, _ = _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, segment_ids, causal, scale, window, interpret,
+           blocks):
+    out, _ = _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret,
+                  blocks)
     return out
 
 
-def _flash_fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret):
-    out, lse = _fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret)
+def _flash_fwd(q, k, v, bias, segment_ids, causal, scale, window, interpret,
+               blocks):
+    out, lse = _fwd(q, k, v, bias, segment_ids, causal, scale, window,
+                    interpret, blocks)
     return out, (q, k, v, bias, segment_ids, out, lse)
 
 
@@ -564,7 +622,8 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_mha(q, k, v, bias=None, causal=True, softmax_scale=None,
-              window=None, segment_ids=None, interpret=False):
+              window=None, segment_ids=None, interpret=False,
+              block_config=None):
     """Flash attention. q [B,Tq,H,Dh]; k/v [B,Tk,KV,Dh], H % KV == 0.
 
     ``window``: sliding-window size (query i sees keys in
@@ -573,6 +632,11 @@ def flash_mha(q, k, v, bias=None, causal=True, softmax_scale=None,
     ``segment_ids``: int32 ``(q_ids [B,Tq], kv_ids [B,Tk])`` tuple or a single
     [B,T] array when Tq == Tk; positions in different segments do not attend
     (packed-sequence pretraining).
+
+    Block sizes resolve env override > tuning table > hardcoded ladder
+    (docs/AUTOTUNING.md); ``block_config`` — a ``BlockConfig`` or
+    ``{"block_q": .., "block_k": ..}`` dict — pins them outright (the tuner
+    sweep path). A pinned block that does not divide the sequence raises.
 
     Raises ValueError on unsupported shapes — callers (the op registry) are
     expected to gate on :func:`is_supported` and fall back to the XLA path.
@@ -592,16 +656,37 @@ def flash_mha(q, k, v, bias=None, causal=True, softmax_scale=None,
     window = None if window is None else int(window)
     seg = None if segment_ids is None else tuple(segment_ids)
     return _dispatch_flash(q, k, v, bias, seg, causal, float(scale), window,
-                           interpret)
+                           interpret, block_config)
 
 
-def _dispatch_flash(q, k, v, bias, seg, causal, scale, window, interpret):
+def _dispatch_flash(q, k, v, bias, seg, causal, scale, window, interpret,
+                    block_config=None):
     """Route ``_flash`` through the SPMD kernel dispatcher: batch over the
     active mesh's data axes, heads over the TP axis (k/v carry KV heads, so
     the head axis must divide KV — GQA sharding keeps whole KV groups
     together). Per-device shapes keep the kernel's own invariants: the seq
-    dims are untouched and ``_pick_blocks`` re-derives blocks from them."""
+    dims are untouched, so blocks resolved on the global shapes are the
+    per-shard blocks too."""
+    from deepspeed_tpu.autotuning.kernel_table import BlockConfig
+    from deepspeed_tpu.ops import registry
     from deepspeed_tpu.ops.registry import sharded_kernel_call
+
+    tq, dh = q.shape[1], q.shape[3]
+    tk = k.shape[1]
+    if block_config is not None:
+        if not isinstance(block_config, BlockConfig):
+            block_config = BlockConfig.make("flash_mha", source="sweep",
+                                            **dict(block_config))
+        bq = block_config.get("block_q")
+        bk = block_config.get("block_k")
+        if tq % bq != 0 or tk % bk != 0:
+            raise ValueError(f"flash_mha: pinned blocks (bq={bq}, bk={bk}) "
+                             f"do not divide seq lens (tq={tq}, tk={tk})")
+        registry.note_block_config("flash_mha", block_config,
+                                   reason=block_config.source)
+    else:
+        block_config = _resolve_blocks(tq, tk, dh, q.dtype)
+    blocks = (block_config.get("block_q"), block_config.get("block_k"))
 
     args = [q, k, v]
     in_roles = [("data", None, "head", None), ("data", None, "head", None),
@@ -622,7 +707,8 @@ def _dispatch_flash(q, k, v, bias, seg, causal, scale, window, interpret):
             b_ = ts[i]
             i += 1
         s_ = None if seg is None else (ts[i], ts[i + 1])
-        return _flash(q_, k_, v_, b_, s_, causal, scale, window, interpret)
+        return _flash(q_, k_, v_, b_, s_, causal, scale, window, interpret,
+                      blocks)
 
     def accept(shard_shapes):
         # per-shard GQA ratio must stay integral (H and KV shrink together)
@@ -631,4 +717,4 @@ def _dispatch_flash(q, k, v, bias, seg, causal, scale, window, interpret):
 
     return sharded_kernel_call(call, args, in_roles,
                                ("data", None, "head", None), accept=accept,
-                               name="flash_mha")
+                               name="flash_mha", block_config=block_config)

@@ -17,13 +17,39 @@ numerics oracle and CPU fallback.
 import jax
 import jax.numpy as jnp
 
-ROW_ALIGN = 128  # gmm's m-dimension tile
+ROW_ALIGN = 128  # gmm's default m-dimension tile (ladder tiling fallback)
 
 
 def is_supported(d_model, d_ff):
     # gmm tiles k/n at 128; ragged m is handled by padding below
     return (d_model is not None and d_ff is not None
             and d_model % ROW_ALIGN == 0 and d_ff % ROW_ALIGN == 0)
+
+
+def _tiling_fits(tm, tk, tn, d, f):
+    """Whether a gmm (tile_m, tile_k, tile_n) triple tiles both GEMMs of the
+    FFN — x@w1/w3 contracts D and emits F, h@w2 contracts F and emits D, so
+    every tile dim must divide both feature dims. tile_m only pads rows
+    (handled below), but keep it lane-aligned for the MXU."""
+    return (tm % ROW_ALIGN == 0
+            and d % tk == 0 and f % tk == 0
+            and d % tn == 0 and f % tn == 0)
+
+
+def _resolve_tiling(rows, d, f, dtype):
+    """Tuning-table-first gmm tiling (ladder = megablox default 128^3)."""
+    from deepspeed_tpu.ops import registry
+
+    def validate(blocks, dims):
+        return _tiling_fits(blocks["tile_m"], blocks["tile_k"],
+                            blocks["tile_n"], dims["d"], dims["f"])
+
+    def ladder():
+        return {"tile_m": ROW_ALIGN, "tile_k": 128, "tile_n": 128}
+
+    return registry.resolve_block_config(
+        "moe_ffn_gmm", {"rows": rows, "d": d, "f": f}, dtype,
+        validate=validate, ladder=ladder)
 
 
 def topk_router(x, gate_wg, k):
@@ -39,7 +65,7 @@ def topk_router(x, gate_wg, k):
 
 
 def moe_ffn_gmm(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
-                interpret=False):
+                interpret=False, block_config=None):
     """Mixtral-style expert FFN: silu(x@w1) * (x@w3) @ w2 per expert, routed
     by precomputed (top_vals, top_idx) from :func:`topk_router`.
 
@@ -51,28 +77,54 @@ def moe_ffn_gmm(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
     is per-token exact, so each shard grouping only its own tokens gives
     bitwise-identical rows. Expert weights stay replicated in the spec — if
     the caller holds them ep-sharded, GSPMD all-gathers at entry.
+
+    The gmm ``tiling`` triple resolves tuning table > ladder (megablox's
+    128^3 default); ``block_config`` (a ``BlockConfig`` or ``{"tile_m": ..,
+    "tile_k": .., "tile_n": ..}`` dict) pins it — the tuner sweep path.
     """
+    from deepspeed_tpu.autotuning.kernel_table import BlockConfig
+    from deepspeed_tpu.ops import registry
     from deepspeed_tpu.ops.registry import sharded_kernel_call
+
+    T, D = x.shape
+    F = w1.shape[-1]
+    rows = T * top_idx.shape[-1]
+    if block_config is not None:
+        if not isinstance(block_config, BlockConfig):
+            block_config = BlockConfig.make("moe_ffn_gmm", source="sweep",
+                                            **dict(block_config))
+        tm, tk, tn = (block_config.get("tile_m"), block_config.get("tile_k"),
+                      block_config.get("tile_n"))
+        if not _tiling_fits(tm, tk, tn, D, F):
+            raise ValueError(f"moe_ffn_gmm: pinned tiling ({tm}, {tk}, {tn})"
+                             f" does not tile D={D}, F={F}")
+        registry.note_block_config("moe_ffn_gmm", block_config,
+                                   reason=block_config.source)
+    else:
+        block_config = _resolve_tiling(rows, D, F, x.dtype)
+    tiling = (block_config.get("tile_m"), block_config.get("tile_k"),
+              block_config.get("tile_n"))
 
     def call(x_, tv_, ti_, w1_, w2_, w3_):
         return _moe_ffn_gmm_local(x_, tv_, ti_, w1_, w2_, w3_,
                                   n_experts=n_experts, dtype=dtype,
-                                  interpret=interpret)
+                                  interpret=interpret, tiling=tiling)
 
     wr = (None, None, None)
     return sharded_kernel_call(
         call, [x, top_vals, top_idx, w1, w2, w3],
         [("data", None), ("data", None), ("data", None), wr, wr, wr],
-        ("data", None), name="moe_ffn_gmm")
+        ("data", None), name="moe_ffn_gmm", block_config=block_config)
 
 
 def _moe_ffn_gmm_local(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
-                       interpret=False):
+                       interpret=False, tiling=None):
     from jax.experimental.pallas.ops.tpu.megablox import gmm
 
     T, D = x.shape
     E = n_experts
     k = top_idx.shape[-1]
+    tm, tk, tn = tiling if tiling is not None else (ROW_ALIGN, 128, 128)
 
     # moe_scatter: stable sort of the T*k (token, expert) rows by expert
     flat_e = top_idx.reshape(-1)                         # [T*k]
@@ -81,7 +133,7 @@ def _moe_ffn_gmm_local(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
     xs = jnp.take(x, token_of[order], axis=0)            # [T*k, D] grouped
 
     rows = T * k
-    pad = (-rows) % ROW_ALIGN
+    pad = (-rows) % tm  # pad rows to the m-tile so every group tiles cleanly
     group_sizes = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
     if pad:
         # pad rows ride in the LAST expert's group; outputs are dropped
@@ -92,6 +144,7 @@ def _moe_ffn_gmm_local(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
     def grouped(lhs, rhs):
         return gmm(lhs, rhs, group_sizes,
                    preferred_element_type=jnp.float32,
+                   tiling=(tm, tk, tn),
                    interpret=interpret).astype(dtype)
 
     h = jax.nn.silu(grouped(xs, w1)) * grouped(xs, w3)   # [rows+pad, F]

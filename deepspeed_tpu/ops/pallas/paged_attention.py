@@ -95,8 +95,16 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
     KV heads (and with them the grouped query heads) shard over the TP axis,
     which slices the pools' ``KV`` dim while the block pool itself (``NB``)
     stays replicated so global block-table indices remain valid per shard.
+
+    No free block knobs (the KV block size comes from the pool layout), but
+    the dispatch still routes through the tuning table so coverage and the
+    tuned|ladder_fallback telemetry treat all five kernels uniformly.
     """
+    from deepspeed_tpu.ops import registry
     from deepspeed_tpu.ops.registry import sharded_kernel_call
+
+    block_config = registry.resolve_block_config(
+        "paged_mha", {"bs": k_pool.shape[2], "dh": q.shape[-1]}, q.dtype)
 
     def call(q_, kp_, vp_, bt_, sn_, ql_):
         return _paged_mha_local(q_, kp_, vp_, bt_, sn_, ql_,
@@ -111,7 +119,8 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
         call, [q, k_pool, v_pool, block_tables, seen, q_len],
         [("data", None, "head", None), (None, "head", None, None),
          (None, "head", None, None), ("data", None), ("data",), ("data",)],
-        ("data", None, "head", None), accept=accept, name="paged_mha")
+        ("data", None, "head", None), accept=accept, name="paged_mha",
+        block_config=block_config)
 
 
 def _paged_mha_local(q, k_pool, v_pool, block_tables, seen, q_len, *,

@@ -20,14 +20,36 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BM, BN, BK = 256, 256, 512
+BM, BN, BK = 256, 256, 512  # ladder defaults; the tuning table overrides
+
+
+def _blocks_fit(bm, bn, bk, m, k, n, group_size):
+    """Whether a (bm, bn, bk) choice tiles these exact dims cleanly."""
+    return (m % 8 == 0 and (m <= bm or m % bm == 0)
+            and k % bk == 0 and n % bn == 0
+            and bn % group_size == 0 and group_size <= bn)
 
 
 def is_supported(m, k, n, group_size, num_bits):
     """Shapes the kernel tiles cleanly; callers fall back to XLA dequant."""
-    return (num_bits == 8 and m % 8 == 0 and (m <= BM or m % BM == 0)
-            and k % BK == 0 and n % BN == 0
-            and BN % group_size == 0 and group_size <= BN)
+    return num_bits == 8 and _blocks_fit(BM, BN, BK, m, k, n, group_size)
+
+
+def _resolve_blocks(m, k, n, group_size, dtype):
+    """Tuning-table-first block resolution (ladder = module defaults)."""
+    from deepspeed_tpu.ops import registry
+
+    def validate(blocks, dims):
+        return _blocks_fit(blocks["block_m"], blocks["block_n"],
+                           blocks["block_k"], dims["m"], dims["k"],
+                           dims["n"], dims["g"])
+
+    def ladder():
+        return {"block_m": BM, "block_n": BN, "block_k": BK}
+
+    return registry.resolve_block_config(
+        "quantized_matmul", {"m": m, "k": k, "n": n, "g": group_size}, dtype,
+        validate=validate, ladder=ladder)
 
 
 def _kernel(x_ref, q_ref, s_ref, o_ref, acc, *, nk, bn, group_size):
@@ -61,8 +83,12 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc, *, nk, bn, group_size):
 
 
 def quantized_matmul(x, q, scale, group_size, out_dtype=None,
-                     interpret=False):
+                     interpret=False, block_config=None):
     """x [M, K] @ dequant(q [K, N] int8, scale [K, N//G]) -> [M, N].
+
+    Blocks resolve tuning table > ladder (module BM/BN/BK defaults);
+    ``block_config`` (a ``BlockConfig`` or ``{"block_m": .., "block_n": ..,
+    "block_k": ..}`` dict) pins them outright — the tuner sweep path.
 
     SPMD: rows (``M``) shard over the active mesh's data axes and output
     features (``N``, with the matching ``N//G`` scale columns) over the TP
@@ -70,45 +96,69 @@ def quantized_matmul(x, q, scale, group_size, out_dtype=None,
     reduction is needed. Sharding is vetoed unless the per-shard dims still
     satisfy the kernel's block constraints (``is_supported``'s rules).
     """
+    from deepspeed_tpu.autotuning.kernel_table import BlockConfig
+    from deepspeed_tpu.ops import registry
     from deepspeed_tpu.ops.registry import sharded_kernel_call
+
+    M, K = x.shape
+    N = q.shape[1]
+    if block_config is not None:
+        if not isinstance(block_config, BlockConfig):
+            block_config = BlockConfig.make("quantized_matmul",
+                                            source="sweep",
+                                            **dict(block_config))
+        bm, bn, bk = (block_config.get("block_m"), block_config.get("block_n"),
+                      block_config.get("block_k"))
+        if not _blocks_fit(bm, bn, bk, M, K, N, group_size):
+            raise ValueError(
+                f"quantized_matmul: pinned blocks (bm={bm}, bn={bn}, bk={bk})"
+                f" do not tile M={M}, K={K}, N={N}, group={group_size}")
+        registry.note_block_config("quantized_matmul", block_config,
+                                   reason=block_config.source)
+    else:
+        block_config = _resolve_blocks(M, K, N, group_size, x.dtype)
+    blocks = (block_config.get("block_m"), block_config.get("block_n"),
+              block_config.get("block_k"))
 
     def call(x_, q_, s_):
         return _quantized_matmul_local(x_, q_, s_, group_size,
                                        out_dtype=out_dtype,
-                                       interpret=interpret)
+                                       interpret=interpret, blocks=blocks)
 
     def accept(shard_shapes):
         (m, k), (_, n), _ = shard_shapes
-        return (m % 8 == 0 and (m <= BM or m % BM == 0)
-                and k % BK == 0 and n % BN == 0)
+        return _blocks_fit(blocks[0], blocks[1], blocks[2], m, k, n,
+                           group_size)
 
     return sharded_kernel_call(
         call, [x, q, scale],
         [("data", None), (None, "head"), (None, "head")],
-        ("data", "head"), accept=accept, name="quantized_matmul")
+        ("data", "head"), accept=accept, name="quantized_matmul",
+        block_config=block_config)
 
 
 def _quantized_matmul_local(x, q, scale, group_size, out_dtype=None,
-                            interpret=False):
+                            interpret=False, blocks=None):
     M, K = x.shape
     _, N = q.shape
     out_dtype = out_dtype or x.dtype
-    bm = min(BM, M)
-    nm, nn, nk = M // bm, N // BN, K // BK
+    BM_, BN_, BK_ = blocks if blocks is not None else (BM, BN, BK)
+    bm = min(BM_, M)
+    nm, nn, nk = M // bm, N // BN_, K // BK_
 
     out = pl.pallas_call(
-        functools.partial(_kernel, nk=nk, bn=BN, group_size=group_size),
+        functools.partial(_kernel, nk=nk, bn=BN_, group_size=group_size),
         grid=(nm, nn, nk),
         in_specs=[
-            pl.BlockSpec((bm, BK), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((BK, BN), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((bm, BK_), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((BK_, BN_), lambda i, j, kk: (kk, j)),
             # per-j scale block [bk, bn//G]: sliced by the DMA machinery
             # here, never by an in-kernel lane-dim dynamic slice
-            pl.BlockSpec((BK, BN // group_size), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((BK_, BN_ // group_size), lambda i, j, kk: (kk, j)),
         ],
-        out_specs=pl.BlockSpec((bm, BN), lambda i, j, kk: (i, j)),
+        out_specs=pl.BlockSpec((bm, BN_), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
-        scratch_shapes=[pltpu.VMEM((bm, BN), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bm, BN_), jnp.float32)],
         interpret=interpret,
     )(x, q, scale.astype(jnp.float32))
     return out

@@ -328,6 +328,7 @@ class DeepSpeedEngine:
         self._last_stats: Optional[StepStats] = None
         self._staged_loss = None
         self._data_iterator = None  # persistent iterator for train_batch()
+        self._host_sync_count = 0   # blocking device->host fetches (see _host_fetch)
 
         # --- state init ---
         self._rng_seed = rng if rng is not None else self.config.seed
@@ -1517,6 +1518,25 @@ class DeepSpeedEngine:
                 out_specs=P(), check_vma=False))
         return fn(sparse_tensor, ids)
 
+    def _host_fetch(self, value, what):
+        """THE accounted device->host fetch. Every blocking d2h transfer the
+        engine issues on its own behalf goes through here so the steady-state
+        no-sync contract is auditable: ``host_sync_count`` must stay flat
+        between ``steps_per_print``/monitor boundaries (enforced by the
+        transfer-guard regression test). Do not call jax.device_get / float()
+        on device values elsewhere in the train loop."""
+        self._host_sync_count += 1
+        from deepspeed_tpu import telemetry
+        if telemetry.enabled():
+            telemetry.count("host_sync", what=what)
+        return jax.device_get(value)
+
+    @property
+    def host_sync_count(self):
+        """Cumulative engine-issued blocking device->host fetches (bench's
+        ``extra.host_sync_count``). Steady-state steps contribute zero."""
+        return self._host_sync_count
+
     def step(self):
         """Optimizer step at the gradient-accumulation boundary (engine.py:2132)."""
         self._step_applied = False
@@ -1554,13 +1574,18 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
             if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
                 events = [
-                    ("Train/Samples/lr", float(stats.lr), self.global_samples),
-                    ("Train/Samples/loss_scale", float(stats.loss_scale), self.global_samples),
+                    ("Train/Samples/lr",
+                     float(self._host_fetch(stats.lr, "monitor/lr")),
+                     self.global_samples),
+                    ("Train/Samples/loss_scale",
+                     float(self._host_fetch(stats.loss_scale, "monitor/loss_scale")),
+                     self.global_samples),
                 ]
                 if getattr(self, "_loss_accum", None) is not None:
                     # reference engine.py:1961 Train/Samples/train_loss —
-                    # the GAS-window mean; float() sync only at monitor cadence
-                    mean = float(jax.device_get(self._loss_accum)) / \
+                    # the GAS-window mean; fetch only at monitor cadence
+                    mean = float(self._host_fetch(self._loss_accum,
+                                                  "monitor/train_loss")) / \
                         self._loss_accum_n
                     events.insert(0, ("Train/Samples/train_loss", mean,
                                       self.global_samples))
@@ -1636,7 +1661,8 @@ class DeepSpeedEngine:
         elif self.global_steps % max(1, g["check_every"]) == 0:
             g["snapshot"].verify(self.state)
             g["trace"].verify(**fns)
-        if (g["checkify_on_overflow"] and bool(jax.device_get(stats.overflow))
+        if (g["checkify_on_overflow"]
+                and bool(self._host_fetch(stats.overflow, "guards/overflow"))
                 and self._last_guard_batch is not None
                 and self._param_store is None
                 and not getattr(self, "quantized_weights", False)):
@@ -1690,17 +1716,25 @@ class DeepSpeedEngine:
             if self.monitor.enabled and \
                     self.global_steps % self.config.steps_per_print == 0:
                 events = [
-                    ("Train/Samples/train_loss", float(jax.device_get(mean)),
+                    ("Train/Samples/train_loss",
+                     float(self._host_fetch(mean, "monitor/train_loss")),
                      self.global_samples),
-                    ("Train/Samples/lr", float(stats.lr), self.global_samples),
-                    ("Train/Samples/loss_scale", float(stats.loss_scale),
+                    ("Train/Samples/lr",
+                     float(self._host_fetch(stats.lr, "monitor/lr")),
+                     self.global_samples),
+                    ("Train/Samples/loss_scale",
+                     float(self._host_fetch(stats.loss_scale,
+                                            "monitor/loss_scale")),
                      self.global_samples)]
                 if self._telemetry_monitor and telemetry.enabled():
                     events.extend(telemetry.monitor_events(self.global_samples))
                 self.monitor.write_events(events)
             self.tput_timer.stop(global_step=True)
             self._resilience_step_boundary()
-            return float(jax.device_get(mean))
+            # device-resident window mean: train_batch itself never blocks on
+            # the result (reference returns the loss tensor, not a float) —
+            # the caller decides when/whether to pay the d2h sync
+            return mean
         from deepspeed_tpu import telemetry
         losses = []
         for _ in range(gas):
@@ -1710,7 +1744,8 @@ class DeepSpeedEngine:
             self.backward(loss)
             self.step()
             losses.append(loss)
-        return sum(jax.device_get(l) for l in losses) / len(losses)
+        # device-side mean: one fused add chain, no per-micro-step d2h sync
+        return sum(losses[1:], losses[0]) / len(losses)
 
     def eval_batch(self, batch):
         self._ensure_initialized(batch)
@@ -1737,11 +1772,14 @@ class DeepSpeedEngine:
         return self.zero_optimization_stage() > 0
 
     def get_lr(self):
-        return [float(self._last_stats.lr)] if self._last_stats is not None \
+        return [float(self._host_fetch(self._last_stats.lr, "get_lr"))] \
+            if self._last_stats is not None \
             else [float(self._schedule_fn(self.global_steps))]
 
     def get_global_grad_norm(self):
-        return float(self._last_stats.grad_norm) if self._last_stats is not None else 0.0
+        return float(self._host_fetch(self._last_stats.grad_norm,
+                                      "grad_norm")) \
+            if self._last_stats is not None else 0.0
 
     def set_lr(self, lr):
         """Override the learning rate from here on (reference engine
@@ -1802,11 +1840,14 @@ class DeepSpeedEngine:
     @property
     def skipped_steps(self):
         """Overflow-skipped optimizer steps (device counter, synced on read)."""
-        return int(jax.device_get(self.state.skipped)) if self.state is not None else 0
+        return int(self._host_fetch(self.state.skipped, "skipped_steps")) \
+            if self.state is not None else 0
 
     @property
     def cur_scale(self):
-        return float(self.state.scale.loss_scale) if self.state is not None else 1.0
+        return float(self._host_fetch(self.state.scale.loss_scale,
+                                      "loss_scale")) \
+            if self.state is not None else 1.0
 
     def loss_scale(self):
         return self.cur_scale

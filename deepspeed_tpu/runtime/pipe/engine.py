@@ -323,7 +323,9 @@ class PipelineEngine(DeepSpeedEngine):
         self.micro_steps += gas - 1
         self.global_samples += (gas - 1) * self.micro_batch_size * self.topology.data_parallel_size
         self.step()
-        return float(jax.device_get(loss))
+        # device-resident, matching DeepSpeedEngine.train_batch: the caller
+        # pays the d2h sync when it actually reads the value
+        return loss
 
     def eval_batch(self, data_iter_or_batch):
         if hasattr(data_iter_or_batch, "__next__"):

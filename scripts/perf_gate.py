@@ -17,11 +17,14 @@ the gate can be wired into CI before the first on-hardware run). Exit codes:
     2  malformed input (unreadable file, schema violation, no JSON)
     3  regression (at least one metric beyond threshold)
 
-``--dry-run`` validates inputs only — parses both docs and, when the
-candidate embeds a telemetry summary, validates it against
-``telemetry/summary.schema.json`` — and exits 0/2 without comparing. The
-tier-1 lane runs ``--dry-run`` against the repo's own BASELINE.json so a
-malformed baseline or summary fails fast on CPU (docs/OBSERVABILITY.md).
+``--dry-run`` validates inputs only — parses both docs, validates any
+embedded telemetry summary against ``telemetry/summary.schema.json``, and
+schema-checks the checked-in kernel tuning tables
+(``deepspeed_tpu/autotuning/tables/``: valid per
+``kernel_table.validate_table`` AND covering every ``BENCH_SHAPES`` bucket)
+— then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run``
+against the repo's own BASELINE.json so a malformed baseline, summary, or
+tuning table fails fast on CPU (docs/OBSERVABILITY.md).
 """
 
 import argparse
@@ -129,6 +132,56 @@ def extract_metrics(doc):
     return m
 
 
+def check_kernel_tables(tables_dir=None):
+    """Validate every checked-in kernel tuning table (schema via
+    ``kernel_table.validate_table``) and require the default-device table to
+    cover all ``BENCH_SHAPES`` bucket keys. Returns (report, errors).
+
+    ``kernel_table`` is loaded standalone (it is stdlib-only at module
+    scope), so this check runs in the tier-1 dry-run lane without jax."""
+    import importlib.util
+    mod_path = os.path.join(REPO_ROOT, "deepspeed_tpu", "autotuning",
+                            "kernel_table.py")
+    spec = importlib.util.spec_from_file_location("_kernel_table", mod_path)
+    kt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(kt)
+
+    tables_dir = tables_dir or kt.TABLES_DIR
+    errors = []
+    report = {"tables": {}, "bench_coverage": {}}
+    try:
+        names = sorted(n for n in os.listdir(tables_dir)
+                       if n.endswith(".json"))
+    except OSError as e:
+        return report, [f"kernel tables dir unreadable: {e}"]
+    if not names:
+        errors.append(f"no kernel tuning tables under {tables_dir}")
+    for name in names:
+        path = os.path.join(tables_dir, name)
+        doc = load_doc(path)
+        if doc is None:
+            errors.append(f"{name}: unreadable")
+            continue
+        errs = kt.validate_table(doc)
+        report["tables"][name] = {"entries": len(doc.get("entries", {})),
+                                  "errors": errs}
+        errors.extend(f"{name}: {e}" for e in errs)
+        if not errs:
+            # bench-shape coverage: every shape the bench/AOT lanes run must
+            # resolve as "tuned" on this device's table
+            missing = []
+            for kernel, shapes in kt.BENCH_SHAPES.items():
+                for dims, dtype in shapes:
+                    key = kt.bucket_key(kernel, dims, dtype)
+                    if key not in doc["entries"]:
+                        missing.append(key)
+            report["bench_coverage"][name] = {
+                "covered": not missing, "missing": missing}
+            if missing:
+                errors.append(f"{name}: bench shapes uncovered: {missing}")
+    return report, errors
+
+
 def validate_summary(doc):
     """Schema-validate an embedded summary when jsonschema is available.
     Returns an error string or None."""
@@ -205,10 +258,15 @@ def main(argv=None):
             return 2
 
     if args.dry_run:
-        print(json.dumps({"dry_run": True, "inputs_ok": True,
+        table_report, table_errors = check_kernel_tables()
+        for err in table_errors:
+            print(f"perf_gate: kernel_table: {err}", file=sys.stderr)
+        print(json.dumps({"dry_run": True,
+                          "inputs_ok": not table_errors,
+                          "kernel_table": table_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
-        return 0
+        return 2 if table_errors else 0
 
     if "candidate" not in docs:
         print("perf_gate: --candidate is required without --dry-run",

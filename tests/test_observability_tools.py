@@ -279,7 +279,42 @@ def test_perf_gate_dry_run_tier1_wiring():
     r = _run([PERF_GATE, "--baseline",
               os.path.join(REPO_ROOT, "BASELINE.json"), "--dry-run"])
     assert r.returncode == 0, (r.stdout, r.stderr)
-    assert json.loads(r.stdout)["inputs_ok"] is True
+    out = json.loads(r.stdout)
+    assert out["inputs_ok"] is True
+    # kernel tuning tables ride the same lane: checked-in table(s) must be
+    # schema-valid and cover every bench shape (docs/AUTOTUNING.md)
+    assert out["kernel_table"]["tables"], "no kernel table checked"
+    for name, info in out["kernel_table"]["tables"].items():
+        assert info["errors"] == [], (name, info)
+    for name, cov in out["kernel_table"]["bench_coverage"].items():
+        assert cov["covered"], (name, cov["missing"])
+
+
+def test_perf_gate_kernel_table_check_fails_on_bad_table(tmp_path,
+                                                         monkeypatch):
+    """check_kernel_tables flags schema breakage and bench-shape gaps."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_pg", PERF_GATE)
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    # empty dir -> error
+    _, errs = pg.check_kernel_tables(tables_dir=str(tmp_path))
+    assert any("no kernel tuning tables" in e for e in errs)
+    # schema-invalid knobs -> error names the entry
+    (tmp_path / "tpu_v5e.json").write_text(json.dumps({
+        "format_version": 1, "device_kind": "tpu_v5e",
+        "entries": {"flash_mha|tq1024,tk1024,dh64|bfloat16":
+                    {"blocks": {"bogus": 7}}}}))
+    _, errs = pg.check_kernel_tables(tables_dir=str(tmp_path))
+    assert any("blocks must have exactly" in e for e in errs)
+    # valid but missing bench shapes -> coverage error
+    (tmp_path / "tpu_v5e.json").write_text(json.dumps({
+        "format_version": 1, "device_kind": "tpu_v5e",
+        "entries": {"flash_mha|tq1024,tk1024,dh64|bfloat16":
+                    {"blocks": {"block_q": 512, "block_k": 512}}}}))
+    report, errs = pg.check_kernel_tables(tables_dir=str(tmp_path))
+    assert any("bench shapes uncovered" in e for e in errs)
+    assert not report["bench_coverage"]["tpu_v5e.json"]["covered"]
 
 
 def test_perf_gate_rejects_bad_embedded_summary(tmp_path):
