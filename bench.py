@@ -271,68 +271,17 @@ def _active_recovery(kill=None):
     return holders
 
 
-def _probe_backend_subprocess():
-    """Probe jax.devices() in a CHILD process with a hard deadline.
-
-    A wedged chip makes backend init HANG (not raise) — in-process there is
-    no way to recover, and the driver's kill would end the run with no JSON
-    emitted. The child takes the hang; the parent keeps control and can still
-    emit the structured error line. (Shared impl:
-    deepspeed_tpu/utils/backend_probe.py — also used by ds_tpu_report.)"""
-    from deepspeed_tpu.utils.backend_probe import probe_backend
-    kind, detail = probe_backend(timeout_s=PROBE_TIMEOUT_S)
-    if kind == "hang":
-        raise RuntimeError(f"backend init UNAVAILABLE: {detail}")
-    if kind != "ok":
-        raise RuntimeError(f"backend {detail}")
-
-
-def init_backend_with_retry():
-    """Initialize the JAX backend, retrying on transient UNAVAILABLE errors.
-
-    A held/wedged chip (e.g. a stale libtpu lockholder from a previous run)
-    either raises RuntimeError('Unable to initialize backend ...') or hangs;
-    both are detected by the subprocess probe. Retrying with backoff gives
-    the holder time to exit. Returns the device list, or raises after all
-    attempts (the caller still emits structured JSON)."""
-    last = None
-    holders_seen = []
-    for attempt in range(1, INIT_ATTEMPTS + 1):
-        try:
-            _probe_backend_subprocess()
-            import jax
-            devs = jax.devices()
-            if devs:
-                return devs
-        except Exception as e:
-            last = e
-            print(f"bench: backend init attempt {attempt}/{INIT_ATTEMPTS} failed: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr)
-            # active recovery: identify (and reap) stale local holders before
-            # the next probe; remote-side wedges at least get the holder list
-            # recorded in the bench JSON
-            try:
-                holders_seen = _active_recovery()
-            except Exception as rec_err:
-                print(f"bench: active recovery failed: {rec_err}",
-                      file=sys.stderr)
-            # the parent's own init can fail transiently even when the probe
-            # succeeded (chip grabbed in between); jax caches the failed
-            # backend — clear it so the next attempt re-probes
-            try:
-                import jax
-                jax.extend.backend.clear_backends()
-            except Exception:
-                try:
-                    import jax
-                    jax.clear_backends()
-                except Exception:
-                    pass
-        if attempt < INIT_ATTEMPTS:
-            time.sleep(INIT_BACKOFF_S * attempt)
-    if last is not None and holders_seen:
-        last.bench_holders = holders_seen  # surfaced in the error JSON
-    raise last if last is not None else RuntimeError("no devices found")
+def init_backend_with_retry(lease_name="bench"):
+    """Queue on the shared chip lease, then initialize the JAX backend with
+    probe + retries (moved to ``deepspeed_tpu/utils/chip_lease.py`` so
+    bench_serving/bench_llama/pytest share it). Active recovery — reaping
+    provably-ours stale holders — is bench policy and stays here, injected
+    as the ``recovery`` hook."""
+    from deepspeed_tpu.utils import chip_lease
+    return chip_lease.init_backend_with_retry(
+        attempts=INIT_ATTEMPTS, backoff_s=INIT_BACKOFF_S,
+        probe_timeout_s=PROBE_TIMEOUT_S, recovery=_active_recovery,
+        lease_name=lease_name)
 
 
 def expand_fused(pairs):

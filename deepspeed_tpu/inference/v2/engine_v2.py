@@ -13,6 +13,7 @@ from typing import Iterable, List, Tuple
 import numpy as np
 import jax.numpy as jnp
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
 from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
 from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import RaggedBatchWrapper
@@ -161,6 +162,10 @@ class InferenceEngineV2:
         if not verdict.success:
             raise RuntimeError(f"cannot schedule batch: {verdict.reason}")
 
+        tm = telemetry.get_telemetry()
+        sp = tm.span("serving/forward", seqs=len(batch_uids),
+                     tokens=int(sum(len(t) for t in batch_tokens))) \
+            if tm.enabled else None
         sm = self._config.state_manager
         wrapper = RaggedBatchWrapper(sm.max_ragged_sequence_count,
                                      sm.max_ragged_batch_size,
@@ -183,6 +188,8 @@ class InferenceEngineV2:
 
         for uid in batch_uids:
             self._state.get_sequence(uid).post_forward()
+        if sp is not None:
+            sp.end(logits)  # block_until_ready only when sample_sync is on
         return logits
 
     def put(self, batch_uids: List[int],
@@ -243,3 +250,10 @@ class InferenceEngineV2:
     def swap_stats(self):
         return {"swap_outs": self._state.swap_outs,
                 "swap_ins": self._state.swap_ins}
+
+    def sample_kv_stats(self, point="step"):
+        """Host-side KV pool stats (occupancy, free-list depth,
+        fragmentation). Always returns the dict; records serving gauges
+        when telemetry is enabled. Sync-free — block bookkeeping lives on
+        the host (the ``sample_memory`` pattern)."""
+        return self._state.sample_kv_stats(point=point)

@@ -37,3 +37,28 @@ class BlockedAllocator:
             if not 0 <= b < self._num_blocks:
                 raise ValueError(f"block id {b} out of range")
             self._free.append(b)
+
+    def stats(self):
+        """Host-side free-list stats for the serving gauges: free/total
+        counts plus contiguous-run structure. ``fragmentation`` is
+        1 - largest_run/free — 0.0 when the free ids form one contiguous
+        range (or the list is empty), approaching 1.0 as the free space
+        shatters. Paged attention doesn't need contiguity, but run structure
+        still predicts swap_in/swap_out gather efficiency."""
+        free_sorted = sorted(self._free)
+        runs, largest = 0, 0
+        run_len = 0
+        prev = None
+        for b in free_sorted:
+            if prev is not None and b == prev + 1:
+                run_len += 1
+            else:
+                runs += 1
+                run_len = 1
+            if run_len > largest:
+                largest = run_len
+            prev = b
+        frag = 1.0 - largest / len(free_sorted) if free_sorted else 0.0
+        return {"free": len(free_sorted), "total": self._num_blocks,
+                "free_runs": runs, "largest_free_run": largest,
+                "fragmentation": frag}

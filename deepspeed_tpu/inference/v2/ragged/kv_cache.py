@@ -37,6 +37,15 @@ class BlockedKVCache:
         return self._allocator.free_blocks
 
     @property
+    def occupancy(self) -> float:
+        """Fraction of pool blocks currently allocated (host-side read)."""
+        return 1.0 - self._allocator.free_blocks / self.num_blocks
+
+    def allocator_stats(self):
+        """Free-list depth + fragmentation (``BlockedAllocator.stats``)."""
+        return self._allocator.stats()
+
+    @property
     def trash_block(self) -> int:
         return self.num_blocks
 

@@ -21,6 +21,7 @@ class DSStateManager:
         self._seqs = {}
         self.swap_outs = 0  # host swap tier counters (kv_cache swap_out/in)
         self.swap_ins = 0
+        self.peak_occupancy = 0.0  # high-water KV occupancy (kv_stats)
         logger.info(f"DSStateManager: {num_blocks} KV blocks x {kv.block_size} "
                     f"tokens ({num_layers} layers, {num_kv_heads} kv heads)")
 
@@ -66,6 +67,43 @@ class DSStateManager:
     @property
     def free_blocks(self):
         return self.kv_cache.free_blocks
+
+    def kv_stats(self):
+        """Pure host-side KV pool read: occupancy, free-list depth,
+        fragmentation, swap counters. Never touches the device — the block
+        bookkeeping is the deque in ``BlockedAllocator`` — so samplers can
+        call this every scheduler step (the PR 4 ``sample_memory`` sync-free
+        pattern applied to the KV pool)."""
+        a = self.kv_cache.allocator_stats()
+        total, free = a["total"], a["free"]
+        occupancy = 1.0 - free / total if total else 0.0
+        if occupancy > self.peak_occupancy:
+            self.peak_occupancy = occupancy
+        swapped = sum(1 for s in self._seqs.values() if s.is_swapped)
+        return {"total_blocks": total, "free_blocks": free,
+                "occupied_blocks": total - free, "occupancy": occupancy,
+                "peak_occupancy": self.peak_occupancy,
+                "free_runs": a["free_runs"],
+                "largest_free_run": a["largest_free_run"],
+                "fragmentation": a["fragmentation"],
+                "tracked_sequences": len(self._seqs),
+                "swapped_sequences": swapped,
+                "swap_outs": self.swap_outs, "swap_ins": self.swap_ins}
+
+    def sample_kv_stats(self, point="step"):
+        """``kv_stats`` + serving-gauge recording when telemetry is enabled
+        (occupancy / free-list depth / fragmentation counter tracks)."""
+        stats = self.kv_stats()
+        from deepspeed_tpu import telemetry
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.serving_gauge("serving/kv_occupancy", stats["occupancy"],
+                             point=point)
+            tm.serving_gauge("serving/kv_free_blocks", stats["free_blocks"],
+                             point=point)
+            tm.serving_gauge("serving/kv_fragmentation",
+                             stats["fragmentation"], point=point)
+        return stats
 
     def get_sequence(self, uid):
         return self._seqs.get(uid)

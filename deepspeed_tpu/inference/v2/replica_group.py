@@ -14,6 +14,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
 from deepspeed_tpu.utils.logging import logger
@@ -74,7 +75,31 @@ class ReplicaGroup:
         mesh, sched = self.replicas[r]
         with mesh:
             sched.submit(uid, prompt, **kwargs)
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.serving_gauge("serving/replica_skew",
+                             self.load_report()["active_skew"], replica=r)
         return r
+
+    def load_report(self):
+        """Per-replica load: assigned/active request counts + KV occupancy,
+        and the active-count skew ((max-min)/mean, 0.0 = perfectly even) —
+        the number the MII load balancer would watch before moving from
+        round-robin to least-loaded placement."""
+        assigned = [0] * len(self.replicas)
+        for rep in self._assignment.values():
+            assigned[rep] += 1
+        per = []
+        for i, (mesh, sched) in enumerate(self.replicas):
+            active = sum(1 for r in sched._requests.values() if not r.done)
+            per.append({"replica": i, "assigned": assigned[i],
+                        "active": active,
+                        "kv_occupancy":
+                            sched._engine._state.kv_stats()["occupancy"]})
+        counts = [p["active"] for p in per]
+        mean = sum(counts) / len(counts) if counts else 0.0
+        skew = (max(counts) - min(counts)) / mean if mean else 0.0
+        return {"replicas": per, "active_skew": skew}
 
     def run_to_completion(self):
         """Drain every replica; merged {uid: tokens}."""

@@ -10,12 +10,28 @@ MXU always sees a full batch.
 Pure host-side policy: composes ragged batches, calls ``engine.put``, samples
 greedily, retires finished sequences. The engine's admission control
 (``can_schedule``) stays the source of truth; the scheduler only proposes.
+
+Every lifecycle transition feeds the telemetry serving stream when enabled
+(submit -> queued -> prefill-chunk -> decode -> finish/evict, plus
+preempt/resume): TTFT/TPOT/e2e/queue-wait histograms, per-request
+Chrome-trace lanes, and per-step scheduler gauges (token-budget utilization,
+running/waiting counts, KV occupancy via ``engine.sample_kv_stats``).
+Disabled, every hook is a single boolean check — zero timing calls, zero
+allocations, zero syncs per step (pinned by
+tests/test_serving_observability.py).
 """
 
 import dataclasses
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from deepspeed_tpu import telemetry
+
+# module-level alias so tests can prove the disabled path never reads the
+# clock (monkeypatching time.perf_counter itself would break jax internals)
+_now = time.perf_counter
 
 
 @dataclasses.dataclass
@@ -32,6 +48,10 @@ class _Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     preempted: bool = False  # KV host-swapped out (scheduler preemption)
+    # serving-telemetry timestamps (perf_counter; 0.0 = not yet / disabled)
+    submit_ts: float = 0.0
+    first_sched_ts: float = 0.0
+    last_token_ts: float = 0.0
 
     @property
     def prefilling(self):
@@ -86,11 +106,17 @@ class SplitFuseScheduler:
         if seed is None:
             import secrets
             seed = secrets.randbits(31)
-        self._requests[uid] = _Request(uid, prompt, int(max_new_tokens),
-                                       eos_token_id,
-                                       temperature=float(temperature),
-                                       top_k=int(top_k), top_p=float(top_p),
-                                       seed=int(seed))
+        req = _Request(uid, prompt, int(max_new_tokens), eos_token_id,
+                       temperature=float(temperature),
+                       top_k=int(top_k), top_p=float(top_p),
+                       seed=int(seed))
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            req.submit_ts = _now()
+            tm.serving_event("submitted")
+            tm.record_request_phase(uid, "submit", req.submit_ts,
+                                    prompt_tokens=len(prompt))
+        self._requests[uid] = req
 
     @property
     def has_work(self):
@@ -102,6 +128,7 @@ class SplitFuseScheduler:
         Decodes (1 token) first — they bound tail latency; leftover budget
         is split across pending prefills (the SplitFuse chunking)."""
         max_ctx = self._engine._config.state_manager.max_context
+        tm = telemetry.get_telemetry()
         uids, chunks, budget = [], [], self._budget
         for r in list(self._requests.values()):
             if r.done or r.prefilling or r.preempted or len(uids) >= self._max_seqs:
@@ -112,6 +139,10 @@ class SplitFuseScheduler:
                 # request can never schedule again and must not wedge others
                 r.done = True
                 self._engine.flush(r.uid)
+                if tm.enabled:
+                    tm.serving_event("evicted")
+                    tm.record_request_phase(r.uid, "evict", _now(),
+                                            seen_tokens=pos)
                 continue
             if budget < 1:
                 break
@@ -154,6 +185,11 @@ class SplitFuseScheduler:
             if need and self._engine.free_blocks >= need + grow:
                 self._engine.resume(r.uid)
                 r.preempted = False
+                tm = telemetry.get_telemetry()
+                if tm.enabled:
+                    tm.serving_event("resumed")
+                    tm.record_request_phase(r.uid, "resume", _now(),
+                                            blocks=need)
 
     def _preempt_for_progress(self):
         """KV pressure relief (the ZeRO-Inference KV-offload path): push the
@@ -173,12 +209,19 @@ class SplitFuseScheduler:
         if len(candidates) < 1 or active < 2:
             return False  # alone: preempting would free blocks we then re-need
         victim = max(candidates, key=blocks_of)
+        n_blocks = blocks_of(victim)
         self._engine.preempt(victim.uid)
         victim.preempted = True
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.serving_event("preempted")
+            tm.record_request_phase(victim.uid, "preempt", _now(),
+                                    blocks=n_blocks)
         return True
 
     def step(self):
         """One scheduling round + forward. Returns uids finished this round."""
+        tm = telemetry.get_telemetry()
         self._try_resume()
         uids, chunks = self._compose()
         if not uids:
@@ -216,6 +259,22 @@ class SplitFuseScheduler:
                     f"{verdict.reason} (KV cache too small for any request?)")
             return []
         self._starved = 0
+        enabled = tm.enabled
+        if enabled:
+            t_fwd = _now()
+            sched_tokens = 0
+            was_prefilling = []
+            for row, uid in enumerate(uids):
+                r = self._requests[uid]
+                sched_tokens += len(chunks[row])
+                was_prefilling.append(r.prefilling)
+                if r.first_sched_ts == 0.0:
+                    r.first_sched_ts = t_fwd
+                    if r.submit_ts:
+                        tm.record_hist("serving/queue_wait_s",
+                                       t_fwd - r.submit_ts)
+                        tm.record_request_phase(uid, "queued", r.submit_ts,
+                                                t_fwd - r.submit_ts)
         if self._device_sampling:
             reqs = [self._requests[u] for u in uids]
             ids = self._engine.put_sampled(
@@ -228,6 +287,13 @@ class SplitFuseScheduler:
             logits = None
         else:
             logits = self._engine.put(uids, chunks)
+        if enabled:
+            t_done = _now()
+            fwd_dur = t_done - t_fwd
+            for row, uid in enumerate(uids):
+                tm.record_request_phase(
+                    uid, "prefill" if was_prefilling[row] else "decode",
+                    t_fwd, fwd_dur, tokens=len(chunks[row]))
         finished = []
         for row, uid in enumerate(uids):
             r = self._requests[uid]
@@ -238,11 +304,44 @@ class SplitFuseScheduler:
             tok = int(ids[row]) if logits is None else \
                 self._sample(r, logits[row])
             r.generated.append(tok)
+            if enabled:
+                if len(r.generated) == 1:
+                    # TTFT spans submit->first generated token; a request
+                    # submitted before telemetry came on anchors at t_fwd
+                    tm.record_hist("serving/ttft_s",
+                                   t_done - (r.submit_ts or t_fwd))
+                elif r.last_token_ts:
+                    tm.record_hist("serving/tpot_s", t_done - r.last_token_ts)
+                r.last_token_ts = t_done
             if (r.eos_token_id is not None and tok == r.eos_token_id) or \
                     len(r.generated) >= r.max_new_tokens:
                 r.done = True
                 self._engine.flush(uid)
                 finished.append(uid)
+                if enabled:
+                    tm.record_hist("serving/e2e_s",
+                                   t_done - (r.submit_ts or t_fwd))
+                    tm.serving_event("finished")
+                    tm.record_request_phase(uid, "finish", t_done,
+                                            new_tokens=len(r.generated))
+        if enabled:
+            running = waiting = preempted = 0
+            uid_set = set(uids)
+            for r in self._requests.values():
+                if r.done:
+                    continue
+                if r.preempted:
+                    preempted += 1
+                elif r.uid in uid_set:
+                    running += 1
+                else:
+                    waiting += 1
+            tm.serving_gauge("serving/token_budget_util",
+                             sched_tokens / self._budget)
+            tm.serving_gauge("serving/running", running)
+            tm.serving_gauge("serving/waiting", waiting)
+            tm.serving_gauge("serving/preempted", preempted)
+            self._engine.sample_kv_stats()
         return finished
 
     def _sample(self, r, row_logits):

@@ -70,6 +70,31 @@ def record_compile(program, seconds, topology=None, cache=None, memory=None):
                            memory=memory)
 
 
+def record_hist(name, value, **tags):
+    """One sample into a fixed-bucket log2 histogram (serving latencies)."""
+    _GLOBAL.record_hist(name, value, **tags)
+
+
+def hist_percentiles(name, qs=(0.5, 0.95, 0.99)):
+    """Percentile tuple for histogram ``name`` (None when empty)."""
+    return _GLOBAL.hist_percentiles(name, qs=qs)
+
+
+def serving_event(event, n=1, **tags):
+    """Count one request-lifecycle event (submitted/finished/evicted/...)."""
+    _GLOBAL.serving_event(event, n=n, **tags)
+
+
+def serving_gauge(name, value, **tags):
+    """Record a scheduler/KV gauge sample (last + peak + counter track)."""
+    _GLOBAL.serving_gauge(name, value, **tags)
+
+
+def record_request_phase(uid, phase, t0, dur=None, **args):
+    """One request-lifecycle phase on the request's Chrome-trace lane."""
+    _GLOBAL.record_request_phase(uid, phase, t0, dur=dur, **args)
+
+
 def record_memory(point, stats=None, device_index=0, **tags):
     """Record one HBM occupancy sample (no-op + None when disabled)."""
     return _GLOBAL.record_memory(point, stats=stats,

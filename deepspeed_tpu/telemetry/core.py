@@ -25,6 +25,14 @@ One object owns every measurement stream the runtime produces:
   ``compute / comm / compile / ckpt / stall / idle``, joined with the
   model's per-step FLOPs (``set_model_flops``) into per-step and rolling
   ``mfu`` and ``goodput`` gauges.
+- **serving stream** (``record_hist`` / ``serving_event`` /
+  ``serving_gauge`` / ``record_request_phase``): per-request lifecycle
+  latencies (TTFT, TPOT, e2e, queue-wait) land in fixed-bucket log2
+  histograms with p50/p95/p99 extraction; scheduler/KV gauges
+  (token-budget utilization, running/waiting, KV-block occupancy,
+  fragmentation) keep last+peak and a Chrome counter track; each request
+  gets its own Chrome-trace lane (a synthetic tid named ``request/<uid>``)
+  carrying its queued/prefill/decode/finish phases.
 
 Every JSON-lines record is stamped with ``(host, pid, run_id)`` so
 ``scripts/trace_merge.py`` can fold N per-host streams into one Chrome trace
@@ -45,6 +53,7 @@ jax is imported lazily inside the enabled-only paths.
 
 import atexit
 import json
+import math
 import os
 import socket
 import threading
@@ -83,6 +92,45 @@ def _ledger_category(span_name):
     if span_name == "dataloader":
         return "stall"
     return None
+
+
+#: fixed-bucket histogram geometry: bucket 0 holds values <= HIST_MIN (1us),
+#: bucket i holds (HIST_MIN*2^(i-1), HIST_MIN*2^i], the last bucket is the
+#: overflow (>~2400s). Log2 spacing bounds the per-sample cost to one
+#: ``math.log2`` and keeps relative quantile error within one octave, while
+#: observed min/max clamping (below) keeps reported percentiles exact at the
+#: distribution edges.
+HIST_BUCKETS = 44
+HIST_MIN = 1e-6
+
+
+def _hist_bucket(v):
+    if v <= HIST_MIN:
+        return 0
+    return min(1 + int(math.log2(v / HIST_MIN)), HIST_BUCKETS - 1)
+
+
+def _hist_bounds(i):
+    lo = 0.0 if i == 0 else HIST_MIN * 2.0 ** (i - 1)
+    return lo, HIST_MIN * 2.0 ** i
+
+
+def _hist_quantile(h, q):
+    """Quantile by cumulative bucket walk + linear interpolation inside the
+    landing bucket, clamped to the observed [min, max] (so a single-valued
+    histogram reports that exact value, and p50 <= p95 <= p99 always holds:
+    the walk is monotone in q and the clamp is order-preserving)."""
+    target = q * h["count"]
+    cum = 0
+    for i, c in enumerate(h["counts"]):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo, hi = _hist_bounds(i)
+            v = lo + (hi - lo) * (target - cum) / c
+            return min(max(v, h["min"]), h["max"])
+        cum += c
+    return h["max"]
 
 
 def _default_peak_flops():
@@ -235,6 +283,11 @@ class Telemetry:
         self.memory_samples = []  # {"point", "bytes_in_use", "peak_...", ...}
         self.memory_peak = 0      # process-level HBM watermark (bytes)
         self.last_oom_report = None
+        # serving stream
+        self.hist_stats = {}       # name -> {counts, count, sum, min, max}
+        self.serving_counters = {}  # lifecycle event -> count
+        self.serving_gauges = {}   # name -> [last, peak]
+        self._request_lanes = {}   # uid -> synthetic chrome tid
         # goodput ledger (seconds per category; idle derived at summary time)
         self.ledger_secs = {c: 0.0 for c in LEDGER_CATEGORIES if c != "idle"}
         self._ledger_epoch = self._epoch
@@ -461,6 +514,133 @@ class Telemetry:
                 tags["memory"] = entry["memory"]
             self._emit_jsonl({"name": f"compile/{program}", "kind": "seconds",
                               "value": seconds, "tags": tags})
+
+    # ------------------------------------------------------------------
+    # serving stream (docs/OBSERVABILITY.md "Serving")
+    # ------------------------------------------------------------------
+    def record_hist(self, name, value, **tags):
+        """One sample into the fixed-bucket log2 histogram ``name`` (values
+        in seconds for latency hists, but unitless values work too). The
+        aggregate — count/sum/min/max + per-bucket counts — feeds
+        ``hist_percentiles`` and ``summary()["serving"]["histograms"]``."""
+        if not self.enabled:
+            return
+        v = max(float(value), 0.0)
+        with self._lock:
+            h = self.hist_stats.get(name)
+            if h is None:
+                h = self.hist_stats[name] = {
+                    "counts": [0] * HIST_BUCKETS, "count": 0, "sum": 0.0,
+                    "min": float("inf"), "max": 0.0}
+            h["counts"][_hist_bucket(v)] += 1
+            h["count"] += 1
+            h["sum"] += v
+            if v < h["min"]:
+                h["min"] = v
+            if v > h["max"]:
+                h["max"] = v
+            self._emit_jsonl({"name": name, "kind": "hist", "value": v,
+                              "tags": tags or {}})
+
+    def hist_percentiles(self, name, qs=(0.5, 0.95, 0.99)):
+        """Percentiles of histogram ``name`` as a tuple aligned with ``qs``,
+        or None when the histogram has no samples."""
+        with self._lock:
+            h = self.hist_stats.get(name)
+            if not h or not h["count"]:
+                return None
+            return tuple(_hist_quantile(h, q) for q in qs)
+
+    def serving_event(self, event, n=1, **tags):
+        """Count one request-lifecycle event ("submitted", "finished",
+        "evicted", "preempted", "resumed", ...) — surfaced in
+        ``summary()["serving"]["requests"]``."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.serving_counters[event] = \
+                self.serving_counters.get(event, 0) + n
+            self._emit_jsonl({"name": f"serving/req/{event}",
+                              "kind": "counter", "value": n,
+                              "tags": tags or {}})
+
+    def serving_gauge(self, name, value, **tags):
+        """Record a scheduler/KV gauge sample: keeps last + peak, emits a
+        Chrome counter track ("C" event) and a JSONL line. Host-side values
+        only — callers must never sync the device to produce one."""
+        if not self.enabled:
+            return
+        v = float(value)
+        with self._lock:
+            g = self.serving_gauges.get(name)
+            if g is None:
+                self.serving_gauges[name] = [v, v]
+            else:
+                g[0] = v
+                if v > g[1]:
+                    g[1] = v
+            self.trace_events.append(
+                {"name": name, "ph": "C", "cat": "serving",
+                 "ts": round((time.perf_counter() - self._epoch) * 1e6, 3),
+                 "pid": os.getpid(), "args": {"value": v}})
+            self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
+                              "tags": tags or {}})
+
+    def record_request_phase(self, uid, phase, t0, dur=None, **args):
+        """One lifecycle phase of request ``uid`` on its own Chrome-trace
+        lane. Each uid gets a synthetic tid (named ``request/<uid>`` via a
+        one-time thread_name metadata event); ``dur`` seconds makes a
+        complete ("X") slice anchored at perf_counter time ``t0``, ``dur``
+        None makes an instant ("i") marker (finish/evict/preempt/resume)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            tid = self._request_lanes.get(uid)
+            if tid is None:
+                # lanes sort after the real-thread tids (0xffff mask above)
+                tid = 0x10000 + (len(self._request_lanes) & 0xFFFF)
+                self._request_lanes[uid] = tid
+                self.trace_events.append(
+                    {"name": "thread_name", "ph": "M", "pid": os.getpid(),
+                     "tid": tid, "args": {"name": f"request/{uid}"}})
+            ev = {"name": f"req/{phase}", "cat": "serving",
+                  "ts": round((t0 - self._epoch) * 1e6, 3),
+                  "pid": os.getpid(), "tid": tid,
+                  "args": {"uid": uid, **args}}
+            if dur is None:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            else:
+                ev["ph"] = "X"
+                ev["dur"] = round(dur * 1e6, 3)
+            self.trace_events.append(ev)
+            self._emit_jsonl({"name": f"serving/phase/{phase}",
+                              "kind": "span", "value": dur or 0.0,
+                              "tags": {"uid": uid, **args}})
+
+    def _serving_summary(self):
+        # caller holds self._lock
+        hists = {}
+        for name, h in sorted(self.hist_stats.items()):
+            if h["count"]:
+                p50, p95, p99 = (_hist_quantile(h, q)
+                                 for q in (0.5, 0.95, 0.99))
+                entry = {"count": h["count"],
+                         "mean_s": round(h["sum"] / h["count"], 6),
+                         "min_s": round(h["min"], 6),
+                         "max_s": round(h["max"], 6),
+                         "p50_s": round(p50, 6), "p95_s": round(p95, 6),
+                         "p99_s": round(p99, 6)}
+            else:
+                entry = {"count": 0, "mean_s": 0.0, "min_s": 0.0,
+                         "max_s": 0.0, "p50_s": 0.0, "p95_s": 0.0,
+                         "p99_s": 0.0}
+            hists[name] = entry
+        gauges = {name: {"last": round(g[0], 6), "peak": round(g[1], 6)}
+                  for name, g in sorted(self.serving_gauges.items())}
+        return {"requests": {k: int(v) for k, v in
+                             sorted(self.serving_counters.items())},
+                "histograms": hists, "gauges": gauges}
 
     # ------------------------------------------------------------------
     # memory stream
@@ -723,7 +903,8 @@ class Telemetry:
                                 "cache_hits": hits, "cache_misses": misses},
                     "counters": counters,
                     "memory": memory,
-                    "ledger": self._ledger_summary()}
+                    "ledger": self._ledger_summary(),
+                    "serving": self._serving_summary()}
 
     def format_summary(self):
         """DeepSpeed-style fixed-width tables over every stream."""
@@ -775,6 +956,18 @@ class Telemetry:
             lines.append(f"hbm peak: {mem['peak_bytes']} bytes"
                          f"  ({mem['sample_count']} samples"
                          f"{', OOM observed' if mem['oom'] else ''})")
+        srv = s.get("serving", {})
+        if srv.get("histograms"):
+            lines.append(f"{'Serving hist':<26}{'Count':<8}{'p50(ms)':<12}"
+                         f"{'p95(ms)':<12}{'p99(ms)':<12}")
+            for name, st in srv["histograms"].items():
+                lines.append(f"{name:<26}{st['count']:<8}"
+                             f"{st['p50_s']*1e3:<12.2f}"
+                             f"{st['p95_s']*1e3:<12.2f}"
+                             f"{st['p99_s']*1e3:<12.2f}")
+        if srv.get("requests"):
+            lines.append("requests: " + "  ".join(
+                f"{k}={v}" for k, v in srv["requests"].items()))
         return "\n".join(lines) if lines else "telemetry: no samples"
 
     def log_summary(self, print_log=True):
@@ -810,4 +1003,15 @@ class Telemetry:
         if led["steps"]:
             events.append((f"{p}Ledger/mfu", led["mfu_rolling"], step))
             events.append((f"{p}Ledger/goodput", led["goodput"], step))
+        srv = s.get("serving", {})
+        for name, st in srv.get("histograms", {}).items():
+            if st["count"]:
+                leaf = name.rsplit("/", 1)[-1]
+                events.append((f"{p}Serving/{leaf}_p50_ms",
+                               st["p50_s"] * 1e3, step))
+                events.append((f"{p}Serving/{leaf}_p99_ms",
+                               st["p99_s"] * 1e3, step))
+        for name, g in srv.get("gauges", {}).items():
+            leaf = name.rsplit("/", 1)[-1]
+            events.append((f"{p}Serving/{leaf}", g["last"], step))
         return events

@@ -1,0 +1,236 @@
+"""Shared flock-based chip lease + backend init retry.
+
+ROADMAP item 5: every bench round so far died because the TPU was held by
+another process — and the holders were usually OUR OWN concurrent legs
+(pytest, bench, scripts) racing for the chip. The fix is a single lease
+protocol shared by every entrypoint: one ``flock``'d file per host; whoever
+holds it owns the chip, everyone else QUEUES (bounded) instead of wedging
+the backend and killing both runs.
+
+``flock`` gives exactly the semantics a crashy harness needs: the lock dies
+with the process (SIGKILL included), so a crashed bench can never wedge the
+queue the way a stale libtpu lockholder wedges the chip. Holder metadata
+(pid/run id/argv) is written into the lock file for diagnostics — readable
+by waiters even while locked.
+
+CPU-pinned runs (``JAX_PLATFORMS=cpu`` or the in-Python pin) skip the lease
+entirely: there is no chip to serialize on, and the tier-1 CPU lane must
+never queue behind a TPU job.
+
+``init_backend_with_retry`` — previously bench.py-private — lives here so
+``bench.py``, ``scripts/bench_serving.py``, ``scripts/bench_llama.py`` and
+the ``onchip`` pytest marker (tests/conftest.py) all share one probe/retry/
+lease path. bench.py injects its stale-holder ``_active_recovery`` as the
+``recovery`` hook; the kill policy stays there — this module only queues.
+"""
+
+import atexit
+import json
+import os
+import sys
+import tempfile
+import time
+
+#: default bound on how long a waiter queues for the chip before giving up
+#: (seconds). Long on purpose: the queue exists so concurrent runs SERIALIZE;
+#: a short timeout would just reintroduce the wedge-and-die failure mode.
+LEASE_TIMEOUT_S = float(os.environ.get("DS_TPU_CHIP_LEASE_TIMEOUT", "1800"))
+
+
+def default_lock_path():
+    """One lock file per host (override: DS_TPU_CHIP_LOCK). tempdir, not the
+    repo: two checkouts benching the same chip must share the lease."""
+    return os.environ.get("DS_TPU_CHIP_LOCK") or \
+        os.path.join(tempfile.gettempdir(), "ds_tpu_chip.lease")
+
+
+def cpu_only():
+    """True when this process is pinned to CPU (env var or the in-Python
+    ``jax.config`` pin — the axon sitecustomize ignores the env var, so the
+    in-Python pin is the one that counts when jax is already imported)."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats and all(p.strip() in ("cpu", "") for p in plats.split(",")):
+        return True
+    if "jax" in sys.modules:
+        try:
+            import jax
+            pin = getattr(jax.config, "jax_platforms", None)
+            if pin and all(p.strip() in ("cpu", "")
+                           for p in str(pin).split(",")):
+                return True
+        except Exception:
+            pass
+    return False
+
+
+class ChipLeaseTimeout(TimeoutError):
+    """The lease stayed held past the waiter's deadline."""
+
+
+class ChipLease:
+    """An exclusive ``flock`` on the per-host chip lock file.
+
+    Usable as a context manager; ``acquire`` polls (the lock holder may be
+    another process OR another fd in this process — both conflict, which is
+    what makes the protocol testable without subprocesses)."""
+
+    def __init__(self, name="harness", path=None):
+        self.name = name
+        self.path = path or default_lock_path()
+        self._fh = None
+
+    @property
+    def held(self):
+        return self._fh is not None
+
+    def holder(self):
+        """Metadata JSON of the current/most-recent holder, or None."""
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except Exception:
+            return None
+
+    def acquire(self, timeout_s=None, poll_s=1.0):
+        if self._fh is not None:
+            return self
+        import fcntl
+        if timeout_s is None:
+            timeout_s = LEASE_TIMEOUT_S
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fh = open(self.path, "a+")
+        deadline = time.monotonic() + timeout_s
+        next_warn = 0.0
+        while True:
+            try:
+                fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                now = time.monotonic()
+                if now >= deadline:
+                    fh.close()
+                    raise ChipLeaseTimeout(
+                        f"chip lease {self.path} still held after "
+                        f"{timeout_s:.0f}s (holder: {self.holder()})")
+                if now >= next_warn:
+                    print(f"chip_lease: {self.name} queued for {self.path} "
+                          f"(holder: {self.holder()})", file=sys.stderr)
+                    next_warn = now + 30.0
+                time.sleep(min(poll_s, max(deadline - now, 0.01)))
+        self._fh = fh
+        try:  # holder metadata for waiters' diagnostics (best-effort)
+            fh.seek(0)
+            fh.truncate()
+            json.dump({"name": self.name, "pid": os.getpid(),
+                       "run_id": os.environ.get("DS_TPU_HARNESS_RUN_ID"),
+                       "argv": sys.argv[:4],
+                       "acquired_at": time.strftime("%Y-%m-%d %H:%M:%S")},
+                      fh)
+            fh.flush()
+        except OSError:
+            pass
+        return self
+
+    def release(self):
+        if self._fh is None:
+            return
+        import fcntl
+        try:
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+            self._fh.close()
+        except Exception:
+            pass
+        finally:
+            self._fh = None
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+_PROCESS_LEASE = None
+
+
+def process_lease(name="harness", timeout_s=None, path=None):
+    """Acquire the chip lease ONCE for this process's lifetime (released at
+    exit; flock also drops it on any crash). Returns the lease, or None on
+    CPU-pinned runs where there is no chip to serialize on."""
+    global _PROCESS_LEASE
+    if cpu_only():
+        return None
+    if _PROCESS_LEASE is not None and _PROCESS_LEASE.held:
+        return _PROCESS_LEASE
+    lease = ChipLease(name=name, path=path)
+    lease.acquire(timeout_s=timeout_s)
+    _PROCESS_LEASE = lease
+    atexit.register(lease.release)
+    return lease
+
+
+def init_backend_with_retry(attempts=None, backoff_s=None,
+                            probe_timeout_s=None, recovery=None,
+                            lease_name="harness", lease_timeout_s=None):
+    """Take the chip lease, then initialize the JAX backend with a
+    subprocess probe + bounded retries (moved here from bench.py so every
+    entrypoint shares it).
+
+    A held/wedged chip either raises RuntimeError('Unable to initialize
+    backend ...') or HANGS; the child-process probe
+    (``utils/backend_probe.probe_backend``) takes the hang with a deadline
+    so the caller keeps control. ``recovery`` (optional callable) runs after
+    each failed attempt and may return a holder list — bench.py passes its
+    stale-holder reaper. Returns the device list, or raises the last error
+    (with ``.bench_holders`` attached when recovery reported any)."""
+    if attempts is None:
+        attempts = int(os.environ.get("DS_BENCH_INIT_ATTEMPTS", "4"))
+    if backoff_s is None:
+        backoff_s = float(os.environ.get("DS_BENCH_INIT_BACKOFF", "15"))
+    # queue for the chip BEFORE probing: a probe racing the holder would
+    # read "hang" and burn retry budget on a chip that was merely busy
+    process_lease(name=lease_name, timeout_s=lease_timeout_s)
+    from deepspeed_tpu.utils.backend_probe import probe_backend
+    last = None
+    holders_seen = []
+    for attempt in range(1, attempts + 1):
+        try:
+            kind, detail = probe_backend(timeout_s=probe_timeout_s)
+            if kind == "hang":
+                raise RuntimeError(f"backend init UNAVAILABLE: {detail}")
+            if kind != "ok":
+                raise RuntimeError(f"backend {detail}")
+            import jax
+            devs = jax.devices()
+            if devs:
+                return devs
+        except Exception as e:
+            last = e
+            print(f"chip_lease: backend init attempt {attempt}/{attempts} "
+                  f"failed: {type(e).__name__}: {e}", file=sys.stderr)
+            if recovery is not None:
+                try:
+                    holders_seen = recovery() or holders_seen
+                except Exception as rec_err:
+                    print(f"chip_lease: recovery hook failed: {rec_err}",
+                          file=sys.stderr)
+            # the parent's own init can fail transiently even when the probe
+            # succeeded (chip grabbed in between); jax caches the failed
+            # backend — clear it so the next attempt re-probes
+            try:
+                import jax
+                jax.extend.backend.clear_backends()
+            except Exception:
+                try:
+                    import jax
+                    jax.clear_backends()
+                except Exception:
+                    pass
+        if attempt < attempts:
+            time.sleep(backoff_s * attempt)
+    if last is not None and holders_seen:
+        last.bench_holders = holders_seen  # surfaced in the error JSON
+    raise last if last is not None else RuntimeError("no devices found")

@@ -55,7 +55,7 @@ def main():
             return
 
     try:
-        devs = bench.init_backend_with_retry()
+        devs = bench.init_backend_with_retry(lease_name="bench_llama")
     except Exception as e:
         bench.emit({"metric": "llama500m_bf16_zero3_tokens_per_sec_per_chip",
                     "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,

@@ -1,11 +1,24 @@
-"""On-chip serving throughput: SplitFuse continuous batching + W8A16 check.
+"""On-chip serving benchmarks: SplitFuse throughput, traffic replay, W8A16.
 
-VERDICT r2 #9: measure InferenceEngineV2 + SplitFuseScheduler tokens/s at a
-fixed prompt/decode mix on real hardware, and validate the fused W8A16
-quantized matmul (ops/pallas/quantized_matmul) against the fp path. Prints
-ONE JSON line per section (serving, w8a16), plus a combined summary line.
+VERDICT r2 #9 plus the serving-observability stream (PR 6):
 
-Usage: python scripts/bench_serving.py [--requests N] [--prompt T] [--new T]
+- ``serving_bench`` — fixed prompt/decode mix, peak tokens/s (the original
+  throughput number).
+- ``--replay`` — a seeded traffic-replay harness: heavy-tailed
+  (lognormal) prompt/output-length mixes and Poisson or burst arrival
+  schedules, submitted on a wall clock against the live scheduler. Emits the
+  latency numbers a serving stack is actually judged on — p50/p99 TTFT,
+  p50/p99 TPOT, tokens/s/chip, peak KV-block occupancy — sourced from the
+  telemetry serving histograms/gauges, and gated by scripts/perf_gate.py.
+- ``w8a16_check`` — fused W8A16 quantized matmul vs the fp reference.
+
+Prints ONE JSON line per section plus stderr progress. ``DS_TPU_TELEMETRY=1``
+additionally embeds the full telemetry summary in each payload's ``extra``
+(same contract as bench.py; docs/OBSERVABILITY.md has the schema).
+
+Usage: python scripts/bench_serving.py [--replay] [--requests N] [--seed S]
+           [--arrival poisson|burst] [--rate R] [--burst-size B]
+           [--prompt T] [--new T]
 """
 
 import argparse
@@ -16,15 +29,49 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import bench  # probe/retry + emit
+import bench  # chip lease + probe/retry + emit
 
 
-def serving_bench(args, on_tpu):
+def _embed_telemetry(extra):
+    """DS_TPU_TELEMETRY=1 -> fold the unified-telemetry summary into the
+    payload (bench.py behavior)."""
+    if os.environ.get("DS_TPU_TELEMETRY") != "1":
+        return
+    from deepspeed_tpu import telemetry
+    extra["telemetry"] = telemetry.summary()
+
+
+def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
+                 num_kv_blocks=None):
     import jax
     import numpy as np
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
     from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
-    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+
+    block = 32 if on_tpu else 8
+    max_ctx = prompt_len + new_tokens + block
+    if num_kv_blocks is None:
+        num_kv_blocks = max(64, (max_ctx // block + 2) * n_req)
+    engine = InferenceEngineV2(model, params, config={
+        "state_manager": {
+            "max_ragged_sequence_count": max(4, n_req) + 1,  # +1 warmup
+            "max_ragged_batch_size": budget,
+            "max_context": max_ctx,
+            "num_kv_blocks": num_kv_blocks},
+        "kv_cache": {"block_size": block,
+                     "cache_dtype": "bf16" if on_tpu else "fp32"}})
+    return model, SplitFuseScheduler(engine, token_budget=budget)
+
+
+def serving_bench(args, on_tpu):
+    import numpy as np
+    from deepspeed_tpu.models.llama import LlamaConfig
 
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
@@ -38,22 +85,9 @@ def serving_bench(args, on_tpu):
         cfg = LlamaConfig.tiny(remat=False)
         n_req, prompt_len, new_tokens, budget = 2, 24, 4, 16
 
-    model = LlamaForCausalLM(cfg)
+    model, sched = _build_stack(cfg, n_req, prompt_len, new_tokens, budget,
+                                on_tpu)
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (1, 8)).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
-
-    block = 32 if on_tpu else 8
-    max_ctx = prompt_len + new_tokens + block
-    engine = InferenceEngineV2(model, params, config={
-        "state_manager": {
-            "max_ragged_sequence_count": max(4, n_req),
-            "max_ragged_batch_size": budget,
-            "max_context": max_ctx,
-            "num_kv_blocks": max(64, (max_ctx // block + 2) * n_req)},
-        "kv_cache": {"block_size": block,
-                     "cache_dtype": "bf16" if on_tpu else "fp32"}})
-    sched = SplitFuseScheduler(engine, token_budget=budget)
     prompts = {u: rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32)
                for u in range(n_req)}
 
@@ -73,16 +107,152 @@ def serving_bench(args, on_tpu):
     # warmup uid, whose tokens were generated before the timer started
     decoded = sum(len(got[u]) for u in prompts)
     total = decoded + n_req * prompt_len
+    extra = {"decode_tokens_per_sec": round(decoded / dt, 1),
+             "requests": n_req, "prompt_len": prompt_len,
+             "new_tokens": new_tokens, "token_budget": budget,
+             "wall_s": round(dt, 2),
+             "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}"}
+    _embed_telemetry(extra)
     payload = {
         "metric": "splitfuse_serving_tokens_per_sec",
         "value": round(total / dt, 1),
         "unit": "tokens/s (prefill+decode)",
         "vs_baseline": None,
-        "extra": {"decode_tokens_per_sec": round(decoded / dt, 1),
-                  "requests": n_req, "prompt_len": prompt_len,
-                  "new_tokens": new_tokens, "token_budget": budget,
-                  "wall_s": round(dt, 2),
-                  "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}"},
+        "extra": extra,
+    }
+    bench.emit(payload)
+    return payload
+
+
+def make_workload(n_req, seed, arrival="poisson", rate=4.0, burst_size=4,
+                  prompt_scale=256, new_scale=64, max_prompt=2048,
+                  max_new=512):
+    """Seeded request trace: heavy-tailed lengths + an arrival schedule.
+
+    Lengths are lognormal (the shape real prompt/completion mixes follow —
+    most requests short, a fat tail of long ones). Arrivals are either
+    ``poisson`` (exponential gaps at ``rate`` req/s — open-loop steady
+    traffic) or ``burst`` (groups of ``burst_size`` land simultaneously,
+    groups spaced to the same average rate — the queue-depth stress case).
+    Same seed -> identical trace, so perf_gate compares like against like.
+    """
+    import numpy as np
+    gen = np.random.default_rng(seed)
+    prompt_lens = np.clip(
+        gen.lognormal(np.log(prompt_scale), 0.7, n_req), 4, max_prompt
+    ).astype(np.int64)
+    out_lens = np.clip(
+        gen.lognormal(np.log(new_scale), 0.6, n_req), 1, max_new
+    ).astype(np.int64)
+    if arrival == "poisson":
+        arrivals = np.cumsum(gen.exponential(1.0 / rate, n_req))
+    elif arrival == "burst":
+        n_groups = -(-n_req // burst_size)
+        group_t = np.arange(n_groups) * (burst_size / rate)
+        arrivals = np.repeat(group_t, burst_size)[:n_req]
+    else:
+        raise ValueError(f"unknown arrival schedule {arrival!r}")
+    arrivals -= arrivals[0]  # first request lands at t=0
+    return prompt_lens, out_lens, arrivals
+
+
+def replay_bench(args, on_tpu):
+    """Wall-clock traffic replay; latency percentiles from the telemetry
+    serving stream."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=args.prompt + args.new + 64,
+                          remat=False)
+        n_req = args.requests
+        prompt_scale, new_scale = args.prompt // 2, args.new
+        max_prompt, max_new = args.prompt, args.new * 4
+        budget, rate = 256, args.rate
+    else:
+        cfg = LlamaConfig.tiny(remat=False)
+        n_req = min(args.requests, 6)
+        prompt_scale, new_scale = 16, 3
+        max_prompt, max_new = 48, 8
+        budget, rate = 16, max(args.rate, 20.0)
+
+    prompt_lens, out_lens, arrivals = make_workload(
+        n_req, args.seed, arrival=args.arrival, rate=rate,
+        burst_size=args.burst_size, prompt_scale=prompt_scale,
+        new_scale=new_scale, max_prompt=max_prompt, max_new=max_new)
+    model, sched = _build_stack(cfg, n_req, int(max_prompt), int(max_new),
+                                budget, on_tpu)
+    gen = np.random.default_rng(args.seed)
+    prompts = [gen.integers(0, cfg.vocab_size, int(n)).astype(np.int32)
+               for n in prompt_lens]
+
+    # compile before the clock starts — replay measures serving latency,
+    # not jit time
+    t0 = time.perf_counter()
+    sched.submit(10_000, prompts[0][:max(4, int(prompt_lens.min()))],
+                 max_new_tokens=2)
+    sched.run_to_completion()
+    print(f"replay: warmup/compile {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+
+    # the replay's latency numbers COME from the serving telemetry stream;
+    # (re)start it clean after warmup so compile never pollutes TTFT — even
+    # when DS_TPU_TELEMETRY=1 enabled it earlier
+    telemetry.reset()
+    telemetry.configure(enabled=True, sample_sync=False,
+                        chrome_trace_path=os.environ.get(
+                            "DS_TPU_TELEMETRY_TRACE", ""))
+    tm = telemetry.get_telemetry()
+
+    t_start = time.perf_counter()
+    nxt = 0
+    while nxt < n_req or sched.has_work:
+        now = time.perf_counter() - t_start
+        while nxt < n_req and arrivals[nxt] <= now:
+            sched.submit(nxt, prompts[nxt],
+                         max_new_tokens=int(out_lens[nxt]))
+            nxt += 1
+        if sched.has_work:
+            sched.step()
+        elif nxt < n_req:
+            # open-loop: idle until the next arrival is due
+            time.sleep(min(float(arrivals[nxt]) - now, 0.05))
+    wall = time.perf_counter() - t_start
+
+    decoded = sum(len(r.generated) for u, r in sched._requests.items()
+                  if u != 10_000)
+    total = decoded + int(prompt_lens.sum())
+    n_chips = jax.device_count()
+    ttft = tm.hist_percentiles("serving/ttft_s", (0.5, 0.99)) or (0.0, 0.0)
+    tpot = tm.hist_percentiles("serving/tpot_s", (0.5, 0.99)) or (0.0, 0.0)
+    serving = telemetry.summary()["serving"]
+    kv_gauge = serving["gauges"].get("serving/kv_occupancy", {})
+    extra = {
+        "ttft_p50_s": round(ttft[0], 6), "ttft_p99_s": round(ttft[1], 6),
+        "tpot_p50_s": round(tpot[0], 6), "tpot_p99_s": round(tpot[1], 6),
+        "tokens_per_sec": round(total / wall, 1),
+        "decode_tokens_per_sec": round(decoded / wall, 1),
+        "peak_kv_occupancy": round(float(kv_gauge.get("peak", 0.0)), 6),
+        "preemptions": int(serving["requests"].get("preempted", 0)),
+        "requests": n_req, "seed": args.seed, "arrival": args.arrival,
+        "rate_req_per_s": rate,
+        "prompt_tokens_total": int(prompt_lens.sum()),
+        "decode_tokens_total": int(decoded),
+        "wall_s": round(wall, 2), "chips": n_chips,
+        "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}",
+    }
+    _embed_telemetry(extra)
+    payload = {
+        "metric": "serving_replay_tokens_per_sec_per_chip",
+        "value": round(total / wall / max(n_chips, 1), 1),
+        "unit": "tokens/s/chip (prefill+decode)",
+        "vs_baseline": None,
+        "extra": extra,
     }
     bench.emit(payload)
     return payload
@@ -128,19 +298,47 @@ def main():
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--prompt", type=int, default=512)
     ap.add_argument("--new", type=int, default=64)
+    ap.add_argument("--replay", action="store_true",
+                    help="traffic-replay mode: seeded heavy-tailed lengths + "
+                         "arrival schedule; emits TTFT/TPOT percentiles")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--arrival", choices=("poisson", "burst"),
+                    default="poisson")
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="mean arrival rate, requests/s")
+    ap.add_argument("--burst-size", type=int, default=4)
     args = ap.parse_args()
+
+    # DS_TPU_TELEMETRY=1: same contract as bench.py — enable the unified
+    # telemetry stream up front; summaries land in each payload's extra
+    if os.environ.get("DS_TPU_TELEMETRY") == "1":
+        from deepspeed_tpu import telemetry
+        telemetry.configure(enabled=True, sample_sync=False,
+                            chrome_trace_path=os.environ.get(
+                                "DS_TPU_TELEMETRY_TRACE", ""))
+
+    metric = ("serving_replay_tokens_per_sec_per_chip" if args.replay
+              else "splitfuse_serving_tokens_per_sec")
     try:
-        devs = bench.init_backend_with_retry()
+        devs = bench.init_backend_with_retry(lease_name="bench_serving")
     except Exception as e:
-        bench.emit({"metric": "splitfuse_serving_tokens_per_sec", "value": 0.0,
+        bench.emit({"metric": metric, "value": 0.0,
                     "unit": "tokens/s", "vs_baseline": None,
                     "extra": {"error": f"{type(e).__name__}: {e}"[:300]}})
         return
     on_tpu = devs[0].platform in ("tpu", "axon")
+    if args.replay:
+        try:
+            replay_bench(args, on_tpu)
+        except Exception as e:
+            bench.emit({"metric": metric, "value": 0.0,
+                        "unit": "tokens/s/chip", "vs_baseline": None,
+                        "extra": {"error": f"{type(e).__name__}: {e}"[:400]}})
+        return
     try:
         serving_bench(args, on_tpu)
     except Exception as e:
-        bench.emit({"metric": "splitfuse_serving_tokens_per_sec", "value": 0.0,
+        bench.emit({"metric": metric, "value": 0.0,
                     "unit": "tokens/s", "vs_baseline": None,
                     "extra": {"error": f"{type(e).__name__}: {e}"[:400]}})
     try:

@@ -1,4 +1,5 @@
-"""Automated perf gate: fail loudly on throughput/MFU/HBM/compile regression.
+"""Automated perf gate: fail loudly on throughput/MFU/HBM/compile/serving
+regression.
 
 Compares a CANDIDATE measurement (a ``BENCH_*.json`` payload, a
 ``telemetry.summary()`` dict, or a ``BASELINE.json``-style doc) against a
@@ -8,6 +9,11 @@ BASELINE of any of the same shapes, with configurable relative thresholds:
         --candidate BENCH_r07.json \
         --max-tokens-drop 0.10 --max-mfu-drop 0.10 \
         --max-hbm-growth 0.10 --max-compile-growth 0.50
+
+Serving-path metrics (``bench_serving.py --replay`` payloads or a summary's
+``serving`` section) gate the latency direction: TTFT/TPOT p50+p99 and peak
+KV-block occupancy regress when they GROW (``--max-ttft-growth``,
+``--max-tpot-growth``, ``--max-kv-occupancy-growth``).
 
 Only metrics present on BOTH sides are compared (an empty baseline —
 ``BASELINE.json`` before any published number — passes with a warning, so
@@ -44,7 +50,18 @@ GATES = {
     "goodput": ("down", "max_goodput_drop"),
     "peak_hbm_bytes": ("up", "max_hbm_growth"),
     "compile_seconds": ("up", "max_compile_growth"),
+    # serving latency (bench_serving --replay / summary["serving"]): higher
+    # is a regression
+    "ttft_p50_s": ("up", "max_ttft_growth"),
+    "ttft_p99_s": ("up", "max_ttft_growth"),
+    "tpot_p50_s": ("up", "max_tpot_growth"),
+    "tpot_p99_s": ("up", "max_tpot_growth"),
+    "peak_kv_occupancy": ("up", "max_kv_occupancy_growth"),
 }
+
+#: extra/doc keys lifted verbatim into the metric dict when positive
+SERVING_KEYS = ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
+                "peak_kv_occupancy")
 
 
 def load_doc(path):
@@ -100,6 +117,14 @@ def extract_metrics(doc):
                     m["peak_hbm_bytes"] = v
             except (TypeError, ValueError):
                 pass
+        for key in SERVING_KEYS:
+            if key in src and key not in m:
+                try:
+                    v = float(src[key])
+                    if v > 0:
+                        m[key] = v
+                except (TypeError, ValueError):
+                    pass
     # BASELINE.json: {"published": {metric: value, ...}}
     pub = doc.get("published")
     if isinstance(pub, dict):
@@ -129,6 +154,22 @@ def extract_metrics(doc):
                     if isinstance(p, dict))
         if total > 0 and "compile_seconds" not in m:
             m["compile_seconds"] = total
+        # serving stream: TTFT/TPOT percentiles + peak KV occupancy
+        srv = s.get("serving", {})
+        hists = srv.get("histograms", {}) if isinstance(srv, dict) else {}
+        for hist_name, prefix in (("serving/ttft_s", "ttft"),
+                                  ("serving/tpot_s", "tpot")):
+            h = hists.get(hist_name)
+            if isinstance(h, dict) and h.get("count"):
+                for q in ("p50_s", "p99_s"):
+                    key = f"{prefix}_{q}"
+                    if key not in m and h.get(q, 0) > 0:
+                        m[key] = float(h[q])
+        g = srv.get("gauges", {}).get("serving/kv_occupancy") \
+            if isinstance(srv, dict) else None
+        if isinstance(g, dict) and g.get("peak", 0) > 0 and \
+                "peak_kv_occupancy" not in m:
+            m["peak_kv_occupancy"] = float(g["peak"])
     return m
 
 
@@ -203,6 +244,39 @@ def validate_summary(doc):
     return None
 
 
+def validate_serving_payload(doc):
+    """Shape-check a bench_serving --replay payload: a SUCCESSFUL run (value
+    > 0) must carry every serving metric, with finite ordered percentiles.
+    Error payloads (value 0 + extra.error) pass untouched. Pure dict checks —
+    runs in the tier-1 dry-run lane without jax or jsonschema. Returns an
+    error string or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "serving_replay" not in str(doc.get("metric", "")):
+        return None
+    try:
+        if float(doc.get("value", 0)) <= 0:
+            return None
+    except (TypeError, ValueError):
+        return None
+    extra = doc.get("extra")
+    if not isinstance(extra, dict):
+        return "serving replay payload has no extra dict"
+    for key in SERVING_KEYS:
+        v = extra.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            return f"serving replay payload: extra[{key!r}] missing or " \
+                   f"non-numeric (got {v!r})"
+        if not (v == v and abs(v) != float("inf")):
+            return f"serving replay payload: extra[{key!r}] not finite"
+    for prefix in ("ttft", "tpot"):
+        if extra[f"{prefix}_p50_s"] > extra[f"{prefix}_p99_s"]:
+            return f"serving replay payload: {prefix} p50 > p99"
+    if not 0.0 <= extra["peak_kv_occupancy"] <= 1.0:
+        return "serving replay payload: peak_kv_occupancy outside [0, 1]"
+    return None
+
+
 def compare(baseline, candidate, thresholds):
     """-> (verdicts, regressed). Only metrics on both sides are gated."""
     verdicts = []
@@ -240,6 +314,9 @@ def main(argv=None):
     ap.add_argument("--max-goodput-drop", type=float, default=0.10)
     ap.add_argument("--max-hbm-growth", type=float, default=0.10)
     ap.add_argument("--max-compile-growth", type=float, default=0.50)
+    ap.add_argument("--max-ttft-growth", type=float, default=0.10)
+    ap.add_argument("--max-tpot-growth", type=float, default=0.10)
+    ap.add_argument("--max-kv-occupancy-growth", type=float, default=0.10)
     ap.add_argument("--dry-run", action="store_true",
                     help="validate inputs (parse + summary schema) only")
     args = ap.parse_args(argv)
@@ -252,7 +329,7 @@ def main(argv=None):
     for label, doc in docs.items():
         if doc is None:
             return 2
-        err = validate_summary(doc)
+        err = validate_summary(doc) or validate_serving_payload(doc)
         if err:
             print(f"perf_gate: {label}: {err}", file=sys.stderr)
             return 2
@@ -282,7 +359,10 @@ def main(argv=None):
                   "max_mfu_drop": args.max_mfu_drop,
                   "max_goodput_drop": args.max_goodput_drop,
                   "max_hbm_growth": args.max_hbm_growth,
-                  "max_compile_growth": args.max_compile_growth}
+                  "max_compile_growth": args.max_compile_growth,
+                  "max_ttft_growth": args.max_ttft_growth,
+                  "max_tpot_growth": args.max_tpot_growth,
+                  "max_kv_occupancy_growth": args.max_kv_occupancy_growth}
     verdicts, regressed = compare(base_m, cand_m, thresholds)
     result = {"compared": len(verdicts), "regressed": regressed,
               "verdicts": verdicts,

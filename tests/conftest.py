@@ -42,6 +42,16 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+def pytest_runtest_setup(item):
+    """``onchip``-marked tests queue on the shared chip lease before touching
+    the accelerator, so a concurrent bench and pytest serialize instead of
+    wedging the TPU. Under the CPU pin above this is a no-op (process_lease
+    returns None); the lease is process-wide and released at exit."""
+    if item.get_closest_marker("onchip") is not None:
+        from deepspeed_tpu.utils import chip_lease
+        chip_lease.process_lease(name="pytest")
+
+
 @pytest.fixture(autouse=True)
 def _reset_groups():
     """Each test gets a fresh global topology registry."""

@@ -1,0 +1,105 @@
+"""Shared chip lease (deepspeed_tpu/utils/chip_lease.py): flock semantics,
+holder metadata, CPU-pin bypass, and the shared backend-init retry loop.
+
+flock conflicts are per-fd, not per-process, so two ChipLease objects in one
+process genuinely contend — the queueing protocol is testable without
+subprocesses.
+"""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.utils import chip_lease
+from deepspeed_tpu.utils.chip_lease import ChipLease, ChipLeaseTimeout
+
+
+def test_lease_excludes_and_queues(tmp_path):
+    path = str(tmp_path / "chip.lease")
+    a = ChipLease(name="bench", path=path)
+    b = ChipLease(name="pytest", path=path)
+    a.acquire(timeout_s=1)
+    assert a.held
+
+    # waiter sees WHO holds the chip
+    holder = b.holder()
+    assert holder["name"] == "bench" and holder["pid"] == os.getpid()
+
+    with pytest.raises(ChipLeaseTimeout, match="held after"):
+        b.acquire(timeout_s=0.2, poll_s=0.02)
+    assert not b.held
+
+    # release -> the waiter gets in
+    a.release()
+    assert not a.held
+    b.acquire(timeout_s=1, poll_s=0.02)
+    assert b.held and b.holder()["name"] == "pytest"
+    b.release()
+
+
+def test_lease_context_manager_and_reentry(tmp_path):
+    path = str(tmp_path / "chip.lease")
+    lease = ChipLease(name="ctx", path=path)
+    with lease:
+        assert lease.held
+        assert lease.acquire(timeout_s=0.1) is lease  # re-acquire is a no-op
+    assert not lease.held
+    lease.release()  # idempotent
+
+
+def test_cpu_pin_skips_lease(tmp_path, monkeypatch):
+    """The tier-1 CPU lane must never queue behind a TPU job: under the CPU
+    pin (env var or conftest's in-Python jax.config pin) process_lease is a
+    no-op."""
+    monkeypatch.setattr(chip_lease, "_PROCESS_LEASE", None)
+    monkeypatch.setenv("DS_TPU_CHIP_LOCK", str(tmp_path / "chip.lease"))
+    # this suite runs under conftest's jax.config cpu pin, so even with the
+    # env var unset the in-Python pin applies
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert chip_lease.cpu_only()
+    assert chip_lease.process_lease("pytest") is None
+    assert not os.path.exists(str(tmp_path / "chip.lease"))
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert chip_lease.cpu_only()
+
+
+def test_init_backend_retries_and_attaches_holders(monkeypatch):
+    """The shared retry loop: probe failures consume the attempt budget, the
+    recovery hook runs between attempts, and its holder report rides the
+    final exception (bench.py's structured-error contract)."""
+    from deepspeed_tpu.utils import backend_probe
+
+    calls = {"probe": 0, "recovery": 0}
+
+    def fake_probe(timeout_s=None):
+        calls["probe"] += 1
+        return "hang", "probe timed out"
+    monkeypatch.setattr(backend_probe, "probe_backend", fake_probe)
+
+    def recovery():
+        calls["recovery"] += 1
+        return [{"pid": 1234, "killed": False}]
+
+    with pytest.raises(RuntimeError, match="UNAVAILABLE") as ei:
+        chip_lease.init_backend_with_retry(attempts=2, backoff_s=0.0,
+                                           recovery=recovery)
+    assert calls["probe"] == 2 and calls["recovery"] == 2
+    assert ei.value.bench_holders == [{"pid": 1234, "killed": False}]
+
+
+def test_bench_delegates_to_chip_lease(monkeypatch):
+    """bench.init_backend_with_retry routes through the shared helper (so
+    bench_serving/bench_llama inherit the lease + retry policy)."""
+    import bench
+
+    seen = {}
+
+    def fake_shared(**kwargs):
+        seen.update(kwargs)
+        return ["fake-device"]
+    monkeypatch.setattr(chip_lease, "init_backend_with_retry", fake_shared)
+    assert bench.init_backend_with_retry() == ["fake-device"]
+    assert seen["recovery"] is bench._active_recovery
+    assert seen["attempts"] == bench.INIT_ATTEMPTS
+    assert seen["lease_name"] == "bench"

@@ -326,3 +326,109 @@ def test_perf_gate_rejects_bad_embedded_summary(tmp_path):
     r = _run([PERF_GATE, "--baseline", str(p), "--dry-run"])
     assert r.returncode == 2
     assert "schema violation" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# serving gates (PR 6)
+# ---------------------------------------------------------------------------
+
+def _replay_payload(ttft=0.05, tpot=0.01, kv=0.4, value=500.0):
+    return {"metric": "serving_replay_tokens_per_sec_per_chip",
+            "value": value, "unit": "tokens/s/chip", "vs_baseline": None,
+            "extra": {"ttft_p50_s": ttft, "ttft_p99_s": ttft * 3,
+                      "tpot_p50_s": tpot, "tpot_p99_s": tpot * 2,
+                      "peak_kv_occupancy": kv, "preemptions": 0,
+                      "requests": 32, "seed": 0, "arrival": "poisson"}}
+
+
+def test_perf_gate_serving_self_compare_and_ttft_regression(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_replay_payload()))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(base)])
+    assert r.returncode == 0, r.stderr
+    compared = {v["metric"] for v in json.loads(r.stdout)["verdicts"]}
+    assert {"ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
+            "peak_kv_occupancy", "tokens_per_sec"} <= compared
+    # synthetic +20% TTFT (threshold 10%) -> regression, latency direction UP
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_replay_payload(ttft=0.06)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 3, (r.stdout, r.stderr)
+    bad = {v["metric"] for v in json.loads(r.stdout)["verdicts"]
+           if v["regressed"]}
+    assert bad == {"ttft_p50_s", "ttft_p99_s"}
+    # generous threshold waves the same candidate through
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand),
+              "--max-ttft-growth", "0.30"])
+    assert r.returncode == 0
+    # TPOT gates independently
+    cand.write_text(json.dumps(_replay_payload(tpot=0.02)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 3
+    # KV-occupancy growth is a regression too (cache headroom shrank)
+    cand.write_text(json.dumps(_replay_payload(kv=0.6)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 3
+
+
+def test_perf_gate_dry_run_validates_replay_payload_shape(tmp_path):
+    """--dry-run shape-checks a successful replay payload without jax: every
+    serving metric present, percentiles ordered, occupancy in [0,1]. Error
+    payloads (value 0) are exempt."""
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_replay_payload()))
+    r = _run([PERF_GATE, "--baseline", str(good), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    metrics = json.loads(r.stdout)["metrics"]["baseline"]
+    assert metrics["ttft_p50_s"] == 0.05
+
+    doc = _replay_payload()
+    del doc["extra"]["peak_kv_occupancy"]
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "peak_kv_occupancy" in r.stderr
+
+    doc = _replay_payload()
+    doc["extra"]["ttft_p50_s"] = doc["extra"]["ttft_p99_s"] * 2  # p50 > p99
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "p50 > p99" in r.stderr
+
+    err_doc = {"metric": "serving_replay_tokens_per_sec_per_chip",
+               "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": None,
+               "extra": {"error": "RuntimeError: backend init UNAVAILABLE"}}
+    errp = tmp_path / "err.json"
+    errp.write_text(json.dumps(err_doc))
+    r = _run([PERF_GATE, "--baseline", str(errp), "--dry-run"])
+    assert r.returncode == 0
+
+
+@pytest.mark.slow
+def test_bench_serving_replay_cpu_acceptance(tmp_path):
+    """The seeded replay harness end to end on CPU: one JSON payload with
+    p50/p99 TTFT, TPOT, tokens/s/chip and peak KV occupancy, accepted by
+    perf_gate in self-comparison (the ISSUE 6 acceptance path)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DS_TPU_TELEMETRY="1")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "bench_serving.py"),
+         "--replay", "--seed", "7"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payloads = [json.loads(ln) for ln in r.stdout.splitlines()
+                if ln.startswith("{")]
+    assert len(payloads) == 1
+    doc = payloads[0]
+    assert doc["metric"] == "serving_replay_tokens_per_sec_per_chip"
+    assert doc["value"] > 0
+    ex = doc["extra"]
+    assert 0 < ex["ttft_p50_s"] <= ex["ttft_p99_s"]
+    assert 0 < ex["tpot_p50_s"] <= ex["tpot_p99_s"]
+    assert 0 < ex["peak_kv_occupancy"] <= 1.0
+    assert ex["telemetry"]["serving"]["requests"]["finished"] == \
+        ex["requests"]
+    p = tmp_path / "replay.json"
+    p.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(p), "--candidate", str(p)])
+    assert r.returncode == 0, (r.stdout, r.stderr)

@@ -70,6 +70,13 @@ def test_disabled_noop_fast_path(tmp_path, monkeypatch):
     telemetry.record_dispatch("flash_mha", "sharded", "data")
     telemetry.record_compile("prog", 1.0)
 
+    # serving-stream entry points (PR 6) ride the same guarantee
+    telemetry.record_hist("serving/ttft_s", 0.05)
+    assert telemetry.hist_percentiles("serving/ttft_s") is None
+    telemetry.serving_event("submitted")
+    telemetry.serving_gauge("serving/running", 3)
+    telemetry.record_request_phase(0, "decode", 0.0, 0.01, tokens=1)
+
     # the memory/ledger hooks must be no-ops too — zero device reads
     from deepspeed_tpu.telemetry.core import Telemetry
 
