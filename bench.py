@@ -574,6 +574,20 @@ def run_bench():
             int(hbm.get("peak_bytes_in_use", 0) or 0),
             int(summ.get("memory", {}).get("peak_bytes", 0)))
         payload["extra"]["goodput_ledger"] = summ.get("ledger", {})
+        # compact wire view: per comm op/axis, quantized wire bytes vs the
+        # logical fp32 bytes (the ZeRO++ fitness function: DCN ratio <= 0.3)
+        comm = summ.get("comm", {})
+        wire = {}
+        for op, per_axis in comm.get("ops", {}).items():
+            for axis, st in per_axis.items():
+                if st.get("wire_bytes", st["bytes"]) != st["bytes"]:
+                    wire[f"{op}@{axis}"] = {
+                        "bytes": st["bytes"],
+                        "wire_bytes": st["wire_bytes"],
+                        "ratio": round(st["wire_bytes"] / st["bytes"], 4)
+                        if st["bytes"] else 0.0}
+        if wire:
+            payload["extra"]["wire_bytes"] = wire
     if on_tpu:
         record_last_good(payload)
     emit(payload)

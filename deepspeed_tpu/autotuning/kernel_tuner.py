@@ -82,6 +82,11 @@ def candidate_space(kernel, dims, dtype):
                 for tk in (128, 256, 512)
                 for tn in (128, 256, 512)
                 if _tiling_fits(tm, tk, tn, d, f)]
+    if kernel in ("block_quantize", "block_dequantize_reduce"):
+        from deepspeed_tpu.ops.pallas.quant_collective import _blocks_fit
+        rows, g = dims["rows"], dims["g"]
+        return [{"block_g": bg} for bg in (8, 16, 32, 64, 128, 256)
+                if _blocks_fit(bg, rows, g)]
     if kernel in ("paged_mha", "sparse_mha"):
         return [{}]  # no free knobs — the single candidate pins the defaults
     raise ValueError(f"unknown kernel {kernel!r}")
@@ -103,6 +108,12 @@ def grid_steps(kernel, dims, config):
                     * (dims["d"] // config["tile_k"])
                     * (dims["f"] // config["tile_n"]))
         return 3 * per_gemm
+    if kernel == "block_quantize":
+        bg = min(config["block_g"], dims["rows"])
+        return dims["rows"] // bg
+    if kernel == "block_dequantize_reduce":
+        bg = min(config["block_g"], dims["rows"])
+        return (dims["rows"] // bg) * dims["peers"]
     return 1
 
 
@@ -125,6 +136,16 @@ def vmem_bytes(kernel, dims, dtype, config):
     if kernel == "moe_ffn_gmm":
         tm, tk, tn = config["tile_m"], config["tile_k"], config["tile_n"]
         return (tm * tk + tk * tn) * db * 2 + tm * tn * 4
+    if kernel == "block_quantize":
+        bg = min(config["block_g"], dims["rows"])
+        g = dims["g"]
+        gw = g if dims["bits"] == 8 else g // 2
+        return bg * g * 4 * 2 + bg * gw + bg * 128 * 4   # f32 in (db) + wire + scales
+    if kernel == "block_dequantize_reduce":
+        bg = min(config["block_g"], dims["rows"])
+        g = dims["g"]
+        gw = g if dims["bits"] == 8 else g // 2
+        return (bg * gw + bg * 128 * 4) * 2 + bg * g * 4 * 2  # wire+scales (db) + acc + out
     return 0
 
 
@@ -186,6 +207,24 @@ def build_program(kernel, dims, dtype, config):
                 jax.ShapeDtypeStruct((S,), jnp.int32),
                 jax.ShapeDtypeStruct((S,), jnp.int32))
         return paged_mha, args
+
+    if kernel == "block_quantize":
+        from deepspeed_tpu.ops.pallas.quant_collective import block_quantize
+        rows, g, bits = dims["rows"], dims["g"], dims["bits"]
+        args = (jax.ShapeDtypeStruct((rows, g), dtype),)
+        return (lambda x: block_quantize(x, num_bits=bits, group_size=g,
+                                         block_config=cfg)), args
+
+    if kernel == "block_dequantize_reduce":
+        from deepspeed_tpu.ops.pallas.quant_collective import (
+            block_dequantize_reduce)
+        peers, rows, g, bits = (dims["peers"], dims["rows"], dims["g"],
+                                dims["bits"])
+        gw = g if bits == 8 else g // 2
+        args = (jax.ShapeDtypeStruct((peers, rows * gw), dtype),
+                jax.ShapeDtypeStruct((peers, rows), jnp.float32))
+        return (lambda q, s: block_dequantize_reduce(
+            q, s, num_bits=bits, group_size=g, block_config=cfg)), args
 
     if kernel == "sparse_mha":
         from deepspeed_tpu.ops.pallas.block_sparse_attention import sparse_mha

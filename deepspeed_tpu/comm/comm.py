@@ -101,6 +101,9 @@ def timed_op(fn):
     def wrapper(*args, **kwargs):
         from deepspeed_tpu import telemetry
         log = _comms_logger
+        # quantized collectives pass the true on-the-wire byte count
+        # (packed ints + scales); plain collectives omit it
+        wire_bytes = kwargs.pop("wire_bytes", None)
         tensor = args[0] if args else kwargs.get("tensor")
         axis = kwargs.get("axis_name", _axis_default)
         tm_on = telemetry.enabled()
@@ -111,7 +114,7 @@ def timed_op(fn):
             result = fn(*args, **kwargs)
             telemetry.record_comm(fn.__name__, _nbytes(tensor),
                                   time.perf_counter() - t0, axis=axis,
-                                  traced=True)
+                                  traced=True, wire_bytes=wire_bytes)
             return result
         # host-level (non-traced) collective: where real comm faults strike
         _faults.maybe_fail("comm.collective", detail=fn.__name__)
@@ -129,7 +132,8 @@ def timed_op(fn):
             log.append(fn.__name__, kwargs.get("log_name", fn.__name__),
                        elapsed, nbytes)
         if tm_on:
-            telemetry.record_comm(fn.__name__, nbytes, elapsed, axis=axis)
+            telemetry.record_comm(fn.__name__, nbytes, elapsed, axis=axis,
+                                  wire_bytes=wire_bytes)
         return result
 
     return wrapper

@@ -12,15 +12,35 @@ TPU mapping: these run inside ``shard_map`` over mesh axes. The hierarchy is
 (cross-slice DCN, the reference's inter-node IB) — see
 ``parallel/topology.py``. qwZ (``zero_quantized_weights``) is
 ``quantized_all_gather``: the wire format is int8 + per-group scales.
+
+The quantize / dequantize halves are the ``ops/pallas/quant_collective``
+kernel pair (``block_quantize`` / ``block_dequantize_reduce``, jnp fallback
+off-TPU): the dequant+sum of the exchange is fused into one VMEM pass, and
+nothing wider than the wire payload is ever materialized per peer. Every
+exchange records trace-time comm telemetry with both the logical fp32 bytes
+(comparable with the unquantized path) and the true ``wire_bytes``
+(packed ints + fp32 group scales) per mesh axis.
 """
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.utils import jax_compat  # noqa: F401  installs lax.axis_size on old jax
 
-from deepspeed_tpu.ops.quantizer import dequantize, quantize
+from deepspeed_tpu.ops.pallas.quant_collective import (
+    block_dequantize,
+    block_dequantize_reduce,
+    block_quantize,
+    wire_nbytes,
+)
+
+
+def _record_wire(op, axis, logical_numel, wire):
+    """Trace-time comm record: logical fp32 bytes + true wire bytes."""
+    from deepspeed_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.record_comm(op, int(logical_numel) * 4, 0.0, axis=axis,
+                              traced=True, wire_bytes=int(wire))
 
 
 def reduce_scatter_coalesced(tensors, axis_name="dp"):
@@ -45,34 +65,51 @@ def quantized_all_gather(x, axis_name="dp", num_bits=8, group_size=2048,
     all-gather: ``partition_parameters.py:728`` CUDAQuantizer +
     ``csrc/quantization/swizzled_quantize.cu``). Gathers ``x`` (this rank's
     shard) from every rank along ``axis_name``; only int8 values + fp32
-    group scales cross the wire."""
-    q, scale = quantize(x, num_bits=num_bits, group_size=group_size)
-    qg = lax.all_gather(q, axis_name)        # [world, groups, packed]
+    group scales cross the wire, and each gathered shard row dequantizes
+    straight into its output slot — no fp32 ``[world, *shape]`` staging
+    pass."""
+    world = lax.axis_size(axis_name)
+    flat = x.reshape(-1)
+    q, scale = block_quantize(flat, num_bits=num_bits, group_size=group_size,
+                              local=True)
+    _record_wire("all_gather_quant", axis_name, flat.shape[0],
+                 wire_nbytes(flat.shape[0], num_bits, group_size))
+    qg = lax.all_gather(q, axis_name)        # [world, wire]
     sg = lax.all_gather(scale, axis_name)    # [world, groups]
-    deq = jax.vmap(lambda qi, si: dequantize(qi, si, x.shape,
-                                             num_bits=num_bits,
-                                             group_size=group_size,
-                                             dtype=dtype))
-    parts = deq(qg, sg)                      # [world, *x.shape]
-    return parts.reshape((parts.shape[0] * x.shape[0],) + x.shape[1:])
+    full = block_dequantize(qg, sg, num_bits=num_bits, group_size=group_size,
+                            out_len=flat.shape[0], dtype=dtype, local=True)
+    return full.reshape((world * x.shape[0],) + x.shape[1:])
 
 
-def exchange_reduce(blocks, axis, bits, group_size=2048):
-    """Quantized all-to-all + local reduce: the qgZ exchange primitive.
+def exchange_reduce(blocks, axis, bits, group_size=2048, return_error=False):
+    """Quantized all-to-all + fused dequant-reduce: the qgZ exchange
+    primitive.
 
     ``blocks``: [peers, m] — row j is this rank's payload destined for peer j.
     Each row is groupwise-quantized to ``bits``, exchanged over ``axis``
-    (row j -> peer j), dequantized, and summed: returns this rank's [m]
-    partial sum over the ``axis`` group."""
-    qfn = jax.vmap(lambda row: quantize(row, num_bits=bits,
-                                        group_size=group_size))
-    q, s = qfn(blocks)
+    (row j -> peer j), and dequant-summed in one kernel pass: returns this
+    rank's [m] partial sum over the ``axis`` group.
+
+    ``return_error=True`` additionally returns the local quantization
+    residual ``blocks - dequantize(quantize(blocks))`` ([peers, m], computed
+    from this rank's own outgoing wire payload, no extra comm) — the
+    error-feedback carry for the next step."""
+    P, m = blocks.shape
+    q, s = block_quantize(blocks, num_bits=bits, group_size=group_size,
+                          local=True)
+    _record_wire("all_to_all_quant", axis, blocks.size,
+                 P * wire_nbytes(m, bits, group_size))
     qx = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
     sx = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
-    m = blocks.shape[1]
-    deq = jax.vmap(lambda qi, si: dequantize(qi, si, (m,), num_bits=bits,
-                                             group_size=group_size))
-    return deq(qx, sx).sum(axis=0)  # [m]
+    out = block_dequantize_reduce(qx, sx, num_bits=bits,
+                                  group_size=group_size, out_len=m,
+                                  local=True)
+    if return_error:
+        err = blocks - block_dequantize(q, s, num_bits=bits,
+                                        group_size=group_size, out_len=m,
+                                        local=True)
+        return out, err
+    return out
 
 
 def all_to_all_quant_reduce(x, intra_axis="dp", inter_axis=None,

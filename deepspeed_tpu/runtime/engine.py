@@ -58,6 +58,7 @@ class TrainState(NamedTuple):
     global_step: jnp.ndarray
     skipped: jnp.ndarray   # overflow-skipped step count (device-side: no per-step host sync)
     rng: jnp.ndarray
+    qgz_residual: Any = None  # qgZ error-feedback carry (stacked grad layout)
 
 
 class StepStats(NamedTuple):
@@ -449,10 +450,17 @@ class DeepSpeedEngine:
         # the stage-3 working copy is stored as int8 + per-group scales, so
         # XLA's per-use all-gathers move int8 over the wire and HBM holds
         # half the bytes. Dequantization happens in-trace at use sites.
-        self.quantized_weights = bool(
-            self.config.zero_config.zero_quantized_weights
-            and self.zero_optimization_stage() >= 3)
-        if self.quantized_weights and not self.mixed_precision:
+        qwz = bool(self.config.zero_config.zero_quantized_weights
+                   and self.zero_optimization_stage() >= 3)
+        # ZeRO++ hpZ composition: with a secondary partition the working copy
+        # stays FULL precision sharded only over the ICI-local param axes
+        # (per-use all-gathers ride ICI in bf16); only the primary
+        # master->working exchange — the leg that crosses DCN — is quantized,
+        # in _apply_core_builder. Without hpZ, qwZ keeps the int8 working
+        # copy so XLA's per-use gathers move int8.
+        self._qwz_hpz = bool(qwz and self.topology.zero_hierarchy == "hpz")
+        self.quantized_weights = qwz and not self._qwz_hpz
+        if qwz and not self.mixed_precision:
             raise ValueError("zero_quantized_weights requires fp16/bf16 training "
                              "(the fp32 master holds full precision)")
 
@@ -483,17 +491,31 @@ class DeepSpeedEngine:
         # gradients accumulate locally per device in a stacked buffer and are
         # quantize-reduced at the GAS boundary (zero/qgz.py)
         self._qgz_plan = None
+        self._qgz_feedback = False
+        qgz_residual = None
         if self.config.zero_config.zero_quantized_gradients:
             if self.zero_optimization_stage() < 2:
                 raise ValueError("zero_quantized_gradients requires ZeRO stage >= 2 "
                                  "(gradients must be partitioned)")
-            if self.quantized_weights:
-                raise ValueError("zero_quantized_gradients + zero_quantized_weights "
-                                 "is not supported yet on TPU")
+            if self.quantized_weights and not self._qwz_hpz:
+                # qwZ+qgZ would quantize BOTH legs of every exchange across
+                # every axis; the composed ZeRO++ path keeps the secondary
+                # (ICI) parameter traffic full-precision via hpZ
+                raise ValueError(
+                    "zero_quantized_gradients + zero_quantized_weights "
+                    "requires a secondary parameter partition: set "
+                    "zero_hpz_partition_size > 1 (ZeRO++ hpZ)")
             from deepspeed_tpu.runtime.zero.qgz import QgzPlan
             self._qgz_plan = QgzPlan(self.topology, self.partitioner, params_f32)
             grad_acc = self._qgz_plan.stacked_zeros(params_f32, self.grad_accum_dtype)
             grad_sh = self._qgz_plan.stacked_shardings(params_f32)
+            self._qgz_feedback = bool(
+                self.config.zero_config.zero_quantized_gradients_error_feedback)
+            if self._qgz_feedback:
+                # fp32 regardless of grad_accum_dtype: the carry is the small
+                # difference the wire format dropped
+                qgz_residual = self._qgz_plan.stacked_zeros(params_f32,
+                                                            jnp.float32)
         else:
             grad_acc = tree_zeros_like(params_f32, self.grad_accum_dtype)
             grad_acc = jax.tree.map(jax.device_put, grad_acc, grad_sh)
@@ -515,6 +537,7 @@ class DeepSpeedEngine:
             global_step=jax.device_put(jnp.int32(0), rep),
             skipped=jax.device_put(jnp.int32(0), rep),
             rng=jax.device_put(rng_key, rep),
+            qgz_residual=qgz_residual,
         )
         n = count_parameters(params_f32)
         log_dist(f"model parameters: {n/1e6:.2f}M", ranks=[0])
@@ -902,6 +925,36 @@ class DeepSpeedEngine:
         dynamic = self.dynamic_loss_scale
         quantized = getattr(self, "quantized_weights", False)
         quantize_fn = self._quantize_working
+        hpz_quant = getattr(self, "_qwz_hpz", False)
+        should_q = self._should_quantize
+
+        def hpz_exchange(working):
+            """qwZ under hpZ: the primary master->working reshard (the one
+            leg that crosses DCN — master is dp x dpr sharded, working only
+            dp) moves int8 + scales; the working copy lands full precision so
+            every later ICI gather is full precision."""
+            from deepspeed_tpu import telemetry
+            from deepspeed_tpu.ops.quantizer import (dequantize_lastdim,
+                                                     quantize_lastdim)
+            logical = wire = 0
+
+            def ex(leaf, s):
+                nonlocal logical, wire
+                if not should_q(leaf):
+                    return jax.lax.with_sharding_constraint(leaf, s)
+                q, sc = quantize_lastdim(leaf)
+                q = jax.lax.with_sharding_constraint(q, s)  # int8 over DCN
+                logical += leaf.size * jnp.dtype(leaf.dtype).itemsize
+                wire += q.size + sc.size * 4
+                out = dequantize_lastdim(q, sc, dtype=working_dtype)
+                return jax.lax.with_sharding_constraint(out, s)
+
+            out = jax.tree.map(ex, working, param_sh)
+            if telemetry.enabled():
+                telemetry.record_comm("hpz_primary_exchange", int(logical),
+                                      0.0, axis="dpr", traced=True,
+                                      wire_bytes=int(wire))
+            return out
 
         def core(state: TrainState, grads, lr):
             overflow = has_overflow(grads) if fp16 else jnp.asarray(False)
@@ -926,6 +979,8 @@ class DeepSpeedEngine:
                     new_params = jax.tree.map(
                         lambda l, s: jax.lax.with_sharding_constraint(l, s),
                         new_working, param_sh, is_leaf=DeepSpeedEngine._is_qleaf)
+                elif hpz_quant:
+                    new_params = hpz_exchange(new_working)
                 else:
                     new_params = constrain_tree(new_working, param_sh)
                 new_master = new_target
@@ -958,21 +1013,35 @@ class DeepSpeedEngine:
     def _build_apply_step(self):
         gas = self.gradient_accumulation_steps_value
         plan = self._qgz_plan
+        feedback = getattr(self, "_qgz_feedback", False)
         core = self._apply_core_builder()
 
         def apply_step(state: TrainState, lr):
             denom = self._grad_denom(state, gas)
+            new_res = None
             if plan is not None:
                 # qgZ boundary: quantized hierarchical reduction of the stacked
                 # local grads (zero/qgz.py). The sum over the world of local
                 # batch-means is world x the global mean — fold into the denom.
-                summed = plan.reduce(state.grad_acc)
+                if feedback:
+                    summed, new_res = plan.reduce(
+                        state.grad_acc, residual=state.qgz_residual,
+                        return_residual=True)
+                else:
+                    summed = plan.reduce(state.grad_acc)
                 qdenom = denom * jnp.float32(plan.world)
                 grads = jax.tree.map(lambda g: g / qdenom, summed)
             else:
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom,
                                      state.grad_acc)
-            return core(state, grads, lr)
+            new_state, stats = core(state, grads, lr)
+            if new_res is not None:
+                # overflow-skipped steps discarded the gradients the fresh
+                # residual belongs to — keep the previous carry
+                new_res = tree_where(stats.overflow, state.qgz_residual,
+                                     new_res)
+                new_state = new_state._replace(qgz_residual=new_res)
+            return new_state, stats
 
         return jax.jit(apply_step, donate_argnums=(0,))
 

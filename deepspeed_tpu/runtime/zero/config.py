@@ -69,6 +69,9 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     zero_quantized_weights = False
     zero_quantized_nontrainable_weights = False
     zero_quantized_gradients = False
+    # carry the per-leaf quantization residual into the next step's gradient
+    # (ZeRO++ error feedback; only meaningful with zero_quantized_gradients)
+    zero_quantized_gradients_error_feedback = False
     mics_shard_size = -1
     mics_hierarchical_params_gather = False
     memory_efficient_linear = True

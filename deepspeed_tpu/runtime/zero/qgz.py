@@ -162,58 +162,120 @@ class QgzPlan:
         return None, None
 
     # --- boundary reduction --------------------------------------------
-    def _reduce_leaf(self, local, d, axes):
+    def _reduce_leaf(self, local, d, axes, want_error=False):
         """Hierarchical quantized exchange of one leaf's chunks along dim d.
 
         ``local``: this device's full-shape accumulated gradient. Returns this
         device's chunk (the GSPMD shard for spec entry ``axes`` on dim d, in
-        axes-major order)."""
+        axes-major order). ``want_error=True`` additionally returns this
+        device's quantization residual mapped back into ``local``'s
+        coordinates (the error-feedback carry: stage-1 errors at their source
+        chunks, the stage-2 error at this device's own dp chunk column)."""
         moved = jnp.moveaxis(local, d, 0)
         rest = moved.shape[1:]
+        err = None
         if axes == ("dpr", "dp"):
             R, D = self.sizes["dpr"], self.sizes["dp"]
             chunks = moved.reshape(R, D, -1)                  # [R, D, m]
+            m = chunks.shape[2]
             # stage 1 (ICI): dp-peer i receives slab chunks[:, i]
             slabs = chunks.transpose(1, 0, 2).reshape(D, -1)  # [D, R*m]
-            partial = exchange_reduce(slabs, "dp", self.intra_bits,
-                                      self.group_size)        # [R*m]
+            s1 = exchange_reduce(slabs, "dp", self.intra_bits,
+                                 self.group_size,
+                                 return_error=want_error)     # [R*m]
+            partial = s1[0] if want_error else s1
             # stage 2 (DCN): dpr-peer r receives row r of the partial
-            m = chunks.shape[2]
-            out = exchange_reduce(partial.reshape(R, m), "dpr",
-                                  self.inter_bits, self.group_size)  # [m]
+            s2 = exchange_reduce(partial.reshape(R, m), "dpr",
+                                 self.inter_bits, self.group_size,
+                                 return_error=want_error)     # [m]
+            out = s2[0] if want_error else s2
+            if want_error:
+                # e1 [D, R*m] back to chunk coords; e2 [R, m] lands at this
+                # device's own dp column (it is an error on the partial sum
+                # only this device held — re-fed here, the next step's stage-1
+                # sum carries it forward)
+                e1 = s1[1].reshape(D, R, m).transpose(1, 0, 2)   # [R, D, m]
+                my_dp = lax.axis_index("dp")
+                hot = (jax.nn.one_hot(my_dp, D, dtype=e1.dtype)
+                       [None, :, None])                          # [1, D, 1]
+                err = (e1 + s2[1][:, None, :] * hot).reshape(moved.shape)
         else:
             (axis,) = axes
             n = self.sizes[axis]
             bits = self.intra_bits if axis == "dp" else self.inter_bits
-            out = exchange_reduce(moved.reshape(n, -1), axis, bits,
-                                  self.group_size)
+            s1 = exchange_reduce(moved.reshape(n, -1), axis, bits,
+                                 self.group_size, return_error=want_error)
+            out = s1[0] if want_error else s1
+            if want_error:
+                err = s1[1].reshape(moved.shape)
         chunk_shape = (moved.shape[0] // self.world
                        if axes == ("dpr", "dp") else
                        moved.shape[0] // self.sizes[axes[0]],) + rest
-        return jnp.moveaxis(out.reshape(chunk_shape), 0, d)
+        out = jnp.moveaxis(out.reshape(chunk_shape), 0, d)
+        if want_error:
+            return out, jnp.moveaxis(err, 0, d)
+        return out
 
-    def reduce(self, acc_stacked):
+    def reduce(self, acc_stacked, residual=None, return_residual=False):
         """Stacked local-grad buffer -> GSPMD-sharded summed gradients.
 
         Runs one shard_map over the manual axes; inside, each leaf either does
         the quantized hierarchical exchange along its ZeRO dim or (no shardable
-        dim) a plain fp psum."""
+        dim) a plain fp psum.
+
+        Error feedback (``zero_quantized_gradients_error_feedback``):
+        ``residual`` is the previous step's quantization error in the same
+        stacked layout as ``acc_stacked``; it is folded into each leaf before
+        quantization. ``return_residual=True`` returns ``(grads, residual')``
+        where ``residual'`` is this step's fresh error carry (zeros for psum
+        leaves — they are never quantized)."""
+        if return_residual and residual is None:
+            raise ValueError("return_residual=True needs the previous "
+                             "residual (pass stacked zeros on the first step)")
         grad_specs, base_specs = self.grad_specs, self.base_specs
-
-        def body(acc_local):
-            def one(leaf, gspec, bspec):
-                local = leaf[0].astype(jnp.float32)        # [*shape]
-                d, axes = self._zero_dim(gspec, bspec)
-                if d is None:
-                    return lax.psum(local, tuple(self.axes))
-                return self._reduce_leaf(local, d, axes)
-            return jax.tree.map(one, acc_local, grad_specs, base_specs)
-
-        out_specs = jax.tree.map(
+        grad_out_specs = jax.tree.map(
             lambda _, s: self._project(s), acc_stacked, grad_specs)
+        stacked_in = self.stacked_specs(acc_stacked, project=True)
+
+        def one(leaf, res, gspec, bspec):
+            local = leaf[0].astype(jnp.float32)            # [*shape]
+            if res is not None:
+                local = local + res[0].astype(jnp.float32)
+            d, axes = self._zero_dim(gspec, bspec)
+            if d is None:
+                out = lax.psum(local, tuple(self.axes))
+                # psum leaves are never quantized: zero error carry
+                return out, (jnp.zeros_like(local)[None]
+                             if return_residual else None)
+            if return_residual:
+                out, err = self._reduce_leaf(local, d, axes, want_error=True)
+                return out, err[None]
+            return self._reduce_leaf(local, d, axes), None
+
+        def body(acc_local, res_local):
+            leaves, treedef = jax.tree.flatten(acc_local)
+            res_leaves = (treedef.flatten_up_to(res_local)
+                          if res_local is not None else [None] * len(leaves))
+            pairs = [one(leaf, res, gspec, bspec)
+                     for leaf, res, gspec, bspec in
+                     zip(leaves, res_leaves,
+                         treedef.flatten_up_to(grad_specs),
+                         treedef.flatten_up_to(base_specs))]
+            grads = jax.tree.unflatten(treedef, [p[0] for p in pairs])
+            if not return_residual:
+                return grads
+            return grads, jax.tree.unflatten(treedef, [p[1] for p in pairs])
+
+        if residual is None:
+            fn = jax.shard_map(lambda a: body(a, None), mesh=self.mesh,
+                               in_specs=(stacked_in,),
+                               out_specs=grad_out_specs,
+                               axis_names=self.manual, check_vma=False)
+            return fn(acc_stacked)
+        out_specs = ((grad_out_specs, stacked_in) if return_residual
+                     else grad_out_specs)
         fn = jax.shard_map(body, mesh=self.mesh,
-                           in_specs=(self.stacked_specs(acc_stacked,
-                                                        project=True),),
+                           in_specs=(stacked_in, stacked_in),
                            out_specs=out_specs,
                            axis_names=self.manual, check_vma=False)
-        return fn(acc_stacked)
+        return fn(acc_stacked, residual)

@@ -57,8 +57,10 @@ def span_begin(name, **tags):
     return _GLOBAL.span_begin(name, **tags)
 
 
-def record_comm(op, nbytes, seconds, axis=None, traced=False):
-    _GLOBAL.record_comm(op, nbytes, seconds, axis=axis, traced=traced)
+def record_comm(op, nbytes, seconds, axis=None, traced=False,
+                wire_bytes=None):
+    _GLOBAL.record_comm(op, nbytes, seconds, axis=axis, traced=traced,
+                        wire_bytes=wire_bytes)
 
 
 def record_dispatch(kernel, outcome, reason, mesh_size=None):

@@ -276,7 +276,7 @@ class Telemetry:
         self.metrics = []         # every record() sample, in order
         self.counters = {}        # name -> {tag_key: int}
         self.span_stats = {}      # name -> [count, total_s]
-        self.comm_stats = {}      # (op, axis) -> [count, bytes, secs, algbw, busbw]
+        self.comm_stats = {}      # (op, axis) -> [count, bytes, secs, algbw, busbw, wire_bytes]
         self.dispatch_stats = {}  # (kernel, outcome, reason) -> count
         self.compile_stats = {}   # program -> {seconds, topology, cache}
         # memory stream
@@ -436,10 +436,15 @@ class Telemetry:
     # ------------------------------------------------------------------
     # layer-specific recorders
     # ------------------------------------------------------------------
-    def record_comm(self, op, nbytes, seconds, axis=None, traced=False):
+    def record_comm(self, op, nbytes, seconds, axis=None, traced=False,
+                    wire_bytes=None):
         """One collective: bytes moved, wall seconds (host-level latency, or
         trace-emission time for in-trace calls), algbw/busbw via the ring
-        correction factors. ``axis`` is the mesh axis (name or tuple)."""
+        correction factors. ``axis`` is the mesh axis (name or tuple).
+        ``wire_bytes`` is the bytes that actually cross the link when they
+        differ from the logical fp32 ``nbytes`` (quantized collectives:
+        packed ints + fp32 group scales); algbw/busbw stay on the logical
+        bytes so they remain comparable across precisions."""
         if not self.enabled:
             return
         from deepspeed_tpu.utils.comms_logging import calc_bw_log
@@ -455,12 +460,14 @@ class Telemetry:
         with self._lock:
             st = self.comm_stats.get((op, axis_key))
             if st is None:
-                st = self.comm_stats[(op, axis_key)] = [0, 0, 0.0, 0.0, 0.0]
+                st = self.comm_stats[(op, axis_key)] = [0, 0, 0.0, 0.0, 0.0,
+                                                        0]
             st[0] += 1
             st[1] += nbytes
             st[2] += seconds
             st[3] += algbw
             st[4] += busbw
+            st[5] += wire_bytes if wire_bytes is not None else nbytes
             if not traced:
                 # traced collectives report trace-emission time and run
                 # INSIDE a compute span — charging them would double-count
@@ -471,14 +478,19 @@ class Telemetry:
                   "dur": round(seconds * 1e6, 3),
                   "pid": os.getpid(), "tid": threading.get_ident() & 0xffff,
                   "args": {"bytes": nbytes, "axis": axis_key,
-                           "traced": bool(traced)}}
+                           "traced": bool(traced),
+                           "wire_bytes": (wire_bytes if wire_bytes is not None
+                                          else nbytes)}}
             self.trace_events.append(ev)
             self._emit_jsonl({"name": f"comm/{op}", "kind": "bytes",
                               "value": nbytes,
                               "tags": {"axis": axis_key, "seconds": seconds,
                                        "algbw_gbs": round(algbw, 4),
                                        "busbw_gbs": round(busbw, 4),
-                                       "traced": bool(traced)}})
+                                       "traced": bool(traced),
+                                       "wire_bytes": (wire_bytes
+                                                      if wire_bytes is not None
+                                                      else nbytes)}})
 
     def record_dispatch(self, kernel, outcome, reason, mesh_size=None):
         """One ``sharded_kernel_call`` decision. ``outcome``: "sharded" |
@@ -870,13 +882,16 @@ class Telemetry:
                      for name, (c, tot) in sorted(self.span_stats.items())}
             comm = {}
             total_bytes = 0
-            for (op, axis), (c, nb, secs, algbw, busbw) in \
+            total_wire_bytes = 0
+            for (op, axis), (c, nb, secs, algbw, busbw, wb) in \
                     sorted(self.comm_stats.items()):
                 comm.setdefault(op, {})[axis] = {
-                    "count": c, "bytes": nb, "total_s": round(secs, 6),
+                    "count": c, "bytes": nb, "wire_bytes": wb,
+                    "total_s": round(secs, 6),
                     "algbw_gbs": round(algbw / c, 4) if c else 0.0,
                     "busbw_gbs": round(busbw / c, 4) if c else 0.0}
                 total_bytes += nb
+                total_wire_bytes += wb
             dispatch = {}
             for (kernel, outcome, reason), c in \
                     sorted(self.dispatch_stats.items()):
@@ -897,7 +912,8 @@ class Telemetry:
                       if self.memory_samples else 0,
                       "oom": self.last_oom_report is not None}
             return {"enabled": True, "spans": spans,
-                    "comm": {"ops": comm, "total_bytes": total_bytes},
+                    "comm": {"ops": comm, "total_bytes": total_bytes,
+                             "total_wire_bytes": total_wire_bytes},
                     "dispatch": dispatch,
                     "compile": {"programs": compile_sec,
                                 "cache_hits": hits, "cache_misses": misses},

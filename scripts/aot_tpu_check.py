@@ -146,12 +146,27 @@ def kernel_programs():
                 jax.ShapeDtypeStruct((512, 256 // 128), jnp.float32))
         return (lambda x, q, s: quantized_matmul(x, q, s, 128)), args
 
+    def block_quant():
+        from deepspeed_tpu.ops.pallas.quant_collective import block_quantize
+        args = (jax.ShapeDtypeStruct((64, 2048), jnp.float32),)
+        return (lambda x: block_quantize(x, num_bits=4, group_size=2048)), args
+
+    def block_deq_reduce():
+        from deepspeed_tpu.ops.pallas.quant_collective import (
+            block_dequantize_reduce)
+        args = (jax.ShapeDtypeStruct((4, 64 * 1024), jnp.uint8),
+                jax.ShapeDtypeStruct((4, 64), jnp.float32))
+        return (lambda q, s: block_dequantize_reduce(
+            q, s, num_bits=4, group_size=2048)), args
+
     return [("flash_fwd", flash_fwd), ("flash_bwd", flash_bwd),
             ("flash_window_fwd", flash_window_fwd),
             ("flash_window_bwd", flash_window_bwd),
             ("flash_segments_fwd", flash_segments_fwd),
             ("paged_mha", paged), ("block_sparse", block_sparse),
-            ("grouped_gemm", grouped_gemm), ("quantized_matmul", quantized)]
+            ("grouped_gemm", grouped_gemm), ("quantized_matmul", quantized),
+            ("block_quantize", block_quant),
+            ("block_dequantize_reduce", block_deq_reduce)]
 
 
 def train_programs():
@@ -431,7 +446,35 @@ def multichip_programs(topo):
 
         return fn, abstract, in_shardings
 
-    return [("llama_tp2xdp2_zero_fwd_bwd", llama_tp2_dp2),
+    def qgz_hpz_exchange():
+        # ZeRO++ composed leg: hpZ secondary param all-gather rides ICI (dp)
+        # full precision while the qgZ gradient exchange quantizes int4 over
+        # dp and int8 over DCN (dpr) — the Pallas quant kernels must lower
+        # inside the manual-axes shard_map for the real topology
+        from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+            all_to_all_quant_reduce)
+        from deepspeed_tpu.utils import jax_compat
+
+        mesh = Mesh(np.array(topo.devices).reshape(2, 2), ("dpr", "dp"))
+
+        def body(g, w):
+            wg = jax.lax.all_gather(w, "dp", axis=0, tiled=True)  # hpZ fp leg
+            shard = all_to_all_quant_reduce(g, intra_axis="dp",
+                                            inter_axis="dpr")
+            return shard, jnp.sum(wg.astype(jnp.float32))
+
+        fn = jax_compat.shard_map(body, mesh=mesh,
+                                  in_specs=(P(), P("dp")),
+                                  out_specs=(P(("dpr", "dp")), P()),
+                                  check_vma=False)
+        abstract = (jax.ShapeDtypeStruct((16, 4096), jnp.float32),
+                    jax.ShapeDtypeStruct((256, 128), jnp.bfloat16))
+        in_shardings = (NamedSharding(mesh, P()),
+                        NamedSharding(mesh, P("dp")))
+        return fn, abstract, in_shardings
+
+    return [("qgz_hpz_grad_exchange", qgz_hpz_exchange),
+            ("llama_tp2xdp2_zero_fwd_bwd", llama_tp2_dp2),
             ("flash_ulysses_sp2_fwd_bwd", flash_ulysses_sp2),
             ("moe_gmm_ep2_fwd", moe_gmm_ep2),
             ("serving_ragged_tp2", serving_ragged_tp2)]

@@ -223,6 +223,70 @@ def check_kernel_tables(tables_dir=None):
     return report, errors
 
 
+#: qgZ acceptance: wire bytes of the quantized DCN exchange relative to the
+#: fp32 reduce-scatter path (ZeRO++: int8 + fp32 group scales ≈ 0.25)
+QGZ_WIRE_MAX_RATIO = 0.3
+
+
+def check_qgz_wire():
+    """Trace (compile nothing, execute nothing) the qgZ hierarchical
+    exchange on 8 forced-host CPU devices and require the DCN (``dpr``) leg's
+    wire bytes <= ``QGZ_WIRE_MAX_RATIO`` x the logical fp32 bytes. The
+    quantized collectives record ``wire_bytes`` comm telemetry at trace
+    time, so ``jit(...).lower`` is enough — no TPU, no execution.
+
+    Returns (report, errors); skipped without error when jax is missing or
+    the host cannot present 8 devices (the dry-run lane must stay runnable
+    on minimal CI hosts)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        import jax
+    except Exception as e:  # pragma: no cover - jax is baked into the image
+        return {"skipped": f"jax unavailable: {e}"}, []
+    if len(jax.devices()) < 8:
+        return {"skipped": f"needs 8 devices, have {len(jax.devices())}"}, []
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+        all_to_all_quant_reduce)
+
+    telemetry.configure(enabled=True, sample_sync=False)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dpr", "dp"))
+    grad = jax.ShapeDtypeStruct((8, 8192), jnp.float32)
+    fn = jax.shard_map(
+        lambda g: all_to_all_quant_reduce(g, intra_axis="dp",
+                                          inter_axis="dpr"),
+        mesh=mesh, in_specs=P(), out_specs=P(("dpr", "dp")),
+        check_vma=False)
+    jax.jit(fn).lower(grad)   # trace-time record_comm only
+
+    ops = telemetry.summary().get("comm", {}).get("ops", {})
+    report, errors = {}, []
+    quant = ops.get("all_to_all_quant", {})
+    if not quant:
+        return report, ["qgz trace recorded no all_to_all_quant telemetry"]
+    for axis, st in sorted(quant.items()):
+        ratio = (st["wire_bytes"] / st["bytes"]) if st["bytes"] else 0.0
+        report[axis] = {"bytes": st["bytes"],
+                        "wire_bytes": st["wire_bytes"],
+                        "ratio": round(ratio, 4)}
+    dcn = report.get("dpr")
+    if dcn is None:
+        errors.append("qgz trace recorded no DCN (dpr) exchange")
+    elif dcn["ratio"] > QGZ_WIRE_MAX_RATIO:
+        errors.append(f"qgz DCN wire ratio {dcn['ratio']} > "
+                      f"{QGZ_WIRE_MAX_RATIO}")
+    return report, errors
+
+
 def validate_summary(doc):
     """Schema-validate an embedded summary when jsonschema is available.
     Returns an error string or None."""
@@ -338,12 +402,17 @@ def main(argv=None):
         table_report, table_errors = check_kernel_tables()
         for err in table_errors:
             print(f"perf_gate: kernel_table: {err}", file=sys.stderr)
+        qgz_report, qgz_errors = check_qgz_wire()
+        for err in qgz_errors:
+            print(f"perf_gate: qgz_wire: {err}", file=sys.stderr)
+        errors = table_errors + qgz_errors
         print(json.dumps({"dry_run": True,
-                          "inputs_ok": not table_errors,
+                          "inputs_ok": not errors,
                           "kernel_table": table_report,
+                          "qgz_wire": qgz_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
-        return 2 if table_errors else 0
+        return 2 if errors else 0
 
     if "candidate" not in docs:
         print("perf_gate: --candidate is required without --dry-run",

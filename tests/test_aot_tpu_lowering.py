@@ -37,7 +37,8 @@ def test_all_pallas_kernels_lower_for_v5e(tmp_path):
     assert report["target"] == "TPU v5 lite"
     names = {r["name"] for r in report["results"]}
     assert {"flash_fwd", "flash_bwd", "paged_mha", "block_sparse",
-            "grouped_gemm", "quantized_matmul"} <= names
+            "grouped_gemm", "quantized_matmul", "block_quantize",
+            "block_dequantize_reduce"} <= names
     # the multichip legs are pinned green in the default lane: GSPMD cannot
     # auto-partition Mosaic kernels, so these only compile while the SPMD
     # kernel dispatch layer (ops/registry.sharded_kernel_call) keeps wrapping
@@ -46,4 +47,5 @@ def test_all_pallas_kernels_lower_for_v5e(tmp_path):
     # "NotImplementedError: Mosaic kernels cannot be automatically
     # partitioned"
     assert {"llama_tp2xdp2_zero_fwd_bwd", "flash_ulysses_sp2_fwd_bwd",
-            "moe_gmm_ep2_fwd", "serving_ragged_tp2"} <= names
+            "moe_gmm_ep2_fwd", "serving_ragged_tp2",
+            "qgz_hpz_grad_exchange"} <= names
