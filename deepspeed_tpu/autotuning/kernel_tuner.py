@@ -49,6 +49,21 @@ _PEAK_FLOPS = {
     "tpu_v6e": 918e12,
 }
 
+#: per-chip aggregate ICI bandwidth (bytes/s) for the collective cost
+#: model (telemetry/overlap.py analytic mode). Same spirit as the HBM
+#: table above: a MODEL for relative cost and CI ratchets, not a latency
+#: prediction.
+LINK_BYTES_PER_S = {
+    "tpu_v4": 300e9,
+    "tpu_v5e": 200e9,
+    "tpu_v5p": 600e9,
+    "tpu_v6e": 400e9,
+}
+
+#: fixed per-collective launch latency so tiny messages never model as
+#: zero-duration intervals
+_COMM_LATENCY_S = 1e-6
+
 
 def _dtype_bytes(dtype):
     import jax.numpy as jnp
@@ -307,6 +322,37 @@ def proxy_score(kernel, dims, dtype, config, cost, device_kind):
     nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
     return (flops / peak + nbytes / bw
             + grid_steps(kernel, dims, config) * GRID_STEP_SECONDS)
+
+
+def roofline_compute_seconds(flops, bytes_accessed, device_kind="tpu_v5e"):
+    """Roofline seconds for a compiled program's cost_analysis() numbers:
+    flops over peak plus HBM traffic over bandwidth (the additive form
+    ``proxy_score`` uses, minus the grid-dispatch term). Feeds the
+    telemetry overlap analyzer's chip-free analytic mode."""
+    slug = kernel_table.normalize_device_kind(device_kind)
+    peak = _PEAK_FLOPS.get(slug, _PEAK_FLOPS["tpu_v5e"])
+    bw = _HBM_BYTES_PER_S.get(slug, _HBM_BYTES_PER_S["tpu_v5e"])
+    return float(flops) / peak + float(bytes_accessed) / bw
+
+
+def comm_roofline_seconds(op, nbytes, n=None, device_kind="tpu_v5e"):
+    """Modeled seconds for one collective of ``nbytes`` payload across
+    ``n`` participants, using the ring busbw factors from
+    ``utils/comms_logging.calc_bw_log`` — all_reduce moves 2(n-1)/n of the
+    payload over the wire, gather/scatter/all-to-all (n-1)/n, point-to-point
+    the payload itself — over the chip's aggregate ICI bandwidth, plus a
+    fixed launch latency. Unknown ``n`` uses the asymptotic factor."""
+    slug = kernel_table.normalize_device_kind(device_kind)
+    link = LINK_BYTES_PER_S.get(slug, LINK_BYTES_PER_S["tpu_v5e"])
+    op = str(op)
+    if op in ("all_reduce", "psum"):
+        factor = (2.0 * (n - 1) / n) if n and n > 1 else 2.0
+    elif op in ("all_gather", "reduce_scatter", "all_to_all",
+                "psum_scatter"):
+        factor = ((n - 1) / n) if n and n > 1 else 1.0
+    else:  # broadcast / permute / send / recv: payload over the wire once
+        factor = 1.0
+    return float(nbytes) * factor / link + _COMM_LATENCY_S
 
 
 def chip_free_rank(kernel, dims, dtype, candidates=None, compile_fn=None,

@@ -131,6 +131,13 @@ def ledger_step(step=None, flops=None):
     return _GLOBAL.ledger_step(step=step, flops=flops)
 
 
+def attach_overlap(report):
+    """Attach a device-timeline overlap report (see telemetry/overlap.py)
+    so it rides ``summary()["overlap"]`` and the perf gate. Returns None
+    when telemetry is disabled."""
+    return _GLOBAL.attach_overlap(report)
+
+
 def summary():
     return _GLOBAL.summary()
 

@@ -295,6 +295,9 @@ class Telemetry:
         self._ledger_steps = 0
         self._mfu_last = 0.0
         self._mfu_roll = 0.0
+        # device-timeline overlap report (telemetry/overlap.py), attached
+        # post-hoc by attach_overlap(); rides summary()["overlap"]
+        self.overlap_report = None
 
     # ------------------------------------------------------------------
     # configuration
@@ -826,7 +829,38 @@ class Telemetry:
                 "peak_flops": self._peak_flops or _default_peak_flops(),
                 "mfu": round(self._mfu_last, 6),
                 "mfu_rolling": round(self._mfu_roll, 6),
-                "goodput": round(goodput, 6)}
+                "goodput": round(goodput, 6),
+                # host-timed wall inside compiled step() — opaque to the
+                # ledger: "compute" here includes any comm XLA overlapped
+                # (or failed to overlap) under it. Only an attached overlap
+                # report (summary()["overlap"]) splits it. See
+                # docs/OBSERVABILITY.md "Overlap & critical path".
+                "in_jit_opaque_s": round(
+                    self.ledger_secs.get("compute", 0.0), 6)}
+
+    # ------------------------------------------------------------------
+    # overlap report (telemetry/overlap.py)
+    # ------------------------------------------------------------------
+    def attach_overlap(self, report):
+        """Attach a device-timeline overlap report (built by
+        :mod:`deepspeed_tpu.telemetry.overlap` from a profiler trace or the
+        chip-free analytic mode) so it rides ``summary()["overlap"]``, the
+        bench payloads and the perf gate. Validates structurally; raises
+        ``ValueError`` on a malformed report. Returns the report, or None
+        when telemetry is disabled (constant-time no-op)."""
+        if not self.enabled:
+            return None
+        from deepspeed_tpu.telemetry import overlap as _overlap
+        errs = _overlap.validate_report(report)
+        if errs:
+            raise ValueError("invalid overlap report: " + "; ".join(errs))
+        with self._lock:
+            self.overlap_report = report
+            self.record("overlap/exposed_comm_s",
+                        report["exposed_comm_s"], kind="gauge",
+                        mode=report.get("mode", "trace"),
+                        overlap_fraction=report["overlap_fraction"])
+        return report
 
     # ------------------------------------------------------------------
     # exporters
@@ -911,16 +945,19 @@ class Telemetry:
                           self.memory_samples[-1]["bytes_in_use"])
                       if self.memory_samples else 0,
                       "oom": self.last_oom_report is not None}
-            return {"enabled": True, "spans": spans,
-                    "comm": {"ops": comm, "total_bytes": total_bytes,
-                             "total_wire_bytes": total_wire_bytes},
-                    "dispatch": dispatch,
-                    "compile": {"programs": compile_sec,
-                                "cache_hits": hits, "cache_misses": misses},
-                    "counters": counters,
-                    "memory": memory,
-                    "ledger": self._ledger_summary(),
-                    "serving": self._serving_summary()}
+            out = {"enabled": True, "spans": spans,
+                   "comm": {"ops": comm, "total_bytes": total_bytes,
+                            "total_wire_bytes": total_wire_bytes},
+                   "dispatch": dispatch,
+                   "compile": {"programs": compile_sec,
+                               "cache_hits": hits, "cache_misses": misses},
+                   "counters": counters,
+                   "memory": memory,
+                   "ledger": self._ledger_summary(),
+                   "serving": self._serving_summary()}
+            if self.overlap_report is not None:
+                out["overlap"] = self.overlap_report
+            return out
 
     def format_summary(self):
         """DeepSpeed-style fixed-width tables over every stream."""
@@ -972,6 +1009,13 @@ class Telemetry:
             lines.append(f"hbm peak: {mem['peak_bytes']} bytes"
                          f"  ({mem['sample_count']} samples"
                          f"{', OOM observed' if mem['oom'] else ''})")
+        ov = s.get("overlap")
+        if ov:
+            lines.append(
+                f"overlap[{ov['mode']}]: comm {ov['comm_s']*1e3:.2f} ms  "
+                f"exposed {ov['exposed_comm_s']*1e3:.2f} ms "
+                f"({ov['exposed_fraction']:.1%})  "
+                f"overlap {ov['overlap_fraction']:.1%}")
         srv = s.get("serving", {})
         if srv.get("histograms"):
             lines.append(f"{'Serving hist':<26}{'Count':<8}{'p50(ms)':<12}"
@@ -1019,6 +1063,12 @@ class Telemetry:
         if led["steps"]:
             events.append((f"{p}Ledger/mfu", led["mfu_rolling"], step))
             events.append((f"{p}Ledger/goodput", led["goodput"], step))
+        ov = s.get("overlap")
+        if ov:
+            events.append((f"{p}Overlap/exposed_comm_s",
+                           ov["exposed_comm_s"], step))
+            events.append((f"{p}Overlap/overlap_fraction",
+                           ov["overlap_fraction"], step))
         srv = s.get("serving", {})
         for name, st in srv.get("histograms", {}).items():
             if st["count"]:

@@ -13,7 +13,11 @@ BASELINE of any of the same shapes, with configurable relative thresholds:
 Serving-path metrics (``bench_serving.py --replay`` payloads or a summary's
 ``serving`` section) gate the latency direction: TTFT/TPOT p50+p99 and peak
 KV-block occupancy regress when they GROW (``--max-ttft-growth``,
-``--max-tpot-growth``, ``--max-kv-occupancy-growth``).
+``--max-tpot-growth``, ``--max-kv-occupancy-growth``). Overlap reports
+(``summary()["overlap"]`` / ``scripts/overlap_report.py`` payloads) gate
+exposed-comm seconds the same way (``--max-exposed-growth``) and are
+shape-validated on every input (finite, exposure <= comm total, fractions
+in [0, 1]).
 
 Only metrics present on BOTH sides are compared (an empty baseline —
 ``BASELINE.json`` before any published number — passes with a warning, so
@@ -28,7 +32,8 @@ embedded telemetry summary against ``telemetry/summary.schema.json``, and
 schema-checks the checked-in kernel tuning tables
 (``deepspeed_tpu/autotuning/tables/``: valid per
 ``kernel_table.validate_table`` AND covering every ``BENCH_SHAPES`` bucket)
-— then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run``
+and drives the overlap analyzer jax-free over a fixed analytic schedule
+(``check_overlap_analytic``) — then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run``
 against the repo's own BASELINE.json so a malformed baseline, summary, or
 tuning table fails fast on CPU (docs/OBSERVABILITY.md).
 """
@@ -57,6 +62,9 @@ GATES = {
     "tpot_p50_s": ("up", "max_tpot_growth"),
     "tpot_p99_s": ("up", "max_tpot_growth"),
     "peak_kv_occupancy": ("up", "max_kv_occupancy_growth"),
+    # overlap report (telemetry/overlap.py): exposed-comm seconds growing
+    # means the schedule got worse at hiding collectives
+    "exposed_comm_s": ("up", "max_exposed_growth"),
 }
 
 #: extra/doc keys lifted verbatim into the metric dict when positive
@@ -94,7 +102,10 @@ def extract_metrics(doc):
     if not isinstance(doc, dict):
         return m
     # bench payload: {"metric": "...tokens_per_sec...", "value": N, "extra": {}}
-    if "value" in doc and "metric" in doc:
+    # (overlap payloads carry exposed SECONDS as value — lower is better,
+    # the opposite gate direction, so never lift them as throughput)
+    if "value" in doc and "metric" in doc and \
+            "overlap" not in str(doc.get("metric", "")):
         try:
             v = float(doc["value"])
             if v > 0:
@@ -170,6 +181,16 @@ def extract_metrics(doc):
         if isinstance(g, dict) and g.get("peak", 0) > 0 and \
                 "peak_kv_occupancy" not in m:
             m["peak_kv_occupancy"] = float(g["peak"])
+    # overlap report: summary["overlap"] or a payload's extra["overlap"]
+    for src in (find_summary(doc) or {}, extra, doc):
+        ov = src.get("overlap") if isinstance(src, dict) else None
+        if isinstance(ov, dict) and "exposed_comm_s" not in m:
+            try:
+                v = float(ov["exposed_comm_s"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if v > 0:
+                m["exposed_comm_s"] = v
     return m
 
 
@@ -341,6 +362,80 @@ def validate_serving_payload(doc):
     return None
 
 
+def _load_overlap_module():
+    """Load telemetry/overlap.py standalone (stdlib-only at module scope,
+    same pattern as kernel_table) so overlap validation runs in the tier-1
+    dry-run lane without importing the package or jax."""
+    import importlib.util
+    mod_path = os.path.join(REPO_ROOT, "deepspeed_tpu", "telemetry",
+                            "overlap.py")
+    spec = importlib.util.spec_from_file_location("_overlap", mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def validate_overlap_payload(doc):
+    """Structurally validate any overlap report riding this doc — a bare
+    ``summary()["overlap"]`` section, a payload's ``extra["overlap"]``
+    (``scripts/overlap_report.py``), or a doc-level ``overlap`` key: every
+    number finite, exposure <= comm total, fractions in [0, 1]. Pure dict
+    checks via the standalone overlap module — no jax, no jsonschema.
+    Returns an error string or None."""
+    if not isinstance(doc, dict):
+        return None
+    extra = doc.get("extra") if isinstance(doc.get("extra"), dict) else {}
+    reports = []
+    for src in (find_summary(doc) or {}, extra, doc):
+        ov = src.get("overlap") if isinstance(src, dict) else None
+        if isinstance(ov, dict) and not any(ov is r for r in reports):
+            reports.append(ov)
+    if not reports:
+        return None
+    try:
+        ov_mod = _load_overlap_module()
+    except Exception as e:
+        return f"cannot load overlap module: {e}"
+    for rep in reports:
+        errs = ov_mod.validate_report(rep)
+        if errs:
+            return "overlap report invalid: " + "; ".join(errs)
+    return None
+
+
+def check_overlap_analytic():
+    """Drive the overlap analyzer end-to-end jax-free: build the analytic
+    serialized schedule from a fixed collective inventory, attribute it,
+    and require the report to validate AND model every collective as fully
+    exposed (the synchronous-XLA worst case the scheduling pass ratchets
+    from). Returns (report, errors) for the dry-run lane."""
+    try:
+        ov = _load_overlap_module()
+    except Exception as e:
+        return {}, [f"cannot load overlap module: {e}"]
+    per_device = ov.analytic_intervals(1e-3, [
+        {"op": "all_gather", "axis": "dp", "bytes": 1 << 20,
+         "seconds": 2e-4, "count": 2},
+        {"op": "reduce_scatter", "axis": "dp", "bytes": 1 << 20,
+         "seconds": 3e-4},
+        {"op": "all_reduce", "axis": "dp", "bytes": 4096, "seconds": 5e-5},
+    ])
+    report = ov.overlap_report(per_device, mode="analytic")
+    errors = ov.validate_report(report)
+    if not errors and abs(report["exposed_comm_s"]
+                          - report["comm_s"]) > 1e-9:
+        errors.append("analytic serialized schedule must be fully exposed "
+                      f"(exposed {report['exposed_comm_s']} != comm "
+                      f"{report['comm_s']})")
+    if not errors and not report["critical_path"]["ops"]:
+        errors.append("analytic report has an empty critical path")
+    return {"exposed_comm_s": report.get("exposed_comm_s"),
+            "comm_s": report.get("comm_s"),
+            "collectives": len(report.get("collectives", [])),
+            "critical_path_ops": len(
+                report.get("critical_path", {}).get("ops", []))}, errors
+
+
 def compare(baseline, candidate, thresholds):
     """-> (verdicts, regressed). Only metrics on both sides are gated."""
     verdicts = []
@@ -381,6 +476,9 @@ def main(argv=None):
     ap.add_argument("--max-ttft-growth", type=float, default=0.10)
     ap.add_argument("--max-tpot-growth", type=float, default=0.10)
     ap.add_argument("--max-kv-occupancy-growth", type=float, default=0.10)
+    ap.add_argument("--max-exposed-growth", type=float, default=0.10,
+                    help="allowed relative growth in exposed-comm seconds "
+                         "(overlap report)")
     ap.add_argument("--dry-run", action="store_true",
                     help="validate inputs (parse + summary schema) only")
     args = ap.parse_args(argv)
@@ -393,7 +491,8 @@ def main(argv=None):
     for label, doc in docs.items():
         if doc is None:
             return 2
-        err = validate_summary(doc) or validate_serving_payload(doc)
+        err = validate_summary(doc) or validate_serving_payload(doc) \
+            or validate_overlap_payload(doc)
         if err:
             print(f"perf_gate: {label}: {err}", file=sys.stderr)
             return 2
@@ -405,11 +504,15 @@ def main(argv=None):
         qgz_report, qgz_errors = check_qgz_wire()
         for err in qgz_errors:
             print(f"perf_gate: qgz_wire: {err}", file=sys.stderr)
-        errors = table_errors + qgz_errors
+        overlap_report, overlap_errors = check_overlap_analytic()
+        for err in overlap_errors:
+            print(f"perf_gate: overlap: {err}", file=sys.stderr)
+        errors = table_errors + qgz_errors + overlap_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
                           "qgz_wire": qgz_report,
+                          "overlap": overlap_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
         return 2 if errors else 0
@@ -431,7 +534,8 @@ def main(argv=None):
                   "max_compile_growth": args.max_compile_growth,
                   "max_ttft_growth": args.max_ttft_growth,
                   "max_tpot_growth": args.max_tpot_growth,
-                  "max_kv_occupancy_growth": args.max_kv_occupancy_growth}
+                  "max_kv_occupancy_growth": args.max_kv_occupancy_growth,
+                  "max_exposed_growth": args.max_exposed_growth}
     verdicts, regressed = compare(base_m, cand_m, thresholds)
     result = {"compared": len(verdicts), "regressed": regressed,
               "verdicts": verdicts,

@@ -2,17 +2,45 @@
 
 Writes a perfetto/tensorboard trace to ``/tmp/ds_tpu_trace`` and prints the
 top compiled-program cost split (from XLA's own cost analysis) so the next
-optimization lever is visible without a trace viewer. One TPU job at a time.
+optimization lever is visible without a trace viewer. Takes the shared chip
+lease (``utils/chip_lease``) like bench.py — one TPU job at a time.
+
+``DS_TPU_TELEMETRY=1`` enables the unified telemetry pipeline and emits one
+JSON payload line to stdout (bench payload convention) with the summary —
+including the overlap report attributed from the captured trace
+(``telemetry/overlap.py``) — embedded in ``extra.telemetry``.
 
     python scripts/profile_step.py [--batch 32] [--remat dots] [--steps 5]
 """
 
 import argparse
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _attach_trace_overlap(trace_dir):
+    """Best-effort: attribute exposure from the trace just captured and
+    attach it to telemetry. Profiler output layout varies by jax version —
+    never let report plumbing kill the profile run."""
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.telemetry import overlap
+    try:
+        events = overlap.load_trace_events(trace_dir)
+        per_device = overlap.intervals_from_trace(events)
+        if not per_device:
+            return None
+        report = overlap.overlap_report(
+            per_device, mode="trace",
+            comm_stats=telemetry.get_telemetry().comm_stats)
+        return telemetry.attach_overlap(report)
+    except Exception as e:
+        print(f"overlap attribution unavailable: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        return None
 
 
 def main():
@@ -23,12 +51,24 @@ def main():
     ap.add_argument("--out", default="/tmp/ds_tpu_trace")
     args = ap.parse_args()
 
+    # one TPU job at a time: same per-host flock bench.py serializes on
+    # (no-op None on CPU-pinned runs; auto-released at process exit)
+    from deepspeed_tpu.utils import chip_lease
+    chip_lease.process_lease(name="profile_step")
+
     import jax
     import numpy as np
 
     import deepspeed_tpu
+    from deepspeed_tpu import telemetry
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
     from deepspeed_tpu.parallel import groups
+
+    telemetry_on = os.environ.get("DS_TPU_TELEMETRY") == "1"
+    if telemetry_on:
+        telemetry.configure(enabled=True, sample_sync=False,
+                            chrome_trace_path=os.environ.get(
+                                "DS_TPU_TELEMETRY_TRACE", ""))
 
     print("devices:", jax.devices(), flush=True)
     seq = 1024
@@ -85,6 +125,16 @@ def main():
     print(f"{dt*1000:.1f} ms/step, {toks:.0f} tokens/s "
           f"(batch {args.batch}, remat {args.remat})", flush=True)
     print(f"trace written to {args.out}", flush=True)
+
+    if telemetry_on:
+        _attach_trace_overlap(args.out)
+        payload = {"metric": "profile_step_ms", "value": round(dt * 1e3, 3),
+                   "unit": "ms",
+                   "extra": {"tokens_per_s": round(toks, 1),
+                             "batch": args.batch, "remat": args.remat,
+                             "trace_dir": args.out,
+                             "telemetry": telemetry.summary()}}
+        print(json.dumps(payload), flush=True)
 
 
 if __name__ == "__main__":

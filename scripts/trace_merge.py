@@ -22,6 +22,14 @@ spread of matched k-th occurrences of each collective key across hosts —
 a persistently-late host is a straggler (data loader, thermal throttle,
 failing chip).
 
+Each host's comm records are additionally run through the overlap
+analyzer (``telemetry/overlap.py``, loaded standalone — no jax): exposed
+segments (comm not covered by that host's fwd/bwd/step spans) land on a
+per-host ``exposure`` lane (tid 1) in the merged trace, and the straggler
+report ranks hosts by exposed-comm seconds (``exposure_by_host`` /
+``most_exposed_host``) so cross-host skew and exposure read off one
+report.
+
 Usage:
     python scripts/trace_merge.py host0.jsonl host1.jsonl ... \
         --out merged_trace.json --report straggler_report.json
@@ -30,10 +38,48 @@ Exit 0 on success, 2 on unreadable/empty input.
 """
 
 import argparse
+import importlib.util
 import json
 import os
 import sys
 from collections import defaultdict
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _overlap_module():
+    """telemetry/overlap.py loaded standalone (stdlib-only at module scope,
+    the kernel_table pattern) — trace_merge stays repo-import-free."""
+    spec = importlib.util.spec_from_file_location(
+        "_overlap", os.path.join(REPO_ROOT, "deepspeed_tpu", "telemetry",
+                                 "overlap.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def host_exposures(per_host):
+    """Per-host exposed-comm attribution from the JSONL records: spans
+    (fwd/bwd/step/eval) are the compute union, ``comm/*`` records the
+    collectives. Timestamps stay in each host's own epoch — callers
+    subtract the alignment offset. Returns
+    ``{host: {"exposed_comm_s", "comm_s", "exposed_fraction",
+    "intervals": [comm interval + exposed segments]}}``."""
+    ov = _overlap_module()
+    out = {}
+    for host, records in per_host.items():
+        att = ov.attribute(ov.intervals_from_jsonl_records(records,
+                                                           host=host))
+        tot = att["totals"]
+        out[host] = {
+            "exposed_comm_s": round(tot["exposed_comm_s"], 6),
+            "comm_s": round(tot["comm_s"], 6),
+            "exposed_fraction": round(
+                min(tot["exposed_comm_s"] / tot["comm_s"], 1.0)
+                if tot["comm_s"] > 0 else 0.0, 6),
+            "intervals": att["comm_intervals"],
+        }
+    return out
 
 
 def load_host_records(path):
@@ -98,7 +144,7 @@ def align_offsets(per_host):
     return offsets, anchor
 
 
-def straggler_report(per_host, offsets):
+def straggler_report(per_host, offsets, exposures=None):
     """Match the k-th occurrence of each collective key across hosts; skew
     of one matched set = max - min aligned timestamp. A host that is
     consistently the max is the straggler."""
@@ -139,16 +185,40 @@ def straggler_report(per_host, offsets):
         "straggler": max(worst, key=worst.get) if worst else None,
         "matches": matches,
     }
+    if exposures:
+        ranked = sorted(exposures.items(),
+                        key=lambda kv: (-kv[1]["exposed_comm_s"], kv[0]))
+        report["exposure_by_host"] = {
+            h: {k: v for k, v in e.items() if k != "intervals"}
+            for h, e in ranked}
+        report["most_exposed_host"] = \
+            ranked[0][0] if ranked and ranked[0][1]["exposed_comm_s"] > 0 \
+            else None
     return report
 
 
-def merged_trace_events(per_host, offsets):
-    """Chrome events with one synthetic pid per host (per-host tracks)."""
+def merged_trace_events(per_host, offsets, exposures=None):
+    """Chrome events with one synthetic pid per host (per-host tracks).
+    Exposed-comm segments land on a dedicated ``exposure`` lane (tid 1) so
+    the uncovered slices of each collective are visible next to the spans
+    that failed to hide them."""
     events = []
     for chrome_pid, host in enumerate(sorted(per_host), start=1):
         events.append({"name": "process_name", "ph": "M", "pid": chrome_pid,
                        "args": {"name": host}})
+        events.append({"name": "thread_name", "ph": "M", "pid": chrome_pid,
+                       "tid": 1, "args": {"name": "exposure"}})
         off = offsets[host]
+        for iv in (exposures or {}).get(host, {}).get("intervals", []):
+            for seg_start, seg_end in iv["exposed_segments"]:
+                events.append({
+                    "pid": chrome_pid, "tid": 1,
+                    "name": f"exposed:{iv['op']}", "ph": "X",
+                    "cat": "exposure",
+                    "ts": round((seg_start - off) * 1e6, 3),
+                    "dur": round((seg_end - seg_start) * 1e6, 3),
+                    "args": {"axis": iv["axis"], "bytes": iv["bytes"],
+                             "exposed_s": round(iv["exposed_s"], 6)}})
         for rec in per_host[host]:
             ts_us = round((rec["ts"] - off) * 1e6, 3)
             name, kind = rec["name"], rec.get("kind")
@@ -194,8 +264,9 @@ def merge(paths, out_path=None, report_path=None):
         else:
             per_host[host] = records
     offsets, anchor = align_offsets(per_host)
-    events = merged_trace_events(per_host, offsets)
-    report = straggler_report(per_host, offsets)
+    exposures = host_exposures(per_host)
+    events = merged_trace_events(per_host, offsets, exposures=exposures)
+    report = straggler_report(per_host, offsets, exposures=exposures)
     report["alignment_anchor"] = list(anchor) if anchor else None
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "otherData": {"producer": "deepspeed_tpu.scripts.trace_merge",

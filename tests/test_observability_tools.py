@@ -206,7 +206,8 @@ def test_train_loop_ledger_and_multihost_merge(eight_devices, tmp_path):
     doc = json.load(open(merged))
     # per-host tracks: 2 process_name labels, and a memory counter track
     # under EACH host pid
-    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    metas = [e for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
     assert len(metas) == 2
     mem_pids = {e["pid"] for e in doc["traceEvents"]
                 if e["ph"] == "C" and e["name"] == "hbm_bytes_in_use"}
@@ -288,6 +289,10 @@ def test_perf_gate_dry_run_tier1_wiring():
         assert info["errors"] == [], (name, info)
     for name, cov in out["kernel_table"]["bench_coverage"].items():
         assert cov["covered"], (name, cov["missing"])
+    # the overlap analyzer rides the same lane: the jax-free analytic
+    # schedule must attribute as fully exposed with a non-empty critical path
+    assert out["overlap"]["exposed_comm_s"] == out["overlap"]["comm_s"]
+    assert out["overlap"]["critical_path_ops"] > 0
 
 
 def test_perf_gate_kernel_table_check_fails_on_bad_table(tmp_path,
@@ -402,6 +407,147 @@ def test_perf_gate_dry_run_validates_replay_payload_shape(tmp_path):
     errp.write_text(json.dumps(err_doc))
     r = _run([PERF_GATE, "--baseline", str(errp), "--dry-run"])
     assert r.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# overlap exposure (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+OVERLAP_REPORT = os.path.join(REPO_ROOT, "scripts", "overlap_report.py")
+
+
+def _overlap_payload(exposed=1e-3, comm=None):
+    comm = exposed if comm is None else comm
+    return {"metric": "overlap_exposed_comm_s", "value": exposed, "unit": "s",
+            "extra": {"overlap": {
+                "mode": "analytic", "devices": 1,
+                "step_s": 1e-3 + comm, "compute_s": 1e-3, "comm_s": comm,
+                "overlapped_comm_s": round(comm - exposed, 9),
+                "exposed_comm_s": exposed, "gap_s": 0.0,
+                "overlap_fraction": round(1.0 - exposed / comm, 6),
+                "exposed_fraction": round(exposed / comm, 6),
+                "collectives": [], "advice": [],
+                "critical_path": {"device": "d0", "length_s": 1e-3 + comm,
+                                  "compute_s": 1e-3, "comm_s": comm,
+                                  "exposed_comm_s": exposed, "ops": []}}}}
+
+
+def test_perf_gate_exposed_growth_gate(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_overlap_payload(exposed=1e-3)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(base)])
+    assert r.returncode == 0, r.stderr
+    compared = {v["metric"] for v in json.loads(r.stdout)["verdicts"]}
+    assert compared == {"exposed_comm_s"}, \
+        "exposed SECONDS must never be lifted as throughput"
+    # +50% exposure (threshold 10%) -> regression in the UP direction
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_overlap_payload(exposed=1.5e-3, comm=1.5e-3)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 3, (r.stdout, r.stderr)
+    bad = {v["metric"] for v in json.loads(r.stdout)["verdicts"]
+           if v["regressed"]}
+    assert bad == {"exposed_comm_s"}
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand),
+              "--max-exposed-growth", "0.60"])
+    assert r.returncode == 0
+    # LESS exposure is an improvement, never a regression
+    cand.write_text(json.dumps(_overlap_payload(exposed=2e-4, comm=1e-3)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def test_perf_gate_validates_overlap_payload_shape(tmp_path):
+    # exposure > comm total is structurally impossible -> reject (exit 2)
+    doc = _overlap_payload(exposed=1e-3)
+    doc["extra"]["overlap"]["exposed_comm_s"] = 5.0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "overlap report invalid" in r.stderr
+    # NaN fractions are rejected without jsonschema (pure dict checks)
+    doc = _overlap_payload(exposed=1e-3)
+    doc["extra"]["overlap"]["overlap_fraction"] = float("nan")
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "overlap" in r.stderr
+
+
+def test_overlap_report_analytic_cpu_acceptance(tmp_path):
+    """The chip-free analytic report end to end on CPU: trace a ZeRO-shaped
+    collective mix on 8 forced host devices, model the serialized schedule,
+    and emit a payload perf_gate accepts — the ISSUE 8 acceptance path."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, OVERLAP_REPORT, "--analytic"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payloads = [json.loads(ln) for ln in r.stdout.splitlines()
+                if ln.startswith("{")]
+    assert len(payloads) == 1
+    doc = payloads[0]
+    assert doc["metric"] == "overlap_exposed_comm_s"
+    rep = doc["extra"]["overlap"]
+    assert rep["mode"] == "analytic"
+    # synchronous-XLA model: every collective serialized, fully exposed
+    assert rep["exposed_fraction"] == 1.0
+    assert doc["value"] == rep["exposed_comm_s"] > 0
+    ops = {c["op"] for c in rep["collectives"]}
+    assert {"all_gather", "reduce_scatter", "all_reduce"} <= ops
+    assert all(c["bytes"] > 0 for c in rep["collectives"])
+    assert rep["advice"], "serialized collectives next to compute must " \
+                          "yield prefetch advice"
+    assert len(rep["critical_path"]["ops"]) >= 4
+    # the summary rides along with the overlap section attached + valid
+    assert doc["extra"]["telemetry"]["overlap"] == rep
+    # and the payload passes the gate: shape validation + self-comparison
+    p = tmp_path / "overlap.json"
+    p.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(p), "--candidate", str(p)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def test_trace_merge_exposure_ranking_and_lanes(tmp_path):
+    """Straggler report ranks hosts by exposed-comm seconds and the merged
+    trace carries per-host exposure lanes: host-a hides its collective under
+    fwd, host-b runs it in the open."""
+    def _write(path, host, pid, span_end, comm_end):
+        recs = [
+            {"kind": "span", "name": "fwd", "ts": span_end, "value": 1.0,
+             "host": host, "pid": pid, "run_id": "r"},
+            {"kind": "gauge", "name": "comm/all_reduce", "ts": comm_end,
+             "value": 4096, "tags": {"axis": "dp", "seconds": 1.0},
+             "host": host, "pid": pid, "run_id": "r"},
+        ]
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write(a, "host-a", 1, span_end=2.0, comm_end=1.5)  # comm [0.5,1.5] ⊂ fwd [1,2]...
+    _write(b, "host-b", 2, span_end=1.0, comm_end=3.0)  # comm [2,3] after fwd [0,1]
+    merged = tmp_path / "merged.json"
+    r = _run([TRACE_MERGE, str(a), str(b), "--out", str(merged)])
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout)
+    exp = report["exposure_by_host"]
+    # host-a: comm [0.5,1.5] vs fwd [1.0,2.0] -> exposed [0.5,1.0] = 0.5s
+    assert exp["host-a:1"]["exposed_comm_s"] == pytest.approx(0.5)
+    # host-b: comm [2,3] entirely outside fwd [0,1] -> fully exposed
+    assert exp["host-b:2"]["exposed_comm_s"] == pytest.approx(1.0)
+    assert exp["host-b:2"]["exposed_fraction"] == pytest.approx(1.0)
+    assert report["most_exposed_host"] == "host-b:2"
+    # ranking order: most exposed first
+    assert list(exp) == ["host-b:2", "host-a:1"]
+    # merged trace: exposure lane (tid 1, cat "exposure") under both hosts
+    doc = json.load(open(merged))
+    lanes = [e for e in doc["traceEvents"] if e.get("cat") == "exposure"]
+    assert lanes and all(e["tid"] == 1 for e in lanes)
+    assert {e["name"] for e in lanes} == {"exposed:all_reduce"}
+    thread_meta = [e for e in doc["traceEvents"]
+                   if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert {e["args"]["name"] for e in thread_meta} == {"exposure"}
 
 
 @pytest.mark.slow

@@ -91,6 +91,10 @@ def test_disabled_noop_fast_path(tmp_path, monkeypatch):
         RuntimeError("RESOURCE_EXHAUSTED: out of memory")) is None
     assert telemetry.oom_postmortem(error="x") is None
 
+    # overlap attachment is a no-op too: no validation, no record, no state
+    assert telemetry.attach_overlap({"not": "even a valid report"}) is None
+    assert telemetry.get_telemetry().overlap_report is None
+
     assert not jl.exists(), "disabled record must never open the jsonl sink"
     assert telemetry.summary() == {"enabled": False}
     assert telemetry.monitor_events(1) == []
