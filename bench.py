@@ -588,6 +588,41 @@ def run_bench():
                         if st["bytes"] else 0.0}
         if wire:
             payload["extra"]["wire_bytes"] = wire
+        # analytic overlap exposure for the measured step: the traced comm
+        # inventory against the FLOP model's roofline compute, scored by the
+        # scheduled timeline when the overlap pass is on (perf_gate gates
+        # exposed_comm_s growth on exactly this block)
+        try:
+            from deepspeed_tpu.autotuning.kernel_table import (
+                normalize_device_kind)
+            from deepspeed_tpu.telemetry import overlap as _overlap
+            comm_ops = []
+            for op, per_axis in comm.get("ops", {}).items():
+                for axis, st in per_axis.items():
+                    comm_ops.append({"op": op, "axis": axis,
+                                     "bytes": st["bytes"],
+                                     "wire_bytes": st["wire_bytes"],
+                                     "count": st["count"]})
+            slug = normalize_device_kind(kind)
+            cost = {"flops": fpt * tokens_per_step / max(n_chips, 1)}
+            axis_sizes = {"dp": max(n_chips, 1)}
+            ov_cfg = engine.config.overlap_config
+            if ov_cfg.schedule and comm_ops:
+                from deepspeed_tpu.runtime.zero import (
+                    overlap_schedule as _osched)
+                plan = _osched.OverlapPlan(
+                    prefetch_depth=ov_cfg.prefetch_depth,
+                    grad_buckets=ov_cfg.grad_buckets)
+                ov_rep = _osched.scheduled_report(
+                    cost, comm_ops, plan, device_kind=slug,
+                    axis_sizes=axis_sizes)
+            else:
+                ov_rep = _overlap.analytic_report(
+                    cost, comm_ops, device_kind=slug,
+                    axis_sizes=axis_sizes)
+            payload["extra"]["overlap"] = ov_rep
+        except Exception as e:
+            print(f"bench: overlap embed failed: {e}", file=sys.stderr)
     if on_tpu:
         record_last_good(payload)
     emit(payload)

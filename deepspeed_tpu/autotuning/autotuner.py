@@ -187,7 +187,7 @@ class Autotuner:
         # monotone in both terms, which is all the ORDERING needs
         return (compute_t + mem_t) / max(mbs, 1)     # per-sample time
 
-    def _build_config(self, stage, mbs, remat, offload=None):
+    def _build_config(self, stage, mbs, remat, offload=None, overlap=None):
         cfg = dict(self.base_config)
         zero = dict(cfg.get("zero_optimization", {}))
         zero["stage"] = stage
@@ -199,11 +199,43 @@ class Autotuner:
         ac = dict(cfg.get("activation_checkpointing", {}))
         ac["policy"] = remat
         cfg["activation_checkpointing"] = ac
+        if overlap is not None:
+            cfg["overlap"] = {
+                "schedule": True,
+                "prefetch_depth": int(overlap["prefetch_depth"]),
+                "grad_buckets": int(overlap["grad_buckets"]),
+            }
         cfg.pop("train_batch_size", None)
         cfg["train_micro_batch_size_per_gpu"] = mbs
         cfg["gradient_accumulation_steps"] = \
             self.base_config.get("gradient_accumulation_steps", 1)
         return cfg
+
+    # ---- overlap co-decision (runtime/zero/overlap_schedule.py) ----
+    def _overlap_comm_ops(self, stage, dp_world):
+        """The collective inventory a ZeRO step at ``stage`` implies, per
+        device per step — what the overlap planner schedules. Stage >= 3
+        all-gathers the working params across the forward (the prefetch-class
+        op the layer pipeline hides); stage >= 2 reduce-scatters the grads
+        (the bucket-class op backward hides); below that the grad all_reduce
+        is a tail op nothing overlaps (the serialized worst case)."""
+        n = self.model_info["num_params"] if self.model_info else 0
+        mixed = (self.base_config.get("bf16", {}).get("enabled")
+                 or self.base_config.get("fp16", {}).get("enabled"))
+        working = (2 if mixed else 4) * n
+        ops = []
+        if stage >= 3 and dp_world > 1:
+            ops.append({"op": "all_gather", "axis": "dp",
+                        "bytes": int(working)})
+        if dp_world > 1:
+            op = "reduce_scatter" if stage >= 2 else "all_reduce"
+            ops.append({"op": op, "axis": "dp", "bytes": int(4 * n)})
+        return ops
+
+    def _overlap_n_layers(self, default=8):
+        sp = (self.model.streaming_plan()
+              if hasattr(self.model, "streaming_plan") else None)
+        return int(sp.get("num_blocks", default)) if sp else default
 
     def _run_experiment(self, exp):
         import deepspeed_tpu
@@ -440,7 +472,8 @@ class Autotuner:
         return step, abstract
 
     def tune_chip_free(self, topology_name="v5e:2x2", search="cost",
-                       compile_fn=None, device_kind=None, headroom=0.4):
+                       compile_fn=None, device_kind=None, headroom=0.4,
+                       overlap_hints=None):
         """Rank the pruned config grid WITHOUT a TPU. Returns
         ``(best_config, ranking)`` where ranking lists every candidate with
         its feasibility verdict and proxy score (seconds/sample — ordering
@@ -451,13 +484,23 @@ class Autotuner:
         its compiled temp+output bytes + the stage-sharded optimizer-state
         estimate fit the target chip's HBM under ``headroom``. Score =
         cost-analysis roofline (flops/peak + bytes/bw) per sample, plus the
-        host-tier PCIe penalty for offload candidates.
+        host-tier PCIe penalty for offload candidates, plus the candidate's
+        best-plan EXPOSED collective seconds: the sweep co-decides (stage x
+        micro-batch x remat x overlap depth/bucket count) — a stage whose
+        collectives the schedule can hide beats one whose tail all_reduce
+        cannot be (runtime/zero/overlap_schedule.py). Each feasible entry
+        carries the chosen plan in ``entry["overlap"]`` and the winning
+        config gains the matching ``overlap`` section.
+
+        ``overlap_hints``: ``telemetry.overlap.advise()`` rows from a prior
+        run; they seed the candidate order (measured exposure first).
 
         ``compile_fn(fn, abstract) -> (cost_dict, memory_analysis)`` is
         injectable so CPU tests can rank against a synthetic target without
         paying AOT compiles."""
         from deepspeed_tpu.autotuning import kernel_tuner
         from deepspeed_tpu.autotuning.kernel_table import normalize_device_kind
+        from deepspeed_tpu.runtime.zero import overlap_schedule
 
         self.profile_model_info()
         if compile_fn is None:
@@ -488,6 +531,7 @@ class Autotuner:
         ranking = []
         compiled_cache = {}  # (mbs, remat) -> (cost, mem) | exception
         n_params = self.model_info["num_params"]
+        n_layers = self._overlap_n_layers()
         for stage, remat, offload, mbs in grid[:self.max_trials]:
             entry = {"zero_stage": stage, "remat_policy": remat,
                      "offload": offload, "micro_batch_size": mbs,
@@ -528,6 +572,22 @@ class Autotuner:
                 t += (4 * n_params + 2 * n_params) / dp_world / 16e9
             elif offload == "param":
                 t += (4 * n_params + 2 * n_params + 4 * n_params) / 16e9
+            # overlap co-decision: the step pays only the comm the best
+            # (depth, buckets) plan cannot hide under this candidate's compute
+            comm_ops = self._overlap_comm_ops(stage, dp_world)
+            if comm_ops:
+                specs = overlap_schedule.fill_comm_seconds(
+                    comm_ops, device_kind=slug,
+                    axis_sizes={"dp": dp_world})
+                serialized = sum(float(s["seconds"])
+                                 * max(int(s.get("count", 1)), 1)
+                                 for s in specs)
+                plan, exposed, _ = overlap_schedule.best_plan(
+                    t, specs, hints=overlap_hints, n_layers=n_layers)
+                entry["overlap"] = dict(
+                    plan.to_dict(), exposed_comm_s=round(exposed, 9),
+                    serialized_comm_s=round(serialized, 9))
+                t += exposed
             entry["feasible"] = True
             entry["score"] = t / max(mbs, 1)  # seconds/sample proxy
 
@@ -539,7 +599,8 @@ class Autotuner:
         best = min(feasible, key=lambda e: e["score"])
         cfg = self._build_config(best["zero_stage"],
                                  best["micro_batch_size"],
-                                 best["remat_policy"], best["offload"])
+                                 best["remat_policy"], best["offload"],
+                                 overlap=best.get("overlap"))
         ranking.sort(key=lambda e: (not e["feasible"],
                                     e["score"] if e["score"] is not None
                                     else float("inf")))

@@ -195,7 +195,7 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.nowrap
     def streaming_apply(self, resident, fetch, batch, deterministic=True,
-                        rng=None):
+                        rng=None, prefetch_depth=0):
         cfg = self.config
         if isinstance(batch, dict):
             input_ids, labels = batch["input_ids"], batch.get("labels")
@@ -212,15 +212,17 @@ class GPT2LMHeadModel(nn.Module):
                 rngs={"dropout": jax.random.fold_in(rng, -1)})
         block = Block(cfg)
 
-        def body(carry, i):
-            bp = fetch(i)
+        def block_fn(carry, bp, i):
             rngs = {"dropout": jax.random.fold_in(rng, i)} if stochastic else None
             return block.apply({"params": bp}, carry, deterministic,
-                               rngs=rngs), None
+                               rngs=rngs)
 
-        # save-nothing remat: backward re-streams each block (see llama.py)
-        body = jax.checkpoint(body, prevent_cse=False)
-        x, _ = jax.lax.scan(body, x, jnp.arange(cfg.n_layer))
+        # save-nothing remat inside scheduled_scan: backward re-streams each
+        # block (see llama.py); prefetch_depth>0 keeps that many blocks'
+        # fetches in flight ahead of compute (overlap_schedule.scheduled_scan)
+        from deepspeed_tpu.runtime.zero.overlap_schedule import scheduled_scan
+        x = scheduled_scan(block_fn, x, cfg.n_layer, fetch,
+                           prefetch_depth=prefetch_depth, remat=True)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype).apply(
             {"params": resident["ln_f"]}, x)
         if labels is None:

@@ -308,11 +308,13 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.nowrap
     def streaming_apply(self, resident, fetch, batch, deterministic=True,
-                        rng=None):
+                        rng=None, prefetch_depth=0):
         """Forward pass with per-block parameter streaming. ``fetch(i)``
         returns block ``i``'s parameter tree (engine-provided, differentiable;
         its backward routes the block's grads to the host tier). ``rng`` (a
-        PRNGKey) is folded per block for stochastic layers. Numerics are
+        PRNGKey) is folded per block for stochastic layers. ``prefetch_depth``
+        keeps that many blocks' fetches in flight ahead of compute
+        (overlap_schedule.scheduled_scan; 0 = fetch at use). Numerics are
         identical to ``__call__`` — same modules, same order."""
         cfg = self.config
         if isinstance(batch, dict):
@@ -324,19 +326,19 @@ class LlamaForCausalLM(nn.Module):
         positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         block = LlamaBlock(cfg)
 
-        def body(carry, i):
-            bp = fetch(i)
+        def block_fn(carry, bp, i):
             rngs = {"dropout": jax.random.fold_in(rng, i)} \
                 if (rng is not None and not deterministic) else None
             return block.apply({"params": bp}, carry, positions,
-                               deterministic, rngs=rngs), None
+                               deterministic, rngs=rngs)
 
         # save-nothing remat regardless of the configured policy: a policy
         # that saved the fetched weights would pin all L blocks in HBM and
         # defeat the tier. Backward re-streams each block (the reference
         # re-gathers partitions for backward the same way).
-        body = jax.checkpoint(body, prevent_cse=False)
-        x, _ = jax.lax.scan(body, x, jnp.arange(cfg.num_hidden_layers))
+        from deepspeed_tpu.runtime.zero.overlap_schedule import scheduled_scan
+        x = scheduled_scan(block_fn, x, cfg.num_hidden_layers, fetch,
+                           prefetch_depth=prefetch_depth, remat=True)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype).apply(
             {"params": resident["norm"]}, x)
         lm_head = resident["lm_head"]

@@ -92,11 +92,11 @@ class DeepSpeedTransformerLayer(nn.Module):
         if cfg.adjust_init_range and cfg.num_hidden_layers > 0:
             out_std = std / (2.0 * cfg.num_hidden_layers) ** 0.5
 
-        def dense(x, n_out, name, init_std):
-            w = self.param(f"{name}w", nn.initializers.normal(init_std),
-                           (x.shape[-1], n_out), jnp.float32)
-            b = self.param(f"{name}b", nn.initializers.zeros, (n_out,),
-                           jnp.float32)
+        def dense(mdl, x, n_out, name, init_std):
+            w = mdl.param(f"{name}w", nn.initializers.normal(init_std),
+                          (x.shape[-1], n_out), jnp.float32)
+            b = mdl.param(f"{name}b", nn.initializers.zeros, (n_out,),
+                          jnp.float32)
             return x @ w.astype(dt) + b.astype(dt)
 
         def ln(x, name):
@@ -105,8 +105,8 @@ class DeepSpeedTransformerLayer(nn.Module):
 
         x = hidden_states.astype(dt)
 
-        def attention(h):
-            qkv = dense(h, 3 * Hs, "attn_qkv", std)
+        def attention(mdl, h):
+            qkv = dense(mdl, h, 3 * Hs, "attn_qkv", std)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, T, nh, dh)
             k = k.reshape(B, T, nh, dh)
@@ -120,29 +120,32 @@ class DeepSpeedTransformerLayer(nn.Module):
             a = mha(q, k, v, bias=bias, causal=False)
             a = a.reshape(B, T, Hs)
             a = nn.Dropout(cfg.attn_dropout_ratio)(a, deterministic=det)
-            return dense(a, Hs, "attn_o", out_std)
+            return dense(mdl, a, Hs, "attn_o", out_std)
 
-        def mlp(h):
-            g = jax.nn.gelu(dense(h, cfg.intermediate_size, "inter_", std),
-                            approximate=True)
-            return dense(g, Hs, "output_", out_std)
+        def mlp(mdl, h):
+            g = jax.nn.gelu(dense(mdl, h, cfg.intermediate_size, "inter_",
+                                  std), approximate=True)
+            return dense(mdl, g, Hs, "output_", out_std)
 
+        # the remat knobs need flax's LIFTED checkpoint: attention/mlp create
+        # params and Dropout submodules, and raw jax.checkpoint around scope-
+        # mutating code raises JaxTransformError (transforms/models mixed)
         if cfg.attn_dropout_checkpoint or cfg.normalize_invertible:
-            attention = jax.checkpoint(attention, prevent_cse=False)
+            attention = nn.remat(attention, prevent_cse=False)
         if cfg.gelu_checkpoint:
-            mlp = jax.checkpoint(mlp, prevent_cse=False)
+            mlp = nn.remat(mlp, prevent_cse=False)
 
         if cfg.pre_layer_norm:
-            a = attention(ln(x, "attn_nn"))
+            a = attention(self, ln(x, "attn_nn"))
             x = x + nn.Dropout(cfg.hidden_dropout_ratio)(a, deterministic=det)
-            m = mlp(ln(x, "norm_"))
+            m = mlp(self, ln(x, "norm_"))
             out = x + nn.Dropout(cfg.hidden_dropout_ratio)(m, deterministic=det)
         else:
-            a = attention(x)
+            a = attention(self, x)
             x = ln(x + nn.Dropout(cfg.hidden_dropout_ratio)(a,
                                                             deterministic=det),
                    "attn_nn")
-            m = mlp(x)
+            m = mlp(self, x)
             out = ln(x + nn.Dropout(cfg.hidden_dropout_ratio)(m,
                                                               deterministic=det),
                      "norm_")

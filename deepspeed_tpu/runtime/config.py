@@ -203,6 +203,21 @@ class AutotuningConfig(DeepSpeedConfigModel):
     tuner_num_trials = 50
 
 
+class OverlapConfig(DeepSpeedConfigModel):
+    """Compute/communication overlap schedule (runtime/zero/overlap_schedule.py).
+
+    ``schedule`` turns on the scheduled qgZ step: double-buffered parameter
+    block prefetch inside the layer scan plus the bucketized grad exchange at
+    the GAS boundary. Default-off — the unscheduled path stays the reference
+    numerics until parity is pinned for a model/config combination.
+    ``prefetch_depth`` is how many layer blocks of gathered parameters stay
+    in flight ahead of compute (0 = fetch-at-use); ``grad_buckets`` is how
+    many independent exchange chains the stacked grad reduce splits into."""
+    schedule = False
+    prefetch_depth = 1
+    grad_buckets = 2
+
+
 class MoEConfig(DeepSpeedConfigModel):
     enabled = False
     ep_size = 1
@@ -225,7 +240,7 @@ KNOWN_TOP_LEVEL_KEYS = {
     C.ACTIVATION_CHECKPOINTING, C.PIPELINE, C.TENSOR_PARALLEL,
     C.SEQUENCE_PARALLEL_SIZE, C.EXPERT_PARALLEL_SIZE, C.COMMS_LOGGER,
     C.MONITOR_TENSORBOARD, C.MONITOR_CSV, C.MONITOR_WANDB, C.FLOPS_PROFILER,
-    C.TELEMETRY, C.RESILIENCE,
+    C.TELEMETRY, C.RESILIENCE, C.OVERLAP,
     C.ELASTICITY, C.AUTOTUNING, C.CHECKPOINT, C.COMPILE,
     "moe", "seed", "hybrid_engine", "curriculum_learning", "data_efficiency",
     "compression_training", "eigenvalue", "progressive_layer_drop",
@@ -347,6 +362,7 @@ class DeepSpeedConfig:
         self.monitor_config_wandb = WandbConfig(pd.get(C.MONITOR_WANDB, {}))
         self.flops_profiler_config = FlopsProfilerConfig(pd.get(C.FLOPS_PROFILER, {}))
         self.telemetry_config = TelemetryConfig(pd.get(C.TELEMETRY, {}))
+        self.overlap_config = OverlapConfig(pd.get(C.OVERLAP, {}))
         self.resilience_config = ResilienceConfig(pd.get(C.RESILIENCE, {}))
         self.checkpoint_config = CheckpointConfig(pd.get(C.CHECKPOINT, {}))
         self.elasticity_config = ElasticityConfig(pd.get(C.ELASTICITY, {}))

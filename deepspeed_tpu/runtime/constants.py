@@ -45,6 +45,7 @@ MONITOR_CSV = "csv_monitor"
 MONITOR_WANDB = "wandb"
 FLOPS_PROFILER = "flops_profiler"
 TELEMETRY = "telemetry"
+OVERLAP = "overlap"
 RESILIENCE = "resilience"
 ELASTICITY = "elasticity"
 AUTOTUNING = "autotuning"

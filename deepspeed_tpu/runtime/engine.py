@@ -846,12 +846,34 @@ class DeepSpeedEngine:
 
         return make_loss_fn, dq, grad_use_sh
 
+    def _overlap_streaming_ready(self, plan):
+        """Can the overlap schedule's prefetch leg run? Needs the qgZ manual
+        path, a model speaking the streaming protocol, and no compression
+        transform (ptx operates on the whole param tree, which a block-streamed
+        forward never materializes). Bucketized grad reduce works regardless."""
+        ov = self.config.overlap_config
+        if not (ov.schedule and plan is not None):
+            return False
+        mod = self.module
+        ok = (mod is not None and hasattr(mod, "streaming_plan")
+              and hasattr(mod, "streaming_apply") and mod.streaming_plan()
+              and self._param_transform is None)
+        if not ok:
+            logger.warning(
+                "overlap.schedule: param prefetch disabled — model lacks the "
+                "streaming protocol (streaming_plan/streaming_split/"
+                "streaming_apply) or a compression transform is active; the "
+                "bucketized grad exchange still applies")
+        return bool(ok)
+
     def _build_micro_step(self):
         grad_sh = self._shardings["grad"]
         accum_dtype = self.grad_accum_dtype
         make_loss_fn, dq, grad_use_sh = self._loss_closures()
 
         plan = self._qgz_plan
+        if plan is not None and self._overlap_streaming_ready(plan):
+            return self._build_scheduled_micro_step(plan)
         if plan is not None:
             # qgZ: manual over the ZeRO data axes — per-device local grads
             # accumulated unreduced in the stacked buffer (zero/qgz.py)
@@ -906,6 +928,123 @@ class DeepSpeedEngine:
             acc = jax.tree.map(lambda a, g: a + g, state.grad_acc, grads)
             acc = constrain_tree(acc, grad_sh)
             return state._replace(grad_acc=acc, rng=rng), loss
+
+        return jax.jit(micro_step, donate_argnums=(0,))
+
+    def _build_scheduled_micro_step(self, plan):
+        """qgZ micro-step under ``overlap.schedule`` (zero/overlap_schedule.py).
+
+        Differences from the unscheduled qgZ body, same math:
+
+        - **Double-buffered prefetch.** Only the resident (non-block) leaves
+          are gathered at step entry; each scan block's params are gathered
+          per layer via ``plan.gather_block`` inside
+          ``streaming_apply(prefetch_depth=D)`` — the scan carry holds the
+          next D gathered blocks and each iteration issues block ``i+D``'s
+          all-gather before block ``i``'s compute, so XLA's async-collective
+          scheduling can hide the exchange under the previous layer's math.
+        - **Shadow-input trick.** The stacked accumulator needs FULL-shape
+          unreduced local grads, but differentiating through the per-block
+          all-gather would make AD transpose it into a full-precision
+          psum_scatter during backward — bypassing the quantized boundary
+          exchange. So the gathers run on stop-gradient values and each
+          fetched block adds a zeros "shadow" slice differentiated instead:
+          ``fetch(i) = gather_block(stop_grad(stacked), i) + shadow[i]``.
+          d(loss)/d(shadow) is exactly the stacked full-shape local grads.
+        """
+        accum_dtype = self.grad_accum_dtype
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+        fp16 = self.fp16_enabled
+        mult = float(getattr(self, "_grad_scale_multiplier", 1.0))
+        mod = self.module
+        ov = self.config.overlap_config
+        depth = max(int(ov.prefetch_depth), 0)
+        n_blocks = int(mod.streaming_plan()["num_blocks"])
+        use_sh = (self._shardings.get("use")
+                  if self.zero_optimization_stage() >= 3 else None)
+        use_res = mod.streaming_split(use_sh)[0] if use_sh is not None else None
+        resident_specs, stacked_specs = mod.streaming_split(plan.param_specs)
+        log_dist(f"overlap.schedule on: prefetch_depth={depth} "
+                 f"grad_buckets={int(ov.grad_buckets)} over {n_blocks} blocks",
+                 ranks=[0])
+        from jax.sharding import PartitionSpec as P
+
+        def micro_step(state: TrainState, batch):
+            rng, sub = jax.random.split(state.rng)
+
+            def body(params_local, acc_local, batch_local, loss_scale,
+                     key, gstep):
+                idx = jnp.int32(0)
+                for a in plan.axes:
+                    idx = idx * plan.sizes[a] + jax.lax.axis_index(a)
+                key = jax.random.fold_in(key, idx)
+                resident_local, stacked_local = mod.streaming_split(
+                    params_local)
+                p_res = plan.gather_params(resident_local,
+                                           specs=resident_specs)
+                stacked_sg = jax.tree.map(jax.lax.stop_gradient,
+                                          stacked_local)
+
+                def full_zeros(x, spec):
+                    shape = list(x.shape)
+                    if spec is not None:
+                        for d, e in enumerate(spec):
+                            if e is None or d >= len(shape):
+                                continue
+                            for a in (e if isinstance(e, tuple) else (e,)):
+                                if a in plan.manual:
+                                    shape[d] *= plan.sizes[a]
+                    return jnp.zeros(shape, x.dtype)
+
+                shadow0 = jax.tree.map(full_zeros, stacked_local,
+                                       stacked_specs)
+
+                def loss_fn(args):
+                    p_r, shadow = args
+                    if use_res is not None:
+                        p_r = constrain_tree(p_r, use_res)
+
+                    def fetch(i):
+                        blk = plan.gather_block(stacked_sg, stacked_specs, i)
+                        return jax.tree.map(
+                            lambda b, s: b + jax.lax.dynamic_index_in_dim(
+                                s, i, axis=0, keepdims=False), blk, shadow)
+
+                    loss = mod.streaming_apply(p_r, fetch, batch_local,
+                                               deterministic=False, rng=key,
+                                               prefetch_depth=depth)
+                    if isinstance(loss, tuple):
+                        loss = loss[0]
+                    scaled = loss.astype(jnp.float32)
+                    if mult != 1.0:
+                        scaled = scaled * mult
+                    if fp16:
+                        scaled = scaled * loss_scale
+                    if prescale and predivide != 1.0:
+                        scaled = scaled / predivide
+                    return scaled, loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)((p_res, shadow0))
+                g_full = mod.streaming_merge(*grads)
+                new_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(accum_dtype)[None],
+                    acc_local, g_full)
+                return new_acc, loss.astype(jnp.float32).reshape(1)
+
+            fn = jax.shard_map(
+                body, mesh=plan.mesh,
+                in_specs=(plan.param_in_specs(state.params),
+                          plan.stacked_specs(state.grad_acc, project=True),
+                          P(plan.axes), P(), P(), P()),
+                out_specs=(plan.stacked_specs(state.grad_acc, project=True),
+                           P(plan.axes)),
+                axis_names=plan.manual, check_vma=False)
+            new_acc, losses = fn(state.params, state.grad_acc, batch,
+                                 state.scale.loss_scale, sub,
+                                 state.global_step)
+            return state._replace(grad_acc=new_acc, rng=rng), losses.mean()
 
         return jax.jit(micro_step, donate_argnums=(0,))
 
@@ -1015,6 +1154,11 @@ class DeepSpeedEngine:
         plan = self._qgz_plan
         feedback = getattr(self, "_qgz_feedback", False)
         core = self._apply_core_builder()
+        # overlap.schedule: split the boundary exchange into byte-balanced
+        # bucket chains XLA can pipeline against each other and the backward
+        # epilogue (zero/overlap_schedule.py; bit-identical per leaf)
+        ov = self.config.overlap_config
+        buckets = max(int(ov.grad_buckets), 1) if ov.schedule else 1
 
         def apply_step(state: TrainState, lr):
             denom = self._grad_denom(state, gas)
@@ -1026,9 +1170,9 @@ class DeepSpeedEngine:
                 if feedback:
                     summed, new_res = plan.reduce(
                         state.grad_acc, residual=state.qgz_residual,
-                        return_residual=True)
+                        return_residual=True, buckets=buckets)
                 else:
-                    summed = plan.reduce(state.grad_acc)
+                    summed = plan.reduce(state.grad_acc, buckets=buckets)
                 qdenom = denom * jnp.float32(plan.world)
                 grads = jax.tree.map(lambda g: g / qdenom, summed)
             else:

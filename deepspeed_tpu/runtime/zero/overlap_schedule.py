@@ -1,0 +1,444 @@
+"""Overlap scheduling pass — double-buffered param prefetch, bucketized grad
+exchange, and the graph-level plan the autotuner co-decides.
+
+ROADMAP item 2 (T3 / DeepCompile in PAPERS.md): PR 8 built the measurement —
+``telemetry/overlap.py`` attributes every collective's *exposed* seconds, and
+its analytic mode's serialized schedule (compute, then every collective after
+it) is the 100%-exposed worst case. This module is the pass that acts on that
+number. Three layers, one plan:
+
+**Analytic scheduler** (stdlib-only — the chip-free model of what the
+scheduled program does). :func:`scheduled_intervals` builds the two-resource
+timeline a prefetch-depth-D / K-bucket step implies: one compute stream (L
+forward layer slabs, then backward), one serialized collective stream.
+Parameter all-gathers split per layer; gather ``i`` may issue when layer
+``i - D``'s compute *starts* (D buffers in flight) and layer ``i``'s compute
+waits on it — the pipeline-fill gather stays exposed, the steady state hides.
+Grad reduce-scatters split into K buckets; bucket ``b`` may issue the moment
+its slice of backward completes, overlapping the remaining backward. Smaller
+chunks pay the per-call link latency — more buckets is not free, which is
+exactly the trade-off the planner weighs. The existing exposure algebra
+(``overlap.attribute``) scores the timeline; nothing here hand-computes
+exposure.
+
+**Planner**. :func:`candidate_plans` turns ``telemetry.overlap.advise()``
+hints into seed candidates ("prefetch all_gather over dp" → deeper prefetch
+first, reduce_scatter hints → more buckets first) and
+:func:`plan_exposure` scores a (depth, buckets) plan on an inventory —
+``Autotuner.tune_chip_free`` sweeps it as a fourth/fifth tuning dimension
+alongside (stage × micro-batch × remat).
+
+**Runtime structure** (jax, imported lazily). :func:`scheduled_scan` is the
+double-buffered layer loop the engine's qgZ micro-step runs under
+``overlap.schedule``: the scan carry holds the next ``depth`` blocks' gathered
+parameters, each iteration issues the gather for block ``i + depth`` *before*
+the compute that consumes block ``i`` — the all-gather is data-independent of
+the current block's math, so XLA's async-collective scheduling can overlap
+them; no hand-ordered host code. The gather itself is
+``QgzPlan.gather_block``; grads ride the shadow-input trick (see
+``engine._build_micro_step``) so the qgZ stacked accumulator keeps its
+unreduced local-grad semantics.
+
+perf_gate loads this file standalone (same pattern as ``telemetry/overlap.py``)
+to re-derive the checked-in baseline's schedule jax-free; ``_OVERLAP`` is the
+injection point for the equally-standalone overlap module.
+"""
+
+import math
+
+# Injection point: perf_gate.py loads this file outside the package and plugs
+# its standalone telemetry/overlap.py module in here. In-package callers
+# resolve it lazily (overlap.py is stdlib-only, so this never drags in jax).
+_OVERLAP = None
+
+# matches kernel_tuner._COMM_LATENCY_S — the per-call launch/sync floor that
+# makes many small collectives cost more than one big one
+DEFAULT_LATENCY_S = 1e-6
+
+# op-name classes the scheduler knows how to move. Everything else (grad-norm
+# all_reduce, MoE dispatch, ...) stays serialized after backward — exposed.
+_PREFETCH_OPS = ("all_gather", "gather")
+_BUCKET_OPS = ("reduce_scatter", "psum_scatter", "all_to_all", "exchange")
+
+
+def _ov():
+    global _OVERLAP
+    if _OVERLAP is None:
+        from deepspeed_tpu.telemetry import overlap as _OVERLAP  # noqa: PLW0603
+    return _OVERLAP
+
+
+def _op_class(op):
+    name = str(op or "").lower()
+    if any(k in name for k in _PREFETCH_OPS):
+        return "prefetch"
+    if any(k in name for k in _BUCKET_OPS):
+        return "bucket"
+    return "tail"
+
+
+class OverlapPlan:
+    """One schedule decision: how deep the param prefetch pipeline runs and
+    how many grad buckets the boundary exchange splits into. ``n_layers`` and
+    ``fwd_fraction`` shape the analytic timeline only."""
+
+    def __init__(self, prefetch_depth=1, grad_buckets=2, n_layers=8,
+                 fwd_fraction=1.0 / 3.0, latency_s=DEFAULT_LATENCY_S):
+        if prefetch_depth < 0:
+            raise ValueError(f"prefetch_depth must be >= 0, got {prefetch_depth}")
+        if grad_buckets < 1:
+            raise ValueError(f"grad_buckets must be >= 1, got {grad_buckets}")
+        if n_layers < 1:
+            raise ValueError(f"n_layers must be >= 1, got {n_layers}")
+        if not 0.0 < fwd_fraction < 1.0:
+            raise ValueError(f"fwd_fraction must be in (0, 1), got {fwd_fraction}")
+        self.prefetch_depth = int(prefetch_depth)
+        self.grad_buckets = int(grad_buckets)
+        self.n_layers = int(n_layers)
+        self.fwd_fraction = float(fwd_fraction)
+        self.latency_s = float(latency_s)
+
+    def to_dict(self):
+        return {"prefetch_depth": self.prefetch_depth,
+                "grad_buckets": self.grad_buckets,
+                "n_layers": self.n_layers,
+                "fwd_fraction": round(self.fwd_fraction, 6),
+                "latency_s": self.latency_s}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(prefetch_depth=d.get("prefetch_depth", 1),
+                   grad_buckets=d.get("grad_buckets", 2),
+                   n_layers=d.get("n_layers", 8),
+                   fwd_fraction=d.get("fwd_fraction", 1.0 / 3.0),
+                   latency_s=d.get("latency_s", DEFAULT_LATENCY_S))
+
+    def __repr__(self):
+        return (f"OverlapPlan(depth={self.prefetch_depth}, "
+                f"buckets={self.grad_buckets}, layers={self.n_layers})")
+
+
+def _split_spec(spec, m, latency_s):
+    """One comm-op inventory entry split into ``m`` equal chunks. The
+    bandwidth share divides evenly; every chunk pays the per-call latency
+    floor again — splitting is never free."""
+    m = max(int(m), 1)
+    count = max(int(spec.get("count", 1)), 1)
+    total_s = float(spec["seconds"]) * count
+    bw_s = max(total_s - latency_s * count, 0.0)
+    chunk_s = bw_s / m + latency_s
+    nbytes = int(spec.get("bytes", 0) or 0)
+    wire = spec.get("wire_bytes")
+    out = []
+    for k in range(m):
+        out.append({"op": spec["op"], "axis": spec.get("axis"),
+                    "bytes": nbytes // m,
+                    "wire_bytes": (int(wire) // m if wire is not None else None),
+                    "count": 1, "seconds": chunk_s})
+    return out
+
+
+def scheduled_intervals(compute_s, comm_ops, plan, device="analytic:0"):
+    """The per-device timeline a scheduled step implies — the analytic-mode
+    counterpart of ``overlap.analytic_intervals``'s serialized worst case.
+
+    Two resources: the compute stream runs ``n_layers`` forward slabs then the
+    backward block; the collective stream serializes chunks (collectives never
+    hide each other — same rule the attribution uses). Data dependencies:
+    layer ``i``'s forward waits on param-gather chunk ``i``; gather ``i`` may
+    issue once layer ``i - depth``'s compute starts (``depth`` buffers in
+    flight; depth 0 = issue at the consuming layer's boundary, fully
+    serialized fill). Grad bucket ``b`` may issue once backward has retired
+    ``(b+1)/K`` of its work; tail ops (grad-norm all_reduce, anything
+    unclassified) wait for backward *and* every bucket.
+
+    ``comm_ops`` entries need ``seconds`` (use :func:`fill_comm_seconds`).
+    Comm totals are conserved up to the per-chunk latency floor, so serialized
+    and scheduled reports stay byte-comparable."""
+    ov = _ov()
+    L, D, K = plan.n_layers, plan.prefetch_depth, plan.grad_buckets
+    lat = plan.latency_s
+
+    gathers, buckets, tail = [], [], []
+    for spec in comm_ops:
+        {"prefetch": gathers, "bucket": buckets,
+         "tail": tail}[_op_class(spec.get("op"))].append(spec)
+
+    # split each class across its pipeline stages
+    gather_chunks = [[] for _ in range(L)]
+    for spec in gathers:
+        for i, c in enumerate(_split_spec(spec, L, lat)):
+            gather_chunks[i].append(c)
+    bucket_chunks = [[] for _ in range(K)]
+    for spec in buckets:
+        for b, c in enumerate(_split_spec(spec, K, lat)):
+            bucket_chunks[b].append(c)
+
+    compute_s = float(compute_s)
+    fwd_s = compute_s * plan.fwd_fraction
+    bwd_s = compute_s - fwd_s
+    fwd_slab = fwd_s / L
+
+    ivs = []
+    comm_free = 0.0
+
+    def issue(chunks, ready, tag):
+        """Serialize ``chunks`` onto the collective stream, not before
+        ``ready``; returns when the last lands."""
+        nonlocal comm_free
+        done = ready
+        for c in chunks:
+            start = max(ready, comm_free)
+            end = start + float(c["seconds"])
+            ivs.append(ov.make_interval(
+                f"comm:{c['op']}/{tag}", start, end, kind="comm",
+                device=device, op=c["op"], axis=c.get("axis"),
+                nbytes=c.get("bytes", 0), wire_bytes=c.get("wire_bytes")))
+            comm_free = done = end
+        return done
+
+    # forward: gather i issues at layer (i - D)'s compute start; layer i's
+    # compute waits on gather i and the previous layer
+    start_c = [0.0] * L
+    end_c = [0.0] * L
+    for i in range(L):
+        if D == 0:
+            ready = end_c[i - 1] if i > 0 else 0.0
+        else:
+            ready = start_c[i - D] if i >= D else 0.0
+        g_done = issue(gather_chunks[i], ready, f"prefetch{i:02d}")
+        start_c[i] = max(end_c[i - 1] if i > 0 else 0.0, g_done)
+        end_c[i] = start_c[i] + fwd_slab
+        if fwd_slab > 0:
+            ivs.append(ov.make_interval(f"compute/fwd{i:02d}", start_c[i],
+                                        end_c[i], kind="compute",
+                                        device=device))
+
+    # backward: one slab per bucket window so bucket readiness lands on a
+    # compute boundary; bucket b issues as soon as its window retires
+    t0b = end_c[L - 1] if L else 0.0
+    last_bucket_done = t0b
+    for b in range(K):
+        s = t0b + bwd_s * b / K
+        e = t0b + bwd_s * (b + 1) / K
+        if bwd_s > 0:
+            ivs.append(ov.make_interval(f"compute/bwd{b:02d}", s, e,
+                                        kind="compute", device=device))
+        done = issue(bucket_chunks[b], e, f"bucket{b:02d}")
+        last_bucket_done = max(last_bucket_done, done)
+
+    # tail: grad-norm all_reduce and anything unclassified needs every grad
+    # bucket — serialized after backward and the last exchange
+    ready = max(t0b + bwd_s, last_bucket_done)
+    for spec in tail:
+        secs = float(spec["seconds"])
+        for _ in range(max(int(spec.get("count", 1)), 1)):
+            issue([dict(spec, seconds=secs, count=1)], ready, "tail")
+            ready = comm_free
+    return {device: ivs}
+
+
+def fill_comm_seconds(comm_ops, device_kind="tpu_v5e", axis_sizes=None):
+    """Per-call roofline seconds for inventory entries that lack them (same
+    model ``overlap.analytic_report`` uses). Needs jax only when something is
+    missing — checked-in baselines carry seconds and stay stdlib-only."""
+    specs = []
+    for spec in comm_ops:
+        spec = dict(spec)
+        if "seconds" not in spec:
+            from deepspeed_tpu.autotuning import kernel_tuner
+            count = max(int(spec.get("count", 1)), 1)
+            per_call = spec.get("bytes", 0) / count
+            n = (axis_sizes or {}).get(spec.get("axis"))
+            spec["seconds"] = kernel_tuner.comm_roofline_seconds(
+                spec["op"], per_call, n=n, device_kind=device_kind)
+        specs.append(spec)
+    return specs
+
+
+def plan_exposure(compute_s, comm_ops, plan, device="analytic:0"):
+    """Exposed-comm seconds of one plan on one inventory (the planner's
+    scoring primitive — attribution algebra, no report assembly)."""
+    per_device = scheduled_intervals(compute_s, comm_ops, plan, device=device)
+    att = _ov().attribute(per_device)
+    return att["totals"]["exposed_comm_s"]
+
+
+def scheduled_report(cost, comm_ops, plan, device_kind="tpu_v5e",
+                     axis_sizes=None, top_k=10, compute_s=None):
+    """Chip-free overlap report for the *scheduled* program, with the
+    serialized worst case it ratchets from riding in ``report["schedule"]``.
+
+    Same inputs as ``overlap.analytic_report`` plus the plan; ``compute_s``
+    short-circuits the cost-model roofline when the caller already has it
+    (the standalone perf_gate path — no jax)."""
+    ov = _ov()
+    if compute_s is None:
+        from deepspeed_tpu.autotuning import kernel_tuner
+        compute_s = kernel_tuner.roofline_compute_seconds(
+            float(cost.get("flops", 0.0) or 0.0),
+            float(cost.get("bytes accessed", 0.0) or 0.0),
+            device_kind=device_kind)
+    specs = fill_comm_seconds(comm_ops, device_kind=device_kind,
+                              axis_sizes=axis_sizes)
+    serialized = ov.attribute(ov.analytic_intervals(compute_s, specs))
+    ser_exposed = serialized["totals"]["exposed_comm_s"]
+
+    per_device = scheduled_intervals(compute_s, specs, plan)
+    report = ov.overlap_report(per_device, mode="analytic", top_k=top_k,
+                               device_kind=device_kind)
+    exposed = report["exposed_comm_s"]
+    reduction = ((ser_exposed - exposed) / ser_exposed
+                 if ser_exposed > 0 else 0.0)
+    report["schedule"] = dict(
+        plan.to_dict(),
+        compute_s=round(float(compute_s), 9),
+        comm_ops=[{k: v for k, v in s.items()} for s in specs],
+        serialized_exposed_comm_s=round(ser_exposed, 9),
+        exposed_reduction_fraction=round(reduction, 6),
+    )
+    return report
+
+
+def validate_schedule(sched):
+    """Structural check of a report's ``schedule`` block (stdlib-only —
+    perf_gate re-derives the baseline from exactly these fields). Returns a
+    list of error strings."""
+    errs = []
+    if not isinstance(sched, dict):
+        return ["schedule block is not a dict"]
+    for k in ("prefetch_depth", "grad_buckets", "n_layers"):
+        v = sched.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"schedule.{k} missing or invalid (got {v!r})")
+    for k in ("compute_s", "serialized_exposed_comm_s", "fwd_fraction"):
+        v = sched.get(k)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not math.isfinite(v) or v < 0:
+            errs.append(f"schedule.{k} missing or non-finite (got {v!r})")
+    ops = sched.get("comm_ops")
+    if not isinstance(ops, list) or not ops:
+        errs.append("schedule.comm_ops missing or empty")
+        return errs
+    for spec in ops:
+        if not isinstance(spec, dict) or "op" not in spec:
+            errs.append(f"malformed comm_ops entry {spec!r}")
+            continue
+        s = spec.get("seconds")
+        if not isinstance(s, (int, float)) or not math.isfinite(s) or s < 0:
+            errs.append(f"comm_ops[{spec['op']}].seconds invalid ({s!r})")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# planner: advisor hints -> candidate plans -> scored sweep dimension
+# ---------------------------------------------------------------------------
+
+DEFAULT_DEPTHS = (0, 1, 2)
+DEFAULT_BUCKETS = (1, 2, 4)
+
+
+def candidate_plans(hints=None, n_layers=8, depths=DEFAULT_DEPTHS,
+                    buckets=DEFAULT_BUCKETS, fwd_fraction=1.0 / 3.0):
+    """(depth, buckets) candidates for the sweep, advisor-seeded.
+
+    ``hints``: ``telemetry.overlap.advise()`` rows. A hint naming a
+    gather-class op with saving potential promotes the deepest prefetch
+    candidates to the front; a reduce-class hint promotes the highest bucket
+    counts — the sweep tries what the measured exposure says matters before
+    falling back to the full ladder. Depth is capped at ``n_layers - 1``
+    (you cannot hold more lookahead than there are layers left)."""
+    depths = sorted({min(int(d), max(n_layers - 1, 0)) for d in depths})
+    buckets = sorted({max(1, min(int(b), n_layers)) for b in buckets})
+    want_depth = want_buckets = False
+    for h in hints or []:
+        if float(h.get("potential_saving_s", 0) or 0) <= 0:
+            continue
+        cls = _op_class(h.get("op"))
+        want_depth |= cls == "prefetch"
+        want_buckets |= cls == "bucket"
+
+    d_order = sorted(depths, reverse=want_depth)
+    b_order = sorted(buckets, reverse=want_buckets)
+    out, seen = [], set()
+    for d in d_order:
+        for b in b_order:
+            if (d, b) not in seen:
+                seen.add((d, b))
+                out.append(OverlapPlan(prefetch_depth=d, grad_buckets=b,
+                                       n_layers=n_layers,
+                                       fwd_fraction=fwd_fraction))
+    return out
+
+
+def best_plan(compute_s, comm_ops, hints=None, n_layers=8,
+              depths=DEFAULT_DEPTHS, buckets=DEFAULT_BUCKETS):
+    """Sweep the candidates on one inventory; returns
+    ``(plan, exposed_s, ranking)`` with the ranking listing every candidate's
+    exposure (ties broken toward the shallower/cheaper plan — fewer live
+    buffers, fewer launches)."""
+    ranking = []
+    for plan in candidate_plans(hints, n_layers=n_layers, depths=depths,
+                                buckets=buckets):
+        exposed = plan_exposure(compute_s, comm_ops, plan)
+        ranking.append({"prefetch_depth": plan.prefetch_depth,
+                        "grad_buckets": plan.grad_buckets,
+                        "exposed_comm_s": round(exposed, 9)})
+    if not ranking:
+        raise ValueError("no overlap candidates to rank")
+    ranking.sort(key=lambda r: (r["exposed_comm_s"], r["prefetch_depth"],
+                                r["grad_buckets"]))
+    top = ranking[0]
+    plan = OverlapPlan(prefetch_depth=top["prefetch_depth"],
+                       grad_buckets=top["grad_buckets"], n_layers=n_layers)
+    return plan, top["exposed_comm_s"], ranking
+
+
+# ---------------------------------------------------------------------------
+# runtime: the double-buffered layer loop (jax, lazy)
+# ---------------------------------------------------------------------------
+
+def scheduled_scan(block_fn, carry, n_blocks, fetch, prefetch_depth=1,
+                   remat=True):
+    """Layer loop with the gather-ahead rotation the scheduling pass needs.
+
+    ``fetch(i)`` returns block ``i``'s (gathered) parameter tree;
+    ``block_fn(carry, block_params, i) -> carry`` applies one block. With
+    ``prefetch_depth`` D >= 1 the scan carry holds the next D fetched blocks:
+    each iteration issues ``fetch(i + D)`` *before* ``block_fn`` consumes the
+    head of the buffer, so inside the loop body the gather has no data
+    dependence on the current block's compute — the async-collective-friendly
+    program order (start on the previous layer's boundary, consume one layer
+    later). Depth 0 degrades to the plain fetch-at-use scan. ``remat=True``
+    wraps the body save-nothing so backward re-issues the gathers instead of
+    pinning every fetched block (stage-3 semantics)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_blocks = int(n_blocks)
+    depth = max(int(prefetch_depth), 0)
+    if depth == 0:
+        def body(c, i):
+            return block_fn(c, fetch(i), i), None
+        if remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        out, _ = lax.scan(body, carry, jnp.arange(n_blocks))
+        return out
+
+    depth = min(depth, max(n_blocks - 1, 1))
+    # pipeline fill: the first D blocks' gathers issue before the loop
+    buf = tuple(fetch(jnp.int32(min(k, n_blocks - 1))) for k in range(depth))
+
+    def body(state, i):
+        c, buf = state
+        # issue the lookahead gather FIRST — independent of this block's math
+        # (tail iterations re-fetch the last block; the value is unused)
+        nxt = fetch(jnp.minimum(i + depth, n_blocks - 1))
+        c = block_fn(c, buf[0], i)
+        return (c, buf[1:] + (nxt,)), None
+
+    if remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    (out, _), _ = lax.scan(body, (carry, buf), jnp.arange(n_blocks))
+    return out

@@ -126,21 +126,47 @@ class QgzPlan:
             out_shardings=shardings)
         return make()
 
-    def gather_params(self, params_local):
+    def _gather_leaf(self, x, spec, skip_dims=0):
+        """All-gather one leaf's manual-axis shards; ``skip_dims`` drops
+        leading spec entries (a sliced-out scan dim shifts the rest left)."""
+        if spec is None:
+            return x
+        for d, e in enumerate(spec[skip_dims:] if skip_dims else spec):
+            if e is None:
+                continue
+            man = tuple(a for a in (e if isinstance(e, tuple) else (e,))
+                        if a in self.manual)
+            if man:
+                x = lax.all_gather(x, man, axis=d, tiled=True)
+        return x
+
+    def gather_params(self, params_local, specs=None):
         """Inside the shard_map body: all-gather stage-3 param shards over the
-        manual axes (the reference's param all-gather, done at step entry)."""
-        def gather(x, spec):
-            if spec is None:
-                return x
-            for d, e in enumerate(spec):
-                if e is None:
-                    continue
+        manual axes (the reference's param all-gather, done at step entry).
+        ``specs`` restricts to a subtree (the overlap pass gathers only the
+        resident leaves here; stacked blocks stream via gather_block)."""
+        specs = self.param_specs if specs is None else specs
+        return jax.tree.map(self._gather_leaf, params_local, specs)
+
+    def gather_block(self, stacked_local, specs, i):
+        """One scan block's params, gathered: slice block ``i`` off each
+        stacked leaf's leading scan dim, then all-gather its ZeRO shards.
+        This is the per-layer shard exchange the overlap schedule issues on
+        the previous layer's boundary (overlap_schedule.scheduled_scan) —
+        same math as slicing the monolithic gather, HBM holds O(depth)
+        blocks instead of the stack."""
+        def one(x, spec):
+            # the partitioner may have put the ZeRO shard on the scan dim
+            # itself — gather it first so index ``i`` addresses global blocks
+            if spec is not None and len(spec) and spec[0] is not None:
+                e = spec[0]
                 man = tuple(a for a in (e if isinstance(e, tuple) else (e,))
                             if a in self.manual)
                 if man:
-                    x = lax.all_gather(x, man, axis=d, tiled=True)
-            return x
-        return jax.tree.map(gather, params_local, self.param_specs)
+                    x = lax.all_gather(x, man, axis=0, tiled=True)
+            x = lax.dynamic_index_in_dim(x, i, axis=0, keepdims=False)
+            return self._gather_leaf(x, spec, skip_dims=1)
+        return jax.tree.map(one, stacked_local, specs)
 
     # --- leaf-wise zero-dim discovery ---------------------------------
     def _zero_dim(self, grad_spec, base_spec):
@@ -216,12 +242,46 @@ class QgzPlan:
             return out, jnp.moveaxis(err, 0, d)
         return out
 
-    def reduce(self, acc_stacked, residual=None, return_residual=False):
+    @staticmethod
+    def _bucketize(sizes, buckets):
+        """Contiguous leaf-index groups with roughly equal byte load — the
+        grad-bucket split the overlap schedule issues as independent
+        exchanges. Deterministic (leaf order), never empty, always exactly
+        ``min(buckets, len(sizes))`` groups."""
+        k = max(1, min(int(buckets), len(sizes)))
+        total = float(sum(sizes)) or 1.0
+        groups, cur, acc = [], [], 0.0
+        for j, s in enumerate(sizes):
+            cur.append(j)
+            acc += s
+            remaining_leaves = len(sizes) - j - 1
+            remaining_groups = k - len(groups) - 1
+            if (len(groups) < k - 1
+                    and (acc >= total * (len(groups) + 1) / k
+                         or remaining_leaves == remaining_groups)
+                    and remaining_leaves >= remaining_groups):
+                groups.append(cur)
+                cur = []
+        if cur:
+            groups.append(cur)
+        return groups
+
+    def reduce(self, acc_stacked, residual=None, return_residual=False,
+               buckets=1):
         """Stacked local-grad buffer -> GSPMD-sharded summed gradients.
 
-        Runs one shard_map over the manual axes; inside, each leaf either does
-        the quantized hierarchical exchange along its ZeRO dim or (no shardable
+        Inside shard_map over the manual axes, each leaf either does the
+        quantized hierarchical exchange along its ZeRO dim or (no shardable
         dim) a plain fp psum.
+
+        ``buckets`` > 1 (the overlap schedule's async grad reduce): the leaf
+        list splits into that many contiguous byte-balanced groups, each
+        exchanged in its OWN shard_map region — the resulting program is
+        ``buckets`` independent collective chains instead of one monolithic
+        chain, so XLA's latency-hiding scheduler can pipeline one bucket's
+        quantize/dequantize math under another bucket's wire time and start
+        exchanging as soon as a bucket's grads exist. Leaf-wise math is
+        untouched — bucketization is bit-identical to the monolithic reduce.
 
         Error feedback (``zero_quantized_gradients_error_feedback``):
         ``residual`` is the previous step's quantization error in the same
@@ -232,10 +292,13 @@ class QgzPlan:
         if return_residual and residual is None:
             raise ValueError("return_residual=True needs the previous "
                              "residual (pass stacked zeros on the first step)")
-        grad_specs, base_specs = self.grad_specs, self.base_specs
-        grad_out_specs = jax.tree.map(
-            lambda _, s: self._project(s), acc_stacked, grad_specs)
-        stacked_in = self.stacked_specs(acc_stacked, project=True)
+        leaves, treedef = jax.tree.flatten(acc_stacked)
+        gspecs = treedef.flatten_up_to(self.grad_specs)
+        bspecs = treedef.flatten_up_to(self.base_specs)
+        res_leaves = (treedef.flatten_up_to(residual)
+                      if residual is not None else [None] * len(leaves))
+        out_projs = [self._project(s) for s in gspecs]
+        in_projs = [self.stacked_spec(s, project=True) for s in bspecs]
 
         def one(leaf, res, gspec, bspec):
             local = leaf[0].astype(jnp.float32)            # [*shape]
@@ -252,30 +315,43 @@ class QgzPlan:
                 return out, err[None]
             return self._reduce_leaf(local, d, axes), None
 
-        def body(acc_local, res_local):
-            leaves, treedef = jax.tree.flatten(acc_local)
-            res_leaves = (treedef.flatten_up_to(res_local)
-                          if res_local is not None else [None] * len(leaves))
-            pairs = [one(leaf, res, gspec, bspec)
-                     for leaf, res, gspec, bspec in
-                     zip(leaves, res_leaves,
-                         treedef.flatten_up_to(grad_specs),
-                         treedef.flatten_up_to(base_specs))]
-            grads = jax.tree.unflatten(treedef, [p[0] for p in pairs])
-            if not return_residual:
-                return grads
-            return grads, jax.tree.unflatten(treedef, [p[1] for p in pairs])
+        sizes = [l.size * jnp.dtype(l.dtype).itemsize for l in leaves]
+        groups = self._bucketize(sizes, buckets)
 
-        if residual is None:
-            fn = jax.shard_map(lambda a: body(a, None), mesh=self.mesh,
-                               in_specs=(stacked_in,),
-                               out_specs=grad_out_specs,
-                               axis_names=self.manual, check_vma=False)
-            return fn(acc_stacked)
-        out_specs = ((grad_out_specs, stacked_in) if return_residual
-                     else grad_out_specs)
-        fn = jax.shard_map(body, mesh=self.mesh,
-                           in_specs=(stacked_in, stacked_in),
-                           out_specs=out_specs,
-                           axis_names=self.manual, check_vma=False)
-        return fn(acc_stacked, residual)
+        out_leaves = [None] * len(leaves)
+        err_leaves = [None] * len(leaves)
+        for idxs in groups:
+            g_in = [in_projs[j] for j in idxs]
+            g_out = [out_projs[j] for j in idxs]
+
+            def body(acc_list, res_list, _idxs=idxs):
+                pairs = [one(leaf, res, gspecs[j], bspecs[j])
+                         for leaf, res, j in zip(acc_list, res_list, _idxs)]
+                if not return_residual:
+                    return [p[0] for p in pairs]
+                return [p[0] for p in pairs], [p[1] for p in pairs]
+
+            if residual is None:
+                fn = jax.shard_map(lambda a, _i=idxs, _b=body: _b(a, [None] * len(_i)),
+                                   mesh=self.mesh, in_specs=(g_in,),
+                                   out_specs=g_out,
+                                   axis_names=self.manual, check_vma=False)
+                got = fn([leaves[j] for j in idxs])
+                errs = [None] * len(idxs)
+            else:
+                out_specs = ((g_out, g_in) if return_residual else g_out)
+                fn = jax.shard_map(body, mesh=self.mesh,
+                                   in_specs=(g_in, g_in),
+                                   out_specs=out_specs,
+                                   axis_names=self.manual, check_vma=False)
+                got = fn([leaves[j] for j in idxs],
+                         [res_leaves[j] for j in idxs])
+                got, errs = got if return_residual else (got, [None] * len(idxs))
+            for j, g, e in zip(idxs, got, errs):
+                out_leaves[j] = g
+                err_leaves[j] = e
+
+        grads = jax.tree.unflatten(treedef, out_leaves)
+        if not return_residual:
+            return grads
+        return grads, jax.tree.unflatten(treedef, err_leaves)

@@ -128,6 +128,31 @@ def run_analytic(args):
     report = overlap.analytic_report(
         dict(ca), comm_ops, device_kind=args.device_kind,
         axis_sizes={"dp": ndev}, top_k=args.top_k)
+
+    if args.schedule:
+        # scheduled analytic mode: run the overlap pass's two-resource
+        # timeline over the SAME inventory; the serialized report's advice
+        # seeds the planner when depth/buckets aren't pinned on the CLI
+        from deepspeed_tpu.runtime.zero import overlap_schedule as osched
+        specs = osched.fill_comm_seconds(comm_ops,
+                                         device_kind=args.device_kind,
+                                         axis_sizes={"dp": ndev})
+        if args.prefetch_depth is None or args.grad_buckets is None:
+            plan, _, _ = osched.best_plan(report["compute_s"], specs,
+                                          hints=report.get("advice"),
+                                          n_layers=args.layers)
+            if args.prefetch_depth is not None:
+                plan.prefetch_depth = args.prefetch_depth
+            if args.grad_buckets is not None:
+                plan.grad_buckets = args.grad_buckets
+        else:
+            plan = osched.OverlapPlan(prefetch_depth=args.prefetch_depth,
+                                      grad_buckets=args.grad_buckets,
+                                      n_layers=args.layers)
+        report = osched.scheduled_report(dict(ca), comm_ops, plan,
+                                         device_kind=args.device_kind,
+                                         axis_sizes={"dp": ndev},
+                                         top_k=args.top_k)
     telemetry.attach_overlap(report)
     return report
 
@@ -150,6 +175,23 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--ffn", type=int, default=1024)
+    ap.add_argument("--schedule", action="store_true",
+                    help="analytic mode: score the overlap pass's scheduled "
+                         "timeline (runtime/zero/overlap_schedule.py) "
+                         "instead of the serialized worst case; the payload "
+                         "carries the serialized baseline in "
+                         "extra.overlap.schedule")
+    ap.add_argument("--prefetch-depth", type=int, default=None,
+                    help="pin the schedule's prefetch depth (default: "
+                         "planner sweep seeded by the advisor hints)")
+    ap.add_argument("--grad-buckets", type=int, default=None,
+                    help="pin the schedule's grad bucket count (default: "
+                         "planner sweep)")
+    ap.add_argument("--layers", type=int, default=8,
+                    help="layer count the scheduled timeline pipelines over")
+    ap.add_argument("--advise", action="store_true",
+                    help="print the top-K actionable prefetch hints with "
+                         "their potential_saving_s")
     args = ap.parse_args()
 
     if args.analytic:
@@ -166,6 +208,16 @@ def main():
         return 1
 
     print(overlap.format_report(report, top_k=args.top_k), file=sys.stderr)
+    if args.advise:
+        hints = (report.get("advice") or [])[:args.top_k]
+        print(f"advisor hints (top {len(hints)}):", file=sys.stderr)
+        for h in hints:
+            print(f"  {h['hint']}  "
+                  f"potential_saving_s={h['potential_saving_s']}",
+                  file=sys.stderr)
+        if not hints:
+            print("  (none — nothing exposed next to independent compute)",
+                  file=sys.stderr)
     extra = {"overlap": report}
     if args.analytic:
         from deepspeed_tpu import telemetry

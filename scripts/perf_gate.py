@@ -33,7 +33,11 @@ schema-checks the checked-in kernel tuning tables
 (``deepspeed_tpu/autotuning/tables/``: valid per
 ``kernel_table.validate_table`` AND covering every ``BENCH_SHAPES`` bucket)
 and drives the overlap analyzer jax-free over a fixed analytic schedule
-(``check_overlap_analytic``) — then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run``
+(``check_overlap_analytic``), and re-derives the checked-in scheduled
+overlap baseline (``onchip_results/overlap_analytic_baseline.json``)
+jax-free, requiring the scheduled exposed seconds to reproduce and to sit
+>= 30% below its serialized worst case (``check_overlap_schedule``) — then
+exits 0/2 without comparing. The tier-1 lane runs ``--dry-run``
 against the repo's own BASELINE.json so a malformed baseline, summary, or
 tuning table fails fast on CPU (docs/OBSERVABILITY.md).
 """
@@ -403,6 +407,82 @@ def validate_overlap_payload(doc):
     return None
 
 
+#: overlap-schedule acceptance: the checked-in scheduled baseline's exposed
+#: seconds must sit at or below this fraction of its own serialized worst
+#: case (>= 30% reduction — ROADMAP item 2's ratchet)
+OVERLAP_SCHEDULE_MAX_RATIO = 0.7
+OVERLAP_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                     "overlap_analytic_baseline.json")
+
+
+def _load_overlap_schedule_module():
+    """Load runtime/zero/overlap_schedule.py standalone (stdlib-only at
+    module scope) with the standalone overlap module plugged into its
+    ``_OVERLAP`` injection point — the scheduled-baseline re-derivation runs
+    in the tier-1 dry-run lane without the package or jax."""
+    import importlib.util
+    mod_path = os.path.join(REPO_ROOT, "deepspeed_tpu", "runtime", "zero",
+                            "overlap_schedule.py")
+    spec = importlib.util.spec_from_file_location("_overlap_schedule",
+                                                  mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod._OVERLAP = _load_overlap_module()
+    return mod
+
+
+def check_overlap_schedule(baseline_path=None):
+    """Re-derive the checked-in scheduled overlap baseline jax-free and hold
+    it to the ratchet: rebuild the two-resource timeline from the recorded
+    ``extra.overlap.schedule`` block (plan + compute_s + comm seconds),
+    require the recomputed exposed seconds to match the recorded payload
+    value, and require exposed <= ``OVERLAP_SCHEDULE_MAX_RATIO`` x the
+    serialized worst case. Returns (report, errors) for the dry-run lane."""
+    path = baseline_path or OVERLAP_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no scheduled baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable scheduled baseline {path}"]
+    ov = doc.get("extra", {}).get("overlap") if isinstance(doc, dict) else None
+    sched = ov.get("schedule") if isinstance(ov, dict) else None
+    if not isinstance(sched, dict):
+        return {}, ["scheduled baseline has no extra.overlap.schedule block"]
+    try:
+        osched = _load_overlap_schedule_module()
+    except Exception as e:
+        return {}, [f"cannot load overlap_schedule module: {e}"]
+    errors = [f"schedule block: {e}"
+              for e in osched.validate_schedule(sched)]
+    if errors:
+        return {}, errors
+    plan = osched.OverlapPlan.from_dict(sched)
+    recomputed = osched.plan_exposure(sched["compute_s"], sched["comm_ops"],
+                                      plan)
+    recorded = float(ov.get("exposed_comm_s", doc.get("value", -1.0)))
+    serialized = float(sched["serialized_exposed_comm_s"])
+    tol = max(1e-9, 1e-4 * max(serialized, recorded))
+    if abs(recomputed - recorded) > tol:
+        errors.append(
+            f"recomputed exposed {recomputed:.3e}s does not match the "
+            f"recorded baseline {recorded:.3e}s — the schedule block and "
+            f"payload value drifted apart (regenerate with "
+            f"scripts/overlap_report.py --analytic --schedule)")
+    if serialized > 0 and recomputed > OVERLAP_SCHEDULE_MAX_RATIO * serialized:
+        errors.append(
+            f"scheduled exposed {recomputed:.3e}s > "
+            f"{OVERLAP_SCHEDULE_MAX_RATIO} x serialized {serialized:.3e}s — "
+            f"the overlap pass no longer hides >= "
+            f"{1 - OVERLAP_SCHEDULE_MAX_RATIO:.0%} of the worst case")
+    return {"exposed_comm_s": round(recomputed, 9),
+            "serialized_exposed_comm_s": serialized,
+            "reduction_fraction": round(
+                (serialized - recomputed) / serialized, 6)
+            if serialized > 0 else 0.0,
+            "prefetch_depth": plan.prefetch_depth,
+            "grad_buckets": plan.grad_buckets}, errors
+
+
 def check_overlap_analytic():
     """Drive the overlap analyzer end-to-end jax-free: build the analytic
     serialized schedule from a fixed collective inventory, attribute it,
@@ -507,12 +587,16 @@ def main(argv=None):
         overlap_report, overlap_errors = check_overlap_analytic()
         for err in overlap_errors:
             print(f"perf_gate: overlap: {err}", file=sys.stderr)
-        errors = table_errors + qgz_errors + overlap_errors
+        sched_report, sched_errors = check_overlap_schedule()
+        for err in sched_errors:
+            print(f"perf_gate: overlap_schedule: {err}", file=sys.stderr)
+        errors = table_errors + qgz_errors + overlap_errors + sched_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
                           "qgz_wire": qgz_report,
                           "overlap": overlap_report,
+                          "overlap_schedule": sched_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
         return 2 if errors else 0

@@ -230,8 +230,11 @@ def test_checkpoint_function_grads_match():
                     dtype=jnp.float32)
     g_plain = jax.grad(f)(x)
     g_remat = jax.grad(lambda x: checkpointing.checkpoint(f, x))(x)
+    # remat recomputes the forward inside the backward program, where XLA
+    # fuses it differently — float32 agrees semantically but not bitwise
+    # (observed max rel diff ~1e-5 on CPU), so rtol must sit above that
     np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_remat),
-                               rtol=1e-6)
+                               rtol=1e-4)
 
 
 def test_checkpoint_policies_and_configure():
