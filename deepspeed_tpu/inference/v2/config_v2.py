@@ -39,3 +39,8 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     state_manager = DSStateManagerConfig()
     kv_cache = KVCacheConfig()
     modules = ModulesConfig()
+    # block-granular prefix caching with copy-on-write sharing
+    # (ragged/prefix_cache.py). Default off: generation is bit-exact either
+    # way (test-pinned) but the knob gates all hashing/refcount bookkeeping
+    # so the disabled path does zero extra work per step.
+    prefix_caching = False

@@ -103,6 +103,18 @@ class InferenceEngineV2:
     def free_blocks(self):
         return self._state.free_blocks
 
+    # -- prefix caching (ragged/prefix_cache.py) ---------------------------
+    @property
+    def prefix_caching(self) -> bool:
+        return self._state.prefix_cache is not None
+
+    def match_prefix(self, uid: int, prompt_tokens) -> int:
+        """Longest-cached-prefix match at sequence creation: creates the
+        sequence holding the shared blocks and returns the matched token
+        count (0 = miss or caching disabled). Schedulers advance their
+        prefill cursor past the return value."""
+        return self._state.match_prefix(uid, prompt_tokens)
+
     def query(self, uid: int, max_request_tokens: int,
               max_request_blocks: int) -> Tuple[int, int]:
         """How many tokens/blocks this sequence could schedule right now."""
@@ -171,10 +183,13 @@ class InferenceEngineV2:
                                      sm.max_ragged_batch_size,
                                      self._max_blocks_per_seq,
                                      self._state.kv_cache.trash_block)
+        caching = self._state.prefix_cache is not None
         for uid, toks in zip(batch_uids, batch_tokens):
             seq = self._state.get_or_create_sequence(uid)
             self._state.ensure_capacity(seq, len(toks))
             seq.in_flight_tokens = len(toks)
+            if caching:
+                seq.tokens.extend(int(t) for t in toks)
             wrapper.insert_sequence(uid, np.asarray(toks, np.int32),
                                     seq.seen_tokens, seq.kv_blocks)
         arrays = wrapper.build()
@@ -187,7 +202,12 @@ class InferenceEngineV2:
         kv.update(k_pool, v_pool)
 
         for uid in batch_uids:
-            self._state.get_sequence(uid).post_forward()
+            seq = self._state.get_sequence(uid)
+            seq.post_forward()
+            if caching:
+                # register blocks as they FILL (not at flush) so concurrent
+                # requests sharing a prefix hit as early as possible
+                self._state.commit_cached_blocks(seq)
         if sp is not None:
             sp.end(logits)  # block_until_ready only when sample_sync is on
         return logits

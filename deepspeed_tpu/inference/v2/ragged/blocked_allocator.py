@@ -4,6 +4,21 @@
 Pure Python on the host: block ids index into the device-resident KV pool.
 The reference keeps the free list in a torch tensor; here a deque is simpler
 and never touches the device.
+
+Blocks are reference counted so one physical block can appear in many
+sequences' block tables (prefix sharing — paged attention indirects through
+block ids, so the kernels never notice). A block is in exactly one of three
+states:
+
+  * **free**   — on the free list, refcount 0, allocatable
+  * **live**   — refcount >= 1, held by one or more sequences
+  * **cached** — refcount 0 but *parked* by a bound ``PrefixCache``: its KV
+    contents are still valid for reuse and it is held out of the free list
+    until the cache evicts it (LRU, under pool pressure) or revives it on a
+    prefix hit
+
+``free + live + cached == num_blocks`` always (``counts`` exposes the terms;
+the property test pins the invariant).
 """
 
 from collections import deque
@@ -16,27 +31,121 @@ class BlockedAllocator:
             raise ValueError(f"need at least 1 block, got {num_blocks}")
         self._num_blocks = num_blocks
         self._free = deque(range(num_blocks))
+        # mirror of _free for O(1) membership and O(free) run-structure stats
+        self._free_set = set(range(num_blocks))
+        self._refs = [0] * num_blocks
+        self._parked = 0        # refcount-0 blocks held by the prefix cache
+        self._cache = None      # bound PrefixCache (park_if_cached / evict)
+        self._stats_cache = None
+
+    def bind_cache(self, cache):
+        """Attach a prefix cache: refcount-0 blocks it recognises are parked
+        (kept warm) instead of freed, and ``allocate`` evicts its LRU parked
+        blocks before declaring the pool exhausted."""
+        self._cache = cache
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
     @property
+    def cached_blocks(self) -> int:
+        return self._parked
+
+    @property
+    def live_blocks(self) -> int:
+        return self._num_blocks - len(self._free) - self._parked
+
+    @property
     def num_blocks(self) -> int:
         return self._num_blocks
 
+    def counts(self):
+        """State census for the allocator invariant
+        (free + live + cached == total)."""
+        return {"free": len(self._free), "live": self.live_blocks,
+                "cached": self._parked, "total": self._num_blocks}
+
+    def refcount(self, block: int) -> int:
+        return self._refs[block]
+
     def allocate(self, num_blocks: int):
-        """Allocate ``num_blocks`` block ids; raises ValueError if exhausted."""
+        """Allocate ``num_blocks`` block ids (refcount 1 each); raises
+        ValueError if exhausted. When a prefix cache is bound, its idle
+        (refcount-0) cached blocks are evicted first — the free tier that
+        runs *before* the scheduler host-swaps any live victim."""
+        if num_blocks > len(self._free) and self._cache is not None:
+            self._cache.evict(num_blocks - len(self._free))
         if num_blocks > len(self._free):
             raise ValueError(
                 f"requested {num_blocks} blocks, only {len(self._free)} free")
-        return [self._free.popleft() for _ in range(num_blocks)]
+        out = []
+        for _ in range(num_blocks):
+            b = self._free.popleft()
+            self._free_set.discard(b)
+            self._refs[b] = 1
+            out.append(b)
+        self._stats_cache = None
+        return out
+
+    def ref(self, blocks):
+        """Take an extra reference on live blocks (prefix sharing)."""
+        for b in blocks:
+            self._check_range(b)
+            if self._refs[b] < 1:
+                raise ValueError(f"ref of non-live block {b}")
+            self._refs[b] += 1
+
+    def deref(self, blocks):
+        """Drop one reference per block; returns the blocks that hit
+        refcount 0 WITHOUT disposing of them (caller decides: free list or
+        cache park). Double-deref raises."""
+        zeroed = []
+        for b in blocks:
+            self._check_range(b)
+            if self._refs[b] < 1:
+                raise ValueError(f"double free of block {b}")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                zeroed.append(b)
+        return zeroed
 
     def free(self, blocks):
+        """Drop one reference per block; blocks reaching refcount 0 return to
+        the free list unless a bound prefix cache parks them (their KV stays
+        warm and evictable). Shared blocks (refcount still > 0) stay live."""
+        for b in self.deref(blocks):
+            if self._cache is not None and self._cache.park_if_cached(b):
+                self._parked += 1
+            else:
+                self._release_one(b)
+
+    # -- prefix-cache coordination ----------------------------------------
+    def revive(self, block: int):
+        """Parked (cached, refcount-0) block -> live on a prefix hit."""
+        self._check_range(block)
+        if self._refs[block] != 0 or block in self._free_set:
+            raise ValueError(f"revive of non-parked block {block}")
+        self._refs[block] = 1
+        self._parked -= 1
+
+    def release(self, blocks):
+        """Return parked blocks to the free list (prefix-cache eviction)."""
         for b in blocks:
-            if not 0 <= b < self._num_blocks:
-                raise ValueError(f"block id {b} out of range")
-            self._free.append(b)
+            self._check_range(b)
+            if self._refs[b] != 0 or b in self._free_set:
+                raise ValueError(f"release of non-parked block {b}")
+            self._parked -= 1
+            self._release_one(b)
+
+    def _release_one(self, b):
+        self._free.append(b)
+        self._free_set.add(b)
+        self._stats_cache = None
+
+    def _check_range(self, b):
+        if not 0 <= b < self._num_blocks:
+            raise ValueError(f"block id {b} out of range")
 
     def stats(self):
         """Host-side free-list stats for the serving gauges: free/total
@@ -44,21 +153,29 @@ class BlockedAllocator:
         1 - largest_run/free — 0.0 when the free ids form one contiguous
         range (or the list is empty), approaching 1.0 as the free space
         shatters. Paged attention doesn't need contiguity, but run structure
-        still predicts swap_in/swap_out gather efficiency."""
-        free_sorted = sorted(self._free)
-        runs, largest = 0, 0
-        run_len = 0
-        prev = None
-        for b in free_sorted:
-            if prev is not None and b == prev + 1:
-                run_len += 1
-            else:
+        still predicts swap_in/swap_out gather efficiency.
+
+        O(free) per recompute (no sort: a block starts a run iff ``b-1`` is
+        not free, then the run is walked forward), and the result is cached
+        until the next allocate/free mutates the free list — per-step
+        ``sample_kv_stats`` calls between mutations are O(1)."""
+        if self._stats_cache is None:
+            fs = self._free_set
+            runs, largest = 0, 0
+            for b in fs:
+                if b - 1 in fs:
+                    continue  # interior of a run; counted from its start
                 runs += 1
                 run_len = 1
-            if run_len > largest:
-                largest = run_len
-            prev = b
-        frag = 1.0 - largest / len(free_sorted) if free_sorted else 0.0
-        return {"free": len(free_sorted), "total": self._num_blocks,
+                nxt = b + 1
+                while nxt in fs:
+                    run_len += 1
+                    nxt += 1
+                if run_len > largest:
+                    largest = run_len
+            frag = 1.0 - largest / len(fs) if fs else 0.0
+            self._stats_cache = {
+                "free": len(fs), "total": self._num_blocks,
                 "free_runs": runs, "largest_free_run": largest,
                 "fragmentation": frag}
+        return dict(self._stats_cache)

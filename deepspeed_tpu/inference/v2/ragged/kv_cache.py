@@ -33,6 +33,11 @@ class BlockedKVCache:
         self._allocator = BlockedAllocator(num_blocks)
 
     @property
+    def allocator(self) -> BlockedAllocator:
+        """Host-side block allocator (refcounts, prefix-cache binding)."""
+        return self._allocator
+
+    @property
     def free_blocks(self) -> int:
         return self._allocator.free_blocks
 
@@ -69,8 +74,10 @@ class BlockedKVCache:
     # to the allocator, and a later ``swap_in`` scatters the bytes into fresh
     # blocks — sequences preempt under KV pressure WITHOUT losing their cache.
     def swap_out(self, blocks):
-        """Pull the given block rows to host memory and free their ids.
-        Returns an opaque host handle for ``swap_in``."""
+        """Pull the given block rows to host memory and release the caller's
+        reference on their ids. Shared (prefix-cached) blocks stay live under
+        their other holders — the copy is conservative but the handle must be
+        self-contained. Returns an opaque host handle for ``swap_in``."""
         import jax
         import numpy as np
         blocks = list(blocks)

@@ -3,6 +3,7 @@
 and owns the blocked KV cache."""
 
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.prefix_cache import PrefixCache
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
 from deepspeed_tpu.utils.logging import logger
 
@@ -18,12 +19,21 @@ class DSStateManager:
                 num_layers, num_kv_heads, head_dim, kv)
         self.kv_cache = BlockedKVCache(num_layers, num_blocks, kv.block_size,
                                        num_kv_heads, head_dim, kv.cache_dtype)
+        # block-granular prefix sharing (config_v2.py prefix_caching knob,
+        # default off). None when disabled — every cache-path branch below
+        # is a single attribute test, so the disabled path does zero
+        # hashing/refcount/clock work.
+        self.prefix_cache = None
+        if getattr(config, "prefix_caching", False):
+            self.prefix_cache = PrefixCache(self.kv_cache.allocator,
+                                            kv.block_size)
         self._seqs = {}
         self.swap_outs = 0  # host swap tier counters (kv_cache swap_out/in)
         self.swap_ins = 0
         self.peak_occupancy = 0.0  # high-water KV occupancy (kv_stats)
         logger.info(f"DSStateManager: {num_blocks} KV blocks x {kv.block_size} "
-                    f"tokens ({num_layers} layers, {num_kv_heads} kv heads)")
+                    f"tokens ({num_layers} layers, {num_kv_heads} kv heads, "
+                    f"prefix_caching={'on' if self.prefix_cache else 'off'})")
 
     @staticmethod
     def _blocks_from_memory_budget(num_layers, num_kv_heads, head_dim, kv):
@@ -66,33 +76,49 @@ class DSStateManager:
 
     @property
     def free_blocks(self):
-        return self.kv_cache.free_blocks
+        """Blocks available to new allocations: the raw free list plus
+        (with prefix caching on) idle cached blocks the allocator will evict
+        on demand — admission control must see the reclaimable total or it
+        would preempt live sequences while free-for-the-taking cached blocks
+        sit parked."""
+        free = self.kv_cache.free_blocks
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.evictable_blocks
+        return free
 
     def kv_stats(self):
         """Pure host-side KV pool read: occupancy, free-list depth,
         fragmentation, swap counters. Never touches the device — the block
         bookkeeping is the deque in ``BlockedAllocator`` — so samplers can
         call this every scheduler step (the PR 4 ``sample_memory`` sync-free
-        pattern applied to the KV pool)."""
+        pattern applied to the KV pool). ``occupancy`` counts blocks *live
+        under sequences*; idle prefix-cached blocks are reclaimable and
+        reported separately (``cached_blocks``/``evictable_blocks``)."""
         a = self.kv_cache.allocator_stats()
         total, free = a["total"], a["free"]
-        occupancy = 1.0 - free / total if total else 0.0
+        parked = self.kv_cache.allocator.cached_blocks
+        occupancy = 1.0 - (free + parked) / total if total else 0.0
         if occupancy > self.peak_occupancy:
             self.peak_occupancy = occupancy
         swapped = sum(1 for s in self._seqs.values() if s.is_swapped)
-        return {"total_blocks": total, "free_blocks": free,
-                "occupied_blocks": total - free, "occupancy": occupancy,
-                "peak_occupancy": self.peak_occupancy,
-                "free_runs": a["free_runs"],
-                "largest_free_run": a["largest_free_run"],
-                "fragmentation": a["fragmentation"],
-                "tracked_sequences": len(self._seqs),
-                "swapped_sequences": swapped,
-                "swap_outs": self.swap_outs, "swap_ins": self.swap_ins}
+        stats = {"total_blocks": total, "free_blocks": free,
+                 "occupied_blocks": total - free - parked,
+                 "occupancy": occupancy,
+                 "peak_occupancy": self.peak_occupancy,
+                 "free_runs": a["free_runs"],
+                 "largest_free_run": a["largest_free_run"],
+                 "fragmentation": a["fragmentation"],
+                 "tracked_sequences": len(self._seqs),
+                 "swapped_sequences": swapped,
+                 "swap_outs": self.swap_outs, "swap_ins": self.swap_ins}
+        if self.prefix_cache is not None:
+            stats.update(self.prefix_cache.stats())
+        return stats
 
     def sample_kv_stats(self, point="step"):
         """``kv_stats`` + serving-gauge recording when telemetry is enabled
-        (occupancy / free-list depth / fragmentation counter tracks)."""
+        (occupancy / free-list depth / fragmentation counter tracks, plus the
+        prefix-cache gauges when caching is on)."""
         stats = self.kv_stats()
         from deepspeed_tpu import telemetry
         tm = telemetry.get_telemetry()
@@ -103,6 +129,13 @@ class DSStateManager:
                              point=point)
             tm.serving_gauge("serving/kv_fragmentation",
                              stats["fragmentation"], point=point)
+            if self.prefix_cache is not None:
+                tm.serving_gauge("serving/prefix_hit_rate",
+                                 stats["prefix_hit_rate"], point=point)
+                tm.serving_gauge("serving/cached_blocks",
+                                 stats["cached_blocks"], point=point)
+                tm.serving_gauge("serving/prefill_tokens_saved",
+                                 stats["prefill_tokens_saved"], point=point)
         return stats
 
     def get_sequence(self, uid):
@@ -119,13 +152,73 @@ class DSStateManager:
         self._seqs[uid] = seq
         return seq
 
+    # -- prefix caching (ragged/prefix_cache.py) ---------------------------
+    def match_prefix(self, uid, prompt_tokens):
+        """Longest-cached-prefix match at sequence creation: on a hit the
+        sequence is created holding the shared blocks with ``seen_tokens``
+        advanced past the matched tokens, so the scheduler never re-runs
+        them. Returns the number of matched tokens (0 = miss or disabled).
+        The match is block-aligned and strictly shorter than the prompt —
+        the tail always runs through a forward (COW boundary: only full,
+        immutable blocks are ever shared)."""
+        cache = self.prefix_cache
+        if cache is None or uid in self._seqs:
+            return 0
+        if len(self._seqs) >= self._config.state_manager.max_tracked_sequences:
+            cache.misses += 1
+            return 0
+        blocks, digests = cache.lookup_chain(prompt_tokens)
+        if not blocks:
+            cache.misses += 1
+            return 0
+        cache.acquire_chain(blocks, digests)
+        seq = self.get_or_create_sequence(uid)
+        matched = len(blocks) * cache.block_size
+        seq.kv_blocks = list(blocks)
+        seq.digests = list(digests)
+        seq.seen_tokens = matched
+        seq.tokens = [int(t) for t in prompt_tokens[:matched]]
+        return matched
+
+    def commit_cached_blocks(self, seq):
+        """Register every newly FILLED full block of ``seq`` in the prefix
+        cache (called after post_forward, and at flush as the donation step).
+        When another sequence concurrently cached identical content, dedup:
+        adopt the canonical shared block and free the private copy — the
+        contents are bit-identical (same tokens, same deterministic
+        per-row forward), so the block table swap is invisible to
+        attention."""
+        cache = self.prefix_cache
+        bs = cache.block_size
+        n_full = seq.seen_tokens // bs
+        while len(seq.digests) < n_full:
+            i = len(seq.digests)
+            parent = seq.digests[i - 1] if i else b""
+            digest, canonical = cache.insert(
+                parent, seq.tokens[i * bs:(i + 1) * bs], seq.kv_blocks[i])
+            if canonical != seq.kv_blocks[i]:
+                self.kv_cache.free([seq.kv_blocks[i]])
+                seq.kv_blocks[i] = canonical
+            seq.digests.append(digest)
+
     def flush_sequence(self, uid):
-        """Drop a sequence and free its KV blocks (reference :110)."""
+        """Drop a sequence and release its KV blocks (reference :110). With
+        prefix caching on, full blocks are donated back to the cache instead
+        of freed — committed as cache entries, then deref'd so refcount-0
+        blocks park (warm, evictable) rather than hit the free list. The
+        partial tail block was never shared, so it frees normally. Blocks
+        deref in reverse order so chain children park before parents — LRU
+        eviction then reclaims leaves first and never orphans a reachable
+        ancestor."""
         seq = self._seqs.pop(uid, None)
         if seq is None:
             logger.warning(f"flush of untracked sequence {uid}")
             return
-        self.kv_cache.free(seq.kv_blocks)
+        if self.prefix_cache is not None and not seq.is_swapped:
+            self.commit_cached_blocks(seq)
+            self.kv_cache.free(list(reversed(seq.kv_blocks)))
+        else:
+            self.kv_cache.free(seq.kv_blocks)
 
     # -- host swap tier (ZeRO-Inference KV offload analog) -----------------
     def swap_out_sequence(self, uid):

@@ -14,6 +14,12 @@ class DSSequenceDescriptor:
     # host handle while the sequence's KV lives in the swap tier
     # (ragged/kv_cache.py swap_out) — kv_blocks is empty meanwhile
     swap_handle: object = None
+    # prefix-cache bookkeeping, populated only when prefix_caching is on:
+    # every token routed through the sequence (prompt + generated), and the
+    # chain digest of each committed full block (digests[i] commits to
+    # tokens[:(i+1)*block_size] and labels kv_blocks[i] in the cache)
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    digests: List[bytes] = dataclasses.field(default_factory=list)
 
     @property
     def is_swapped(self) -> bool:

@@ -75,6 +75,14 @@ class SplitFuseScheduler:
         self._max_seqs = sm.max_ragged_sequence_count
         self._requests: Dict[int, _Request] = {}
         self._starved = 0  # consecutive rounds with nothing schedulable
+        # prefix-cache awareness: resolved once at construction so the
+        # disabled path costs one attribute read per prefill candidate
+        self._prefix_caching = bool(getattr(engine, "prefix_caching", False))
+        # prompt tokens actually run vs skipped via cached prefixes —
+        # plain ints (always on) so bench harnesses can report reductions
+        # without telemetry
+        self.prefill_tokens_executed = 0
+        self.prefill_tokens_saved = 0
         # device_sampling=True (default) fuses temperature/top-k/top-p and
         # the categorical draw into the decode step on the accelerator: the
         # host receives one int32 per sequence instead of a [S, vocab] float
@@ -136,12 +144,18 @@ class SplitFuseScheduler:
             pos = len(r.prompt) + len(r.generated)
             if pos >= max_ctx:
                 # context capacity reached: retire with what it has — the
-                # request can never schedule again and must not wedge others
+                # request can never schedule again and must not wedge others.
+                # This IS the request's terminal event: record e2e latency
+                # and the evict lane here or replay percentiles silently drop
+                # exactly the worst-latency requests.
                 r.done = True
                 self._engine.flush(r.uid)
                 if tm.enabled:
+                    t_evict = _now()
+                    tm.record_hist("serving/e2e_s",
+                                   t_evict - (r.submit_ts or t_evict))
                     tm.serving_event("evicted")
-                    tm.record_request_phase(r.uid, "evict", _now(),
+                    tm.record_request_phase(r.uid, "evict", t_evict,
                                             seen_tokens=pos)
                 continue
             if budget < 1:
@@ -160,6 +174,21 @@ class SplitFuseScheduler:
             take = min(budget, room, len(r.prompt) - r.prefill_pos)
             if take < 1:
                 continue
+            if self._prefix_caching and r.prefill_pos == 0 and not r.generated:
+                # longest-cached-prefix match, deferred to the moment the
+                # first chunk actually schedules — by then earlier requests
+                # have committed their blocks, so queued bursts sharing a
+                # prefix hit even when submitted before it was cached
+                matched = self._engine.match_prefix(r.uid, r.prompt)
+                if tm.enabled:
+                    tm.serving_event("prefix_hit" if matched
+                                     else "prefix_miss")
+                    if matched:
+                        tm.serving_event("prefill_tokens_saved", n=matched)
+                if matched:
+                    r.prefill_pos = matched
+                    self.prefill_tokens_saved += matched
+                    take = min(budget, room, len(r.prompt) - r.prefill_pos)
             uids.append(r.uid)
             chunks.append(r.prompt[r.prefill_pos:r.prefill_pos + take])
             budget -= take
@@ -298,6 +327,7 @@ class SplitFuseScheduler:
         for row, uid in enumerate(uids):
             r = self._requests[uid]
             if r.prefilling:
+                self.prefill_tokens_executed += len(chunks[row])
                 r.prefill_pos += len(chunks[row])
                 if r.prefilling:
                     continue  # mid-prompt ids/logits are not a next token

@@ -16,9 +16,15 @@ Prints ONE JSON line per section plus stderr progress. ``DS_TPU_TELEMETRY=1``
 additionally embeds the full telemetry summary in each payload's ``extra``
 (same contract as bench.py; docs/OBSERVABILITY.md has the schema).
 
-Usage: python scripts/bench_serving.py [--replay] [--requests N] [--seed S]
-           [--arrival poisson|burst] [--rate R] [--burst-size B]
-           [--prompt T] [--new T]
+- ``--replay --prefix-mix`` — shared system-prompt pools: the same seeded
+  trace runs with ``prefix_caching`` off then on, and the payload reports the
+  prefill-token reduction, prefix hit rate, and TTFT comparison the prefix
+  cache is judged on (gated by perf_gate's prefix checks).
+
+Usage: python scripts/bench_serving.py [--replay] [--prefix-mix]
+           [--requests N] [--seed S] [--arrival poisson|burst] [--rate R]
+           [--burst-size B] [--prompt T] [--new T]
+           [--prefix-pools P] [--prefix-len L]
 """
 
 import argparse
@@ -42,7 +48,7 @@ def _embed_telemetry(extra):
 
 
 def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
-                 num_kv_blocks=None):
+                 num_kv_blocks=None, prefix_caching=False):
     import jax
     import numpy as np
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
@@ -65,7 +71,8 @@ def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
             "max_context": max_ctx,
             "num_kv_blocks": num_kv_blocks},
         "kv_cache": {"block_size": block,
-                     "cache_dtype": "bf16" if on_tpu else "fp32"}})
+                     "cache_dtype": "bf16" if on_tpu else "fp32"},
+        "prefix_caching": prefix_caching})
     return model, SplitFuseScheduler(engine, token_budget=budget)
 
 
@@ -156,6 +163,202 @@ def make_workload(n_req, seed, arrival="poisson", rate=4.0, burst_size=4,
     return prompt_lens, out_lens, arrivals
 
 
+def _drive_replay(sched, prompts, out_lens, arrivals):
+    """Open-loop wall-clock submission of a request trace against the live
+    scheduler (uids = trace indices). Returns the wall seconds."""
+    n_req = len(prompts)
+    t_start = time.perf_counter()
+    nxt = 0
+    while nxt < n_req or sched.has_work:
+        now = time.perf_counter() - t_start
+        while nxt < n_req and arrivals[nxt] <= now:
+            sched.submit(nxt, prompts[nxt], max_new_tokens=int(out_lens[nxt]))
+            nxt += 1
+        if sched.has_work:
+            sched.step()
+        elif nxt < n_req:
+            # open-loop: idle until the next arrival is due
+            time.sleep(min(float(arrivals[nxt]) - now, 0.05))
+    return time.perf_counter() - t_start
+
+
+def _precompile_batch_grid(sched, n_req, budget):
+    """Compile every (sequence-bucket, token-bucket) batch shape the replay
+    can reach, directly through ``put_sampled`` (the scheduler's only device
+    path). ``RaggedBatchWrapper.build`` buckets S and Q to powers of two
+    (min 4 / 8, capped at the config maxima), so the reachable grid is small
+    and enumerable — compiling it up front makes the measured legs
+    compile-free regardless of how arrival timing composes the batches.
+    Sequences use throwaway uids and are flushed afterwards."""
+    import numpy as np
+    eng = sched._engine
+    sm = eng._config.state_manager
+    max_s = min(sm.max_ragged_sequence_count, n_req)
+    s_vals, s = [], 4
+    while s < max_s:
+        s_vals.append(s)
+        s *= 2
+    s_vals.append(max_s)
+    q_vals, q = [], 8
+    while q < budget:
+        q_vals.append(q)
+        q *= 2
+    q_vals.append(budget)
+    for n in s_vals:
+        for i, qb in enumerate(q_vals):
+            longest = min(qb, budget - (n - 1))
+            if i and longest <= q_vals[i - 1]:
+                continue  # token budget can't reach this bucket at n seqs
+            uids = list(range(90_000, 90_000 + n))
+            toks = [np.zeros(longest, np.int32)] + \
+                [np.zeros(1, np.int32)] * (n - 1)
+            eng.put_sampled(uids, toks, temperatures=[0.0] * n,
+                            top_ks=[0] * n, top_ps=[1.0] * n,
+                            seeds=[0] * n, positions=[0] * n)
+            for u in uids:
+                eng.flush(u)
+
+
+def prefix_mix_bench(args, on_tpu):
+    """Shared-system-prompt replay: every request's prompt = one of
+    ``--prefix-pools`` seeded pool prefixes + a private lognormal suffix.
+    Runs the SAME trace twice — ``prefix_caching`` off, then on — so the
+    payload carries a like-for-like prefill-token and TTFT comparison at an
+    identical seed. Emits one ``serving_replay_tokens_per_sec_per_chip``
+    payload (value = cached leg) whose extra adds the prefix-cache fields
+    perf_gate validates (hit rate, tokens saved/executed, reduction,
+    nocache TTFT)."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=args.prompt + args.new + 64,
+                          remat=False)
+        n_req, block = args.requests, 32
+        prefix_len = args.prefix_len or 256
+        suffix_scale, max_suffix = 32, 128
+        new_scale, max_new = args.new, args.new * 2
+        budget, rate = 256, args.rate
+    else:
+        cfg = LlamaConfig.tiny(remat=False)
+        n_req, block = min(args.requests, 16), 8
+        prefix_len = args.prefix_len or 40
+        suffix_scale, max_suffix = 6, 16
+        new_scale, max_new = 2, 4
+        budget, rate = 48, max(args.rate, 200.0)
+    prefix_len -= prefix_len % block  # block-aligned prefixes share fully
+    n_pools = max(1, args.prefix_pools)
+
+    suffix_lens, out_lens, arrivals = make_workload(
+        n_req, args.seed, arrival=args.arrival, rate=rate,
+        burst_size=args.burst_size, prompt_scale=suffix_scale,
+        new_scale=new_scale, max_prompt=max_suffix, max_new=max_new)
+    gen = np.random.default_rng(args.seed)
+    pools = [gen.integers(0, cfg.vocab_size, prefix_len).astype(np.int32)
+             for _ in range(n_pools)]
+    assign = gen.integers(0, n_pools, n_req)
+    prompts = [np.concatenate([
+        pools[assign[i]],
+        gen.integers(0, cfg.vocab_size, int(suffix_lens[i])).astype(np.int32)])
+        for i in range(n_req)]
+    prompt_total = int(sum(len(p) for p in prompts))
+
+    legs = {}
+    for label, caching in (("nocache", False), ("cached", True)):
+        model, sched = _build_stack(cfg, n_req, prefix_len + max_suffix,
+                                    int(max_new), budget, on_tpu,
+                                    prefix_caching=caching)
+        # warmup: compile the full reachable batch-shape grid before the
+        # clock starts. The cached leg fuses more, shorter chunks per
+        # forward and so composes different (seqs, tokens) buckets than the
+        # nocache leg — a trace-shaped warmup chases a moving target, the
+        # grid covers both legs by construction
+        t0 = time.perf_counter()
+        _precompile_batch_grid(sched, n_req, budget)
+        print(f"prefix-mix[{label}]: warmup/compile "
+              f"{time.perf_counter()-t0:.1f}s", file=sys.stderr)
+        # the warmup batches must not pollute the comparison: zero the
+        # prefill counters and drop their donated blocks + match stats so
+        # the measured leg starts with a cold, empty cache
+        sched.prefill_tokens_executed = 0
+        sched.prefill_tokens_saved = 0
+        cache = sched._engine._state.prefix_cache
+        if cache is not None:
+            cache.evict(cache.evictable_blocks)
+            cache.hits = cache.misses = cache.tokens_saved = 0
+            cache.insertions = cache.evictions = 0
+        telemetry.reset()
+        telemetry.configure(enabled=True, sample_sync=False,
+                            chrome_trace_path=os.environ.get(
+                                "DS_TPU_TELEMETRY_TRACE", ""))
+        tm = telemetry.get_telemetry()
+        wall = _drive_replay(sched, prompts, out_lens, arrivals)
+        decoded = sum(len(r.generated) for u, r in sched._requests.items()
+                      if u < 10_000)
+        ttft = tm.hist_percentiles("serving/ttft_s", (0.5, 0.99)) or (0.0, 0.0)
+        tpot = tm.hist_percentiles("serving/tpot_s", (0.5, 0.99)) or (0.0, 0.0)
+        serving = telemetry.summary()["serving"]
+        kv_gauge = serving["gauges"].get("serving/kv_occupancy", {})
+        cached_gauge = serving["gauges"].get("serving/cached_blocks", {})
+        legs[label] = {
+            "wall": wall, "decoded": decoded,
+            "executed": sched.prefill_tokens_executed,
+            "saved": sched.prefill_tokens_saved,
+            "ttft": ttft, "tpot": tpot,
+            "kv_peak": float(kv_gauge.get("peak", 0.0)),
+            "cached_blocks_peak": float(cached_gauge.get("peak", 0.0)),
+            "hit_rate": cache.hit_rate if cache is not None else 0.0,
+            "preemptions": int(serving["requests"].get("preempted", 0)),
+        }
+    c, nc = legs["cached"], legs["nocache"]
+    reduction = (nc["executed"] - c["executed"]) / nc["executed"] \
+        if nc["executed"] else 0.0
+    total = c["decoded"] + prompt_total
+    n_chips = jax.device_count()
+    extra = {
+        "ttft_p50_s": round(c["ttft"][0], 6),
+        "ttft_p99_s": round(c["ttft"][1], 6),
+        "tpot_p50_s": round(c["tpot"][0], 6),
+        "tpot_p99_s": round(c["tpot"][1], 6),
+        "tokens_per_sec": round(total / c["wall"], 1),
+        "decode_tokens_per_sec": round(c["decoded"] / c["wall"], 1),
+        "peak_kv_occupancy": round(c["kv_peak"], 6),
+        "preemptions": c["preemptions"],
+        "requests": n_req, "seed": args.seed, "arrival": args.arrival,
+        "rate_req_per_s": rate,
+        "prompt_tokens_total": prompt_total,
+        "decode_tokens_total": int(c["decoded"]),
+        "wall_s": round(c["wall"], 2), "chips": n_chips,
+        "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}",
+        # prefix-cache comparison (same trace, caching off vs on)
+        "prefix_pools": n_pools, "prefix_len": prefix_len,
+        "prefix_hit_rate": round(c["hit_rate"], 6),
+        "prefill_tokens_saved": int(c["saved"]),
+        "executed_prefill_tokens": int(c["executed"]),
+        "executed_prefill_tokens_nocache": int(nc["executed"]),
+        "prefill_reduction": round(reduction, 6),
+        "ttft_p50_nocache_s": round(nc["ttft"][0], 6),
+        "ttft_p99_nocache_s": round(nc["ttft"][1], 6),
+        "wall_nocache_s": round(nc["wall"], 2),
+        "cached_blocks_peak": int(c["cached_blocks_peak"]),
+    }
+    _embed_telemetry(extra)
+    payload = {
+        "metric": "serving_replay_tokens_per_sec_per_chip",
+        "value": round(total / c["wall"] / max(n_chips, 1), 1),
+        "unit": "tokens/s/chip (prefill+decode)",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+    bench.emit(payload)
+    return payload
+
+
 def replay_bench(args, on_tpu):
     """Wall-clock traffic replay; latency percentiles from the telemetry
     serving stream."""
@@ -209,20 +412,7 @@ def replay_bench(args, on_tpu):
                             "DS_TPU_TELEMETRY_TRACE", ""))
     tm = telemetry.get_telemetry()
 
-    t_start = time.perf_counter()
-    nxt = 0
-    while nxt < n_req or sched.has_work:
-        now = time.perf_counter() - t_start
-        while nxt < n_req and arrivals[nxt] <= now:
-            sched.submit(nxt, prompts[nxt],
-                         max_new_tokens=int(out_lens[nxt]))
-            nxt += 1
-        if sched.has_work:
-            sched.step()
-        elif nxt < n_req:
-            # open-loop: idle until the next arrival is due
-            time.sleep(min(float(arrivals[nxt]) - now, 0.05))
-    wall = time.perf_counter() - t_start
+    wall = _drive_replay(sched, prompts, out_lens, arrivals)
 
     decoded = sum(len(r.generated) for u, r in sched._requests.items()
                   if u != 10_000)
@@ -307,6 +497,15 @@ def main():
     ap.add_argument("--rate", type=float, default=4.0,
                     help="mean arrival rate, requests/s")
     ap.add_argument("--burst-size", type=int, default=4)
+    ap.add_argument("--prefix-mix", action="store_true",
+                    help="with --replay: shared system-prompt pools, run the "
+                         "same trace with prefix_caching off then on and "
+                         "report the prefill-token/TTFT comparison")
+    ap.add_argument("--prefix-pools", type=int, default=4,
+                    help="number of shared prefix pools (--prefix-mix)")
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="shared prefix length in tokens; 0 = per-platform "
+                         "default (--prefix-mix)")
     args = ap.parse_args()
 
     # DS_TPU_TELEMETRY=1: same contract as bench.py — enable the unified
@@ -329,7 +528,10 @@ def main():
     on_tpu = devs[0].platform in ("tpu", "axon")
     if args.replay:
         try:
-            replay_bench(args, on_tpu)
+            if args.prefix_mix:
+                prefix_mix_bench(args, on_tpu)
+            else:
+                replay_bench(args, on_tpu)
         except Exception as e:
             bench.emit({"metric": metric, "value": 0.0,
                         "unit": "tokens/s/chip", "vs_baseline": None,

@@ -36,8 +36,13 @@ and drives the overlap analyzer jax-free over a fixed analytic schedule
 (``check_overlap_analytic``), and re-derives the checked-in scheduled
 overlap baseline (``onchip_results/overlap_analytic_baseline.json``)
 jax-free, requiring the scheduled exposed seconds to reproduce and to sit
->= 30% below its serialized worst case (``check_overlap_schedule``) — then
-exits 0/2 without comparing. The tier-1 lane runs ``--dry-run``
+>= 30% below its serialized worst case (``check_overlap_schedule``), and
+validates the checked-in shared-prefix replay baseline
+(``onchip_results/serving_prefix_baseline.json``): prefix-mix payload shape
+(hit rate in [0, 1], tokens saved <= prompt tokens, finite percentiles) plus
+the acceptance ratchet — >= 40% prefill-token reduction, hit rate > 0.5,
+cached TTFT p50 no worse than the cache-off leg (``check_prefix_baseline``)
+— then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run``
 against the repo's own BASELINE.json so a malformed baseline, summary, or
 tuning table fails fast on CPU (docs/OBSERVABILITY.md).
 """
@@ -69,11 +74,20 @@ GATES = {
     # overlap report (telemetry/overlap.py): exposed-comm seconds growing
     # means the schedule got worse at hiding collectives
     "exposed_comm_s": ("up", "max_exposed_growth"),
+    # prefix-cache effectiveness (bench_serving --replay --prefix-mix):
+    # the hit rate or the prefill-token reduction shrinking means prompt
+    # reuse got worse
+    "prefix_hit_rate": ("down", "max_prefix_hit_drop"),
+    "prefill_reduction": ("down", "max_prefix_hit_drop"),
 }
 
 #: extra/doc keys lifted verbatim into the metric dict when positive
 SERVING_KEYS = ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
                 "peak_kv_occupancy")
+
+#: prefix-mix payload keys (bench_serving --replay --prefix-mix); lifted and
+#: validated only when present — plain replay payloads don't carry them
+PREFIX_KEYS = ("prefix_hit_rate", "prefill_reduction")
 
 
 def load_doc(path):
@@ -132,7 +146,7 @@ def extract_metrics(doc):
                     m["peak_hbm_bytes"] = v
             except (TypeError, ValueError):
                 pass
-        for key in SERVING_KEYS:
+        for key in SERVING_KEYS + PREFIX_KEYS:
             if key in src and key not in m:
                 try:
                     v = float(src[key])
@@ -363,6 +377,38 @@ def validate_serving_payload(doc):
             return f"serving replay payload: {prefix} p50 > p99"
     if not 0.0 <= extra["peak_kv_occupancy"] <= 1.0:
         return "serving replay payload: peak_kv_occupancy outside [0, 1]"
+    return _validate_prefix_fields(extra)
+
+
+def _validate_prefix_fields(extra):
+    """Shape-check the prefix-mix fields riding a replay payload's extra
+    (present only for ``--prefix-mix`` runs): hit rate in [0, 1], saved and
+    executed prefill tokens consistent with the prompt total, finite ordered
+    nocache percentiles. Returns an error string or None."""
+    if "prefix_hit_rate" not in extra:
+        return None  # plain replay payload — nothing prefix to check
+    def bad_num(v):
+        return not isinstance(v, (int, float)) or isinstance(v, bool) or \
+            not (v == v and abs(v) != float("inf"))
+    for key in ("prefix_hit_rate", "prefill_tokens_saved",
+                "executed_prefill_tokens", "executed_prefill_tokens_nocache",
+                "prefill_reduction", "ttft_p50_nocache_s",
+                "ttft_p99_nocache_s"):
+        if bad_num(extra.get(key)):
+            return f"prefix-mix payload: extra[{key!r}] missing or not finite"
+    if not 0.0 <= extra["prefix_hit_rate"] <= 1.0:
+        return "prefix-mix payload: prefix_hit_rate outside [0, 1]"
+    prompt_total = extra.get("prompt_tokens_total")
+    if isinstance(prompt_total, int) and prompt_total > 0:
+        if extra["prefill_tokens_saved"] > prompt_total:
+            return "prefix-mix payload: prefill_tokens_saved > prompt tokens"
+        if extra["executed_prefill_tokens"] + extra["prefill_tokens_saved"] \
+                > prompt_total:
+            return "prefix-mix payload: executed + saved > prompt tokens"
+    if not -1.0 <= extra["prefill_reduction"] <= 1.0:
+        return "prefix-mix payload: prefill_reduction outside [-1, 1]"
+    if extra["ttft_p50_nocache_s"] > extra["ttft_p99_nocache_s"]:
+        return "prefix-mix payload: nocache ttft p50 > p99"
     return None
 
 
@@ -483,6 +529,63 @@ def check_overlap_schedule(baseline_path=None):
             "grad_buckets": plan.grad_buckets}, errors
 
 
+#: prefix-cache acceptance for the checked-in shared-prefix replay baseline:
+#: the recorded run must have skipped >= 40% of prefill tokens with a hit
+#: rate > 0.5 and a no-worse TTFT p50 than its own cache-off leg
+PREFIX_MIN_REDUCTION = 0.40
+PREFIX_MIN_HIT_RATE = 0.5
+PREFIX_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                    "serving_prefix_baseline.json")
+
+
+def check_prefix_baseline(baseline_path=None):
+    """Validate the checked-in ``--prefix-mix`` replay baseline: payload
+    shape (``validate_serving_payload`` incl. the prefix fields), internal
+    consistency (executed + saved vs the recorded nocache leg), and the
+    acceptance ratchet — prefill reduction >= ``PREFIX_MIN_REDUCTION``, hit
+    rate > ``PREFIX_MIN_HIT_RATE``, cached TTFT p50 <= the nocache leg's.
+    Pure dict checks over recorded values (wall-clock legs cannot be
+    re-derived jax-free). Returns (report, errors) for the dry-run lane."""
+    path = baseline_path or PREFIX_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no prefix baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable prefix baseline {path}"]
+    err = validate_serving_payload(doc)
+    if err:
+        return {}, [f"prefix baseline: {err}"]
+    extra = doc.get("extra", {}) if isinstance(doc, dict) else {}
+    if "prefix_hit_rate" not in extra:
+        return {}, ["prefix baseline payload carries no prefix-mix fields "
+                    "(regenerate with bench_serving --replay --prefix-mix)"]
+    errors = []
+    hit, red = extra["prefix_hit_rate"], extra["prefill_reduction"]
+    executed = extra["executed_prefill_tokens"]
+    nocache = extra["executed_prefill_tokens_nocache"]
+    if nocache > 0:
+        derived = (nocache - executed) / nocache
+        if abs(derived - red) > 1e-3:
+            errors.append(
+                f"prefix baseline: recorded prefill_reduction {red} does not "
+                f"match derived {derived:.6f} from executed token counts")
+    if red < PREFIX_MIN_REDUCTION:
+        errors.append(f"prefix baseline: prefill reduction {red} < "
+                      f"{PREFIX_MIN_REDUCTION} — prompt reuse regressed")
+    if hit <= PREFIX_MIN_HIT_RATE:
+        errors.append(f"prefix baseline: prefix_hit_rate {hit} <= "
+                      f"{PREFIX_MIN_HIT_RATE}")
+    if extra["ttft_p50_s"] > extra["ttft_p50_nocache_s"]:
+        errors.append(
+            f"prefix baseline: cached TTFT p50 {extra['ttft_p50_s']}s worse "
+            f"than the cache-off leg {extra['ttft_p50_nocache_s']}s")
+    return {"prefix_hit_rate": hit, "prefill_reduction": red,
+            "executed_prefill_tokens": executed,
+            "executed_prefill_tokens_nocache": nocache,
+            "ttft_p50_s": extra["ttft_p50_s"],
+            "ttft_p50_nocache_s": extra["ttft_p50_nocache_s"]}, errors
+
+
 def check_overlap_analytic():
     """Drive the overlap analyzer end-to-end jax-free: build the analytic
     serialized schedule from a fixed collective inventory, attribute it,
@@ -559,6 +662,9 @@ def main(argv=None):
     ap.add_argument("--max-exposed-growth", type=float, default=0.10,
                     help="allowed relative growth in exposed-comm seconds "
                          "(overlap report)")
+    ap.add_argument("--max-prefix-hit-drop", type=float, default=0.10,
+                    help="allowed relative drop in prefix-cache hit rate / "
+                         "prefill reduction (--prefix-mix payloads)")
     ap.add_argument("--dry-run", action="store_true",
                     help="validate inputs (parse + summary schema) only")
     args = ap.parse_args(argv)
@@ -590,13 +696,18 @@ def main(argv=None):
         sched_report, sched_errors = check_overlap_schedule()
         for err in sched_errors:
             print(f"perf_gate: overlap_schedule: {err}", file=sys.stderr)
-        errors = table_errors + qgz_errors + overlap_errors + sched_errors
+        prefix_report, prefix_errors = check_prefix_baseline()
+        for err in prefix_errors:
+            print(f"perf_gate: prefix_cache: {err}", file=sys.stderr)
+        errors = table_errors + qgz_errors + overlap_errors + sched_errors \
+            + prefix_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
                           "qgz_wire": qgz_report,
                           "overlap": overlap_report,
                           "overlap_schedule": sched_report,
+                          "prefix_cache": prefix_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
         return 2 if errors else 0
@@ -619,7 +730,8 @@ def main(argv=None):
                   "max_ttft_growth": args.max_ttft_growth,
                   "max_tpot_growth": args.max_tpot_growth,
                   "max_kv_occupancy_growth": args.max_kv_occupancy_growth,
-                  "max_exposed_growth": args.max_exposed_growth}
+                  "max_exposed_growth": args.max_exposed_growth,
+                  "max_prefix_hit_drop": args.max_prefix_hit_drop}
     verdicts, regressed = compare(base_m, cand_m, thresholds)
     result = {"compared": len(verdicts), "regressed": regressed,
               "verdicts": verdicts,

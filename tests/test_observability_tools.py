@@ -410,6 +410,165 @@ def test_perf_gate_dry_run_validates_replay_payload_shape(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# prefix-cache gates
+# ---------------------------------------------------------------------------
+
+def _prefix_payload(hit=0.6875, reduction=0.597015, saved=440, executed=297,
+                    nocache=737, ttft=0.0049, ttft_nc=0.0573):
+    """A --prefix-mix replay payload: the plain replay extra plus the
+    prefix-cache comparison fields (internally consistent by default:
+    reduction == (nocache - executed) / nocache, saved + executed <=
+    prompt total, cached TTFT better than the nocache leg)."""
+    doc = _replay_payload(ttft=ttft)
+    doc["extra"].update({
+        "prompt_tokens_total": nocache,
+        "prefix_hit_rate": hit,
+        "prefill_tokens_saved": saved,
+        "executed_prefill_tokens": executed,
+        "executed_prefill_tokens_nocache": nocache,
+        "prefill_reduction": reduction,
+        "ttft_p50_nocache_s": ttft_nc,
+        "ttft_p99_nocache_s": ttft_nc * 2,
+        "wall_nocache_s": 0.1,
+        "cached_blocks_peak": 24})
+    return doc
+
+
+def test_perf_gate_dry_run_validates_prefix_payload_shape(tmp_path):
+    """--dry-run shape-checks the prefix-mix fields without jax: hit rate
+    in [0, 1], saved/executed tokens consistent with the prompt total."""
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_prefix_payload()))
+    r = _run([PERF_GATE, "--baseline", str(good), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    metrics = json.loads(r.stdout)["metrics"]["baseline"]
+    assert metrics["prefix_hit_rate"] == 0.6875
+    assert metrics["prefill_reduction"] == 0.597015
+
+    doc = _prefix_payload(hit=1.5)  # impossible hit rate
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "prefix_hit_rate" in r.stderr
+
+    doc = _prefix_payload(saved=800)  # saved > prompt tokens
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "prefill_tokens_saved" in r.stderr
+
+    doc = _prefix_payload()
+    del doc["extra"]["executed_prefill_tokens_nocache"]
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "executed_prefill_tokens_nocache" in r.stderr
+
+
+def test_perf_gate_prefix_hit_drop_gate(tmp_path):
+    """prefix_hit_rate and prefill_reduction gate like any other serving
+    metric: a drop past --max-prefix-hit-drop regresses."""
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_prefix_payload()))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(base)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    compared = {v["metric"] for v in json.loads(r.stdout)["verdicts"]}
+    assert {"prefix_hit_rate", "prefill_reduction"} <= compared
+    # hit rate drops 0.6875 -> 0.5 (-27%, threshold 10%)
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_prefix_payload(
+        hit=0.5, reduction=0.597015)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 3, (r.stdout, r.stderr)
+    bad = {v["metric"] for v in json.loads(r.stdout)["verdicts"]
+           if v["regressed"]}
+    assert bad == {"prefix_hit_rate"}
+    # generous threshold waves it through
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand),
+              "--max-prefix-hit-drop", "0.35"])
+    assert r.returncode == 0
+
+
+def test_perf_gate_prefix_baseline_ratchet(tmp_path):
+    """check_prefix_baseline enforces the acceptance ratchet on the
+    checked-in prefix baseline: reduction >= 0.40, hit rate > 0.5, cached
+    TTFT p50 no worse than the nocache leg, recorded reduction consistent
+    with the executed token counts."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_pg_prefix", PERF_GATE)
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_prefix_payload()))
+    report, errs = pg.check_prefix_baseline(str(good))
+    assert errs == [] and report["prefix_hit_rate"] == 0.6875
+
+    # reduction below the 0.40 ratchet ((737-516)/737 ~= 0.30)
+    low = tmp_path / "low.json"
+    low.write_text(json.dumps(_prefix_payload(
+        reduction=0.2999, executed=516, saved=221)))
+    _, errs = pg.check_prefix_baseline(str(low))
+    assert any("reduction" in e for e in errs)
+
+    # hit rate at/below 0.5 fails
+    low.write_text(json.dumps(_prefix_payload(hit=0.5)))
+    _, errs = pg.check_prefix_baseline(str(low))
+    assert any("prefix_hit_rate" in e for e in errs)
+
+    # cached TTFT p50 worse than the cache-off leg fails
+    low.write_text(json.dumps(_prefix_payload(ttft=0.08, ttft_nc=0.05)))
+    _, errs = pg.check_prefix_baseline(str(low))
+    assert any("TTFT p50" in e for e in errs)
+
+    # recorded reduction inconsistent with the token counts fails
+    low.write_text(json.dumps(_prefix_payload(reduction=0.9)))
+    _, errs = pg.check_prefix_baseline(str(low))
+    assert any("does not match derived" in e for e in errs)
+
+    # no baseline file -> skip, not error (pre-prefix-cache checkouts)
+    report, errs = pg.check_prefix_baseline(str(tmp_path / "absent.json"))
+    assert errs == [] and "skipped" in report
+
+    # the repo's own checked-in baseline passes the ratchet
+    report, errs = pg.check_prefix_baseline()
+    assert errs == [], errs
+    assert report["prefill_reduction"] >= pg.PREFIX_MIN_REDUCTION
+    assert report["prefix_hit_rate"] > pg.PREFIX_MIN_HIT_RATE
+
+
+def test_bench_serving_prefix_mix_cpu_acceptance(tmp_path):
+    """The seeded shared-prefix replay end to end on CPU: one payload whose
+    prefix fields are internally consistent, accepted by perf_gate both in
+    self-comparison and dry-run shape validation."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "bench_serving.py"),
+         "--replay", "--prefix-mix", "--requests", "8", "--seed", "7",
+         "--rate", "200"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payloads = [json.loads(ln) for ln in r.stdout.splitlines()
+                if ln.startswith("{")]
+    assert len(payloads) == 1
+    doc = payloads[0]
+    assert doc["metric"] == "serving_replay_tokens_per_sec_per_chip"
+    assert doc["value"] > 0
+    ex = doc["extra"]
+    assert 0.0 < ex["prefix_hit_rate"] <= 1.0
+    assert ex["prefill_reduction"] > 0
+    assert ex["executed_prefill_tokens"] + ex["prefill_tokens_saved"] \
+        <= ex["prompt_tokens_total"]
+    assert ex["executed_prefill_tokens_nocache"] == ex["prompt_tokens_total"]
+    assert 0 < ex["ttft_p50_s"] <= ex["ttft_p99_s"]
+    p = tmp_path / "prefix.json"
+    p.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(p), "--candidate", str(p)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    r = _run([PERF_GATE, "--baseline", str(p), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+# ---------------------------------------------------------------------------
 # overlap exposure (ISSUE 8)
 # ---------------------------------------------------------------------------
 

@@ -195,6 +195,38 @@ def test_kv_stats_pure_read(served):
     assert "serving/kv_occupancy" in telemetry.summary()["serving"]["gauges"]
 
 
+def test_max_context_eviction_records_terminal_latency(served, tmp_path):
+    """A request retired at max_context never "finishes" — the eviction IS
+    its terminal event, so it must record ``serving/e2e_s`` and an evict
+    lane phase or replay percentiles silently drop exactly the
+    worst-latency requests."""
+    cfg, model, params = served
+    tr = tmp_path / "trace.json"
+    telemetry.configure(enabled=True, chrome_trace_path=str(tr),
+                        sample_sync=False, jax_annotations=False)
+    engine = InferenceEngineV2(model, params, config={
+        "state_manager": {"max_ragged_sequence_count": 2,
+                          "max_ragged_batch_size": 16,
+                          "max_context": 16, "num_kv_blocks": 8},
+        "kv_cache": {"block_size": 8, "cache_dtype": "fp32"}})
+    sched = SplitFuseScheduler(engine)
+    rng = np.random.default_rng(9)
+    sched.submit(0, rng.integers(0, cfg.vocab_size, 12).astype(np.int32),
+                 max_new_tokens=10)  # 12 + 10 cannot fit 16: evicted at 4
+    out = sched.run_to_completion()
+    assert 1 <= len(out[0]) <= 4
+    srv = telemetry.summary()["serving"]
+    assert srv["requests"]["evicted"] == 1
+    assert srv["requests"].get("finished", 0) == 0
+    e2e = srv["histograms"]["serving/e2e_s"]
+    assert e2e["count"] == 1 and np.isfinite(e2e["p50_s"])
+    path = telemetry.export_chrome_trace()
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    assert any(e["name"] == "req/evict" for e in events), \
+        "eviction must land in the request lane as the terminal phase"
+
+
 # ---------------------------------------------------------------------------
 # disabled-noop guarantee for the serving hooks
 # ---------------------------------------------------------------------------
@@ -202,14 +234,28 @@ def test_kv_stats_pure_read(served):
 def test_disabled_serving_hooks_zero_overhead(served, monkeypatch):
     """Telemetry disabled, a full scheduler run performs ZERO clock reads
     (scheduler._now patched to raise), ZERO allocations inside the telemetry
-    core, and leaves the telemetry serving state untouched."""
+    core, and leaves the telemetry serving state untouched. With the
+    ``prefix_caching`` knob off (the default) the same run must also do zero
+    prefix-cache work — every ``PrefixCache`` method is patched to raise."""
     import tracemalloc
     from deepspeed_tpu.inference.v2 import scheduler as sched_mod
+    from deepspeed_tpu.inference.v2.ragged import prefix_cache as pc_mod
 
     cfg, model, params = served
     assert not telemetry.enabled()
+
+    def _cache_boom(*a, **kw):
+        raise AssertionError(
+            "prefix_caching off must mean zero hashing/refcount work")
+    for name in ("__init__", "chain_digest", "lookup_chain", "acquire_chain",
+                 "insert", "park_if_cached", "evict"):
+        monkeypatch.setattr(pc_mod.PrefixCache, name, _cache_boom)
+
     engine = make_engine(cfg, model, params)
+    assert engine._state.prefix_cache is None
+    assert engine.prefix_caching is False
     sched = SplitFuseScheduler(engine, token_budget=16)
+    assert sched._prefix_caching is False
 
     def _boom():
         raise AssertionError(
