@@ -115,6 +115,17 @@ class InferenceEngineV2:
         prefill cursor past the return value."""
         return self._state.match_prefix(uid, prompt_tokens)
 
+    def peek_prefix(self, prompt_tokens) -> int:
+        """How many prompt tokens a cached prefix would cover, WITHOUT
+        creating a sequence or taking references (pure read). The fleet
+        router's prefix-affinity signal: route a request to the replica
+        whose cache already holds its longest chain."""
+        cache = self._state.prefix_cache
+        if cache is None:
+            return 0
+        blocks, _ = cache.lookup_chain(prompt_tokens)
+        return len(blocks) * cache.block_size
+
     def query(self, uid: int, max_request_tokens: int,
               max_request_blocks: int) -> Tuple[int, int]:
         """How many tokens/blocks this sequence could schedule right now."""
@@ -218,6 +229,41 @@ class InferenceEngineV2:
         logits = self._forward_device(batch_uids, batch_tokens)
         return np.asarray(logits[:len(batch_uids)])
 
+    def put_sampled_device(self, batch_uids: List[int],
+                           batch_tokens: List[np.ndarray],
+                           temperatures, top_ks, top_ps, seeds,
+                           positions):
+        """``put_sampled`` without the final host fetch: returns the
+        [S-bucket] int32 ids as a DEVICE array (rows past ``len(uids)`` are
+        padding — callers read only the first ``len(uids)`` after fetching),
+        leaving the forward + sampler dispatched asynchronously. The
+        two-phase scheduler step (``step_begin``/``step_finish``) uses this
+        to keep several replicas' forwards in flight at once — the fleet's
+        cross-replica overlap — fetching each result only when retiring
+        tokens."""
+        from deepspeed_tpu.inference.v2.sampling import sample_rows_packed
+        logits = self._forward_device(batch_uids, batch_tokens)
+        s_max = logits.shape[0]
+        n = len(batch_uids)
+        # arbitrary Python-int seeds (the host sampler accepted any) fold
+        # deterministically into the int31 space PRNGKey wants
+        seeds = [int(s) & 0x7FFFFFFF for s in seeds]
+        # pack the five per-row parameter vectors into two host arrays and
+        # let the jit fast path move them — per-dispatch host time, not
+        # device math, bounds a fleet stepping several schedulers per round
+        fparams = np.zeros((2, s_max), np.float32)
+        fparams[0, :n] = temperatures
+        fparams[1, :n] = top_ps
+        iparams = np.zeros((3, s_max), np.int32)
+        iparams[0, :n] = top_ks
+        iparams[1, :n] = seeds
+        iparams[2, :n] = positions
+        # return the PADDED [S-bucket] ids: a device-side ids[:n] would
+        # compile one slice program per distinct live count (n is not
+        # bucketed), a cold ~10ms stall every time a request finishes.
+        # Callers fetch with np.asarray and read rows < n on the host.
+        return sample_rows_packed(logits, fparams, iparams)
+
     def put_sampled(self, batch_uids: List[int],
                     batch_tokens: List[np.ndarray],
                     temperatures, top_ks, top_ps, seeds,
@@ -232,26 +278,77 @@ class InferenceEngineV2:
         the logits before. Per-row sampling params are traced, so one
         compiled program covers any greedy/sampled mix.
         """
-        from deepspeed_tpu.inference.v2.sampling import sample_rows
-        logits = self._forward_device(batch_uids, batch_tokens)
-        s_max = logits.shape[0]
-
-        def pad(vals, dtype):
-            a = np.zeros(s_max, dtype)
-            a[:len(batch_uids)] = np.asarray(vals, dtype)
-            return jnp.asarray(a)
-
-        # arbitrary Python-int seeds (the host sampler accepted any) fold
-        # deterministically into the int31 space PRNGKey wants
-        seeds = [int(s) & 0x7FFFFFFF for s in seeds]
-        ids = sample_rows(logits, pad(temperatures, np.float32),
-                          pad(top_ks, np.int32), pad(top_ps, np.float32),
-                          pad(seeds, np.int32), pad(positions, np.int32))
-        return np.asarray(ids[:len(batch_uids)])
+        return np.asarray(self.put_sampled_device(
+            batch_uids, batch_tokens, temperatures, top_ks, top_ps, seeds,
+            positions))[:len(batch_uids)]
 
     def flush(self, uid: int) -> None:
         """Retire a sequence, freeing its KV blocks (reference :242)."""
         self._state.flush_sequence(uid)
+
+    # -- page transfer (prefill/decode disaggregation) ---------------------
+    def export_pages(self, uid: int):
+        """Detach ``uid``'s KV pages as device arrays for shipping to a
+        decode replica (``KVPageTransport``); releases the local sequence."""
+        return self._state.export_sequence_pages(uid)
+
+    def import_pages(self, uid: int, handle) -> int:
+        """Bind shipped KV pages into this engine's pool under fresh
+        refcount-1 block ids; creates the sequence mid-stream."""
+        return self._state.import_sequence_pages(uid, handle)
+
+    def export_pages_many(self, uids):
+        """Batched ``export_pages``: one device gather covers every listed
+        finished sequence (the fleet ships a whole round's handoffs as one
+        transfer)."""
+        return self._state.export_sequences_pages(list(uids))
+
+    def import_pages_many(self, handle) -> int:
+        """Batched ``import_pages``; returns total pages bound."""
+        return self._state.import_sequences_pages(handle)
+
+    def kv_stats(self):
+        """Pure host-side KV pool stats (occupancy, free blocks,
+        fragmentation, swap counters) — the router's load signal. Never
+        touches the device."""
+        return self._state.kv_stats()
+
+    @property
+    def kv_block_size(self) -> int:
+        return self._state.kv_block_size
+
+    @property
+    def kv_page_sharding(self):
+        """Current placement of the KV pools — the ``device_put`` target
+        ``KVPageTransport`` ships pages onto."""
+        return self._state.kv_cache.k_pool.sharding
+
+    def place_kv(self, sharding):
+        """Commit the KV pools onto an explicit device/sharding
+        (``BlockedKVCache.place``). Replica builders call this so pages can
+        ship INTO a replica before its first forward has pinned the pools."""
+        self._state.kv_cache.place(sharding)
+
+    def warm_page_transfer(self, dst_engine, max_pages):
+        """Compile the page-transfer path toward ``dst_engine`` for every
+        padded bucket up to ``max_pages``. Ships trash-block rows only — no
+        live KV is read and no allocator ids are held afterwards — so a
+        fleet can pay the gather/device_put/scatter compiles before the
+        serving clock starts."""
+        import jax
+        src = self._state.kv_cache
+        dst = dst_engine._state.kv_cache
+        b = 1
+        while True:
+            if b > dst.free_blocks:
+                break  # a bucket the destination pool can never bind
+            k, v = src.export_blocks([src.trash_block] * b)
+            k = jax.device_put(k, dst_engine.kv_page_sharding)
+            v = jax.device_put(v, dst_engine.kv_page_sharding)
+            dst.free(dst.import_blocks(k, v, b))
+            if b >= max_pages:
+                break
+            b *= 2
 
     # -- KV host swap (ZeRO-Inference KV offload; scheduler preemption) ----
     def preempt(self, uid: int) -> None:

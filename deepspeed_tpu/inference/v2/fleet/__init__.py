@@ -1,0 +1,15 @@
+"""Serving fleet: SLO-aware admission routing + prefill/decode
+disaggregation over shipped KV pages.
+
+The layer above a single ``ReplicaGroup`` (the MII load-balancer analog,
+PAPER.md §inference): ``SLORouter`` places by least-predicted-TTFT with
+prefix-digest affinity and sheds/queues with typed outcomes;
+``PrefillDecodeFleet`` specializes replicas so prefill never competes with
+decode for a token budget, shipping finished KV pages between submeshes
+through ``KVPageTransport``. See docs/SERVING.md "Serving fleet".
+"""
+
+from deepspeed_tpu.inference.v2.fleet.router import (  # noqa: F401
+    RequestAdmitted, RequestQueued, RequestRejected, SLORouter)
+from deepspeed_tpu.inference.v2.fleet.disagg import (  # noqa: F401
+    KVPageTransport, PrefillDecodeFleet)

@@ -1,0 +1,380 @@
+"""Prefill/decode disaggregation: specialized replicas + KV page shipping.
+
+The splitwise/distserve-style specialization the ROADMAP names for the
+millions-of-users path: PREFILL replicas run SplitFuse prompt chunks only
+(their token budget is never taxed by decodes), and the moment a request's
+first token is sampled its finished KV pages ship to a DECODE replica,
+which continues generation without ever re-running prefill.
+
+Mechanics on TPU: replicas are tp-submeshes inside one process
+(``replica_group.build_replica``), so the ship is an in-process
+``jax.device_put`` of the gathered page rows onto the destination pool's
+sharding — the ICI analog of the reference's NVLink/NIXL page transfer —
+with bytes and latency recorded per handoff (``telemetry.record_handoff``).
+Binding goes through the destination ``BlockedAllocator`` (refcount-1 ids
+via ``import_pages``), and the decode scheduler ``adopt``s the request
+mid-stream. Bit-exactness falls out of deterministic sampling: the decode
+side inherits the request's (seed, position) stream and identical params,
+so fleet output matches the monolithic single-replica path token for token
+(pinned by tests/test_fleet.py).
+
+Handoff protocol (one request):
+
+  1. router/``submit`` places the request on a prefill replica with
+     ``max_new_tokens=1`` — SplitFuse runs the prompt chunks and samples
+     exactly the first token.
+  2. the scheduler's ``on_finish`` hook fires BEFORE the flush: if the
+     request is truly done (wanted 1 token, or hit EOS) it finishes there;
+     otherwise the hook picks the least-occupied decode replica that can
+     bind the pages, ships, adopts, and returns True so the prefill side
+     skips flush + terminal telemetry.
+  3. the decode replica's next round carries the request as a plain decode
+     row; its finish is the request's one terminal event.
+"""
+
+import functools
+import secrets
+import time
+
+import numpy as np
+
+import jax
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.inference.v2.replica_group import build_replica
+from deepspeed_tpu.utils.logging import logger
+
+
+class KVPageTransport:
+    """Ships a finished sequence's KV pages between replica engines.
+
+    ``ship`` = export (device-side gather, source released) -> device_put
+    onto the destination pool's sharding -> import (allocator bind). The
+    latency recorded spans the whole protocol including the copy
+    (``block_until_ready`` — honesty over pipelining here; the handoff IS
+    the disaggregation tax being measured)."""
+
+    def __init__(self):
+        self.handoffs = 0
+        self.transfers = 0
+        self.pages_shipped = 0
+        self.pages_bound = 0
+        self.bytes_shipped = 0
+        self.total_s = 0.0
+
+    def ship(self, uid, src_engine, dst_engine, src="prefill", dst="decode"):
+        """Move ``uid``'s pages from ``src_engine`` to ``dst_engine``;
+        returns the number of pages bound at the destination."""
+        return self.ship_many([uid], src_engine, dst_engine,
+                              src=src, dst=dst)
+
+    def ship_many(self, uids, src_engine, dst_engine, src="prefill",
+                  dst="decode"):
+        """Move several finished sequences' pages in ONE gather ->
+        device_put -> scatter. The fleet batches every handoff that
+        finished in the same scheduler round into one transfer, so the
+        dispatch cost is per ROUND, not per request. ``handoffs`` counts
+        requests, ``transfers`` counts device copies; the transfer latency
+        is apportioned to each request's telemetry lane by its page share.
+        Returns the total pages bound at the destination."""
+        uids = list(uids)
+        t0 = time.perf_counter()
+        handle = src_engine.export_pages_many(uids)
+        sharding = dst_engine.kv_page_sharding
+        k = jax.device_put(handle["k"], sharding)
+        v = jax.device_put(handle["v"], sharding)
+        jax.block_until_ready((k, v))
+        handle["k"], handle["v"] = k, v
+        bound = dst_engine.import_pages_many(handle)
+        dt = time.perf_counter() - t0
+        nbytes = int(k.nbytes) + int(v.nbytes)
+        self.handoffs += len(uids)
+        self.transfers += 1
+        self.pages_shipped += handle["n"]
+        self.pages_bound += bound
+        self.bytes_shipped += nbytes
+        self.total_s += dt
+        total = max(handle["n"], 1)
+        for m in handle["seqs"]:
+            share = m["n"] / total
+            telemetry.record_handoff(m["uid"], m["n"],
+                                     int(nbytes * share), dt * share,
+                                     src=src, dst=dst, bound=m["n"])
+        return bound
+
+    def stats(self):
+        return {"handoffs": self.handoffs,
+                "transfers": self.transfers,
+                "pages_shipped": self.pages_shipped,
+                "pages_bound": self.pages_bound,
+                "bytes_shipped": self.bytes_shipped,
+                "total_s": self.total_s}
+
+
+class PrefillDecodeFleet:
+    """Prefill-specialized + decode-specialized replicas over one device set.
+
+    Args:
+        model / params: as ``ReplicaGroup`` (params re-placed per replica).
+        prefill_replicas / decode_replicas: replica counts per side; the
+            first ``prefill_replicas * tp_size`` devices go to prefill.
+        tp_size: devices per replica.
+        engine_config / token_budget: prefill-side engine config + SplitFuse
+            budget (prefill wants a LARGE budget — it only sees chunks).
+        decode_engine_config / decode_token_budget: decode-side overrides
+            (default: same config; budget defaults to the decode batch size
+            need, which is just the concurrent-sequence count). The decode
+            pool must be sized for the working set of in-flight sequences —
+            a handoff that cannot bind raises rather than silently re-runs
+            prefill.
+    """
+
+    def __init__(self, model, params, prefill_replicas=1, decode_replicas=1,
+                 tp_size=1, engine_config=None, token_budget=None,
+                 decode_engine_config=None, decode_token_budget=None,
+                 transport=None):
+        devices = jax.devices()
+        need = (prefill_replicas + decode_replicas) * tp_size
+        if need > len(devices):
+            raise ValueError(
+                f"fleet needs {need} devices ({prefill_replicas} prefill + "
+                f"{decode_replicas} decode, tp={tp_size}); "
+                f"only {len(devices)} available")
+        self.prefill = []
+        for i in range(prefill_replicas):
+            sub = devices[i * tp_size:(i + 1) * tp_size]
+            mesh, sched = build_replica(model, params, sub, tp_size=tp_size,
+                                        engine_config=engine_config,
+                                        token_budget=token_budget)
+            sched.on_finish = functools.partial(self._on_prefill_finish, i)
+            self.prefill.append((mesh, sched))
+        off = prefill_replicas * tp_size
+        self.decode = []
+        for j in range(decode_replicas):
+            sub = devices[off + j * tp_size:off + (j + 1) * tp_size]
+            self.decode.append(build_replica(
+                model, params, sub, tp_size=tp_size,
+                engine_config=decode_engine_config or engine_config,
+                token_budget=decode_token_budget or token_budget))
+        self.transport = transport or KVPageTransport()
+        self._meta = {}   # uid -> decode-leg params (limits, sampling, seed)
+        self._route = {}  # uid -> ("prefill" | "decode" | "done", index)
+        self._pending_ships = []  # (prefill index, request) awaiting handoff
+        logger.info(f"PrefillDecodeFleet: {prefill_replicas} prefill + "
+                    f"{decode_replicas} decode replicas, tp={tp_size}")
+
+    # -- routing surface (SLORouter backend protocol) ----------------------
+    def router_targets(self):
+        """Placement targets for ``SLORouter`` — the prefill side only;
+        decode placement happens at handoff (least KV occupancy)."""
+        return list(self.prefill)
+
+    @property
+    def has_work(self):
+        return any(s.has_work for _, s in self.prefill) or \
+            any(s.has_work for _, s in self.decode)
+
+    def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None,
+               temperature=0.0, top_k=0, top_p=1.0, seed=None,
+               replica=None):
+        """Admit a request on a prefill replica (least-active when
+        ``replica`` is None). The prefill leg is capped at ONE generated
+        token; the remaining ``max_new_tokens`` run on the decode side
+        after the handoff."""
+        if seed is None:
+            # drawn HERE, not in the prefill scheduler: prefill and decode
+            # must share one deterministic sampling stream for bit-exactness
+            seed = secrets.randbits(31)
+        if replica is None:
+            replica = min(range(len(self.prefill)),
+                          key=lambda i: self.prefill[i][1].active_count())
+        self._meta[uid] = {"max_new_tokens": int(max_new_tokens),
+                           "eos_token_id": eos_token_id,
+                           "temperature": float(temperature),
+                           "top_k": int(top_k), "top_p": float(top_p),
+                           "seed": int(seed)}
+        self._route[uid] = ("prefill", replica)
+        mesh, sched = self.prefill[replica]
+        with mesh:
+            sched.submit(uid, prompt, max_new_tokens=1,
+                         eos_token_id=eos_token_id, temperature=temperature,
+                         top_k=top_k, top_p=top_p, seed=seed)
+        return replica
+
+    def warm_transport(self, max_pages=None):
+        """Compile every (prefill -> decode) ship bucket up front, so the
+        first real handoff pays only the copy (benchmarks call this with
+        the forward-grid warmup, before the serving clock starts). Buckets
+        cover up to a full BATCHED round of handoffs — every prefill that
+        can finish in one round (the scheduler's sequence cap) at the
+        maximum per-sequence page count. The mesh nesting mirrors the real
+        handoff exactly — prefill mesh outer (from the step), decode mesh
+        inner — because the ambient mesh context is part of the dispatch
+        cache key: a warm under a different context still recompiles at
+        the first live ship."""
+        for pmesh, psched in self.prefill:
+            per_seq = -(-psched.max_context // psched.engine.kv_block_size)
+            smax = psched.engine._config.state_manager \
+                .max_ragged_sequence_count
+            pages = max_pages or per_seq * smax
+            for dmesh, dsched in self.decode:
+                with pmesh, dmesh:
+                    psched.engine.warm_page_transfer(dsched.engine, pages)
+
+    # -- handoff -----------------------------------------------------------
+    def _pick_decode(self, need_blocks):
+        """Least-KV-occupancy decode replica that can bind ``need_blocks``
+        pages (``free_blocks`` counts evictable cached blocks — the
+        allocator evicts parked pages before declaring exhaustion)."""
+        order = sorted(
+            range(len(self.decode)),
+            key=lambda j: self.decode[j][1].kv_stats()["occupancy"])
+        for j in order:
+            if self.decode[j][1].engine.free_blocks >= need_blocks:
+                return j
+        return None
+
+    def _on_prefill_finish(self, index, sched, req):
+        """``SplitFuseScheduler.on_finish`` hook on prefill replica
+        ``index``: defer the ship-and-adopt unless the request is truly
+        complete. Returns True when ownership will move (the prefill side
+        then skips flush + terminal telemetry; the sequence's pages stay
+        resident until ``_flush_handoffs`` exports them at the end of the
+        round, so every handoff that finishes in one round shares ONE
+        device transfer instead of paying a dispatch each)."""
+        meta = self._meta.get(req.uid)
+        if meta is None:
+            return False  # not fleet-managed (defensive)
+        tok = req.generated[-1]
+        if len(req.generated) >= meta["max_new_tokens"] or \
+                (meta["eos_token_id"] is not None and
+                 tok == meta["eos_token_id"]):
+            # wanted exactly one token, or EOS on the first: complete at
+            # prefill — normal flush + finish events apply
+            self._route[req.uid] = ("done", index)
+            return False
+        self._pending_ships.append((index, req))
+        return True
+
+    def _flush_handoffs(self):
+        """Ship every request that finished prefill this round. Handoffs
+        are grouped per source replica into one ``ship_many`` transfer
+        when a single decode pool can bind the whole group; otherwise the
+        group falls back to per-request placement (spreading across
+        pools). Raises when even a single request cannot bind anywhere —
+        a handoff must never silently re-run prefill."""
+        if not self._pending_ships:
+            return
+        pending, self._pending_ships = self._pending_ships, []
+        by_src = {}
+        for index, req in pending:
+            by_src.setdefault(index, []).append(req)
+        for index, reqs in by_src.items():
+            block = self.prefill[index][1].engine.kv_block_size
+            pages = [-(-len(r.prompt) // block) for r in reqs]
+            j = self._pick_decode(sum(pages))
+            if j is not None:
+                self._ship_group(index, reqs, j)
+                continue
+            for req, need in zip(reqs, pages):
+                j = self._pick_decode(need)
+                if j is None:
+                    raise RuntimeError(
+                        f"no decode replica can bind {need} KV pages for "
+                        f"uid {req.uid}: decode pools exhausted — size "
+                        f"decode-side num_kv_blocks for the in-flight "
+                        f"working set")
+                self._ship_group(index, [req], j)
+
+    def _ship_group(self, index, reqs, j):
+        """One transfer prefill[index] -> decode[j] covering ``reqs``,
+        then adopt each on the decode scheduler. Mesh nesting (prefill
+        outer, decode inner) mirrors ``warm_transport`` exactly — the
+        ambient mesh context is part of the dispatch cache key."""
+        pmesh, psched = self.prefill[index]
+        dmesh, dsched = self.decode[j]
+        with pmesh, dmesh:
+            self.transport.ship_many([r.uid for r in reqs], psched.engine,
+                                     dsched.engine, src=f"prefill{index}",
+                                     dst=f"decode{j}")
+            for req in reqs:
+                meta = self._meta[req.uid]
+                dsched.adopt(req.uid, req.prompt, req.generated,
+                             max_new_tokens=meta["max_new_tokens"],
+                             eos_token_id=meta["eos_token_id"],
+                             temperature=meta["temperature"],
+                             top_k=meta["top_k"], top_p=meta["top_p"],
+                             seed=meta["seed"], submit_ts=req.submit_ts,
+                             last_token_ts=req.last_token_ts)
+        for req in reqs:
+            self._route[req.uid] = ("decode", j)
+
+    # -- serving loop ------------------------------------------------------
+    def step(self):
+        """One pipelined round: every replica (both sides) dispatches its
+        forward before any result is fetched, so the submeshes compute
+        concurrently. Prefill completions collect during ``step_finish``
+        (the on_finish hook) and ship as ONE batched transfer per
+        (source, destination) pair at the end of the round; the adopted
+        requests decode next round. Returns uids that truly finished
+        (handed-off uids are not reported by the prefill side)."""
+        pendings = []
+        for side in (self.prefill, self.decode):
+            for mesh, sched in side:
+                if not sched.has_work:
+                    continue
+                with mesh:
+                    p = sched.step_begin()
+                if p is not None:
+                    pendings.append((mesh, sched, p))
+        finished = []
+        for mesh, sched, p in pendings:
+            with mesh:
+                finished.extend(sched.step_finish(p))
+        self._flush_handoffs()
+        return finished
+
+    def cancel(self, uid):
+        """Cancel wherever the request currently lives; frees its KV pages
+        on that side. Returns True iff it was live."""
+        route = self._route.get(uid)
+        if route is None:
+            return False
+        state, index = route
+        side = {"prefill": self.prefill, "decode": self.decode}.get(state)
+        if side is None:
+            return False  # already done
+        mesh, sched = side[index]
+        with mesh:
+            return sched.cancel(uid)
+
+    def results(self):
+        """Merged {uid: generated tokens}; decode-side entries win (they
+        extend the prefill side's first token)."""
+        out = {}
+        for mesh, sched in self.prefill:
+            out.update(sched.results())
+        for mesh, sched in self.decode:
+            out.update(sched.results())
+        return out
+
+    def run_to_completion(self, max_rounds=10000):
+        for _ in range(max_rounds):
+            if not self.has_work:
+                break
+            self.step()
+        else:
+            raise RuntimeError("fleet did not converge")
+        return self.results()
+
+    def load_report(self):
+        """Per-replica load by role + transport accounting."""
+        per = []
+        for role, side in (("prefill", self.prefill),
+                           ("decode", self.decode)):
+            for i, (mesh, sched) in enumerate(side):
+                per.append({"replica": f"{role}{i}", "role": role,
+                            "active": sched.active_count(),
+                            "kv_occupancy":
+                                sched.kv_stats()["occupancy"]})
+        return {"replicas": per, "transport": self.transport.stats()}

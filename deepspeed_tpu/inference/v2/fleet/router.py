@@ -1,0 +1,259 @@
+"""SLO-aware admission router over a replica backend.
+
+The reference scales FastGen with MII's replica load balancer; this is the
+admission-control upgrade the ROADMAP calls for: instead of blind
+round-robin, every request is placed on the replica with the LEAST
+PREDICTED TTFT, computed from live serving telemetry (the ``serving/tpot_s``
+histogram gives the fleet's measured per-step seconds), the router's own
+outstanding-token backlog per replica, and KV occupancy. Requests whose
+chain digest hits a replica's warm prefix cache are pulled toward it
+(prefix-digest affinity — the cached blocks make its predicted TTFT
+strictly smaller). Requests that cannot meet the SLO anywhere are QUEUED
+(bounded) or REJECTED (shed) with typed outcomes, never silently admitted
+into an unbounded backlog.
+
+Backends: anything exposing ``router_targets() -> [(mesh, scheduler)]``,
+``submit(uid, prompt, replica=i, **kw)``, ``step() -> finished uids`` and
+``has_work`` — ``ReplicaGroup`` (dp replicas) and ``PrefillDecodeFleet``
+(specialized prefill/decode sides) both qualify.
+"""
+
+import collections
+import dataclasses
+import math
+
+import numpy as np
+
+from deepspeed_tpu import telemetry
+
+
+@dataclasses.dataclass
+class RequestAdmitted:
+    """Placed on ``replica`` with ``predicted_ttft_s`` at admission;
+    ``affinity_tokens`` > 0 means a warm prefix pulled it there."""
+    uid: int
+    replica: int
+    predicted_ttft_s: float
+    affinity_tokens: int = 0
+
+
+@dataclasses.dataclass
+class RequestQueued:
+    """Over SLO on every replica but the bounded router queue has room;
+    drained (FIFO) as capacity frees."""
+    uid: int
+    position: int
+    predicted_ttft_s: float
+
+
+@dataclasses.dataclass
+class RequestRejected:
+    """Shed: over SLO everywhere and the queue is full, or the request can
+    never be served (e.g. prompt exceeds max_context)."""
+    uid: int
+    reason: str
+    predicted_ttft_s: float = math.inf
+
+
+class SLORouter:
+    """Least-predicted-TTFT placement with bounded queueing and shedding.
+
+    Args:
+        backend: ``ReplicaGroup`` / ``PrefillDecodeFleet`` (see module doc).
+        slo_ttft_s: admission bar — a request predicted to exceed this on
+            every replica queues (or sheds when the queue is full).
+        queue_limit: router-side queue bound (the shed threshold).
+        default_step_s: per-forward seconds assumed until the live
+            ``serving/tpot_s`` histogram has samples (or telemetry is off).
+        occupancy_high / occupancy_penalty: a replica above the occupancy
+            threshold multiplies its predicted TTFT — admissions there risk
+            preemption/swap, which the token-backlog model can't see.
+        prefix_affinity: subtract each replica's cached-prefix coverage
+            (``peek_prefix``) from the prompt tokens it would owe.
+    """
+
+    def __init__(self, backend, slo_ttft_s=0.5, queue_limit=32,
+                 default_step_s=0.02, occupancy_high=0.95,
+                 occupancy_penalty=4.0, prefix_affinity=True):
+        self._backend = backend
+        self._targets = [sched for _, sched in backend.router_targets()]
+        if not self._targets:
+            raise ValueError("backend has no router targets")
+        self._slo = float(slo_ttft_s)
+        self._queue_limit = int(queue_limit)
+        self._default_step_s = float(default_step_s)
+        self._occ_high = float(occupancy_high)
+        self._occ_penalty = float(occupancy_penalty)
+        self._prefix_affinity = bool(prefix_affinity)
+        self._queue = collections.deque()
+        # outstanding tokens routed to each target and not yet finished —
+        # the backlog term of the TTFT prediction, O(1) per submit/finish
+        self._backlog = [0] * len(self._targets)
+        self._placed = {}  # uid -> (target index, expected tokens)
+        self.submitted = 0
+        self.admitted = 0
+        self.queued = 0
+        self.rejected = 0
+        self.affinity_hits = 0
+
+    # -- TTFT prediction ---------------------------------------------------
+    def _step_seconds(self):
+        """Fleet-wide measured seconds per scheduler round: live
+        ``serving/tpot_s`` p50 when telemetry has samples, else the
+        configured default."""
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            p = tm.hist_percentiles("serving/tpot_s", (0.5,))
+            if p and p[0] > 0:
+                return p[0]
+        return self._default_step_s
+
+    def predicted_ttft(self, index, prompt_len, affinity_tokens=0):
+        """Predicted submit->first-token seconds on replica ``index``:
+        rounds to burn through (backlog + this prompt - cached prefix) at
+        the replica's token budget, times the measured per-round seconds,
+        amplified when its KV pool is near capacity."""
+        t = self._targets[index]
+        owed = self._backlog[index] + max(prompt_len - affinity_tokens, 1)
+        rounds = math.ceil(owed / max(t.budget, 1))
+        ttft = rounds * self._step_seconds()
+        if t.kv_stats()["occupancy"] >= self._occ_high:
+            ttft *= self._occ_penalty
+        return ttft
+
+    def _place(self, prompt):
+        """(best index, predicted ttft, affinity tokens) — least predicted
+        TTFT; at equal TTFT the warmer prefix wins (the prediction is
+        round-granular, so a cached prefix that doesn't change the round
+        count still saves real prefill compute), then active count."""
+        best = None
+        for i, t in enumerate(self._targets):
+            aff = t.peek_prefix(prompt) if self._prefix_affinity else 0
+            ttft = self.predicted_ttft(i, len(prompt), aff)
+            key = (ttft, -aff, t.active_count())
+            if best is None or key < best[0]:
+                best = (key, i, ttft, aff)
+        return best[1], best[2], best[3]
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, uid, prompt, max_new_tokens=16, **kwargs):
+        """Route one request. Returns a typed outcome: ``RequestAdmitted``
+        (placed now), ``RequestQueued`` (bounded router queue) or
+        ``RequestRejected`` (shed)."""
+        self.submitted += 1
+        prompt = np.asarray(prompt, np.int32)
+        tm = telemetry.get_telemetry()
+        max_ctx = min(t.max_context for t in self._targets)
+        if len(prompt) >= max_ctx:
+            # unservable anywhere: typed rejection instead of a ValueError
+            # from deep inside a scheduler
+            self.rejected += 1
+            if tm.enabled:
+                tm.fleet_event("rejected")
+                tm.fleet_gauge("fleet/shed_rate", self.shed_rate)
+            return RequestRejected(
+                uid, f"prompt of {len(prompt)} tokens cannot fit "
+                     f"max_context {max_ctx}")
+        i, ttft, aff = self._place(prompt)
+        if tm.enabled:
+            tm.record_hist("fleet/predicted_ttft_s", ttft)
+        if ttft <= self._slo:
+            return self._admit(uid, prompt, i, ttft, aff, max_new_tokens,
+                               kwargs)
+        if len(self._queue) < self._queue_limit:
+            self._queue.append((uid, prompt, max_new_tokens, kwargs))
+            self.queued += 1
+            if tm.enabled:
+                tm.fleet_event("queued")
+                tm.fleet_gauge("fleet/queue_depth", len(self._queue))
+            return RequestQueued(uid, len(self._queue) - 1, ttft)
+        self.rejected += 1
+        if tm.enabled:
+            tm.fleet_event("rejected")
+            tm.fleet_gauge("fleet/shed_rate", self.shed_rate)
+        return RequestRejected(
+            uid, f"predicted TTFT {ttft:.3f}s over SLO {self._slo:.3f}s on "
+                 f"every replica and router queue full", ttft)
+
+    def _admit(self, uid, prompt, index, ttft, aff, max_new_tokens, kwargs):
+        self._backend.submit(uid, prompt, replica=index,
+                             max_new_tokens=max_new_tokens, **kwargs)
+        expected = len(prompt) + int(max_new_tokens)
+        self._backlog[index] += expected
+        self._placed[uid] = (index, expected)
+        self.admitted += 1
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_event("admitted")
+            if aff:
+                tm.fleet_event("affinity_hit")
+        if aff:
+            self.affinity_hits += 1
+        return RequestAdmitted(uid, index, ttft, aff)
+
+    def _drain_queue(self):
+        """FIFO re-admission: the head re-places when some replica is back
+        under SLO. An idle backend force-admits — with nothing running, the
+        prediction model has no live samples to trust and waiting longer
+        cannot help."""
+        while self._queue:
+            uid, prompt, max_new_tokens, kwargs = self._queue[0]
+            i, ttft, aff = self._place(prompt)
+            if ttft > self._slo and self._backend.has_work:
+                break
+            self._queue.popleft()
+            self._admit(uid, prompt, i, ttft, aff, max_new_tokens, kwargs)
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_gauge("fleet/queue_depth", len(self._queue))
+
+    # -- serving loop ------------------------------------------------------
+    @property
+    def has_work(self):
+        return bool(self._queue) or self._backend.has_work
+
+    @property
+    def queue_depth(self):
+        return len(self._queue)
+
+    @property
+    def shed_rate(self):
+        return self.rejected / self.submitted if self.submitted else 0.0
+
+    def step(self):
+        """Drain the queue into freed capacity, run one backend round, and
+        retire finished requests from the backlog model. Returns finished
+        uids."""
+        self._drain_queue()
+        finished = self._backend.step()
+        for uid in finished:
+            placed = self._placed.pop(uid, None)
+            if placed is not None:
+                index, expected = placed
+                self._backlog[index] = max(0, self._backlog[index] - expected)
+        return finished
+
+    def results(self):
+        """Generated tokens per admitted uid (shed requests never ran)."""
+        return self._backend.results()
+
+    def run_to_completion(self, max_rounds=10000):
+        """Drain queue + backend; merged {uid: tokens} for everything that
+        was admitted (shed requests never ran)."""
+        for _ in range(max_rounds):
+            if not self.has_work:
+                break
+            self.step()
+        else:
+            raise RuntimeError("router did not converge")
+        return self.results()
+
+    def report(self):
+        """Admission accounting (``admitted + rejected == submitted`` once
+        the queue is empty) + current backlog model."""
+        return {"submitted": self.submitted, "admitted": self.admitted,
+                "queued": self.queued, "rejected": self.rejected,
+                "shed_rate": self.shed_rate,
+                "queue_depth": len(self._queue),
+                "affinity_hits": self.affinity_hits,
+                "backlog_tokens": list(self._backlog)}

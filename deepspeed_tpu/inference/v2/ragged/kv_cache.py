@@ -66,6 +66,16 @@ class BlockedKVCache:
         """Swap in pools returned by the jitted forward."""
         self.k_pool, self.v_pool = k_pool, v_pool
 
+    def place(self, sharding):
+        """Commit the pools onto an explicit device/sharding. Freshly zeroed
+        pools are UNCOMMITTED (default-device) until the first forward runs;
+        a replica pinned to a submesh must commit them eagerly so
+        cross-replica page shipping (``import_blocks`` before any forward)
+        lands on the replica's devices, not device 0."""
+        import jax
+        self.k_pool = jax.device_put(self.k_pool, sharding)
+        self.v_pool = jax.device_put(self.v_pool, sharding)
+
     # -- host swap tier (ZeRO-Inference KV offload analog) -----------------
     # Reference capability: ``deepspeed/inference`` ZeRO-Inference offloads
     # KV to host so more/longer sequences fit (README "20x" claim combines
@@ -100,4 +110,44 @@ class BlockedKVCache:
             jnp.asarray(handle["k"], self.dtype))
         self.v_pool = self.v_pool.at[:, idx].set(
             jnp.asarray(handle["v"], self.dtype))
+        return new_blocks
+
+    # -- page transfer (prefill/decode disaggregation) ---------------------
+    # Unlike the swap tier above, these never round-trip through host numpy:
+    # the gather stays a device array so ``KVPageTransport`` can device_put
+    # it straight onto the destination pool's submesh (ICI path), and the
+    # scatter accepts whatever placement the transport delivered.
+    def _pad_pages(self, blocks):
+        """Pad a block-id list to the next power of two with trash-block
+        reads/writes. Transfers bucket their shapes so the gather/scatter
+        pair compiles once per bucket, not once per page count — a cold
+        compile per handoff would dwarf the copy it measures."""
+        b = 1
+        while b < len(blocks):
+            b *= 2
+        return list(blocks) + [self.trash_block] * (b - len(blocks))
+
+    def export_blocks(self, blocks):
+        """Gather the given block rows as DEVICE arrays for shipping to
+        another pool. The gather COPIES, so the caller may free or donate
+        the source ids immediately — later eviction of a donated block
+        cannot corrupt the shipped pages. Returns ``(k, v)`` shaped
+        ``[num_layers, bucket(len(blocks)), heads, block_size, head_dim]``
+        — rows past ``len(blocks)`` are trash-block padding."""
+        idx = jnp.asarray(self._pad_pages(list(blocks)), jnp.int32)
+        k = jnp.take(self.k_pool, idx, axis=1)
+        v = jnp.take(self.v_pool, idx, axis=1)
+        return k, v
+
+    def import_blocks(self, k, v, n):
+        """Bind the first ``n`` shipped block rows into this pool under
+        freshly allocated ids (refcount 1 via the allocator, evicting parked
+        cached blocks first under pressure); padding rows scatter into the
+        trash block. Returns the new ids in shipping order."""
+        new_blocks = self._allocator.allocate(n)
+        idx = jnp.asarray(
+            new_blocks + [self.trash_block] * (int(k.shape[1]) - n),
+            jnp.int32)
+        self.k_pool = self.k_pool.at[:, idx].set(jnp.asarray(k, self.dtype))
+        self.v_pool = self.v_pool.at[:, idx].set(jnp.asarray(v, self.dtype))
         return new_blocks

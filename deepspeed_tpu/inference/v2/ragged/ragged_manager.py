@@ -220,6 +220,93 @@ class DSStateManager:
         else:
             self.kv_cache.free(seq.kv_blocks)
 
+    # -- page transfer (prefill/decode disaggregation) ---------------------
+    def export_sequence_pages(self, uid):
+        """Detach ``uid``'s KV pages for shipping to another engine's pool
+        (single-sequence form of ``export_sequences_pages``). Returns a
+        handle for ``import_sequence_pages``."""
+        h = self.export_sequences_pages([uid])
+        m = h["seqs"][0]
+        return {"n": m["n"], "k": h["k"], "v": h["v"],
+                "seen_tokens": m["seen_tokens"], "tokens": m["tokens"]}
+
+    def export_sequences_pages(self, uids):
+        """Batched export: EVERY listed sequence's page rows leave in ONE
+        device gather (``export_blocks`` over the concatenated block lists)
+        — the fleet ships a whole round's finished prefills as one
+        transfer, paying dispatch cost per transfer instead of per request.
+        Each sequence is then released exactly as ``flush_sequence`` would
+        — with prefix caching on, full blocks are donated to the cache
+        first, so a prefill replica keeps serving warm prefixes after the
+        handoff. Returns a handle for ``import_sequences_pages`` whose
+        ``seqs`` list preserves submission order."""
+        for uid in uids:  # validate everything before mutating anything
+            seq = self._seqs.get(uid)
+            if seq is None:
+                raise ValueError(f"export of untracked sequence {uid}")
+            if seq.is_swapped:
+                raise ValueError(f"cannot export swapped sequence {uid}")
+            assert seq.in_flight_tokens == 0, "cannot export mid-forward"
+        blocks, seqs, popped = [], [], []
+        for uid in uids:
+            seq = self._seqs.pop(uid)
+            popped.append(seq)
+            seqs.append({"uid": uid, "n": len(seq.kv_blocks),
+                         "seen_tokens": seq.seen_tokens,
+                         "tokens": list(seq.tokens)})
+            blocks.extend(seq.kv_blocks)
+        # one gather for the whole group — it COPIES, so the ids can be
+        # freed/donated immediately after
+        k, v = self.kv_cache.export_blocks(blocks)
+        for seq in popped:
+            if self.prefix_cache is not None:
+                self.commit_cached_blocks(seq)
+                self.kv_cache.free(list(reversed(seq.kv_blocks)))
+            else:
+                self.kv_cache.free(seq.kv_blocks)
+        return {"n": len(blocks), "k": k, "v": v, "seqs": seqs}
+
+    def import_sequence_pages(self, uid, handle):
+        """Bind shipped KV pages into this pool (single-sequence form of
+        ``import_sequences_pages``). Returns the bound block count."""
+        return self.import_sequences_pages(
+            {"n": handle["n"], "k": handle["k"], "v": handle["v"],
+             "seqs": [{"uid": uid, "n": handle["n"],
+                       "seen_tokens": handle["seen_tokens"],
+                       "tokens": handle.get("tokens", [])}]})
+
+    def import_sequences_pages(self, handle):
+        """Bind a batched shipment: ONE scatter allocates fresh block ids
+        (refcount 1 via the ``BlockedAllocator``) for every sequence in the
+        handle, then each sequence is created mid-stream with
+        ``seen_tokens`` already past its shipped pages — decode never
+        re-runs prefill. With prefix caching on, the token streams ride
+        along so imported full blocks register in THIS pool's cache at the
+        next commit. All-or-nothing: on any failure the partially created
+        sequences and all imported blocks are released. Returns the total
+        bound block count."""
+        for m in handle["seqs"]:
+            if m["uid"] in self._seqs:
+                raise ValueError(f"uid {m['uid']} already tracked")
+        ids = list(self.kv_cache.import_blocks(
+            handle["k"], handle["v"], int(handle["n"])))
+        off, created = 0, []
+        try:
+            for m in handle["seqs"]:
+                seq = self.get_or_create_sequence(m["uid"])
+                created.append(m["uid"])
+                seq.kv_blocks = ids[off:off + int(m["n"])]
+                off += int(m["n"])
+                seq.seen_tokens = int(m["seen_tokens"])
+                if self.prefix_cache is not None:
+                    seq.tokens = [int(t) for t in m["tokens"]]
+        except Exception:
+            for uid in created:
+                self._seqs.pop(uid, None)
+            self.kv_cache.free(ids)
+            raise
+        return len(ids)
+
     # -- host swap tier (ZeRO-Inference KV offload analog) -----------------
     def swap_out_sequence(self, uid):
         """Move a tracked sequence's KV blocks to host memory; the sequence

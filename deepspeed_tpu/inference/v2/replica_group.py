@@ -7,6 +7,11 @@ the global device set (a tp-submesh), with requests distributed round-robin.
 Computation follows parameter placement in XLA, so pinning is just
 ``device_put`` of each replica's params onto its submesh; multi-host works
 the same way because ``jax.devices()`` is global.
+
+For SLO-aware placement instead of round-robin, put a
+``fleet.SLORouter`` in front (it consumes the public load signals exposed
+here); for prefill/decode specialization see ``fleet.PrefillDecodeFleet``,
+which builds its replica sides through the same ``build_replica`` helper.
 """
 
 import numpy as np
@@ -18,6 +23,33 @@ from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
 from deepspeed_tpu.utils.logging import logger
+
+
+def build_replica(model, params, devices, tp_size=1, engine_config=None,
+                  token_budget=None):
+    """One (mesh, ``SplitFuseScheduler``) pair pinned to ``devices``.
+
+    Params are re-placed onto the submesh (sharded over ("tp",) via
+    ``model.param_specs`` when available); the engine and its KV pool
+    follow parameter placement. Shared by ``ReplicaGroup`` and the fleet's
+    prefill/decode sides so every replica flavor is built identically."""
+    sub = list(devices)
+    mesh = Mesh(np.array(sub).reshape(tp_size), ("tp",))
+    if tp_size > 1 and hasattr(model, "param_specs"):
+        specs = model.param_specs(params)
+        sh = jax.tree.map(
+            lambda s: NamedSharding(mesh, s if s is not None else P()),
+            specs, is_leaf=lambda s: s is None or isinstance(s, P))
+        local = jax.device_put(params, sh)
+    else:
+        local = jax.device_put(params, sub[0]) if tp_size == 1 else \
+            jax.device_put(params, NamedSharding(mesh, P()))
+    engine = InferenceEngineV2(model, local, config=engine_config)
+    # commit the KV pools to the submesh NOW: a decode-side replica may
+    # receive shipped pages (device_put onto kv_page_sharding) before its
+    # first forward would otherwise pin the uncommitted pools
+    engine.place_kv(sub[0] if tp_size == 1 else NamedSharding(mesh, P()))
+    return mesh, SplitFuseScheduler(engine, token_budget=token_budget)
 
 
 class ReplicaGroup:
@@ -49,62 +81,105 @@ class ReplicaGroup:
         self.replicas = []
         for r in range(replica_num):
             sub = devices[r * tp_size:(r + 1) * tp_size]
-            mesh = Mesh(np.array(sub).reshape(tp_size), ("tp",))
-            if tp_size > 1 and hasattr(model, "param_specs"):
-                specs = model.param_specs(params)
-                sh = jax.tree.map(
-                    lambda s: NamedSharding(mesh, s if s is not None else P()),
-                    specs, is_leaf=lambda s: s is None or isinstance(s, P))
-                local = jax.device_put(params, sh)
-            else:
-                local = jax.device_put(params, sub[0]) if tp_size == 1 else \
-                    jax.device_put(params, NamedSharding(mesh, P()))
-            engine = InferenceEngineV2(model, local, config=engine_config)
-            self.replicas.append(
-                (mesh, SplitFuseScheduler(engine, token_budget=token_budget)))
+            self.replicas.append(build_replica(
+                model, params, sub, tp_size=tp_size,
+                engine_config=engine_config, token_budget=token_budget))
         self._assignment = {}
+        # incremental per-replica assigned counts: submit must not pay an
+        # O(total-assigned) rebuild per request (the load_report scan)
+        self._assigned = [0] * len(self.replicas)
 
     @property
     def replica_num(self):
         return len(self.replicas)
 
-    def submit(self, uid, prompt, **kwargs):
-        """Round-robin request placement (reference MII load balancer)."""
-        r = len(self._assignment) % len(self.replicas)
+    def submit(self, uid, prompt, replica=None, **kwargs):
+        """Round-robin request placement (reference MII load balancer);
+        pass ``replica`` to pin (the fleet router does)."""
+        r = len(self._assignment) % len(self.replicas) if replica is None \
+            else int(replica)
         self._assignment[uid] = r
+        self._assigned[r] += 1
         mesh, sched = self.replicas[r]
         with mesh:
             sched.submit(uid, prompt, **kwargs)
         tm = telemetry.get_telemetry()
         if tm.enabled:
-            tm.serving_gauge("serving/replica_skew",
-                             self.load_report()["active_skew"], replica=r)
+            # skew is recomputed only when actually recording, from the
+            # schedulers' O(1) active counters — not a full load_report
+            tm.serving_gauge("serving/replica_skew", self.active_skew(),
+                             replica=r)
         return r
+
+    def active_skew(self):
+        """Active-count skew across replicas ((max-min)/mean, 0.0 =
+        perfectly even) — the number the MII load balancer watches before
+        moving from round-robin to least-loaded placement. O(replicas)."""
+        counts = [sched.active_count() for _, sched in self.replicas]
+        mean = sum(counts) / len(counts) if counts else 0.0
+        return (max(counts) - min(counts)) / mean if mean else 0.0
 
     def load_report(self):
         """Per-replica load: assigned/active request counts + KV occupancy,
-        and the active-count skew ((max-min)/mean, 0.0 = perfectly even) —
-        the number the MII load balancer would watch before moving from
-        round-robin to least-loaded placement."""
-        assigned = [0] * len(self.replicas)
-        for rep in self._assignment.values():
-            assigned[rep] += 1
+        plus the active-count skew. Reads only public scheduler accessors
+        (``active_count``/``kv_stats``)."""
         per = []
         for i, (mesh, sched) in enumerate(self.replicas):
-            active = sum(1 for r in sched._requests.values() if not r.done)
-            per.append({"replica": i, "assigned": assigned[i],
-                        "active": active,
-                        "kv_occupancy":
-                            sched._engine._state.kv_stats()["occupancy"]})
-        counts = [p["active"] for p in per]
-        mean = sum(counts) / len(counts) if counts else 0.0
-        skew = (max(counts) - min(counts)) / mean if mean else 0.0
-        return {"replicas": per, "active_skew": skew}
+            per.append({"replica": i, "assigned": self._assigned[i],
+                        "active": sched.active_count(),
+                        "kv_occupancy": sched.kv_stats()["occupancy"]})
+        return {"replicas": per, "active_skew": self.active_skew()}
 
-    def run_to_completion(self):
-        """Drain every replica; merged {uid: tokens}."""
+    @property
+    def has_work(self):
+        return any(sched.has_work for _, sched in self.replicas)
+
+    def step(self):
+        """One pipelined round across all replicas: every replica's forward
+        is dispatched (``step_begin``) before any result is fetched
+        (``step_finish``), so the submeshes compute concurrently instead of
+        serializing on each host fetch. Returns merged finished uids."""
+        pendings = []
+        for mesh, sched in self.replicas:
+            if not sched.has_work:
+                continue
+            with mesh:
+                p = sched.step_begin()
+            if p is not None:
+                pendings.append((mesh, sched, p))
+        finished = []
+        for mesh, sched, p in pendings:
+            with mesh:
+                finished.extend(sched.step_finish(p))
+        return finished
+
+    def router_targets(self):
+        """The (mesh, scheduler) pairs a ``fleet.SLORouter`` places over."""
+        return list(self.replicas)
+
+    def cancel(self, uid):
+        """Cancel a request wherever it was placed (frees its KV blocks —
+        ``SplitFuseScheduler.cancel``). Returns True iff it was live."""
+        r = self._assignment.get(uid)
+        if r is None:
+            return False
+        mesh, sched = self.replicas[r]
+        with mesh:
+            return sched.cancel(uid)
+
+    def results(self):
+        """Generated tokens so far across all replicas, {uid: int32}."""
         out = {}
         for mesh, sched in self.replicas:
-            with mesh:
-                out.update(sched.run_to_completion())
+            out.update(sched.results())
         return out
+
+    def run_to_completion(self, max_rounds=10000):
+        """Drain every replica (pipelined rounds); merged {uid: tokens}."""
+        for _ in range(max_rounds):
+            if not self.has_work:
+                break
+            self.step()
+        else:
+            raise RuntimeError("replica group did not converge")
+        return self.results()

@@ -64,3 +64,16 @@ def sample_rows(logits, temps, top_ks, top_ps, seeds, positions):
     """
     return jax.vmap(_row_sample)(logits, temps, top_ks, top_ps, seeds,
                                  positions)
+
+
+@jax.jit
+def sample_rows_packed(logits, fparams, iparams):
+    """``sample_rows`` with the five per-row parameter vectors packed into
+    two host arrays — ``fparams`` ``[2, S]`` float32 (temps, top_ps) and
+    ``iparams`` ``[3, S]`` int32 (top_ks, seeds, positions) — unpacked
+    inside the trace. Two host->device transfers per decode dispatch
+    instead of five; on CPU fleets stepping several schedulers per round
+    the per-dispatch host time is the serving bottleneck, not the math.
+    """
+    return jax.vmap(_row_sample)(logits, fparams[0], iparams[0], fparams[1],
+                                 iparams[1], iparams[2])

@@ -90,6 +90,15 @@ class SplitFuseScheduler:
         # draws differ stream-wise from jax.random, but both are
         # deterministic per (seed, position)).
         self._device_sampling = bool(device_sampling)
+        # submitted-but-unfinished count, maintained incrementally so
+        # per-request placement decisions (fleet router, replica skew)
+        # never scan the request table
+        self._active = 0
+        # prefill/decode disaggregation hook: called as on_finish(sched, req)
+        # the moment a request completes, BEFORE the sequence flushes; a
+        # truthy return means ownership (KV pages + remaining decode) moved
+        # to another scheduler — this one skips flush and terminal telemetry
+        self.on_finish = None
 
     def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None,
                temperature=0.0, top_k=0, top_p=1.0, seed=None):
@@ -125,6 +134,99 @@ class SplitFuseScheduler:
             tm.record_request_phase(uid, "submit", req.submit_ts,
                                     prompt_tokens=len(prompt))
         self._requests[uid] = req
+        self._active += 1
+
+    def adopt(self, uid, prompt, generated, max_new_tokens=16,
+              eos_token_id=None, temperature=0.0, top_k=0, top_p=1.0,
+              seed=0, submit_ts=0.0, last_token_ts=0.0):
+        """Adopt a mid-generation request whose KV pages were just imported
+        into this scheduler's engine (prefill/decode disaggregation): the
+        prompt is fully prefilled and ``generated`` holds the tokens the
+        prefill side already sampled. Decode continues bit-exactly — device
+        sampling is deterministic per (seed, position) and positions resume
+        from ``len(generated)``. ``submit_ts``/``last_token_ts`` carry the
+        originating timestamps through so e2e and TPOT histograms span the
+        handoff instead of restarting at it."""
+        if uid in self._requests:
+            raise ValueError(f"uid {uid} already submitted")
+        generated = [int(t) for t in generated]
+        if not generated:
+            raise ValueError("adopt requires at least one generated token")
+        prompt = np.asarray(prompt, np.int32)
+        seq = self._engine._state.get_sequence(uid)
+        if seq is None or seq.seen_tokens != len(prompt):
+            raise ValueError(
+                f"uid {uid}: imported KV does not cover the prompt "
+                f"(seen={seq.seen_tokens if seq else None}, "
+                f"prompt={len(prompt)})")
+        req = _Request(uid, prompt, int(max_new_tokens), eos_token_id,
+                       temperature=float(temperature), top_k=int(top_k),
+                       top_p=float(top_p), seed=int(seed),
+                       prefill_pos=len(prompt), generated=generated)
+        req.submit_ts = float(submit_ts)
+        req.last_token_ts = float(last_token_ts)
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            t = _now()
+            req.first_sched_ts = t  # queue-wait was recorded at prefill
+            tm.serving_event("adopted")
+            tm.record_request_phase(uid, "adopt", t,
+                                    seen_tokens=len(prompt),
+                                    new_tokens=len(generated))
+        self._requests[uid] = req
+        self._active += 1
+
+    def cancel(self, uid):
+        """Withdraw a request (router shedding / requeue): frees its KV
+        blocks — device-resident or host-swapped — and records the terminal
+        ``serving/e2e_s`` + ``req/cancel`` lane, so cancellation never leaks
+        blocks or silently drops the worst latencies from replay
+        percentiles. Call between steps (the scheduler is synchronous).
+        Returns True iff a live request was cancelled."""
+        r = self._requests.get(uid)
+        if r is None or r.done:
+            return False
+        r.done = True
+        self._active -= 1
+        if self._engine._state.get_sequence(uid) is not None:
+            self._engine.flush(uid)
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            t = _now()
+            tm.record_hist("serving/e2e_s", t - (r.submit_ts or t))
+            tm.serving_event("cancelled")
+            tm.record_request_phase(uid, "cancel", t,
+                                    new_tokens=len(r.generated))
+        return True
+
+    # -- public load signals (fleet router / ReplicaGroup) -----------------
+    def active_count(self):
+        """Submitted-but-unfinished request count, O(1)."""
+        return self._active
+
+    def kv_stats(self):
+        """This replica's host-side KV pool stats
+        (``InferenceEngineV2.kv_stats`` — occupancy, free blocks, swaps)."""
+        return self._engine.kv_stats()
+
+    def peek_prefix(self, prompt_tokens):
+        """Cached-prefix coverage for a prompt, pure read (router
+        prefix-digest affinity)."""
+        return self._engine.peek_prefix(prompt_tokens)
+
+    @property
+    def budget(self):
+        """Per-forward token budget (SplitFuse)."""
+        return self._budget
+
+    @property
+    def engine(self):
+        """The underlying ``InferenceEngineV2`` (page transfer, admission)."""
+        return self._engine
+
+    @property
+    def max_context(self):
+        return self._engine._config.state_manager.max_context
 
     @property
     def has_work(self):
@@ -149,6 +251,7 @@ class SplitFuseScheduler:
                 # and the evict lane here or replay percentiles silently drop
                 # exactly the worst-latency requests.
                 r.done = True
+                self._active -= 1
                 self._engine.flush(r.uid)
                 if tm.enabled:
                     t_evict = _now()
@@ -250,6 +353,18 @@ class SplitFuseScheduler:
 
     def step(self):
         """One scheduling round + forward. Returns uids finished this round."""
+        pending = self.step_begin()
+        return self.step_finish(pending) if pending is not None else []
+
+    def step_begin(self):
+        """Compose + dispatch one round WITHOUT fetching the result.
+
+        Returns an opaque pending handle for ``step_finish`` (None when
+        nothing was schedulable). The forward and on-device sampling stay
+        asynchronously dispatched in between — a fleet stepping N replicas
+        begins them all, then finishes them all, so the forwards run
+        concurrently across submeshes instead of serializing on each
+        replica's host fetch. ``step()`` is the fused single-replica form."""
         tm = telemetry.get_telemetry()
         self._try_resume()
         uids, chunks = self._compose()
@@ -265,7 +380,7 @@ class SplitFuseScheduler:
                         f"no schedulable work for {self._starved} rounds: "
                         f"preempted sequence(s) cannot be resumed (KV cache "
                         f"too small for the request?)")
-            return []
+            return None
         # shrink the proposal until the engine admits it (KV pressure):
         # drop the largest chunk each time and RE-validate — put() would
         # raise on an oversubscribed batch
@@ -281,22 +396,23 @@ class SplitFuseScheduler:
             # host-swap a blocked decode's KV before declaring starvation
             if self._preempt_for_progress():
                 self._starved = 0
-                return []
+                return None
             if self._starved > 3:
                 raise RuntimeError(
                     f"no schedulable work for {self._starved} rounds: "
                     f"{verdict.reason} (KV cache too small for any request?)")
-            return []
+            return None
         self._starved = 0
         enabled = tm.enabled
+        t_fwd = 0.0
+        sched_tokens = 0
+        was_prefilling = None
         if enabled:
             t_fwd = _now()
-            sched_tokens = 0
-            was_prefilling = []
+            was_prefilling = [self._requests[u].prefilling for u in uids]
             for row, uid in enumerate(uids):
                 r = self._requests[uid]
                 sched_tokens += len(chunks[row])
-                was_prefilling.append(r.prefilling)
                 if r.first_sched_ts == 0.0:
                     r.first_sched_ts = t_fwd
                     if r.submit_ts:
@@ -306,7 +422,7 @@ class SplitFuseScheduler:
                                                 t_fwd - r.submit_ts)
         if self._device_sampling:
             reqs = [self._requests[u] for u in uids]
-            ids = self._engine.put_sampled(
+            ids = self._engine.put_sampled_device(
                 uids, chunks,
                 temperatures=[r.temperature for r in reqs],
                 top_ks=[r.top_k for r in reqs],
@@ -316,6 +432,21 @@ class SplitFuseScheduler:
             logits = None
         else:
             logits = self._engine.put(uids, chunks)
+            ids = None
+        return (uids, chunks, ids, logits, t_fwd, was_prefilling,
+                sched_tokens)
+
+    def step_finish(self, pending):
+        """Fetch a dispatched round's sampled ids and retire tokens /
+        finished requests. Returns uids finished this round."""
+        uids, chunks, ids, logits, t_fwd, was_prefilling, sched_tokens = \
+            pending
+        tm = telemetry.get_telemetry()
+        # t_fwd == 0.0 means telemetry was off at dispatch; recording phases
+        # against a zero anchor would be garbage, so the round stays dark
+        enabled = tm.enabled and t_fwd > 0.0
+        if ids is not None:
+            ids = np.asarray(ids)  # the only device sync of the round
         if enabled:
             t_done = _now()
             fwd_dur = t_done - t_fwd
@@ -346,6 +477,13 @@ class SplitFuseScheduler:
             if (r.eos_token_id is not None and tok == r.eos_token_id) or \
                     len(r.generated) >= r.max_new_tokens:
                 r.done = True
+                self._active -= 1
+                # disaggregation hook: truthy return = ownership of the KV
+                # pages and the remaining decode moved to another scheduler;
+                # skip flush and terminal telemetry — the adopting side
+                # records the true finish
+                if self.on_finish is not None and self.on_finish(self, r):
+                    continue
                 self._engine.flush(uid)
                 finished.append(uid)
                 if enabled:
@@ -397,6 +535,12 @@ class SplitFuseScheduler:
         rng = np.random.default_rng((r.seed << 20) + len(r.generated))
         return int(rng.choice(len(p), p=p))
 
+    def results(self):
+        """Generated tokens so far, {uid: int32 array} — includes finished,
+        cancelled, and (on a prefill replica) handed-off requests."""
+        return {uid: np.asarray(r.generated, np.int32)
+                for uid, r in self._requests.items()}
+
     def run_to_completion(self, max_rounds=10000):
         for _ in range(max_rounds):
             if not self.has_work:
@@ -404,5 +548,4 @@ class SplitFuseScheduler:
             self.step()
         else:
             raise RuntimeError("scheduler did not converge")
-        return {uid: np.asarray(r.generated, np.int32)
-                for uid, r in self._requests.items()}
+        return self.results()

@@ -97,6 +97,23 @@ def record_request_phase(uid, phase, t0, dur=None, **args):
     _GLOBAL.record_request_phase(uid, phase, t0, dur=dur, **args)
 
 
+def fleet_event(event, n=1, **tags):
+    """Count one fleet-router admission outcome (admitted/queued/rejected)."""
+    _GLOBAL.fleet_event(event, n=n, **tags)
+
+
+def fleet_gauge(name, value, **tags):
+    """Record a fleet-level gauge (queue depth, predicted TTFT, shed rate)."""
+    _GLOBAL.fleet_gauge(name, value, **tags)
+
+
+def record_handoff(uid, pages, nbytes, seconds, src="prefill", dst="decode",
+                   bound=None):
+    """Record one prefill->decode KV page handoff (bytes/latency/pages)."""
+    _GLOBAL.record_handoff(uid, pages, nbytes, seconds, src=src, dst=dst,
+                           bound=bound)
+
+
 def record_memory(point, stats=None, device_index=0, **tags):
     """Record one HBM occupancy sample (no-op + None when disabled)."""
     return _GLOBAL.record_memory(point, stats=stats,

@@ -288,6 +288,11 @@ class Telemetry:
         self.serving_counters = {}  # lifecycle event -> count
         self.serving_gauges = {}   # name -> [last, peak]
         self._request_lanes = {}   # uid -> synthetic chrome tid
+        # fleet stream (router admission + prefill/decode handoffs)
+        self.fleet_counters = {}   # admission outcome -> count
+        self.fleet_gauges = {}     # name -> [last, peak]
+        self.fleet_handoff = {"count": 0, "pages_shipped": 0,
+                              "pages_bound": 0, "bytes": 0, "total_s": 0.0}
         # goodput ledger (seconds per category; idle derived at summary time)
         self.ledger_secs = {c: 0.0 for c in LEDGER_CATEGORIES if c != "idle"}
         self._ledger_epoch = self._epoch
@@ -658,6 +663,87 @@ class Telemetry:
                 "histograms": hists, "gauges": gauges}
 
     # ------------------------------------------------------------------
+    # fleet stream (docs/OBSERVABILITY.md "Fleet")
+    # ------------------------------------------------------------------
+    def fleet_event(self, event, n=1, **tags):
+        """Count one fleet-router admission outcome ("admitted", "queued",
+        "rejected", "affinity_hit", ...) — surfaced in
+        ``summary()["fleet"]["events"]``."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.fleet_counters[event] = \
+                self.fleet_counters.get(event, 0) + n
+            self._emit_jsonl({"name": f"fleet/req/{event}",
+                              "kind": "counter", "value": n,
+                              "tags": tags or {}})
+
+    def fleet_gauge(self, name, value, **tags):
+        """Fleet-level gauge (router queue depth, predicted TTFT, shed
+        rate): keeps last + peak, emits a Chrome counter track and a JSONL
+        line. Host-side values only, like ``serving_gauge``."""
+        if not self.enabled:
+            return
+        v = float(value)
+        with self._lock:
+            g = self.fleet_gauges.get(name)
+            if g is None:
+                self.fleet_gauges[name] = [v, v]
+            else:
+                g[0] = v
+                if v > g[1]:
+                    g[1] = v
+            self.trace_events.append(
+                {"name": name, "ph": "C", "cat": "fleet",
+                 "ts": round((time.perf_counter() - self._epoch) * 1e6, 3),
+                 "pid": os.getpid(), "args": {"value": v}})
+            self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
+                              "tags": tags or {}})
+
+    def record_handoff(self, uid, pages, nbytes, seconds, src="prefill",
+                       dst="decode", bound=None):
+        """One prefill->decode KV page handoff: aggregates pages / bytes /
+        latency into ``summary()["fleet"]["handoff"]`` (perf_gate checks
+        the accounting identity ``pages_shipped == pages_bound``), records
+        a ``fleet/handoff_s`` histogram sample, and drops a "handoff"
+        slice on the request's Chrome-trace lane so the shipping cost sits
+        visibly between the prefill and decode phases."""
+        if not self.enabled:
+            return
+        seconds = float(seconds)
+        t_end = time.perf_counter()
+        with self._lock:
+            h = self.fleet_handoff
+            h["count"] += 1
+            h["pages_shipped"] += int(pages)
+            h["pages_bound"] += int(pages if bound is None else bound)
+            h["bytes"] += int(nbytes)
+            h["total_s"] += seconds
+            self._emit_jsonl({"name": "fleet/handoff", "kind": "seconds",
+                              "value": seconds,
+                              "tags": {"uid": uid, "pages": int(pages),
+                                       "bytes": int(nbytes),
+                                       "src": src, "dst": dst}})
+        self.record_hist("fleet/handoff_s", seconds)
+        self.record_request_phase(uid, "handoff", t_end - seconds, seconds,
+                                  pages=int(pages), bytes=int(nbytes),
+                                  src=src, dst=dst)
+
+    def _fleet_summary(self):
+        # caller holds self._lock
+        h = self.fleet_handoff
+        gauges = {name: {"last": round(g[0], 6), "peak": round(g[1], 6)}
+                  for name, g in sorted(self.fleet_gauges.items())}
+        return {"events": {k: int(v) for k, v in
+                           sorted(self.fleet_counters.items())},
+                "gauges": gauges,
+                "handoff": {"count": int(h["count"]),
+                            "pages_shipped": int(h["pages_shipped"]),
+                            "pages_bound": int(h["pages_bound"]),
+                            "bytes": int(h["bytes"]),
+                            "total_s": round(h["total_s"], 6)}}
+
+    # ------------------------------------------------------------------
     # memory stream
     # ------------------------------------------------------------------
     def record_memory(self, point, stats=None, device_index=0, **tags):
@@ -954,7 +1040,8 @@ class Telemetry:
                    "counters": counters,
                    "memory": memory,
                    "ledger": self._ledger_summary(),
-                   "serving": self._serving_summary()}
+                   "serving": self._serving_summary(),
+                   "fleet": self._fleet_summary()}
             if self.overlap_report is not None:
                 out["overlap"] = self.overlap_report
             return out
@@ -1028,6 +1115,15 @@ class Telemetry:
         if srv.get("requests"):
             lines.append("requests: " + "  ".join(
                 f"{k}={v}" for k, v in srv["requests"].items()))
+        flt = s.get("fleet", {})
+        if flt.get("events"):
+            lines.append("fleet: " + "  ".join(
+                f"{k}={v}" for k, v in flt["events"].items()))
+        if flt.get("handoff", {}).get("count"):
+            h = flt["handoff"]
+            lines.append(f"handoffs: {h['count']}  pages: "
+                         f"{h['pages_shipped']}->{h['pages_bound']}  "
+                         f"bytes: {h['bytes']}  total: {h['total_s']*1e3:.2f} ms")
         return "\n".join(lines) if lines else "telemetry: no samples"
 
     def log_summary(self, print_log=True):
@@ -1080,4 +1176,13 @@ class Telemetry:
         for name, g in srv.get("gauges", {}).items():
             leaf = name.rsplit("/", 1)[-1]
             events.append((f"{p}Serving/{leaf}", g["last"], step))
+        flt = s.get("fleet", {})
+        for name, v in flt.get("events", {}).items():
+            events.append((f"{p}Fleet/{name}", v, step))
+        for name, g in flt.get("gauges", {}).items():
+            leaf = name.rsplit("/", 1)[-1]
+            events.append((f"{p}Fleet/{leaf}", g["last"], step))
+        if flt.get("handoff", {}).get("count"):
+            events.append((f"{p}Fleet/handoff_bytes",
+                           flt["handoff"]["bytes"], step))
         return events

@@ -21,10 +21,20 @@ additionally embeds the full telemetry summary in each payload's ``extra``
   prefill-token reduction, prefix hit rate, and TTFT comparison the prefix
   cache is judged on (gated by perf_gate's prefix checks).
 
-Usage: python scripts/bench_serving.py [--replay] [--prefix-mix]
+- ``--replay --fleet`` — serving-fleet replay: the same seeded trace runs
+  twice — once against a single scheduler at its saturation rate, then
+  against an ``SLORouter`` over a ``PrefillDecodeFleet`` (prefill/decode
+  disaggregation with KV-page handoffs) at DOUBLE the offered rate. The
+  payload reports the sustained-rate multiplier, both legs' TTFT/TPOT
+  percentiles, the shed rate, and the page-handoff accounting
+  (pages shipped == pages bound; bytes; latency), gated by perf_gate's
+  fleet checks.
+
+Usage: python scripts/bench_serving.py [--replay] [--prefix-mix] [--fleet]
            [--requests N] [--seed S] [--arrival poisson|burst] [--rate R]
            [--burst-size B] [--prompt T] [--new T]
            [--prefix-pools P] [--prefix-len L]
+           [--fleet-prefill N] [--fleet-decode N]
 """
 
 import argparse
@@ -205,10 +215,15 @@ def _precompile_batch_grid(sched, n_req, budget):
         q *= 2
     q_vals.append(budget)
     for n in s_vals:
-        for i, qb in enumerate(q_vals):
-            longest = min(qb, budget - (n - 1))
-            if i and longest <= q_vals[i - 1]:
-                continue  # token budget can't reach this bucket at n seqs
+        for qb in q_vals:
+            if qb < n:
+                continue  # can't give every sequence a token
+            # compose a batch totalling EXACTLY qb tokens so the wrapper
+            # buckets it to (bucket(n), qb) — one chunk takes the slack,
+            # the rest decode one token. Covers pure-decode rounds
+            # (qb == min bucket) as well as chunked-prefill mixes; a shape
+            # missed here cold-compiles inside the measured leg
+            longest = qb - (n - 1)
             uids = list(range(90_000, 90_000 + n))
             toks = [np.zeros(longest, np.int32)] + \
                 [np.zeros(1, np.int32)] * (n - 1)
@@ -351,6 +366,206 @@ def prefix_mix_bench(args, on_tpu):
     payload = {
         "metric": "serving_replay_tokens_per_sec_per_chip",
         "value": round(total / c["wall"] / max(n_chips, 1), 1),
+        "unit": "tokens/s/chip (prefill+decode)",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+    bench.emit(payload)
+    return payload
+
+
+def fleet_replay_bench(args, on_tpu):
+    """Serving-fleet replay: single scheduler at saturation rate R, then
+    ``SLORouter`` + ``PrefillDecodeFleet`` at rate 2R over the same seeded
+    trace (arrival gaps halved). The fleet leg must SUSTAIN the doubled
+    rate: perf_gate's fleet baseline ratchet holds the completed-request
+    rate multiplier >= 2x and the fleet's TTFT p99 near the single leg's,
+    with bounded shedding and exact page-handoff accounting."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.v2.fleet import SLORouter, PrefillDecodeFleet
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    n_prefill, n_decode = args.fleet_prefill, args.fleet_decode
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=args.prompt + args.new + 64,
+                          remat=False)
+        n_req = args.requests
+        prompt_scale, new_scale = args.prompt // 2, args.new
+        max_prompt, max_new = args.prompt, args.new * 4
+        budget, rate = 256, args.rate
+    else:
+        cfg = LlamaConfig.tiny(remat=False)
+        n_req = min(args.requests, 32)
+        # prompt-heavy with real decode tails: the monolithic leg must pay
+        # the decode-interference tax (every live decode row occupies a
+        # sequence slot in the shared forward — S-bucket padding plus one
+        # budget token per round — throttling prefill), which is the
+        # contention disaggregation removes
+        prompt_scale, new_scale = 96, 4
+        max_prompt, max_new = 256, 8
+        # rate well past the single replica's service capacity: the
+        # reference leg must be SATURATED for the multiplier to mean
+        # anything (an underloaded single replica tracks the offered rate
+        # and no fleet can look faster)
+        budget, rate = 16, max(args.rate, 400.0)
+    # the disaggregation dividend: a monolithic replica must chunk prefill
+    # to the small TPOT-bounding budget (decode rows ride every forward),
+    # but a prefill-only replica hosts no decodes, so it runs WHOLE-PROMPT
+    # chunks (Splitwise/DistServe phase splitting — chunking exists solely
+    # to protect decode latency); decode replicas keep the latency budget
+    prefill_budget = max(budget * 4, max_prompt)
+    if (n_prefill + n_decode) > len(jax.devices()):
+        raise RuntimeError(
+            f"fleet replay needs {n_prefill + n_decode} devices, have "
+            f"{len(jax.devices())} (CPU runs force 8 host devices)")
+
+    prompt_lens, out_lens, arrivals = make_workload(
+        n_req, args.seed, arrival=args.arrival, rate=rate,
+        burst_size=args.burst_size, prompt_scale=prompt_scale,
+        new_scale=new_scale, max_prompt=max_prompt, max_new=max_new)
+    gen = np.random.default_rng(args.seed)
+    prompts = [gen.integers(0, cfg.vocab_size, int(n)).astype(np.int32)
+               for n in prompt_lens]
+    prompt_total = int(prompt_lens.sum())
+
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    block = 32 if on_tpu else 8
+    max_ctx = int(max_prompt) + int(max_new) + block
+    eng_cfg = {
+        "state_manager": {"max_ragged_sequence_count": max(4, n_req) + 1,
+                          "max_ragged_batch_size": prefill_budget,
+                          "max_context": max_ctx,
+                          "num_kv_blocks":
+                              max(64, (max_ctx // block + 2) * n_req)},
+        "kv_cache": {"block_size": block,
+                     "cache_dtype": "bf16" if on_tpu else "fp32"}}
+    # prefill replicas cap the per-forward sequence count at the minimum
+    # S bucket: forward cost scales with the PADDED sequence axis (sampling
+    # rows, attention padding), and a prefill-only replica gains nothing
+    # from packing many prompts into one chunk — submitted requests beyond
+    # the cap wait in the scheduler and ride the next whole-prompt chunk
+    prefill_cfg = {
+        "state_manager": dict(eng_cfg["state_manager"],
+                              max_ragged_sequence_count=4),
+        "kv_cache": dict(eng_cfg["kv_cache"])}
+
+    def measure(backend, scheds, arr, label):
+        """Warm the batch-shape grid on every replica, then drive the trace
+        wall-clock with a clean telemetry stream. Returns the leg report."""
+        t0 = time.perf_counter()
+        for mesh, sched in scheds:
+            with mesh:
+                _precompile_batch_grid(sched, n_req, sched.budget)
+        print(f"fleet[{label}]: warmup/compile {time.perf_counter()-t0:.1f}s",
+              file=sys.stderr)
+        telemetry.reset()
+        telemetry.configure(enabled=True, sample_sync=False,
+                            chrome_trace_path=os.environ.get(
+                                "DS_TPU_TELEMETRY_TRACE", ""))
+        tm = telemetry.get_telemetry()
+        wall = _drive_replay(backend, prompts, out_lens, arr)
+        results = backend.results()
+        decoded = int(sum(len(v) for v in results.values()))
+        ttft = tm.hist_percentiles("serving/ttft_s", (0.5, 0.99)) or (0.0, 0.0)
+        tpot = tm.hist_percentiles("serving/tpot_s", (0.5, 0.99)) or (0.0, 0.0)
+        return {"wall": wall, "decoded": decoded,
+                "completed": len(results),
+                "ttft": ttft, "tpot": tpot,
+                "handoff_p50": (tm.hist_percentiles("fleet/handoff_s",
+                                                    (0.5,)) or (0.0,))[0]}
+
+    # leg 1 — single replica at its saturation rate (the reference the
+    # multiplier is judged against); built through the same replica path so
+    # both legs pin pools identically
+    from deepspeed_tpu.inference.v2.replica_group import build_replica
+    mesh1, sched1 = build_replica(model, params, [jax.devices()[0]],
+                                  engine_config=eng_cfg, token_budget=budget)
+
+    class _Single:
+        has_work = property(lambda self: sched1.has_work)
+
+        def submit(self, uid, prompt, **kw):
+            with mesh1:
+                sched1.submit(uid, prompt, **kw)
+
+        def step(self):
+            with mesh1:
+                return sched1.step()
+
+        def results(self):
+            return sched1.results()
+
+    single = measure(_Single(), [(mesh1, sched1)], arrivals, "single")
+
+    # leg 2 — SLO router over a disaggregated fleet at DOUBLE the offered
+    # rate (same trace, arrival gaps halved)
+    fleet = PrefillDecodeFleet(
+        model, params, prefill_replicas=n_prefill, decode_replicas=n_decode,
+        engine_config=prefill_cfg, token_budget=prefill_budget,
+        decode_engine_config=eng_cfg, decode_token_budget=budget)
+    fleet.warm_transport()
+    router = SLORouter(fleet, slo_ttft_s=max(4.0, single["ttft"][1] * 8),
+                       queue_limit=n_req)
+    fl = measure(router, fleet.prefill + fleet.decode, arrivals * 0.5,
+                 "router+disagg")
+
+    tstats = fleet.transport.stats()
+    single_rps = single["completed"] / single["wall"]
+    fleet_rps = fl["completed"] / fl["wall"]
+    rate_multiplier = fleet_rps / single_rps if single_rps else 0.0
+    total = fl["decoded"] + prompt_total
+    n_chips = jax.device_count()
+    extra = {
+        # fleet leg (the payload's headline numbers)
+        "ttft_p50_s": round(fl["ttft"][0], 6),
+        "ttft_p99_s": round(fl["ttft"][1], 6),
+        "tpot_p50_s": round(fl["tpot"][0], 6),
+        "tpot_p99_s": round(fl["tpot"][1], 6),
+        "tokens_per_sec": round(total / fl["wall"], 1),
+        "requests_per_sec": round(fleet_rps, 3),
+        "rate_multiplier": round(rate_multiplier, 4),
+        "offered_rate_req_per_s": rate * 2,
+        "shed_rate": round(router.shed_rate, 6),
+        "admitted": router.admitted, "queued": router.queued,
+        "rejected": router.rejected,
+        "affinity_hits": router.affinity_hits,
+        # handoff accounting (KVPageTransport + telemetry must agree)
+        "handoffs": tstats["handoffs"],
+        "handoff_transfers": tstats["transfers"],
+        "pages_shipped": tstats["pages_shipped"],
+        "pages_bound": tstats["pages_bound"],
+        "handoff_bytes": tstats["bytes_shipped"],
+        "handoff_total_s": round(tstats["total_s"], 6),
+        "handoff_p50_s": round(fl["handoff_p50"], 6),
+        "prefill_replicas": n_prefill, "decode_replicas": n_decode,
+        "prefill_token_budget": prefill_budget,
+        "decode_token_budget": budget,
+        # single-replica reference leg
+        "single_ttft_p50_s": round(single["ttft"][0], 6),
+        "single_ttft_p99_s": round(single["ttft"][1], 6),
+        "single_tpot_p50_s": round(single["tpot"][0], 6),
+        "single_tpot_p99_s": round(single["tpot"][1], 6),
+        "single_requests_per_sec": round(single_rps, 3),
+        "single_rate_req_per_s": rate,
+        "single_wall_s": round(single["wall"], 2),
+        "requests": n_req, "seed": args.seed, "arrival": args.arrival,
+        "prompt_tokens_total": prompt_total,
+        "decode_tokens_total": fl["decoded"],
+        "wall_s": round(fl["wall"], 2), "chips": n_chips,
+        "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}",
+    }
+    _embed_telemetry(extra)
+    payload = {
+        "metric": "serving_fleet_replay_tokens_per_sec_per_chip",
+        "value": round(total / fl["wall"] / max(n_chips, 1), 1),
         "unit": "tokens/s/chip (prefill+decode)",
         "vs_baseline": None,
         "extra": extra,
@@ -506,7 +721,27 @@ def main():
     ap.add_argument("--prefix-len", type=int, default=0,
                     help="shared prefix length in tokens; 0 = per-platform "
                          "default (--prefix-mix)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="with --replay: single-replica saturation leg, then "
+                         "SLORouter over a prefill/decode fleet at 2x the "
+                         "offered rate")
+    ap.add_argument("--fleet-prefill", type=int, default=2,
+                    help="prefill replicas in the fleet leg (--fleet)")
+    ap.add_argument("--fleet-decode", type=int, default=1,
+                    help="decode replicas in the fleet leg (--fleet); decode "
+                         "throughput is bounded by live sequences per round, "
+                         "not budget, so 1 is usually right until the KV "
+                         "working set outgrows one pool")
     args = ap.parse_args()
+
+    if args.fleet:
+        # the fleet leg needs one device per replica; CPU runs present them
+        # via forced host devices (inert when a real TPU backend is used) —
+        # must be set before jax first initializes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
 
     # DS_TPU_TELEMETRY=1: same contract as bench.py — enable the unified
     # telemetry stream up front; summaries land in each payload's extra
@@ -516,7 +751,9 @@ def main():
                             chrome_trace_path=os.environ.get(
                                 "DS_TPU_TELEMETRY_TRACE", ""))
 
-    metric = ("serving_replay_tokens_per_sec_per_chip" if args.replay
+    metric = ("serving_fleet_replay_tokens_per_sec_per_chip"
+              if args.replay and args.fleet
+              else "serving_replay_tokens_per_sec_per_chip" if args.replay
               else "splitfuse_serving_tokens_per_sec")
     try:
         devs = bench.init_backend_with_retry(lease_name="bench_serving")
@@ -528,7 +765,9 @@ def main():
     on_tpu = devs[0].platform in ("tpu", "axon")
     if args.replay:
         try:
-            if args.prefix_mix:
+            if args.fleet:
+                fleet_replay_bench(args, on_tpu)
+            elif args.prefix_mix:
                 prefix_mix_bench(args, on_tpu)
             else:
                 replay_bench(args, on_tpu)

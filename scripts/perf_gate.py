@@ -42,9 +42,16 @@ validates the checked-in shared-prefix replay baseline
 (hit rate in [0, 1], tokens saved <= prompt tokens, finite percentiles) plus
 the acceptance ratchet — >= 40% prefill-token reduction, hit rate > 0.5,
 cached TTFT p50 no worse than the cache-off leg (``check_prefix_baseline``)
-— then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run``
-against the repo's own BASELINE.json so a malformed baseline, summary, or
-tuning table fails fast on CPU (docs/OBSERVABILITY.md).
+— and validates the checked-in disaggregated fleet replay baseline
+(``onchip_results/serving_fleet_baseline.json``): payload shape (finite
+ordered percentiles for both legs, shed rate in [0, 1], every shipped KV
+page bound) plus the fleet acceptance ratchet — saturation-rate multiplier
+>= 2x the single replica, shed rate <= 0.1, at least one real handoff,
+fleet TTFT p99 no worse than the saturated single replica
+(``check_fleet_baseline``) — then exits 0/2 without comparing. The tier-1
+lane runs ``--dry-run`` against the repo's own BASELINE.json so a malformed
+baseline, summary, or tuning table fails fast on CPU
+(docs/OBSERVABILITY.md).
 """
 
 import argparse
@@ -79,6 +86,10 @@ GATES = {
     # reuse got worse
     "prefix_hit_rate": ("down", "max_prefix_hit_drop"),
     "prefill_reduction": ("down", "max_prefix_hit_drop"),
+    # fleet replay (bench_serving --fleet --replay): the saturation-rate
+    # multiplier over the monolithic single replica shrinking means the
+    # disaggregation dividend regressed
+    "rate_multiplier": ("down", "max_rate_multiplier_drop"),
 }
 
 #: extra/doc keys lifted verbatim into the metric dict when positive
@@ -88,6 +99,10 @@ SERVING_KEYS = ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
 #: prefix-mix payload keys (bench_serving --replay --prefix-mix); lifted and
 #: validated only when present — plain replay payloads don't carry them
 PREFIX_KEYS = ("prefix_hit_rate", "prefill_reduction")
+
+#: fleet replay payload keys (bench_serving --fleet --replay); lifted only
+#: when present (the rate multiplier rides the fleet payload's extra)
+FLEET_KEYS = ("rate_multiplier",)
 
 
 def load_doc(path):
@@ -146,7 +161,7 @@ def extract_metrics(doc):
                     m["peak_hbm_bytes"] = v
             except (TypeError, ValueError):
                 pass
-        for key in SERVING_KEYS + PREFIX_KEYS:
+        for key in SERVING_KEYS + PREFIX_KEYS + FLEET_KEYS:
             if key in src and key not in m:
                 try:
                     v = float(src[key])
@@ -412,6 +427,52 @@ def _validate_prefix_fields(extra):
     return None
 
 
+def validate_fleet_payload(doc):
+    """Shape-check a bench_serving --fleet --replay payload: a SUCCESSFUL
+    run (value > 0) must carry finite ordered percentiles for BOTH legs
+    (fleet and the single-replica reference), a shed rate in [0, 1], a
+    finite positive rate multiplier, and page conservation — every shipped
+    KV page bound at a decode replica (a shipped-but-unbound page means the
+    handoff protocol leaked). Pure dict checks — runs in the tier-1 dry-run
+    lane without jax. Returns an error string or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "serving_fleet_replay" not in str(doc.get("metric", "")):
+        return None
+    try:
+        if float(doc.get("value", 0)) <= 0:
+            return None
+    except (TypeError, ValueError):
+        return None
+    extra = doc.get("extra")
+    if not isinstance(extra, dict):
+        return "fleet replay payload has no extra dict"
+    def bad_num(v):
+        return not isinstance(v, (int, float)) or isinstance(v, bool) or \
+            not (v == v and abs(v) != float("inf"))
+    for key in ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
+                "single_ttft_p50_s", "single_ttft_p99_s", "rate_multiplier",
+                "shed_rate", "requests_per_sec", "single_requests_per_sec",
+                "handoffs", "pages_shipped", "pages_bound"):
+        if bad_num(extra.get(key)):
+            return f"fleet replay payload: extra[{key!r}] missing or " \
+                   f"not finite (got {extra.get(key)!r})"
+    for prefix in ("ttft", "tpot", "single_ttft"):
+        if extra[f"{prefix}_p50_s"] > extra[f"{prefix}_p99_s"]:
+            return f"fleet replay payload: {prefix} p50 > p99"
+    if not 0.0 <= extra["shed_rate"] <= 1.0:
+        return "fleet replay payload: shed_rate outside [0, 1]"
+    if extra["rate_multiplier"] <= 0:
+        return "fleet replay payload: rate_multiplier not positive"
+    if extra["pages_shipped"] != extra["pages_bound"]:
+        return (f"fleet replay payload: pages_shipped "
+                f"{extra['pages_shipped']} != pages_bound "
+                f"{extra['pages_bound']} — KV handoff leaked pages")
+    if extra["handoffs"] < 0:
+        return "fleet replay payload: negative handoff count"
+    return None
+
+
 def _load_overlap_module():
     """Load telemetry/overlap.py standalone (stdlib-only at module scope,
     same pattern as kernel_table) so overlap validation runs in the tier-1
@@ -586,6 +647,63 @@ def check_prefix_baseline(baseline_path=None):
             "ttft_p50_nocache_s": extra["ttft_p50_nocache_s"]}, errors
 
 
+#: fleet acceptance for the checked-in disaggregated replay baseline: the
+#: recorded run must sustain >= 2x the single replica's saturation request
+#: rate (the ISSUE's dividend) without shedding more than 10% of admits,
+#: and must actually have exercised the KV handoff path
+FLEET_MIN_RATE_MULTIPLIER = 2.0
+FLEET_MAX_SHED_RATE = 0.1
+FLEET_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                   "serving_fleet_baseline.json")
+
+
+def check_fleet_baseline(baseline_path=None):
+    """Validate the checked-in ``--fleet --replay`` baseline: payload shape
+    (``validate_fleet_payload`` incl. page conservation), then the
+    acceptance ratchet — rate multiplier >= ``FLEET_MIN_RATE_MULTIPLIER``,
+    shed rate <= ``FLEET_MAX_SHED_RATE``, at least one real KV handoff, and
+    fleet TTFT p99 no worse than the saturated single replica's (the whole
+    point of admitting onto prefill-only replicas). Pure dict checks over
+    recorded values (wall-clock legs cannot be re-derived jax-free).
+    Returns (report, errors) for the dry-run lane."""
+    path = baseline_path or FLEET_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no fleet baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable fleet baseline {path}"]
+    err = validate_fleet_payload(doc)
+    if err:
+        return {}, [f"fleet baseline: {err}"]
+    extra = doc.get("extra", {}) if isinstance(doc, dict) else {}
+    if "rate_multiplier" not in extra:
+        return {}, ["fleet baseline payload carries no fleet fields "
+                    "(regenerate with bench_serving --fleet --replay)"]
+    errors = []
+    mult = extra["rate_multiplier"]
+    if mult < FLEET_MIN_RATE_MULTIPLIER:
+        errors.append(
+            f"fleet baseline: rate multiplier {mult} < "
+            f"{FLEET_MIN_RATE_MULTIPLIER} — the disaggregated fleet no "
+            f"longer sustains the required saturation-rate dividend")
+    if extra["shed_rate"] > FLEET_MAX_SHED_RATE:
+        errors.append(f"fleet baseline: shed_rate {extra['shed_rate']} > "
+                      f"{FLEET_MAX_SHED_RATE}")
+    if extra["handoffs"] <= 0:
+        errors.append("fleet baseline: no KV handoffs recorded — the run "
+                      "never exercised prefill->decode shipping")
+    if extra["ttft_p99_s"] > extra["single_ttft_p99_s"]:
+        errors.append(
+            f"fleet baseline: fleet TTFT p99 {extra['ttft_p99_s']}s worse "
+            f"than the saturated single replica "
+            f"{extra['single_ttft_p99_s']}s")
+    return {"rate_multiplier": mult, "shed_rate": extra["shed_rate"],
+            "handoffs": extra["handoffs"],
+            "pages_shipped": extra["pages_shipped"],
+            "ttft_p99_s": extra["ttft_p99_s"],
+            "single_ttft_p99_s": extra["single_ttft_p99_s"]}, errors
+
+
 def check_overlap_analytic():
     """Drive the overlap analyzer end-to-end jax-free: build the analytic
     serialized schedule from a fixed collective inventory, attribute it,
@@ -665,6 +783,9 @@ def main(argv=None):
     ap.add_argument("--max-prefix-hit-drop", type=float, default=0.10,
                     help="allowed relative drop in prefix-cache hit rate / "
                          "prefill reduction (--prefix-mix payloads)")
+    ap.add_argument("--max-rate-multiplier-drop", type=float, default=0.10,
+                    help="allowed relative drop in the fleet saturation-"
+                         "rate multiplier (--fleet --replay payloads)")
     ap.add_argument("--dry-run", action="store_true",
                     help="validate inputs (parse + summary schema) only")
     args = ap.parse_args(argv)
@@ -678,7 +799,7 @@ def main(argv=None):
         if doc is None:
             return 2
         err = validate_summary(doc) or validate_serving_payload(doc) \
-            or validate_overlap_payload(doc)
+            or validate_fleet_payload(doc) or validate_overlap_payload(doc)
         if err:
             print(f"perf_gate: {label}: {err}", file=sys.stderr)
             return 2
@@ -699,8 +820,11 @@ def main(argv=None):
         prefix_report, prefix_errors = check_prefix_baseline()
         for err in prefix_errors:
             print(f"perf_gate: prefix_cache: {err}", file=sys.stderr)
+        fleet_report, fleet_errors = check_fleet_baseline()
+        for err in fleet_errors:
+            print(f"perf_gate: fleet: {err}", file=sys.stderr)
         errors = table_errors + qgz_errors + overlap_errors + sched_errors \
-            + prefix_errors
+            + prefix_errors + fleet_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
@@ -708,6 +832,7 @@ def main(argv=None):
                           "overlap": overlap_report,
                           "overlap_schedule": sched_report,
                           "prefix_cache": prefix_report,
+                          "fleet": fleet_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
         return 2 if errors else 0
@@ -731,7 +856,8 @@ def main(argv=None):
                   "max_tpot_growth": args.max_tpot_growth,
                   "max_kv_occupancy_growth": args.max_kv_occupancy_growth,
                   "max_exposed_growth": args.max_exposed_growth,
-                  "max_prefix_hit_drop": args.max_prefix_hit_drop}
+                  "max_prefix_hit_drop": args.max_prefix_hit_drop,
+                  "max_rate_multiplier_drop": args.max_rate_multiplier_drop}
     verdicts, regressed = compare(base_m, cand_m, thresholds)
     result = {"compared": len(verdicts), "regressed": regressed,
               "verdicts": verdicts,

@@ -737,3 +737,173 @@ def test_bench_serving_replay_cpu_acceptance(tmp_path):
     p.write_text(json.dumps(doc))
     r = _run([PERF_GATE, "--baseline", str(p), "--candidate", str(p)])
     assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+# ---------------------------------------------------------------------------
+# fleet gates (bench_serving --fleet --replay / check_fleet_baseline)
+# ---------------------------------------------------------------------------
+
+def _fleet_payload(mult=2.25, shed=0.0, handoffs=28, shipped=399, bound=399,
+                   ttft99=0.34, single99=0.94):
+    """A --fleet --replay payload: both legs' percentiles, the admission
+    accounting, and the KV-handoff conservation counters (internally
+    consistent by default: pages shipped == bound, fleet tail TTFT better
+    than the saturated single replica, multiplier over the 2x ratchet)."""
+    return {"metric": "serving_fleet_replay_tokens_per_sec_per_chip",
+            "value": 970.0, "unit": "tokens/s/chip (prefill+decode)",
+            "vs_baseline": None,
+            "extra": {"ttft_p50_s": 0.18, "ttft_p99_s": ttft99,
+                      "tpot_p50_s": 0.047, "tpot_p99_s": 0.075,
+                      "rate_multiplier": mult, "shed_rate": shed,
+                      "requests_per_sec": 69.0,
+                      "single_requests_per_sec": 30.6,
+                      "single_ttft_p50_s": 0.46, "single_ttft_p99_s": single99,
+                      "handoffs": handoffs, "handoff_transfers": 15,
+                      "pages_shipped": shipped, "pages_bound": bound,
+                      "handoff_bytes": 2162688, "handoff_total_s": 0.058,
+                      "prefill_replicas": 2, "decode_replicas": 1,
+                      "requests": 32}}
+
+
+def test_perf_gate_dry_run_validates_fleet_payload_shape(tmp_path):
+    """--dry-run shape-checks a successful fleet payload without jax: both
+    legs' percentiles finite and ordered, shed rate in [0, 1], every
+    shipped page bound. Error payloads (value 0) are exempt."""
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_fleet_payload()))
+    r = _run([PERF_GATE, "--baseline", str(good), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    metrics = json.loads(r.stdout)["metrics"]["baseline"]
+    assert metrics["rate_multiplier"] == 2.25
+
+    doc = _fleet_payload()
+    del doc["extra"]["single_ttft_p99_s"]
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "single_ttft_p99_s" in r.stderr
+
+    doc = _fleet_payload(ttft99=0.05)  # fleet p50 0.18 > p99 0.05
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "p50 > p99" in r.stderr
+
+    doc = _fleet_payload(shed=1.5)
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "shed_rate" in r.stderr
+
+    doc = _fleet_payload(bound=390)  # shipped 399 != bound 390: leak
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "pages_shipped" in r.stderr
+
+    err_doc = {"metric": "serving_fleet_replay_tokens_per_sec_per_chip",
+               "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": None,
+               "extra": {"error": "RuntimeError: backend init UNAVAILABLE"}}
+    errp = tmp_path / "err.json"
+    errp.write_text(json.dumps(err_doc))
+    r = _run([PERF_GATE, "--baseline", str(errp), "--dry-run"])
+    assert r.returncode == 0
+
+
+def test_perf_gate_fleet_rate_multiplier_gate(tmp_path):
+    """rate_multiplier gates like any other serving metric: a drop past
+    --max-rate-multiplier-drop regresses."""
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_fleet_payload()))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(base)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    compared = {v["metric"] for v in json.loads(r.stdout)["verdicts"]}
+    assert "rate_multiplier" in compared
+    # 2.25 -> 1.8 (-20%, threshold 10%)
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_fleet_payload(mult=1.8)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 3, (r.stdout, r.stderr)
+    bad = {v["metric"] for v in json.loads(r.stdout)["verdicts"]
+           if v["regressed"]}
+    assert bad == {"rate_multiplier"}
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand),
+              "--max-rate-multiplier-drop", "0.25"])
+    assert r.returncode == 0
+
+
+def test_perf_gate_fleet_baseline_ratchet(tmp_path):
+    """check_fleet_baseline enforces the acceptance ratchet on the
+    checked-in fleet baseline: multiplier >= 2x, shed rate <= 0.1, at least
+    one handoff, fleet tail TTFT no worse than the saturated single
+    replica."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_pg_fleet", PERF_GATE)
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_fleet_payload()))
+    report, errs = pg.check_fleet_baseline(str(good))
+    assert errs == [] and report["rate_multiplier"] == 2.25
+
+    low = tmp_path / "low.json"
+    low.write_text(json.dumps(_fleet_payload(mult=1.9)))
+    _, errs = pg.check_fleet_baseline(str(low))
+    assert any("rate multiplier" in e for e in errs)
+
+    low.write_text(json.dumps(_fleet_payload(shed=0.2)))
+    _, errs = pg.check_fleet_baseline(str(low))
+    assert any("shed_rate" in e for e in errs)
+
+    low.write_text(json.dumps(_fleet_payload(handoffs=0)))
+    _, errs = pg.check_fleet_baseline(str(low))
+    assert any("handoffs" in e for e in errs)
+
+    # disaggregation that WORSENS tail TTFT vs the saturated single
+    # replica defeats its own purpose
+    low.write_text(json.dumps(_fleet_payload(ttft99=0.95, single99=0.94)))
+    _, errs = pg.check_fleet_baseline(str(low))
+    assert any("TTFT p99" in e for e in errs)
+
+    # no baseline file -> skip, not error (pre-fleet checkouts)
+    report, errs = pg.check_fleet_baseline(str(tmp_path / "absent.json"))
+    assert errs == [] and "skipped" in report
+
+    # the repo's own checked-in baseline passes the ratchet
+    report, errs = pg.check_fleet_baseline()
+    assert errs == [], errs
+    assert report["rate_multiplier"] >= pg.FLEET_MIN_RATE_MULTIPLIER
+    assert report["shed_rate"] <= pg.FLEET_MAX_SHED_RATE
+    assert report["handoffs"] > 0
+
+
+@pytest.mark.slow
+def test_bench_serving_fleet_cpu_acceptance(tmp_path):
+    """The disaggregated fleet replay end to end on CPU: one payload whose
+    two legs and handoff counters are internally consistent, accepted by
+    perf_gate dry-run shape validation. (The >= 2x multiplier itself is
+    pinned by the checked-in serving_fleet_baseline.json ratchet — at the
+    small request count this smoke run uses, saturation is too shallow to
+    assert it.)"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "bench_serving.py"),
+         "--replay", "--fleet", "--requests", "8", "--seed", "7"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payloads = [json.loads(ln) for ln in r.stdout.splitlines()
+                if ln.startswith("{")]
+    assert len(payloads) == 1
+    doc = payloads[0]
+    assert doc["metric"] == "serving_fleet_replay_tokens_per_sec_per_chip"
+    assert doc["value"] > 0
+    ex = doc["extra"]
+    assert 0 < ex["ttft_p50_s"] <= ex["ttft_p99_s"]
+    assert 0 < ex["single_ttft_p50_s"] <= ex["single_ttft_p99_s"]
+    assert ex["rate_multiplier"] > 0
+    assert ex["handoffs"] > 0
+    assert ex["pages_shipped"] == ex["pages_bound"] > 0
+    assert 0 <= ex["shed_rate"] <= 1
+    p = tmp_path / "fleet.json"
+    p.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(p), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
