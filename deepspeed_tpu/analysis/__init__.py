@@ -1,0 +1,10 @@
+"""Static analysis for the TPU stack — graftlint.
+
+Layer A (``astlint``) is stdlib-only and safe to load standalone (the
+``kernel_table``/``perf_gate`` pattern); Layer B (``jaxpr_checks``)
+requires jax and runs in the ``lint`` pytest lane. Import submodules
+directly — this package ``__init__`` must stay import-light so the
+tier-1 CPU lane can reach Layer A without pulling in jax.
+"""
+
+__all__ = ["astlint", "jaxpr_checks"]

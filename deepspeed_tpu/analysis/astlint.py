@@ -1,0 +1,634 @@
+"""graftlint Layer A — AST rule engine for TPU-stack trace hazards.
+
+Every perf win this repo ships (host-sync-free stepping, overlap scheduling,
+fleet handoff) is an *invariant about program structure* — no blocking
+device->host transfer on the hot path, no retrace per step, no wall-clock
+read inside traced code — and runtime guards only cover the handful of paths
+a test happens to drive. This module checks the invariants on the whole tree
+statically, the DeepCompile thesis (PAPERS.md) applied pre-silicon: the
+distributed-training graph's defects are cheapest to catch before a chip
+ever sees the program.
+
+STDLIB-ONLY at module scope (the ``kernel_table``/``overlap`` pattern):
+``scripts/graftlint.py`` and ``scripts/perf_gate.py --dry-run`` load this
+file standalone via importlib so the tier-1 CPU lane lints the tree without
+importing the package or jax. Layer B (jaxpr checks, jax required) lives in
+``analysis/jaxpr_checks.py``.
+
+Rule inventory (docs/ANALYSIS.md has the full table):
+
+======  ========  =====================================================
+id      severity  hazard
+======  ========  =====================================================
+GL000   error     malformed ``# graftlint:`` pragma (unknown rule / no
+                  reason) — a pragma that cannot suppress must not look
+                  like it does
+GL001   error     ``.item()`` — blocking device->host transfer
+GL002   error     ``float()/int()/bool()`` over a jax expression —
+                  implicit blocking transfer (or a tracer error)
+GL003   error     ``jax.device_get`` outside the accounted
+                  ``_host_fetch`` path
+GL004   warn      ``np.asarray(...)`` — host materialization; device
+                  values silently sync, host values are fine but every
+                  new site deserves a look
+GL101   error     ``jax.jit``/``pjit`` called inside a loop body —
+                  fresh callable per iteration, retrace every time
+GL102   warn      step-shaped jit (``*step``/``update``) without
+                  ``donate_argnums`` — params+opt state double-buffer
+                  in HBM
+GL103   error     ``time.time()/perf_counter()`` in a function
+                  reachable from traced code — traces as a constant
+                  (or breaks the trace)
+GL104   warn      ``jax.jit`` on a lambda / locally-defined function —
+                  the jit cache keys on callable identity; a fresh
+                  callable per call recompiles every call (factories
+                  that cache the result pragma this)
+GL105   info      module defines an injectable clock alias
+                  (``_now = time.*``) but still reads ``time.*``
+                  directly elsewhere — pin-ability regression
+GL201   info      write to a ``global`` outside any ``with *lock*:``
+                  block — thread-shared module state raced
+======  ========  =====================================================
+
+Suppression: ``# graftlint: allow[GL003] reason text`` on the finding's
+line, or on the ``def`` line of the enclosing function to allow the whole
+function. The reason is mandatory — a bare allow is itself a GL000 finding
+and suppresses nothing. ``.item()``/``device_get``/``asarray`` inside a
+function named ``_host_fetch``/``host_fetch`` are exempt by construction:
+that IS the accounted path the rules funnel everything toward.
+
+The baseline ratchet (``check_baseline``) freezes today's per-rule,
+per-file counts (``onchip_results/lint_baseline.json``); counts may only
+go down. New findings anywhere — a new ``.item()`` in a guarded path, a
+jit in a loop — fail the gate (exit 3 via the CLI) before any test runs.
+"""
+
+import ast
+import json
+import os
+import re
+
+__all__ = [
+    "RULES", "lint_source", "lint_file", "lint_paths", "summarize",
+    "make_baseline", "load_baseline", "check_baseline", "format_finding",
+]
+
+#: rule id -> (severity, one-line summary). Severity is advisory metadata —
+#: the ratchet treats every rule the same (counts may only go down).
+RULES = {
+    "GL000": ("error", "malformed graftlint pragma"),
+    "GL001": ("error", ".item() blocks on a device->host transfer"),
+    "GL002": ("error", "float/int/bool() over a jax expression syncs (or "
+                       "raises on a tracer)"),
+    "GL003": ("error", "jax.device_get outside the accounted _host_fetch "
+                       "path"),
+    "GL004": ("warn", "np.asarray materializes on host (device values "
+                      "silently sync)"),
+    "GL101": ("error", "jit built inside a loop body retraces every "
+                       "iteration"),
+    "GL102": ("warn", "step-shaped jit without donate_argnums "
+                      "double-buffers params in HBM"),
+    "GL103": ("error", "wall-clock read reachable from traced code traces "
+                       "as a constant"),
+    "GL104": ("warn", "jit on a fresh lambda/local def recompiles per "
+                      "call unless the callee is cached"),
+    "GL105": ("info", "raw time.* call bypasses the module's injectable "
+                      "clock alias"),
+    "GL201": ("info", "global write outside a lock block races "
+                      "thread-shared module state"),
+}
+
+#: canonical callables whose call forces a host sync
+_DEVICE_GET = {"jax.device_get"}
+_ASARRAY = {"numpy.asarray", "numpy.array", "jax.device_get"}
+#: canonical jit entry points (GL101/GL102/GL104)
+_JIT_FNS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+#: callables that trace their function argument (GL103 roots)
+_TRACING_FNS = _JIT_FNS | {
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.checkpoint", "jax.remat", "jax.grad", "jax.value_and_grad",
+    "jax.vmap", "jax.pmap", "jax.lax.scan", "jax.lax.while_loop",
+    "jax.lax.cond", "jax.lax.fori_loop", "jax.make_jaxpr", "jax.eval_shape",
+}
+#: wall-clock reads that become trace-time constants (GL103/GL105)
+_CLOCK_FNS = {"time.time", "time.perf_counter", "time.monotonic",
+              "time.time_ns", "time.perf_counter_ns", "time.monotonic_ns"}
+#: functions whose body IS the accounted host fetch — GL001/GL003/GL004
+#: are definitionally exempt inside them
+_ACCOUNTED_FNS = {"_host_fetch", "host_fetch"}
+#: function-name shapes that hold a full TrainState/params tree — missing
+#: donation doubles the resident bytes (GL102)
+_STEP_NAME = re.compile(r"(^|_)(micro_step|train_step|apply_step|step|"
+                        r"update)(_fn)?$")
+
+_PRAGMA = re.compile(r"#\s*graftlint:\s*allow\[([A-Za-z0-9_,\s]+)\]\s*(.*)$")
+#: a comment that starts like a pragma but fails to parse — the tight
+#: "#<ws>graftlint:<ws>allow" prefix keeps prose/regex mentions out
+_PRAGMA_ATTEMPT = re.compile(r"#\s*graftlint:\s*allow")
+_SKIP_DIRS = {"__pycache__", ".git", "build", "node_modules", ".venv"}
+
+
+def _finding(rule, path, node, message):
+    sev, _ = RULES[rule]
+    return {"rule": rule, "severity": sev, "path": path,
+            "line": getattr(node, "lineno", 0),
+            "col": getattr(node, "col_offset", 0), "message": message}
+
+
+def format_finding(f):
+    return (f"{f['path']}:{f['line']}:{f['col'] + 1}: {f['rule']} "
+            f"[{f['severity']}] {f['message']}")
+
+
+# ---------------------------------------------------------------------------
+# pragma parsing
+# ---------------------------------------------------------------------------
+
+def _parse_pragmas(src, path):
+    """``{lineno: set(rule_ids)}`` for well-formed pragmas, plus GL000
+    findings for malformed ones (unknown rule id or missing reason)."""
+    allows, findings = {}, []
+    for lineno, line in enumerate(src.splitlines(), start=1):
+        m = _PRAGMA.search(line)
+        if m is None:
+            if _PRAGMA_ATTEMPT.search(line):
+                node = ast.Constant(None)
+                node.lineno, node.col_offset = lineno, 0
+                findings.append(_finding(
+                    "GL000", path, node,
+                    "unparseable graftlint pragma (expected "
+                    "'graftlint: allow[RULE] reason' in a comment)"))
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip()
+        node = ast.Constant(None)
+        node.lineno, node.col_offset = lineno, 0
+        bad = sorted(r for r in rules if r not in RULES)
+        if bad:
+            findings.append(_finding(
+                "GL000", path, node,
+                f"pragma names unknown rule(s) {', '.join(bad)}"))
+            rules -= set(bad)
+        if not reason:
+            findings.append(_finding(
+                "GL000", path, node,
+                "pragma has no reason — 'allow[RULE] why it is safe' is "
+                "required; a bare allow suppresses nothing"))
+            continue  # an unjustified pragma must not suppress
+        if rules:
+            allows[lineno] = allows.get(lineno, set()) | rules
+    return allows, findings
+
+
+# ---------------------------------------------------------------------------
+# name resolution (import-alias aware)
+# ---------------------------------------------------------------------------
+
+class _Aliases:
+    """Maps local names to canonical dotted paths through import aliases:
+    ``import numpy as np`` -> np = numpy; ``from jax import device_get`` ->
+    device_get = jax.device_get. ``jax.numpy`` folds onto ``numpy``-style
+    roots only where rules care (asarray)."""
+
+    def __init__(self):
+        self.map = {}
+
+    def add_import(self, node):
+        for a in node.names:
+            local = a.asname or a.name.split(".")[0]
+            self.map[local] = a.name if a.asname else a.name.split(".")[0]
+
+    def add_import_from(self, node):
+        if node.level or not node.module:
+            return  # relative imports never alias jax/numpy/time
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.map[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node):
+        """Canonical dotted name for a Name/Attribute chain, or None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.map.get(node.id, node.id)
+        parts.append(root)
+        name = ".".join(reversed(parts))
+        # fold jax.numpy onto numpy for the asarray-style rules
+        if name.startswith("jax.numpy."):
+            name = "jnp." + name[len("jax.numpy."):]
+        return name
+
+
+def _contains_jax_expr(node, aliases):
+    """True when the expression subtree references jnp./jax. values — the
+    float()/int()/bool() wrapper then forces a transfer (GL002)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Attribute, ast.Name)):
+            name = aliases.resolve(sub)
+            if name and (name.startswith("jnp.") or name.startswith("jax.")
+                         or name == "jnp" or name == "jax"):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class _FunctionInfo:
+    """Per-function facts for the module-local reachability pass (GL103)."""
+
+    __slots__ = ("node", "name", "traced_root", "calls", "clock_calls")
+
+    def __init__(self, node):
+        self.node = node
+        self.name = node.name
+        self.traced_root = False   # jit-decorated / passed to a tracer
+        self.calls = set()         # simple callee names within the module
+        self.clock_calls = []      # (node, canonical clock name)
+
+
+class _Linter(ast.NodeVisitor):
+
+    def __init__(self, path, src, select=None):
+        self.path = path
+        self.select = select
+        self.aliases = _Aliases()
+        self.findings = []
+        self.allow_lines, pragma_findings = _parse_pragmas(src, path)
+        self._pragma_findings = pragma_findings
+        self.func_stack = []       # enclosing FunctionDef nodes
+        self.loop_depth = 0        # For/While nesting inside current func
+        self.lock_depth = 0        # with-<lock>: nesting
+        self.global_names = set()  # names declared global in current func
+        self.functions = {}        # name -> _FunctionInfo (last def wins)
+        self._fn_info = []         # stack parallel to func_stack
+        self.clock_aliases = []    # (alias_name, assign_node) at module scope
+
+    # -- emission -----------------------------------------------------------
+    def emit(self, rule, node, message):
+        if self.select is not None and rule not in self.select:
+            return
+        lines = {getattr(node, "lineno", 0)}
+        for fn in self.func_stack:  # def-line pragma covers the function
+            lines.add(fn.lineno)
+        for ln in lines:
+            if rule in self.allow_lines.get(ln, ()):
+                return
+        self.findings.append(_finding(rule, self.path, node, message))
+
+    def _in_accounted_fn(self):
+        return any(fn.name in _ACCOUNTED_FNS for fn in self.func_stack)
+
+    # -- imports ------------------------------------------------------------
+    def visit_Import(self, node):
+        self.aliases.add_import(node)
+
+    def visit_ImportFrom(self, node):
+        self.aliases.add_import_from(node)
+
+    # -- module-scope clock aliases (GL105) ---------------------------------
+    def visit_Assign(self, node):
+        if not self.func_stack:
+            val = self.aliases.resolve(node.value) \
+                if isinstance(node.value, (ast.Attribute, ast.Name)) else None
+            if val in _CLOCK_FNS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.clock_aliases.append((tgt.id, node))
+        self._check_global_write(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_global_write(node, [node.target])
+        self.generic_visit(node)
+
+    def _check_global_write(self, node, targets):
+        if not self.func_stack or self.lock_depth > 0:
+            return
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id in self.global_names:
+                self.emit("GL201", node,
+                          f"write to module global '{tgt.id}' outside a "
+                          f"lock block — concurrent steppers race it")
+
+    def visit_Global(self, node):
+        self.global_names.update(node.names)
+
+    # -- scopes -------------------------------------------------------------
+    def visit_With(self, node):
+        lockish = any(
+            "lock" in (self.aliases.resolve(item.context_expr.func
+                       if isinstance(item.context_expr, ast.Call)
+                       else item.context_expr) or
+                       ast.dump(item.context_expr)).lower()
+            for item in node.items)
+        self.lock_depth += 1 if lockish else 0
+        self.generic_visit(node)
+        self.lock_depth -= 1 if lockish else 0
+
+    def _visit_function(self, node):
+        info = _FunctionInfo(node)
+        self.functions[node.name] = info
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = self.aliases.resolve(target)
+            if name in _TRACING_FNS:
+                info.traced_root = True
+            if isinstance(dec, ast.Call):
+                # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+                if name and name.rsplit(".", 1)[-1] == "partial" and \
+                        dec.args and \
+                        self.aliases.resolve(dec.args[0]) in _TRACING_FNS:
+                    info.traced_root = True
+                    self._check_donate(dec, node.name, node)
+            if name in _JIT_FNS:
+                self._check_donate(dec if isinstance(dec, ast.Call) else None,
+                                   node.name, node)
+        saved_globals = self.global_names
+        saved_loops = self.loop_depth
+        self.global_names = set(saved_globals)
+        self.loop_depth = 0
+        self.func_stack.append(node)
+        self._fn_info.append(info)
+        self.generic_visit(node)
+        self._fn_info.pop()
+        self.func_stack.pop()
+        self.loop_depth = saved_loops
+        self.global_names = saved_globals
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = visit_For
+
+    def _check_donate(self, call, fn_name, report_node):
+        """GL102: a step-shaped jit target must donate its state arg."""
+        if not _STEP_NAME.search(fn_name or ""):
+            return
+        if (fn_name or "").startswith("eval"):
+            return  # eval steps read state; donating it would be the bug
+        kws = {k.arg for k in call.keywords} if call is not None else set()
+        if not kws & {"donate_argnums", "donate_argnames"}:
+            self.emit("GL102", report_node,
+                      f"jit of step-shaped '{fn_name}' without "
+                      f"donate_argnums — the old state stays resident and "
+                      f"params double-buffer in HBM")
+
+    # -- calls: the bulk of the rules ---------------------------------------
+    def visit_Call(self, node):
+        name = self.aliases.resolve(node.func)
+
+        # GL001 — .item()
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and not node.args and not node.keywords \
+                and not self._in_accounted_fn():
+            self.emit("GL001", node,
+                      ".item() blocks the host on a device->host transfer; "
+                      "keep the value device-resident or route it through "
+                      "the engine's accounted _host_fetch")
+
+        if name is not None:
+            # GL003 — device_get outside the accounted path
+            if name in _DEVICE_GET and not self._in_accounted_fn():
+                self.emit("GL003", node,
+                          "jax.device_get outside _host_fetch — the fetch "
+                          "is unaccounted, host_sync_count cannot audit it")
+            # GL004 — np.asarray host materialization
+            elif name in _ASARRAY and not self._in_accounted_fn():
+                self.emit("GL004", node,
+                          f"{name}() materializes on host; a device-array "
+                          f"argument silently syncs the dispatch queue")
+            # GL002 — float/int/bool over a jax expression
+            elif name in ("float", "int", "bool") and len(node.args) == 1 \
+                    and not self._in_accounted_fn() \
+                    and _contains_jax_expr(node.args[0], self.aliases):
+                self.emit("GL002", node,
+                          f"{name}() over a jax expression forces a "
+                          f"blocking transfer (and raises under trace)")
+            # clock reads: record for the GL103 reachability pass; GL105
+            # fires immediately when the module has an injectable alias
+            elif name in _CLOCK_FNS:
+                if self._fn_info:
+                    self._fn_info[-1].clock_calls.append((node, name))
+                if self.clock_aliases:
+                    alias = self.clock_aliases[0][0]
+                    self.emit("GL105", node,
+                              f"raw {name}() bypasses this module's "
+                              f"injectable clock alias '{alias}' — tests "
+                              f"can no longer pin time")
+            # GL101 / GL104 / GL102 — jit call forms
+            elif name in _JIT_FNS:
+                if self.loop_depth > 0:
+                    self.emit("GL101", node,
+                              "jit called inside a loop body builds a "
+                              "fresh callable every iteration — the "
+                              "compile cache never hits; hoist it out")
+                if node.args:
+                    target = node.args[0]
+                    tname = target.id if isinstance(target, ast.Name) else None
+                    if isinstance(target, ast.Lambda) or (
+                            self.func_stack and tname in self.functions and
+                            self._is_local_def(tname)):
+                        self.emit("GL104", node,
+                                  "jit over a fresh lambda/local def keys "
+                                  "the compile cache on a new callable "
+                                  "identity — cache the jitted result or "
+                                  "hoist the callee to module scope")
+                    if tname is not None:
+                        self._check_donate(node, tname, node)
+                    info = self.functions.get(tname)
+                    if info is not None:
+                        info.traced_root = True
+            # any tracer taking a function argument marks GL103 roots
+            elif name in _TRACING_FNS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in self.functions:
+                        self.functions[arg.id].traced_root = True
+
+        # record intra-module simple-name calls for the reachability pass
+        if self._fn_info and isinstance(node.func, ast.Name):
+            self._fn_info[-1].calls.add(node.func.id)
+
+        self.generic_visit(node)
+
+    def _is_local_def(self, name):
+        """Is ``name`` a function defined inside the CURRENT function body
+        (as opposed to module scope)? Local defs are fresh objects per call
+        of the enclosing function."""
+        info = self.functions.get(name)
+        if info is None:
+            return False
+        encl = self.func_stack[-1]
+        return any(child is info.node for child in ast.walk(encl)) and \
+            info.node is not encl
+
+    # -- finale -------------------------------------------------------------
+    def finish(self):
+        # GL103: propagate traced-root reachability over the module-local
+        # simple-name call graph, then flag clock reads inside the closure
+        reachable = {n for n, i in self.functions.items() if i.traced_root}
+        changed = True
+        while changed:
+            changed = False
+            for n, info in self.functions.items():
+                if n in reachable:
+                    for callee in info.calls:
+                        if callee in self.functions and callee not in reachable:
+                            reachable.add(callee)
+                            changed = True
+        for n in reachable:
+            for node, cname in self.functions[n].clock_calls:
+                self.emit("GL103", node,
+                          f"{cname}() inside '{n}', which is reachable "
+                          f"from traced code — under jit it traces as a "
+                          f"compile-time constant; time outside the trace "
+                          f"or use io_callback")
+        # pragma findings honor line-level GL000 suppression of themselves
+        for f in self._pragma_findings:
+            if "GL000" not in self.allow_lines.get(f["line"], ()):
+                if self.select is None or "GL000" in self.select:
+                    self.findings.append(f)
+        self.findings.sort(key=lambda f: (f["line"], f["col"], f["rule"]))
+        return self.findings
+
+
+def lint_source(src, path="<string>", select=None):
+    """Lint one source string. Returns a list of finding dicts. A syntax
+    error is reported as a GL000-style error finding rather than raised —
+    the tree gate must not crash on one bad file."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        node = ast.Constant(None)
+        node.lineno, node.col_offset = e.lineno or 0, (e.offset or 1) - 1
+        f = _finding("GL000", path, node, f"unparseable source: {e.msg}")
+        return [f]
+    linter = _Linter(path, src, select=set(select) if select else None)
+    linter.visit(tree)
+    return linter.finish()
+
+
+def lint_file(path, select=None, relative_to=None):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = os.path.relpath(path, relative_to).replace(os.sep, "/") \
+        if relative_to else path
+    return lint_source(src, path=rel, select=select)
+
+
+def lint_paths(paths, select=None, relative_to=None):
+    """Lint files and directory trees (``*.py``, skipping ``__pycache__``
+    and friends). Findings carry ``relative_to``-relative paths so the
+    baseline is stable across checkouts."""
+    findings = []
+    for p in paths:
+        if os.path.isfile(p):
+            findings.extend(lint_file(p, select=select,
+                                      relative_to=relative_to))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    findings.extend(lint_file(
+                        os.path.join(dirpath, fn), select=select,
+                        relative_to=relative_to))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------------
+
+def summarize(findings):
+    """Per-rule totals and per-rule-per-file counts — the ratchet unit."""
+    rules = {}
+    for f in findings:
+        r = rules.setdefault(f["rule"], {"count": 0, "files": {}})
+        r["count"] += 1
+        r["files"][f["path"]] = r["files"].get(f["path"], 0) + 1
+    for r in rules.values():
+        r["files"] = dict(sorted(r["files"].items()))
+    return {"total": len(findings), "rules": dict(sorted(rules.items()))}
+
+
+def make_baseline(findings, root="deepspeed_tpu"):
+    return {"version": 1, "tool": "graftlint", "root": root,
+            "regenerate": "python scripts/graftlint.py --write-baseline",
+            **summarize(findings)}
+
+
+def load_baseline(path):
+    """Returns (baseline_dict, error_string). A missing/unreadable file or
+    a wrong-shape doc is a hard error (exit 2): the gate must never pass
+    because its own baseline rotted."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"cannot read lint baseline {path}: {e}"
+    if not isinstance(doc, dict) or doc.get("tool") != "graftlint" \
+            or not isinstance(doc.get("rules"), dict):
+        return None, (f"malformed lint baseline {path}: expected a "
+                      f"graftlint doc with a 'rules' map")
+    for rid, entry in doc["rules"].items():
+        if rid not in RULES:
+            return None, f"baseline names unknown rule {rid}"
+        if not isinstance(entry, dict) \
+                or not isinstance(entry.get("count"), int) \
+                or not isinstance(entry.get("files"), dict):
+            return None, f"baseline rule {rid} entry malformed: {entry!r}"
+    return doc, None
+
+
+def check_baseline(findings, baseline):
+    """The ratchet: per-rule totals AND per-rule-per-file counts may only
+    go down. Returns a report dict::
+
+        {"ok": bool,
+         "regressions": ["GL001: deepspeed_tpu/x.py has 2 findings, "
+                         "baseline allows 1", ...],
+         "improvements": ["GL004: 120 -> 118 (baseline can tighten)", ...],
+         "counts": {rule: current_count}}
+
+    A finding in a file the baseline has never seen is a regression; a
+    count below baseline is reported so the baseline can be regenerated
+    tighter (it never auto-tightens — that would hide a flapping rule).
+    """
+    current = summarize(findings)
+    base_rules = baseline.get("rules", {})
+    regressions, improvements = [], []
+    for rid in sorted(set(current["rules"]) | set(base_rules)):
+        entry = current["rules"].get(rid, {"count": 0, "files": {}})
+        base = base_rules.get(rid, {"count": 0, "files": {}})
+        if entry["count"] > base["count"]:
+            regressions.append(
+                f"{rid}: {entry['count']} findings, baseline allows "
+                f"{base['count']} ({RULES[rid][1]})")
+        elif entry["count"] < base["count"]:
+            improvements.append(
+                f"{rid}: {base['count']} -> {entry['count']} (baseline can "
+                f"tighten)")
+        base_files = base.get("files", {})
+        for path, n in sorted(entry["files"].items()):
+            allowed = base_files.get(path, 0)
+            if n > allowed:
+                regressions.append(
+                    f"{rid}: {path} has {n} finding(s), baseline allows "
+                    f"{allowed}")
+    return {"ok": not regressions, "regressions": regressions,
+            "improvements": improvements,
+            "counts": {rid: e["count"]
+                       for rid, e in sorted(current["rules"].items())}}

@@ -443,7 +443,7 @@ def onchip_rank(kernel, dims, dtype, candidates=None, iters=10, warmup=2):
                 return jax.numpy.asarray(
                     rng.standard_normal(a.shape).astype("float32"), a.dtype)
             args = jax.tree.map(concrete, abstract)
-            jitted = jax.jit(fn)
+            jitted = jax.jit(fn)  # graftlint: allow[GL101] the tuner compiles each candidate config on purpose — compile_s is part of the score
             t0 = time.perf_counter()
             jax.block_until_ready(jitted(*args))
             rec["compile_s"] = round(time.perf_counter() - t0, 2)

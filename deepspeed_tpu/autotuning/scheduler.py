@@ -21,6 +21,10 @@ from typing import Callable, Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import logger
 
+# injectable clock (the PR-2 pattern): tests pin tuning-budget/timeout
+# behavior by monkeypatching this module alias, never time.* globally
+_now = time.time
+
 
 class Node:
     """A host with ``max_slots`` schedulable device slots (reference :260)."""
@@ -141,7 +145,7 @@ class ResourceManager:
         contain the metric the caller will rank by). Returns
         ``finished_experiments`` {name: exp} where exp['result'] holds the
         outcome or exp['error'] the failure."""
-        start = time.time()
+        start = _now()
         running: List[dict] = []
         lock = threading.Lock()
 
@@ -178,10 +182,10 @@ class ResourceManager:
                     done_once.set()
 
             def work():
-                t0 = time.time()
+                t0 = _now()
                 try:
                     out = run_fn(exp, res)
-                    finish(result=out, elapsed=time.time() - t0)
+                    finish(result=out, elapsed=_now() - t0)
                 except Exception as e:  # experiment failure, not scheduler
                     finish(error=f"{type(e).__name__}: {e}"[:300])
 
@@ -190,7 +194,7 @@ class ResourceManager:
             rec = {"exp": exp, "thread": t, "finish": finish,
                    "done_evt": done_once,
                    "deadline": None if self.exp_timeout_s is None
-                   else time.time() + self.exp_timeout_s}
+                   else _now() + self.exp_timeout_s}
             t.start()
             running.append(rec)
 
@@ -203,7 +207,7 @@ class ResourceManager:
         while self.experiment_queue or alive():
             if self.experiment_queue:
                 if (self.tuning_budget_s is not None
-                        and time.time() - start > self.tuning_budget_s):
+                        and _now() - start > self.tuning_budget_s):
                     for exp in self.experiment_queue:
                         exp["error"] = ("skipped: tuning wall-clock budget "
                                         "exhausted")
@@ -223,7 +227,7 @@ class ResourceManager:
             # per-experiment cap: mark + release slots; the runner thread is
             # abandoned (daemon) and its late outcome discarded — the
             # reference kills the remote job over ssh instead (:402 clean_up)
-            now = time.time()
+            now = _now()
             for r in alive():
                 if r["deadline"] is not None and now > r["deadline"]:
                     r["finish"](error=f"timeout after {self.exp_timeout_s}s")

@@ -95,8 +95,30 @@ class InferenceEngineV2:
         sm = config.state_manager
         bs = self._state.kv_block_size
         self._max_blocks_per_seq = -(-sm.max_context // bs)
+        self._host_sync_count = 0
         logger.info(f"InferenceEngineV2: S<={sm.max_ragged_sequence_count} "
                     f"tokens<={sm.max_ragged_batch_size} context<={sm.max_context}")
+
+    # -- accounted host fetch (mirrors DeepSpeedEngine._host_fetch) --------
+    @property
+    def host_sync_count(self) -> int:
+        """Device->host syncs this engine has performed. One decode round
+        through the scheduler costs exactly one (the sampled-ids fetch);
+        anything faster-growing is a stray sync on the hot path."""
+        return self._host_sync_count
+
+    def host_fetch(self, value, what: str):
+        """THE accounted device->host boundary for serving, counted and
+        attributed exactly like the training engine's ``_host_fetch``
+        (``runtime/engine.py``). Every hot-path transfer funnels through
+        here so ``host_sync_count`` + the ``host_sync`` telemetry counter
+        audit the per-round sync budget; graftlint (GL003/GL004) flags any
+        fetch that bypasses it."""
+        self._host_sync_count += 1
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.count("host_sync", what=what)
+        return np.asarray(value)  # graftlint: allow[GL004] this IS the accounted fetch
 
     # -- admission control (reference engine_v2.py:158-241) ----------------
     @property
@@ -227,7 +249,7 @@ class InferenceEngineV2:
             batch_tokens: List[np.ndarray]) -> np.ndarray:
         """Run one ragged forward; returns [len(uids), vocab] next-token logits."""
         logits = self._forward_device(batch_uids, batch_tokens)
-        return np.asarray(logits[:len(batch_uids)])
+        return self.host_fetch(logits[:len(batch_uids)], "serving/logits")
 
     def put_sampled_device(self, batch_uids: List[int],
                            batch_tokens: List[np.ndarray],
@@ -278,9 +300,9 @@ class InferenceEngineV2:
         the logits before. Per-row sampling params are traced, so one
         compiled program covers any greedy/sampled mix.
         """
-        return np.asarray(self.put_sampled_device(
+        return self.host_fetch(self.put_sampled_device(
             batch_uids, batch_tokens, temperatures, top_ks, top_ps, seeds,
-            positions))[:len(batch_uids)]
+            positions), "serving/sampled_ids")[:len(batch_uids)]
 
     def flush(self, uid: int) -> None:
         """Retire a sequence, freeing its KV blocks (reference :242)."""

@@ -446,7 +446,9 @@ class SplitFuseScheduler:
         # against a zero anchor would be garbage, so the round stays dark
         enabled = tm.enabled and t_fwd > 0.0
         if ids is not None:
-            ids = np.asarray(ids)  # the only device sync of the round
+            # the only device sync of the round, accounted so
+            # engine.host_sync_count audits the one-fetch-per-round budget
+            ids = self._engine.host_fetch(ids, "scheduler/sampled_ids")
         if enabled:
             t_done = _now()
             fwd_dur = t_done - t_fwd

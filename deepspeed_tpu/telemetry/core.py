@@ -59,6 +59,13 @@ import socket
 import threading
 import time
 
+# injectable clocks (the PR-2 pattern, see docs/OBSERVABILITY.md): tests pin
+# time by monkeypatching THESE module aliases, never time.* globally (which
+# would break jax internals). All span/ledger timing reads _now; _now_wall
+# is only for human-facing stamps (run ids).
+_now = time.perf_counter
+_now_wall = time.time
+
 #: goodput-ledger taxonomy (docs/OBSERVABILITY.md). Every wall-second of an
 #: enabled run lands in exactly one bucket; ``idle`` is the unattributed
 #: remainder (wall − sum of the others, floored at 0).
@@ -210,7 +217,7 @@ class _Span:
                 self._annotation.__enter__()
             except Exception:
                 self._annotation = None
-        self._t0 = time.perf_counter()
+        self._t0 = _now()
 
     def __enter__(self):
         return self
@@ -232,7 +239,7 @@ class _Span:
                 jax.block_until_ready(token)
             except Exception:
                 pass
-        dt = time.perf_counter() - self._t0
+        dt = _now() - self._t0
         if self._annotation is not None:
             try:
                 self._annotation.__exit__(None, None, None)
@@ -264,14 +271,14 @@ class Telemetry:
         except Exception:
             self.host = "localhost"
         self.run_id = os.environ.get("DS_TPU_HARNESS_RUN_ID") or \
-            f"{os.getpid()}-{int(time.time())}"
+            f"{os.getpid()}-{int(_now_wall())}"
         # goodput-ledger model parameters (survive reset, like sinks)
         self.memory_enabled = True
         self._flops_per_step = 0.0
         self._peak_flops = 0.0
 
     def _reset_state(self):
-        self._epoch = time.perf_counter()
+        self._epoch = _now()
         self.trace_events = []    # chrome-trace event dicts
         self.metrics = []         # every record() sample, in order
         self.counters = {}        # name -> {tag_key: int}
@@ -364,7 +371,7 @@ class Telemetry:
                 if self.enabled and not was:
                     # ledger wall time starts when measurement starts, not
                     # at the (possibly much earlier) import of this module
-                    self._ledger_epoch = time.perf_counter()
+                    self._ledger_epoch = _now()
                     self._ledger_last_step_ts = None
 
     def _atexit_export(self):
@@ -481,7 +488,7 @@ class Telemetry:
                 # INSIDE a compute span — charging them would double-count
                 self.ledger_secs["comm"] += seconds
             ev = {"name": f"comm:{op}", "ph": "X", "cat": "comm",
-                  "ts": round((time.perf_counter() - seconds - self._epoch)
+                  "ts": round((_now() - seconds - self._epoch)
                               * 1e6, 3),
                   "dur": round(seconds * 1e6, 3),
                   "pid": os.getpid(), "tid": threading.get_ident() & 0xffff,
@@ -601,7 +608,7 @@ class Telemetry:
                     g[1] = v
             self.trace_events.append(
                 {"name": name, "ph": "C", "cat": "serving",
-                 "ts": round((time.perf_counter() - self._epoch) * 1e6, 3),
+                 "ts": round((_now() - self._epoch) * 1e6, 3),
                  "pid": os.getpid(), "args": {"value": v}})
             self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
                               "tags": tags or {}})
@@ -695,7 +702,7 @@ class Telemetry:
                     g[1] = v
             self.trace_events.append(
                 {"name": name, "ph": "C", "cat": "fleet",
-                 "ts": round((time.perf_counter() - self._epoch) * 1e6, 3),
+                 "ts": round((_now() - self._epoch) * 1e6, 3),
                  "pid": os.getpid(), "args": {"value": v}})
             self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
                               "tags": tags or {}})
@@ -711,7 +718,7 @@ class Telemetry:
         if not self.enabled:
             return
         seconds = float(seconds)
-        t_end = time.perf_counter()
+        t_end = _now()
         with self._lock:
             h = self.fleet_handoff
             h["count"] += 1
@@ -772,7 +779,7 @@ class Telemetry:
             # Chrome counter track: one "C" event per sample
             self.trace_events.append(
                 {"name": "hbm_bytes_in_use", "ph": "C", "cat": "memory",
-                 "ts": round((time.perf_counter() - self._epoch) * 1e6, 3),
+                 "ts": round((_now() - self._epoch) * 1e6, 3),
                  "pid": os.getpid(),
                  "args": {"bytes_in_use": in_use}})
             self._emit_jsonl({"name": f"memory/{point}", "kind": "bytes",
@@ -880,7 +887,7 @@ class Telemetry:
         records them. Returns (mfu, goodput) or None when disabled."""
         if not self.enabled:
             return None
-        now = time.perf_counter()
+        now = _now()
         if flops is None:
             flops = self._flops_per_step
         peak = self._peak_flops or _default_peak_flops()
@@ -904,7 +911,7 @@ class Telemetry:
 
     def _ledger_summary(self):
         # caller holds self._lock
-        wall = max(time.perf_counter() - self._ledger_epoch, 0.0)
+        wall = max(_now() - self._ledger_epoch, 0.0)
         secs = {k: round(v, 6) for k, v in self.ledger_secs.items()}
         accounted = sum(secs.values())
         secs["idle"] = round(max(wall - accounted, 0.0), 6)
@@ -960,7 +967,7 @@ class Telemetry:
             if d:
                 os.makedirs(d, exist_ok=True)
             self._jsonl_fh = open(self.jsonl_path, "a")
-        obj["ts"] = round(time.perf_counter() - self._epoch, 6)
+        obj["ts"] = round(_now() - self._epoch, 6)
         # multi-host identity for scripts/trace_merge.py
         obj["host"] = self.host
         obj["pid"] = os.getpid()

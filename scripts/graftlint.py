@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""graftlint — static trace-hazard linting for the TPU stack.
+
+Runs the Layer A AST rules (``deepspeed_tpu/analysis/astlint.py``) over the
+tree and ratchets the finding counts against the checked-in baseline: per
+(rule, file) counts may only go DOWN. A new ``.item()``, an unaccounted
+``device_get``, a jit inside a loop — anywhere in the package — fails the
+gate before a single test runs. stdlib-only: no jax, no package import
+(the module is exec'd standalone, the ``perf_gate`` idiom), so this runs
+in the tier-1 CPU lane and on machines with nothing installed.
+
+Usage:
+    python scripts/graftlint.py                      # lint vs baseline
+    python scripts/graftlint.py --json               # machine-readable
+    python scripts/graftlint.py --no-baseline        # print ALL findings
+    python scripts/graftlint.py --write-baseline     # freeze current counts
+    python scripts/graftlint.py path/to/file.py ...  # lint specific paths
+                                                     # (no ratchet)
+
+Exit codes (perf_gate conventions):
+    0  clean — no findings beyond the baseline
+    2  malformed input (unreadable/invalid baseline, bad arguments)
+    3  regression — findings the baseline does not allow
+
+The jaxpr lane (Layer B) is separate: ``pytest -m lint`` traces the real
+engine/serving/scheduled programs and needs jax. See docs/ANALYSIS.md.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASTLINT_PATH = os.path.join(REPO_ROOT, "deepspeed_tpu", "analysis",
+                            "astlint.py")
+BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                             "lint_baseline.json")
+DEFAULT_SCAN = os.path.join(REPO_ROOT, "deepspeed_tpu")
+
+
+def _load_astlint():
+    spec = importlib.util.spec_from_file_location("_astlint", ASTLINT_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: deepspeed_tpu/ with "
+                         "the baseline ratchet; explicit paths skip the "
+                         "ratchet and report every finding)")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="lint baseline to ratchet against")
+    ap.add_argument("--scan-root", default="",
+                    help="directory to scan WITH the ratchet (default: the "
+                         "repo's deepspeed_tpu/); paths inside it are "
+                         "recorded relative to its parent")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; print and count ALL findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current finding counts into --baseline")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document instead of human lines")
+    args = ap.parse_args(argv)
+
+    try:
+        lint = _load_astlint()
+    except (OSError, SyntaxError) as e:
+        print(f"graftlint: cannot load {ASTLINT_PATH}: {e}", file=sys.stderr)
+        return 2
+
+    select = [r.strip() for r in args.select.split(",") if r.strip()] or None
+    if select:
+        unknown = [r for r in select if r not in lint.RULES]
+        if unknown:
+            print(f"graftlint: unknown rule id(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(lint.RULES))})",
+                  file=sys.stderr)
+            return 2
+
+    if args.paths and args.scan_root:
+        print("graftlint: explicit paths and --scan-root are exclusive",
+              file=sys.stderr)
+        return 2
+    explicit = bool(args.paths)
+    scan_root = os.path.abspath(args.scan_root) if args.scan_root else ""
+    paths = args.paths or [scan_root or DEFAULT_SCAN]
+    rel_root = os.path.dirname(scan_root) if scan_root else REPO_ROOT
+    findings = lint.lint_paths(paths, select=select, relative_to=rel_root)
+    summary = lint.summarize(findings)
+
+    if args.write_baseline:
+        doc = lint.make_baseline(findings)
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"graftlint: wrote baseline ({summary['total']} findings, "
+              f"{len(summary['rules'])} rules) to {args.baseline}")
+        return 0
+
+    if explicit or args.no_baseline:
+        # no ratchet: every finding is surfaced, exit 3 if any
+        if args.json:
+            print(json.dumps({"tool": "graftlint", "baseline": None,
+                              "findings": findings, **summary}, indent=1))
+        else:
+            for f in findings:
+                print(lint.format_finding(f))
+            print(f"graftlint: {summary['total']} finding(s)")
+        return 3 if findings else 0
+
+    baseline, err = lint.load_baseline(args.baseline)
+    if err:
+        print(f"graftlint: {err}", file=sys.stderr)
+        return 2
+    verdict = lint.check_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({"tool": "graftlint", "baseline": args.baseline,
+                          "ok": verdict["ok"],
+                          "regressions": verdict["regressions"],
+                          "improvements": verdict["improvements"],
+                          "counts": verdict["counts"],
+                          "total": summary["total"]}, indent=1))
+    else:
+        for line in verdict["regressions"]:
+            print(f"graftlint: REGRESSION {line}")
+        for line in verdict["improvements"]:
+            print(f"graftlint: note: {line}")
+        state = "clean" if verdict["ok"] else \
+            f"{len(verdict['regressions'])} regression(s)"
+        print(f"graftlint: {summary['total']} finding(s) vs baseline — "
+              f"{state}")
+    return 3 if not verdict["ok"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

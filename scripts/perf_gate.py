@@ -737,6 +737,49 @@ def check_overlap_analytic():
                 report.get("critical_path", {}).get("ops", []))}, errors
 
 
+#: graftlint ratchet: per-rule/per-file finding counts frozen by this doc
+#: may only go down (see docs/ANALYSIS.md; regenerate with
+#: scripts/graftlint.py --write-baseline)
+LINT_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                  "lint_baseline.json")
+
+
+def _load_astlint_module():
+    """Load analysis/astlint.py standalone (stdlib-only at module scope, the
+    same idiom as ``_load_overlap_module``) so the tier-1 dry-run lane lints
+    the tree without importing the package or jax."""
+    import importlib.util
+    mod_path = os.path.join(REPO_ROOT, "deepspeed_tpu", "analysis",
+                            "astlint.py")
+    spec = importlib.util.spec_from_file_location("_astlint", mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def check_lint_baseline(baseline_path=None, scan_root=None):
+    """Run graftlint Layer A over the package and ratchet against the
+    checked-in lint baseline. Returns (report, errors) for the dry-run
+    lane — a new finding in any guarded (rule, file) is an error, exactly
+    the exit-3 condition ``scripts/graftlint.py`` enforces standalone."""
+    path = baseline_path or LINT_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no lint baseline at {path}"}, []
+    try:
+        lint = _load_astlint_module()
+    except Exception as e:
+        return {}, [f"cannot load astlint module: {e}"]
+    baseline, err = lint.load_baseline(path)
+    if err:
+        return {}, [err]
+    root = scan_root or os.path.join(REPO_ROOT, "deepspeed_tpu")
+    findings = lint.lint_paths([root], relative_to=REPO_ROOT)
+    verdict = lint.check_baseline(findings, baseline)
+    return {"findings": len(findings), "counts": verdict["counts"],
+            "improvements": verdict["improvements"]}, \
+        verdict["regressions"]
+
+
 def compare(baseline, candidate, thresholds):
     """-> (verdicts, regressed). Only metrics on both sides are gated."""
     verdicts = []
@@ -823,8 +866,11 @@ def main(argv=None):
         fleet_report, fleet_errors = check_fleet_baseline()
         for err in fleet_errors:
             print(f"perf_gate: fleet: {err}", file=sys.stderr)
+        lint_report, lint_errors = check_lint_baseline()
+        for err in lint_errors:
+            print(f"perf_gate: lint: {err}", file=sys.stderr)
         errors = table_errors + qgz_errors + overlap_errors + sched_errors \
-            + prefix_errors + fleet_errors
+            + prefix_errors + fleet_errors + lint_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
@@ -833,6 +879,7 @@ def main(argv=None):
                           "overlap_schedule": sched_report,
                           "prefix_cache": prefix_report,
                           "fleet": fleet_report,
+                          "lint": lint_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
         return 2 if errors else 0
