@@ -12,6 +12,16 @@ class DSStateManagerConfig(DeepSpeedConfigModel):
     max_context = 8192                   # max tokens a single sequence may hold
     memory_config = "reserve"            # accepted for parity
     num_kv_blocks = None                 # explicit block count; None = derive
+    # KV storage dtype: "fp" keeps pages in kv_cache.cache_dtype; "int8"
+    # stores pages int8 with per-token fp32 scales (quantize-on-write in the
+    # forward, fused dequant-on-read in the paged kernel) — ~4x page capacity
+    # vs fp32 at generation-parity quality (test-pinned).
+    kv_dtype = "fp"
+    # host-DRAM KV spill tier capacity, in blocks. 0 disables the tier.
+    # When > 0, parked prefix-cache blocks under pool pressure SPILL to host
+    # (contents preserved, device id freed) instead of being evicted; the
+    # pressure order becomes spill-to-host -> evict-to-free -> preempt-live.
+    host_kv_blocks = 0
 
 
 class KVCacheConfig(DeepSpeedConfigModel):

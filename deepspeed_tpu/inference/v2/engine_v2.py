@@ -92,6 +92,10 @@ class InferenceEngineV2:
                            cfg.num_attention_heads)  # OPT has no GQA field
         self._state = DSStateManager(config, cfg.num_hidden_layers,
                                      kv_heads, head_dim)
+        # KV host-spill transfers (prefix blocks demoted to the DRAM tier)
+        # land through the SAME accounted fetch as logits/sampled ids, so
+        # host_sync_count + graftlint audit them like every other boundary
+        self._state.kv_cache.set_host_fetch(self.host_fetch)
         sm = config.state_manager
         bs = self._state.kv_block_size
         self._max_blocks_per_seq = -(-sm.max_context // bs)
@@ -228,8 +232,10 @@ class InferenceEngineV2:
         arrays = wrapper.build()
 
         kv = self._state.kv_cache
+        # fwd_k/fwd_v are (int8, scale) pairs when kv_dtype="int8" — they
+        # flow through the jitted forwards as pytree leaves
         logits, k_pool, v_pool = self._ragged_forward(
-            self._model_config, self._params, kv.k_pool, kv.v_pool,
+            self._model_config, self._params, kv.fwd_k, kv.fwd_v,
             jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["q_len"]),
             jnp.asarray(arrays["seen"]), jnp.asarray(arrays["block_tables"]))
         kv.update(k_pool, v_pool)

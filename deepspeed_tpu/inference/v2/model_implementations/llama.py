@@ -28,13 +28,55 @@ def _rmsnorm(x, scale, eps):
     return (norm * scale).astype(x.dtype)
 
 
+def _pool_parts(pool):
+    """A per-layer KV pool is either an array (fp) or an ``(int8, scale)``
+    pair (``state_manager.kv_dtype="int8"``) — split without probing."""
+    return pool if isinstance(pool, tuple) else (pool, None)
+
+
+def _pool_block_size(pool):
+    """Block size from a possibly-quantized STACKED pool [L, NB, KV, bs, Dh]."""
+    return _pool_parts(pool)[0].shape[3]
+
+
+def _pool_layer(pool, i):
+    """Index layer ``i`` out of a stacked pool (pairs index leaf-wise)."""
+    d, s = _pool_parts(pool)
+    return d[i] if s is None else (d[i], s[i])
+
+
+def _pool_set_layer(pool, i, new):
+    """Write layer ``i`` back into a stacked pool (pairs update leaf-wise)."""
+    d, s = _pool_parts(pool)
+    nd, ns = _pool_parts(new)
+    if s is None:
+        return d.at[i].set(nd)
+    return (d.at[i].set(nd), s.at[i].set(ns))
+
+
+def _quantize_kv_rows(x):
+    """[..., Dh] fp -> (int8 [..., Dh], fp32 scale [...]) — the per-row
+    symmetric wire format of ``quant_collective`` applied per token row.
+    Uses the module's jnp twin (the Pallas producer kernel needs
+    group_size >= 256; KV rows are Dh wide), fused into the jitted forward."""
+    from deepspeed_tpu.ops.pallas.quant_collective import _quantize_rows_ref
+    q, scale = _quantize_rows_ref(
+        x.astype(jnp.float32).reshape(-1, x.shape[-1]), 8)
+    return q.reshape(x.shape), scale.reshape(x.shape[:-1])
+
+
 def _scatter_kv(k_pool, v_pool, k, v, block_tables, seen, q_len, block_size):
     """Write [S, Q, KV, Dh] new KVs into the [NB, KV, bs, Dh] pool via block
     tables.
 
     Padded token slots are routed to the trash block (last block of the pool).
-    Analog of the reference's linear_blocked_kv_copy kernel.
+    Analog of the reference's linear_blocked_kv_copy kernel. Quantized pools
+    (``(int8, scale)`` pairs) quantize on-write: each token's row quantizes
+    per (token, kv head) over Dh, and the fp32 scale scatters into the side
+    pool [NB, KV, 1, bs] under the same block/slot indices.
     """
+    k_pool, k_scale = _pool_parts(k_pool)
+    v_pool, v_scale = _pool_parts(v_pool)
     S, Q = k.shape[:2]
     nb = k_pool.shape[0]          # includes trash block
     pos = seen[:, None] + jnp.arange(Q)[None, :]              # [S, Q]
@@ -43,12 +85,21 @@ def _scatter_kv(k_pool, v_pool, k, v, block_tables, seen, q_len, block_size):
                               mode="clip")
     bi = jnp.where(valid, blk, nb - 1).reshape(-1)            # [S*Q]
     si = jnp.where(valid, pos % block_size, 0).reshape(-1)
+    if k_scale is not None:
+        k, ks = _quantize_kv_rows(k)          # int8 [S,Q,KV,Dh], f32 [S,Q,KV]
+        v, vs = _quantize_kv_rows(v)
+        # scale pool advanced indices (dims 0 and 3) straddle the head slice
+        # and the unit dim, so values land as [S*Q, KV]
+        k_scale = k_scale.at[bi, :, 0, si].set(ks.reshape(S * Q, -1))
+        v_scale = v_scale.at[bi, :, 0, si].set(vs.reshape(S * Q, -1))
     # advanced indices at dims (0, 2) straddle the head slice, so the token
     # dim lands in front: values are [S*Q, KV, Dh]
     k_pool = k_pool.at[bi, :, si].set(
         k.reshape(S * Q, *k.shape[2:]).astype(k_pool.dtype))
     v_pool = v_pool.at[bi, :, si].set(
         v.reshape(S * Q, *v.shape[2:]).astype(v_pool.dtype))
+    if k_scale is not None:
+        return (k_pool, k_scale), (v_pool, v_scale)
     return k_pool, v_pool
 
 
@@ -59,14 +110,16 @@ def _paged_attention(q, k_pool, v_pool, block_tables, seen, block_size,
     when the heuristics layer selects it, dense gather fallback elsewhere.
     ``window``: Mistral-style sliding window. ``prefer``: config pin from
     the modules registry. q: [S,Q,H,Dh] -> [S,Q,H,Dh]."""
+    kp, ks = _pool_parts(k_pool)
     if q_len is not None:
         from deepspeed_tpu.inference.v2.modules.heuristics import (
             instantiate_attention)
-        impl, fn = instantiate_attention(q.shape, k_pool.shape,
+        impl, fn = instantiate_attention(q.shape, kp.shape,
                                          preference=prefer)
         if impl == "pallas_paged":
-            return fn(q, k_pool, v_pool, block_tables, seen, q_len,
-                      window=window)
+            vp, vs = _pool_parts(v_pool)
+            return fn(q, kp, vp, block_tables, seen, q_len,
+                      k_scale=ks, v_scale=vs, window=window)
     return _paged_attention_dense(q, k_pool, v_pool, block_tables, seen,
                                   block_size, window=window)
 
@@ -74,7 +127,10 @@ def _paged_attention(q, k_pool, v_pool, block_tables, seen, block_size,
 def _paged_attention_dense(q, k_pool, v_pool, block_tables, seen, block_size,
                            window=None):
     """Pure-XLA reference path (gathers the full table; numerics twin of the
-    Pallas kernel)."""
+    Pallas kernel — including the fused-dequant int8 path, which it
+    reproduces as gather-then-dequantize with broadcast scales)."""
+    k_pool, k_scale = _pool_parts(k_pool)
+    v_pool, v_scale = _pool_parts(v_pool)
     S, Q, H, Dh = q.shape
     KV = k_pool.shape[1]
     rep = H // KV
@@ -82,10 +138,17 @@ def _paged_attention_dense(q, k_pool, v_pool, block_tables, seen, block_size,
     MB = block_tables.shape[1]
 
     def one_seq(q_s, bt_s, seen_s):
+        keys, vals = k_pool[bt_s], v_pool[bt_s]       # [MB, KV, bs, Dh]
+        if k_scale is not None:
+            # scale rows [MB, KV, 1, bs] -> per-token column [MB, KV, bs, 1]
+            keys = keys.astype(jnp.float32) * \
+                jnp.swapaxes(k_scale[bt_s], -1, -2)
+            vals = vals.astype(jnp.float32) * \
+                jnp.swapaxes(v_scale[bt_s], -1, -2)
         # [MB, KV, bs, Dh] -> token-major [MB*bs, KV, Dh]
-        keys = (k_pool[bt_s].transpose(0, 2, 1, 3)
+        keys = (keys.transpose(0, 2, 1, 3)
                 .reshape(MB * block_size, KV, Dh).astype(q_s.dtype))
-        vals = (v_pool[bt_s].transpose(0, 2, 1, 3)
+        vals = (vals.transpose(0, 2, 1, 3)
                 .reshape(MB * block_size, KV, Dh).astype(q_s.dtype))
         qg = q_s.reshape(Q, KV, rep, Dh)
         logits = jnp.einsum("qkrd,skd->krqs", qg, keys).astype(jnp.float32) * scale
@@ -110,7 +173,7 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     """
     S, Q = tokens.shape
     H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-    bs = k_pool.shape[3]          # [L, NB, KV, bs, Dh]
+    bs = _pool_block_size(k_pool)  # [L, NB, KV, bs, Dh] (pair when int8)
     positions = seen[:, None] + jnp.arange(Q)[None, :]
 
     x = params["embed_tokens"].astype(cfg.dtype)[tokens]

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.llama import rotary_embed
 from deepspeed_tpu.inference.v2.model_implementations.llama import (
-    _paged_attention, _rmsnorm, _scatter_kv)
+    _paged_attention, _pool_block_size, _pool_layer, _pool_set_layer,
+    _rmsnorm, _scatter_kv)
 from deepspeed_tpu.inference.v2.modules.module_registry import module_preference
 
 
@@ -79,7 +80,7 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     S, Q = tokens.shape
     H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
     Dh = cfg.hidden_size // H
-    bs = k_pool.shape[3]          # [L, NB, KV, bs, Dh]
+    bs = _pool_block_size(k_pool)  # [L, NB, KV, bs, Dh] (pair when int8)
     positions = seen[:, None] + jnp.arange(Q)[None, :]
 
     x = params["embed_tokens"].astype(cfg.dtype)[tokens]
@@ -114,9 +115,10 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     # layer count is static and the weights differ per layer)
     for i in range(cfg.num_hidden_layers):
         x, kpi, vpi = layer_step(x, params[f"layers_{i}"],
-                                 k_pool[i], v_pool[i])
-        k_pool = k_pool.at[i].set(kpi)
-        v_pool = v_pool.at[i].set(vpi)
+                                 _pool_layer(k_pool, i),
+                                 _pool_layer(v_pool, i))
+        k_pool = _pool_set_layer(k_pool, i, kpi)
+        v_pool = _pool_set_layer(v_pool, i, vpi)
 
     x = _rmsnorm(x, params["norm"]["scale"], cfg.rms_norm_eps)
     last = jnp.take_along_axis(

@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.v2.model_implementations.llama import (
-    _paged_attention, _scatter_kv)
+    _paged_attention, _pool_block_size, _pool_layer, _pool_set_layer,
+    _scatter_kv)
 from deepspeed_tpu.inference.v2.model_implementations.parallel_block import (
     _layernorm)
 from deepspeed_tpu.inference.v2.modules.module_registry import module_preference
@@ -26,7 +27,7 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     S, Q = tokens.shape
     H = cfg.num_attention_heads
     Dh = cfg.hidden_size // H
-    bs = k_pool.shape[3]          # [L, NB, KV, bs, Dh]
+    bs = _pool_block_size(k_pool)  # [L, NB, KV, bs, Dh] (pair when int8)
     positions = seen[:, None] + jnp.arange(Q)[None, :]
 
     embed = params["embed_tokens"].astype(cfg.dtype)
@@ -63,9 +64,10 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     else:
         for i in range(cfg.num_hidden_layers):
             x, kpi, vpi = layer_step(x, params[f"layers_{i}"],
-                                     k_pool[i], v_pool[i])
-            k_pool = k_pool.at[i].set(kpi)
-            v_pool = v_pool.at[i].set(vpi)
+                                     _pool_layer(k_pool, i),
+                                     _pool_layer(v_pool, i))
+            k_pool = _pool_set_layer(k_pool, i, kpi)
+            v_pool = _pool_set_layer(v_pool, i, vpi)
 
     fl = params["final_layer_norm"]
     x = _layernorm(x, fl["scale"], fl["bias"], cfg.layer_norm_epsilon)

@@ -14,7 +14,8 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.parallel_block import partial_rotary
 from deepspeed_tpu.inference.v2.model_implementations.llama import (
-    _paged_attention, _scatter_kv)
+    _paged_attention, _pool_block_size, _pool_layer, _pool_set_layer,
+    _scatter_kv)
 from deepspeed_tpu.inference.v2.modules.module_registry import module_preference
 
 
@@ -31,7 +32,7 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     """One ragged Falcon/Phi forward step -> (last-token logits, new pools)."""
     S, Q = tokens.shape
     H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-    bs = k_pool.shape[3]          # [L, NB, KV, bs, Dh]
+    bs = _pool_block_size(k_pool)  # [L, NB, KV, bs, Dh] (pair when int8)
     positions = seen[:, None] + jnp.arange(Q)[None, :]
 
     embed = params["embed_tokens"].astype(cfg.dtype)
@@ -58,10 +59,10 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
             v = lin(lp["v_proj"], h).reshape(S, Q, KV, Dh)
         q = partial_rotary(q, positions, cfg.rope_theta, cfg.rotary_dim)
         k = partial_rotary(k, positions, cfg.rope_theta, cfg.rotary_dim)
-        kp, vp = _scatter_kv(k_pool[i], v_pool[i], k, v, block_tables, seen,
-                             q_len, bs)
-        k_pool = k_pool.at[i].set(kp)
-        v_pool = v_pool.at[i].set(vp)
+        kp, vp = _scatter_kv(_pool_layer(k_pool, i), _pool_layer(v_pool, i),
+                             k, v, block_tables, seen, q_len, bs)
+        k_pool = _pool_set_layer(k_pool, i, kp)
+        v_pool = _pool_set_layer(v_pool, i, vp)
         attn = _paged_attention(q, kp, vp, block_tables, seen, bs, q_len=q_len,
                                 prefer=module_preference(cfg, "attention"))
         attn_out = lin(lp["dense"], attn.reshape(S, Q, H * Dh))

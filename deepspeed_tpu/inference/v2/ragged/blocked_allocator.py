@@ -7,18 +7,27 @@ and never touches the device.
 
 Blocks are reference counted so one physical block can appear in many
 sequences' block tables (prefix sharing — paged attention indirects through
-block ids, so the kernels never notice). A block is in exactly one of three
+block ids, so the kernels never notice). A block is in exactly one of four
 states:
 
   * **free**   — on the free list, refcount 0, allocatable
   * **live**   — refcount >= 1, held by one or more sequences
   * **cached** — refcount 0 but *parked* by a bound ``PrefixCache``: its KV
     contents are still valid for reuse and it is held out of the free list
-    until the cache evicts it (LRU, under pool pressure) or revives it on a
-    prefix hit
+    until the cache spills/evicts it (LRU, under pool pressure) or revives
+    it on a prefix hit
+  * **host**   — spilled to the host-DRAM tier (ZeRO-Inference/Infinity
+    offload analog): the *contents* live in a host payload under a spill
+    handle while the device id has returned to the free list. Host blocks
+    therefore don't occupy HBM — the census counts them against a grown
+    ``total``: ``free + live + cached + host == num_blocks + host`` always
+    (device side, ``free + live + cached == num_blocks``, stays a hard
+    invariant; ``counts`` exposes all the terms and the property test pins
+    them).
 
-``free + live + cached == num_blocks`` always (``counts`` exposes the terms;
-the property test pins the invariant).
+A spill handle is single-shot: ``restore`` consumes it, and a second restore
+(or any restore of a dropped handle) raises — swapped-out refs cannot be
+resurrected.
 """
 
 from collections import deque
@@ -26,7 +35,7 @@ from collections import deque
 
 class BlockedAllocator:
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, host_capacity: int = 0):
         if num_blocks < 1:
             raise ValueError(f"need at least 1 block, got {num_blocks}")
         self._num_blocks = num_blocks
@@ -37,6 +46,14 @@ class BlockedAllocator:
         self._parked = 0        # refcount-0 blocks held by the prefix cache
         self._cache = None      # bound PrefixCache (park_if_cached / evict)
         self._stats_cache = None
+        # host-DRAM spill tier: handle -> opaque payload (set by the caller —
+        # typically the kv_cache's host copy of the block's pages)
+        self._host_capacity = host_capacity
+        self._host = {}
+        self._next_host_ref = 0
+        self._host_spills = 0    # cumulative blocks spilled (swapped out)
+        self._host_restores = 0  # cumulative blocks restored (swapped in)
+        self._host_drops = 0     # cumulative records invalidated unread
 
     def bind_cache(self, cache):
         """Attach a prefix cache: refcount-0 blocks it recognises are parked
@@ -60,11 +77,24 @@ class BlockedAllocator:
     def num_blocks(self) -> int:
         return self._num_blocks
 
+    @property
+    def host_blocks(self) -> int:
+        """Blocks currently resident in the host-DRAM spill tier."""
+        return len(self._host)
+
+    @property
+    def host_capacity(self) -> int:
+        return self._host_capacity
+
     def counts(self):
-        """State census for the allocator invariant
-        (free + live + cached == total)."""
+        """State census for the allocator invariant: device side
+        ``free + live + cached == num_blocks`` is hard, and with the spill
+        tier ``free + live + cached + host == total`` where ``total`` grows
+        by the host-resident count (host blocks hold no device id)."""
+        host = len(self._host)
         return {"free": len(self._free), "live": self.live_blocks,
-                "cached": self._parked, "total": self._num_blocks}
+                "cached": self._parked, "host": host,
+                "total": self._num_blocks + host}
 
     def refcount(self, block: int) -> int:
         return self._refs[block]
@@ -137,6 +167,58 @@ class BlockedAllocator:
                 raise ValueError(f"release of non-parked block {b}")
             self._parked -= 1
             self._release_one(b)
+
+    # -- host-DRAM spill tier ----------------------------------------------
+    def can_spill(self) -> bool:
+        """Room left in the host tier? (Full tier -> callers fall back to
+        plain eviction; records are never silently dropped, which keeps the
+        swap accounting identity ``spills == restores + resident`` exact.)"""
+        return len(self._host) < self._host_capacity
+
+    def spill(self, block: int, payload):
+        """Parked (cached, refcount-0) block -> host: store ``payload`` under
+        a fresh single-shot handle and return the device id to the free list.
+        Raises on non-parked blocks or a full host tier."""
+        self._check_range(block)
+        if self._refs[block] != 0 or block in self._free_set:
+            raise ValueError(f"spill of non-parked block {block}")
+        if not self.can_spill():
+            raise ValueError(
+                f"host tier full ({len(self._host)}/{self._host_capacity})")
+        self._parked -= 1
+        self._release_one(block)
+        ref = self._next_host_ref
+        self._next_host_ref += 1
+        self._host[ref] = payload
+        self._host_spills += 1
+        return ref
+
+    def restore(self, ref: int):
+        """Consume a spill handle and return its payload. The caller
+        allocates a fresh device block and rebinds the contents; the handle
+        is dead afterwards (no resurrection of swapped-out refs)."""
+        if ref not in self._host:
+            raise ValueError(f"restore of non-host record {ref}")
+        self._host_restores += 1
+        return self._host.pop(ref)
+
+    def drop_host(self, ref: int):
+        """Discard a host record without restoring it (cache invalidation —
+        e.g. the owning prefix cache is flushed)."""
+        if ref not in self._host:
+            raise ValueError(f"drop of non-host record {ref}")
+        self._host_drops += 1
+        del self._host[ref]
+
+    def host_swap_stats(self):
+        """Cumulative spill/restore/drop counters;
+        ``spilled == restored + dropped + resident`` always (the swap
+        accounting identity the perf gate checks)."""
+        return {"spilled": self._host_spills,
+                "restored": self._host_restores,
+                "dropped": self._host_drops,
+                "resident": len(self._host),
+                "capacity": self._host_capacity}
 
     def _release_one(self, b):
         self._free.append(b)

@@ -9,28 +9,74 @@ handed out by the host-side ``BlockedAllocator``; the model's paged-attention
 path scatters new KVs into the pool and gathers per-sequence views through
 block tables. One extra *trash block* (index ``num_blocks``) absorbs writes
 from padded token slots, keeping every scatter shape static for XLA.
+
+Storage tiers (the long-context capacity axes):
+
+* ``kv_dtype="int8"`` stores the pools int8 with per-token fp32 scales in
+  side pools shaped ``[num_layers, num_blocks, num_kv_heads, 1, block_size]``
+  (one scale per token row over head_dim — incremental decode appends one row
+  at a time, so per-row scales never rescale a page). The EQuARX-style wire
+  format of ``ops/pallas/quant_collective.py`` applied to pages: quantization
+  happens on-write inside the jitted forward, dequantization fuses into the
+  paged-attention read. Throughout this file a "page array" is either a plain
+  array (fp) or a ``(int8_data, fp32_scale)`` tuple — jax pytrees make the
+  pair flow through jit/scan/device_put unchanged.
+* a host-DRAM spill tier (``host_capacity`` blocks) behind the allocator's
+  fourth block state: parked prefix blocks spill device->host through a
+  double-buffered ``HostKVSwapper`` instead of being evicted, and restore on
+  prefix hits. All device->host landings route through the injectable
+  accounted fetch (``set_host_fetch`` — the engine wires ``host_fetch`` in so
+  the host-sync ratchet sees them).
 """
+
+import time
 
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.runtime.swap_tensor.kv_swapper import HostKVSwapper
 
 _DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16, "fp32": jnp.float32}
+
+# injectable clock alias: the zero-overhead test proves the disabled
+# telemetry path never reads it (same pattern as inference/v2/scheduler.py)
+_now = time.perf_counter
+
+
+def split_pages(x):
+    """Page array -> (data, scale_or_None); accepts both conventions."""
+    return x if isinstance(x, tuple) else (x, None)
 
 
 class BlockedKVCache:
 
     def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
-                 head_dim, dtype="bf16"):
+                 head_dim, dtype="bf16", kv_dtype="fp", host_capacity=0):
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
-        self.dtype = _DTYPES.get(dtype, dtype)
+        self.quantized = (kv_dtype == "int8")
+        if kv_dtype not in ("fp", "int8"):
+            raise ValueError(f"kv_dtype must be 'fp' or 'int8', got {kv_dtype!r}")
+        self.dtype = jnp.int8 if self.quantized else _DTYPES.get(dtype, dtype)
         # +1 trash block for masked writes
         shape = (num_layers, num_blocks + 1, num_kv_heads, block_size, head_dim)
         self.k_pool = jnp.zeros(shape, self.dtype)
         self.v_pool = jnp.zeros(shape, self.dtype)
-        self._allocator = BlockedAllocator(num_blocks)
+        if self.quantized:
+            # one fp32 scale per (layer, block, kv head, token row); the
+            # trailing (1, block_size) layout makes the kernel's scale tile a
+            # legal [1, bs] lane row under the same block-table index map
+            sshape = (num_layers, num_blocks + 1, num_kv_heads, 1, block_size)
+            self.k_scale = jnp.ones(sshape, jnp.float32)
+            self.v_scale = jnp.ones(sshape, jnp.float32)
+        else:
+            self.k_scale = self.v_scale = None
+        self._allocator = BlockedAllocator(num_blocks,
+                                           host_capacity=host_capacity)
+        self._fetch = None  # injectable accounted device->host fetch
+        self._swapper = HostKVSwapper(self._fetch_arrays, buffer_count=2,
+                                      land_wrapper=self._timed_land)
 
     @property
     def allocator(self) -> BlockedAllocator:
@@ -62,9 +108,25 @@ class BlockedKVCache:
         """Return block ids to the pool (reference ``kv_cache.py:155``)."""
         self._allocator.free(blocks)
 
-    def update(self, k_pool, v_pool):
-        """Swap in pools returned by the jitted forward."""
-        self.k_pool, self.v_pool = k_pool, v_pool
+    # -- forward-pass pool views ------------------------------------------
+    @property
+    def fwd_k(self):
+        """K pages as the forward wants them: the pool array, or the
+        ``(int8, scale)`` pair when quantized (one donated pytree arg)."""
+        return (self.k_pool, self.k_scale) if self.quantized else self.k_pool
+
+    @property
+    def fwd_v(self):
+        return (self.v_pool, self.v_scale) if self.quantized else self.v_pool
+
+    def update(self, k, v):
+        """Swap in pools returned by the jitted forward (pairs when
+        quantized, mirroring ``fwd_k``/``fwd_v``)."""
+        if self.quantized:
+            (self.k_pool, self.k_scale) = k
+            (self.v_pool, self.v_scale) = v
+        else:
+            self.k_pool, self.v_pool = k, v
 
     def place(self, sharding):
         """Commit the pools onto an explicit device/sharding. Freshly zeroed
@@ -75,6 +137,59 @@ class BlockedKVCache:
         import jax
         self.k_pool = jax.device_put(self.k_pool, sharding)
         self.v_pool = jax.device_put(self.v_pool, sharding)
+        if self.quantized:
+            self.k_scale = jax.device_put(self.k_scale, sharding)
+            self.v_scale = jax.device_put(self.v_scale, sharding)
+
+    # -- accounted device->host transfers ----------------------------------
+    def set_host_fetch(self, fetch):
+        """Route every device->host landing (swap_out, spill) through
+        ``fetch(value, what) -> numpy`` — the engine wires its accounted
+        ``host_fetch`` in so the host-sync ratchet sees KV swap traffic."""
+        self._fetch = fetch
+
+    def _fetch_arrays(self, arrays, what):
+        """Land a tuple of dispatched device arrays on host."""
+        if self._fetch is not None:
+            return tuple(self._fetch(a, what) for a in arrays)
+        import jax
+        import numpy as np
+        out = jax.device_get(tuple(arrays))  # graftlint: allow[GL003] unwired fallback; the engine injects the accounted host_fetch here
+        return tuple(np.asarray(a) for a in out)  # graftlint: allow[GL004] device_get above already landed the arrays on host
+
+    def _timed_land(self, thunk):
+        """Swap-out landing hook: time the host fetch only when telemetry is
+        on (the disabled path never reads the clock — test-pinned)."""
+        from deepspeed_tpu import telemetry
+        tm = telemetry.get_telemetry()
+        if not tm.enabled:
+            return thunk()
+        t0 = _now()
+        out = thunk()
+        tm.record_hist("serving/kv_swap_out_s", _now() - t0)
+        return out
+
+    def _gather_pages(self, idx):
+        """Dispatch gathers of the given block rows (and their scales) —
+        all before any fetch, so the device->host copies pipeline."""
+        parts = [jnp.take(self.k_pool, idx, axis=1),
+                 jnp.take(self.v_pool, idx, axis=1)]
+        if self.quantized:
+            parts += [jnp.take(self.k_scale, idx, axis=1),
+                      jnp.take(self.v_scale, idx, axis=1)]
+        return tuple(parts)
+
+    def _scatter_pages(self, idx, parts):
+        """Bind host (or shipped device) page rows under the given ids."""
+        self.k_pool = self.k_pool.at[:, idx].set(
+            jnp.asarray(parts[0], self.dtype))
+        self.v_pool = self.v_pool.at[:, idx].set(
+            jnp.asarray(parts[1], self.dtype))
+        if self.quantized:
+            self.k_scale = self.k_scale.at[:, idx].set(
+                jnp.asarray(parts[2], jnp.float32))
+            self.v_scale = self.v_scale.at[:, idx].set(
+                jnp.asarray(parts[3], jnp.float32))
 
     # -- host swap tier (ZeRO-Inference KV offload analog) -----------------
     # Reference capability: ``deepspeed/inference`` ZeRO-Inference offloads
@@ -88,35 +203,60 @@ class BlockedKVCache:
         reference on their ids. Shared (prefix-cached) blocks stay live under
         their other holders — the copy is conservative but the handle must be
         self-contained. Returns an opaque host handle for ``swap_in``."""
-        import jax
-        import numpy as np
         blocks = list(blocks)
-        idx = jnp.asarray(blocks, jnp.int32)
-        # dispatch BOTH gathers before fetching so the device→host copies
+        # dispatch every gather before fetching so the device→host copies
         # pipeline (jax async dispatch), instead of stalling on K before V
-        k_g = jnp.take(self.k_pool, idx, axis=1)
-        v_g = jnp.take(self.v_pool, idx, axis=1)
-        k, v = jax.device_get((k_g, v_g))  # graftlint: allow[GL003] the host tier IS the destination; swap_out runs off the decode hot path
+        parts = self._gather_pages(jnp.asarray(blocks, jnp.int32))
+        landed = self._fetch_arrays(parts, "kv_cache/swap_out")
         self._allocator.free(blocks)
-        return {"n": len(blocks), "k": np.asarray(k), "v": np.asarray(v)}  # graftlint: allow[GL004] device_get above already landed k/v on host
+        return {"n": len(blocks), "parts": landed}
 
     def swap_in(self, handle):
         """Restore swapped blocks into freshly allocated ids (order preserved:
         the i-th restored block holds what the i-th swapped-out block held).
         Returns the new block ids."""
         new_blocks = self._allocator.allocate(handle["n"])
-        idx = jnp.asarray(new_blocks, jnp.int32)
-        self.k_pool = self.k_pool.at[:, idx].set(
-            jnp.asarray(handle["k"], self.dtype))
-        self.v_pool = self.v_pool.at[:, idx].set(
-            jnp.asarray(handle["v"], self.dtype))
+        self._scatter_pages(jnp.asarray(new_blocks, jnp.int32),
+                            handle["parts"])
         return new_blocks
+
+    # -- host-DRAM spill tier (parked prefix blocks) -----------------------
+    # Unlike ``swap_out`` (live-sequence preemption: synchronous handle, ids
+    # freed), spills keep the block's identity alive in the allocator's
+    # fourth state: the gather is dispatched here but only LANDS on host when
+    # the double-buffered swapper rotates (or a restore demands it), so
+    # decode steps dispatched in between overlap the copies.
+    def spill_block(self, block):
+        """Dispatch a parked block's pages device->host; returns the opaque
+        payload for ``BlockedAllocator.spill`` (pending until landed)."""
+        return self._swapper.submit(
+            self._gather_pages(jnp.asarray([block], jnp.int32)))
+
+    def restore_block(self, payload, block):
+        """Scatter a spilled payload's pages into device block ``block``
+        (freshly allocated by the caller). Lands the payload first if its
+        device->host copy is still in flight."""
+        parts = self._swapper.land(payload)
+        from deepspeed_tpu import telemetry
+        tm = telemetry.get_telemetry()
+        if not tm.enabled:
+            self._scatter_pages(jnp.asarray([block], jnp.int32), parts)
+            return
+        t0 = _now()
+        self._scatter_pages(jnp.asarray([block], jnp.int32), parts)
+        tm.record_hist("serving/kv_swap_in_s", _now() - t0)
+
+    @property
+    def swapper(self) -> HostKVSwapper:
+        return self._swapper
 
     # -- page transfer (prefill/decode disaggregation) ---------------------
     # Unlike the swap tier above, these never round-trip through host numpy:
     # the gather stays a device array so ``KVPageTransport`` can device_put
     # it straight onto the destination pool's submesh (ICI path), and the
-    # scatter accepts whatever placement the transport delivered.
+    # scatter accepts whatever placement the transport delivered. Quantized
+    # pools ship ``(int8, scale)`` pairs — the pytree flows through
+    # device_put like a plain array.
     def _pad_pages(self, blocks):
         """Pad a block-id list to the next power of two with trash-block
         reads/writes. Transfers bucket their shapes so the gather/scatter
@@ -133,21 +273,28 @@ class BlockedKVCache:
         the source ids immediately — later eviction of a donated block
         cannot corrupt the shipped pages. Returns ``(k, v)`` shaped
         ``[num_layers, bucket(len(blocks)), heads, block_size, head_dim]``
-        — rows past ``len(blocks)`` are trash-block padding."""
+        (each a ``(data, scale)`` pair when quantized) — rows past
+        ``len(blocks)`` are trash-block padding."""
         idx = jnp.asarray(self._pad_pages(list(blocks)), jnp.int32)
-        k = jnp.take(self.k_pool, idx, axis=1)
-        v = jnp.take(self.v_pool, idx, axis=1)
-        return k, v
+        parts = self._gather_pages(idx)
+        if self.quantized:
+            return (parts[0], parts[2]), (parts[1], parts[3])
+        return parts
 
     def import_blocks(self, k, v, n):
         """Bind the first ``n`` shipped block rows into this pool under
         freshly allocated ids (refcount 1 via the allocator, evicting parked
         cached blocks first under pressure); padding rows scatter into the
         trash block. Returns the new ids in shipping order."""
+        k, ks = split_pages(k)
+        v, vs = split_pages(v)
+        if (ks is not None) != self.quantized:
+            raise ValueError("page dtype mismatch: shipment and pool must "
+                             "both be quantized or both fp")
         new_blocks = self._allocator.allocate(n)
         idx = jnp.asarray(
             new_blocks + [self.trash_block] * (int(k.shape[1]) - n),
             jnp.int32)
-        self.k_pool = self.k_pool.at[:, idx].set(jnp.asarray(k, self.dtype))
-        self.v_pool = self.v_pool.at[:, idx].set(jnp.asarray(v, self.dtype))
+        parts = (k, v) if ks is None else (k, v, ks, vs)
+        self._scatter_pages(idx, parts)
         return new_blocks

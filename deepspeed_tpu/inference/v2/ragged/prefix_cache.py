@@ -25,10 +25,17 @@ Lifecycle of a cached block:
     ``BlockedAllocator.free`` asks ``park_if_cached``: cached blocks are
     held out of the free list with their KV contents warm.
   * **revive** — a later prefix hit on a parked block takes it live again.
-  * **evict** — under pool pressure ``BlockedAllocator.allocate`` evicts
-    parked blocks LRU-first and returns them to the free list. This runs
-    *before* the scheduler's ``_preempt_for_progress`` host-swaps any live
-    victim: dropping an unreferenced cached block is free, a swap is not.
+  * **spill** — under pool pressure ``BlockedAllocator.allocate`` reclaims
+    parked blocks LRU-first. With a bound spiller (the ``BlockedKVCache``)
+    and room in the host-DRAM tier, the block's pages move to a host payload
+    and the digest stays matchable (host-resident); otherwise the block is
+    **evicted** outright (contents dropped, digest forgotten). Either way
+    the device id returns to the free list, and both run *before* the
+    scheduler's ``_preempt_for_progress`` host-swaps any live victim —
+    pressure order: spill-to-host, evict-to-free, preempt-live.
+  * **restore** — a later prefix match on a host-resident digest allocates
+    a fresh device block and swaps the pages back in transparently inside
+    ``acquire_chain`` (callers just see a hit).
 
 The digest is SHA-256 over the parent digest + the raw int32 token bytes —
 a collision would silently serve another prompt's KV, so a cryptographic
@@ -53,12 +60,24 @@ class PrefixCache:
         # parked (refcount-0) digests in park order == LRU order; flush
         # parks a chain children-first so eviction orphans no ancestors
         self._lru = OrderedDict()
+        # host-resident digests: digest -> allocator spill handle. Entries
+        # here hold NO device block; a match restores into a fresh one.
+        self._host_map = {}
+        self._spiller = None  # bound BlockedKVCache (spill_block/restore_block)
         self.hits = 0             # requests that matched >= 1 cached block
         self.misses = 0
         self.tokens_saved = 0     # cumulative prefill tokens skipped
         self.insertions = 0
         self.evictions = 0
+        self.spills = 0           # parked blocks demoted to the host tier
+        self.restores = 0         # host-resident blocks revived on a match
         allocator.bind_cache(self)
+
+    def bind_spiller(self, spiller):
+        """Attach the page mover (``BlockedKVCache``): eviction pressure then
+        demotes LRU parked blocks to the host-DRAM tier (while the allocator
+        has spill room) instead of dropping their KV."""
+        self._spiller = spiller
 
     @staticmethod
     def chain_digest(parent: bytes, block_tokens) -> bytes:
@@ -68,8 +87,13 @@ class PrefixCache:
 
     @property
     def cached_blocks(self) -> int:
-        """Blocks registered in the cache (live shared + parked)."""
+        """Device blocks registered in the cache (live shared + parked)."""
         return len(self._map)
+
+    @property
+    def host_cached_blocks(self) -> int:
+        """Digests whose pages live in the host-DRAM tier (still matchable)."""
+        return len(self._host_map)
 
     @property
     def evictable_blocks(self) -> int:
@@ -85,7 +109,8 @@ class PrefixCache:
     def lookup_chain(self, token_ids):
         """Longest chain of cached FULL blocks covering a strict prefix of
         ``token_ids``. Pure read — takes no references, counts no stats.
-        Returns (block_ids, digests)."""
+        Returns (block_ids, digests); a host-resident link appears as
+        ``None`` in ``block_ids`` (``acquire_chain`` swaps it back in)."""
         bs = self.block_size
         limit = (len(token_ids) - 1) // bs  # strict prefix: tail must run
         parent = _ROOT
@@ -93,7 +118,7 @@ class PrefixCache:
         for i in range(limit):
             d = self.chain_digest(parent, token_ids[i * bs:(i + 1) * bs])
             b = self._map.get(d)
-            if b is None:
+            if b is None and d not in self._host_map:
                 break
             blocks.append(b)
             digests.append(d)
@@ -101,12 +126,39 @@ class PrefixCache:
         return blocks, digests
 
     def acquire_chain(self, blocks, digests):
-        """Take references on a matched chain (parked blocks revive) and
-        record the hit."""
+        """Take references on a matched chain (parked blocks revive,
+        host-resident blocks swap back into fresh device blocks) and record
+        the hit. Returns the resolved device block ids — a prefix of the
+        match when the pool can't hold a restore (the chain truncates there
+        and the dropped tail simply re-prefills)."""
+        resolved = []
         for b, d in zip(blocks, digests):
-            self._acquire(b, d)
+            if b is None:
+                b = self._restore(d)
+                if b is None:
+                    break  # no device room: shorten the match, keep going
+            else:
+                self._acquire(b, d)
+            resolved.append(b)
         self.hits += 1
-        self.tokens_saved += len(blocks) * self.block_size
+        self.tokens_saved += len(resolved) * self.block_size
+        return resolved
+
+    def _restore(self, digest):
+        """Swap a host-resident block back in under a fresh device id
+        (refcount 1 for the acquiring sequence). Returns None when the pool
+        has no room even after eviction — the record stays host-resident."""
+        try:
+            nb = self._alloc.allocate(1)[0]
+        except ValueError:
+            return None
+        ref = self._host_map.pop(digest)
+        payload = self._alloc.restore(ref)
+        self._spiller.restore_block(payload, nb)
+        self._map[digest] = nb
+        self._by_block[nb] = digest
+        self.restores += 1
+        return nb
 
     def _acquire(self, block, digest):
         if digest in self._lru:
@@ -129,6 +181,11 @@ class PrefixCache:
             if cur != block:
                 self._acquire(cur, d)
             return d, cur
+        if d in self._host_map:
+            # the sequence re-prefilled identical content on-device (its
+            # match predated the spill or a restore found no room) — the
+            # host copy is now a stale duplicate
+            self._alloc.drop_host(self._host_map.pop(d))
         self._map[d] = block
         self._by_block[block] = d
         self.insertions += 1
@@ -146,23 +203,36 @@ class PrefixCache:
         return True
 
     def evict(self, n: int) -> int:
-        """Release up to ``n`` least-recently-parked refcount-0 blocks back
-        to the allocator free list. Returns the number released."""
-        freed = []
-        while self._lru and len(freed) < n:
+        """Reclaim up to ``n`` least-recently-parked refcount-0 device
+        blocks. With a bound spiller and room in the host tier each block's
+        pages demote to host DRAM (digest stays matchable); otherwise the
+        block is released outright. Returns device blocks freed either way."""
+        freed = 0
+        released = []
+        while self._lru and freed < n:
             d, b = self._lru.popitem(last=False)
             del self._map[d]
             del self._by_block[b]
-            freed.append(b)
-        if freed:
-            self.evictions += len(freed)
-            self._alloc.release(freed)
-        return len(freed)
+            if self._spiller is not None and self._alloc.can_spill():
+                # gather the pages BEFORE the id returns to the free list
+                payload = self._spiller.spill_block(b)
+                self._host_map[d] = self._alloc.spill(b, payload)
+                self.spills += 1
+            else:
+                released.append(b)
+            freed += 1
+        if released:
+            self.evictions += len(released)
+            self._alloc.release(released)
+        return freed
 
     def stats(self):
         return {"cached_blocks": self.cached_blocks,
+                "host_cached_blocks": self.host_cached_blocks,
                 "evictable_blocks": self.evictable_blocks,
                 "prefix_hits": self.hits, "prefix_misses": self.misses,
                 "prefix_hit_rate": self.hit_rate,
                 "prefill_tokens_saved": self.tokens_saved,
-                "insertions": self.insertions, "evictions": self.evictions}
+                "insertions": self.insertions, "evictions": self.evictions,
+                "prefix_spills": self.spills,
+                "prefix_restores": self.restores}

@@ -16,9 +16,12 @@ class DSStateManager:
         num_blocks = sm.num_kv_blocks
         if num_blocks is None:
             num_blocks = self._blocks_from_memory_budget(
-                num_layers, num_kv_heads, head_dim, kv)
+                num_layers, num_kv_heads, head_dim, kv,
+                kv_dtype=sm.kv_dtype)
         self.kv_cache = BlockedKVCache(num_layers, num_blocks, kv.block_size,
-                                       num_kv_heads, head_dim, kv.cache_dtype)
+                                       num_kv_heads, head_dim, kv.cache_dtype,
+                                       kv_dtype=sm.kv_dtype,
+                                       host_capacity=sm.host_kv_blocks)
         # block-granular prefix sharing (config_v2.py prefix_caching knob,
         # default off). None when disabled — every cache-path branch below
         # is a single attribute test, so the disabled path does zero
@@ -27,6 +30,11 @@ class DSStateManager:
         if getattr(config, "prefix_caching", False):
             self.prefix_cache = PrefixCache(self.kv_cache.allocator,
                                             kv.block_size)
+            if sm.host_kv_blocks > 0:
+                # pressure then demotes LRU parked blocks to host DRAM
+                # (pages move through the kv_cache's async swapper) before
+                # dropping anything
+                self.prefix_cache.bind_spiller(self.kv_cache)
         self._seqs = {}
         self.swap_outs = 0  # host swap tier counters (kv_cache swap_out/in)
         self.swap_ins = 0
@@ -36,14 +44,23 @@ class DSStateManager:
                     f"prefix_caching={'on' if self.prefix_cache else 'off'})")
 
     @staticmethod
-    def _blocks_from_memory_budget(num_layers, num_kv_heads, head_dim, kv):
+    def _blocks_from_memory_budget(num_layers, num_kv_heads, head_dim, kv,
+                                   kv_dtype="fp"):
         """Size the pool from device memory (the reference derives block count
         from a reserved memory fraction, ``ragged_manager.py`` memory_config):
-        ~60% of the device's memory limit, fallback 1 GiB when unknown."""
+        ~60% of the device's memory limit, fallback 1 GiB when unknown.
+        int8 pages cost 1 byte/element plus one fp32 scale per token row —
+        the capacity lever: the same budget holds ~itemsize/(1+4/Dh) times
+        more blocks than fp."""
         import numpy as np
-        itemsize = np.dtype("float32" if kv.cache_dtype == "fp32" else "uint16").itemsize
-        bytes_per_block = (2 * num_layers * kv.block_size * num_kv_heads
-                           * head_dim * itemsize)  # K + V pools
+        if kv_dtype == "int8":
+            # int8 page + fp32 per-(token, kv head) scale
+            elt_bytes = 1 + 4 / head_dim
+        else:
+            elt_bytes = np.dtype(
+                "float32" if kv.cache_dtype == "fp32" else "uint16").itemsize
+        bytes_per_block = int(2 * num_layers * kv.block_size * num_kv_heads
+                              * head_dim * elt_bytes)  # K + V pools
         try:
             from deepspeed_tpu import telemetry
             stats = telemetry.sample_memory("kv_cache_budget") or {}
@@ -101,6 +118,7 @@ class DSStateManager:
         if occupancy > self.peak_occupancy:
             self.peak_occupancy = occupancy
         swapped = sum(1 for s in self._seqs.values() if s.is_swapped)
+        hs = self.kv_cache.allocator.host_swap_stats()
         stats = {"total_blocks": total, "free_blocks": free,
                  "occupied_blocks": total - free - parked,
                  "occupancy": occupancy,
@@ -110,7 +128,17 @@ class DSStateManager:
                  "fragmentation": a["fragmentation"],
                  "tracked_sequences": len(self._seqs),
                  "swapped_sequences": swapped,
-                 "swap_outs": self.swap_outs, "swap_ins": self.swap_ins}
+                 # swap_outs/ins count whole-sequence preemptions of LIVE
+                 # sequences (the expensive tier); the host tier's
+                 # block-granular prefix traffic is the kv_* trio below
+                 "swap_outs": self.swap_outs, "swap_ins": self.swap_ins,
+                 "swap_outs_live": self.swap_outs,
+                 "host_kv_blocks": hs["resident"],
+                 "host_kv_capacity": hs["capacity"],
+                 "host_kv_occupancy": (hs["resident"] / hs["capacity"]
+                                       if hs["capacity"] else 0.0),
+                 "kv_spilled": hs["spilled"], "kv_restored": hs["restored"],
+                 "kv_dropped": hs["dropped"]}
         if self.prefix_cache is not None:
             stats.update(self.prefix_cache.stats())
         return stats
@@ -136,6 +164,9 @@ class DSStateManager:
                                  stats["cached_blocks"], point=point)
                 tm.serving_gauge("serving/prefill_tokens_saved",
                                  stats["prefill_tokens_saved"], point=point)
+            if stats["host_kv_capacity"]:
+                tm.serving_gauge("serving/host_kv_blocks",
+                                 stats["host_kv_blocks"], point=point)
         return stats
 
     def get_sequence(self, uid):
@@ -171,11 +202,15 @@ class DSStateManager:
         if not blocks:
             cache.misses += 1
             return 0
-        cache.acquire_chain(blocks, digests)
+        # host-resident links swap back in here; the resolved chain may be a
+        # prefix of the match when the pool can't hold a restore
+        resolved = cache.acquire_chain(blocks, digests)
+        if not resolved:
+            return 0
         seq = self.get_or_create_sequence(uid)
-        matched = len(blocks) * cache.block_size
-        seq.kv_blocks = list(blocks)
-        seq.digests = list(digests)
+        matched = len(resolved) * cache.block_size
+        seq.kv_blocks = list(resolved)
+        seq.digests = list(digests[:len(resolved)])
         seq.seen_tokens = matched
         seq.tokens = [int(t) for t in prompt_tokens[:matched]]
         return matched

@@ -329,7 +329,15 @@ class SplitFuseScheduler:
         can run; its cache is restored later, not recomputed. Half-prefilled
         sequences are valid victims — two of them deadlocking the pool
         (neither can grow) is the classic starvation case. Returns True if a
-        sequence was preempted."""
+        sequence was preempted.
+
+        This is the LAST pressure tier. Before any live sequence swaps,
+        ``BlockedAllocator.allocate`` has already asked the prefix cache to
+        reclaim parked blocks — spilling them to the host-DRAM KV tier while
+        it has room (contents stay matchable; the double-buffered swapper
+        defers the device->host landing so the transfer overlaps the next
+        rounds' decode dispatches), then evicting outright. Pressure order:
+        spill-to-host, evict-to-free, preempt-live."""
         def blocks_of(r):
             seq = self._engine._state.get_sequence(r.uid)
             return len(seq.kv_blocks) if seq is not None else 0

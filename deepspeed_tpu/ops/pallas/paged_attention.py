@@ -20,6 +20,14 @@ k/v pools [NB, KV, bs, Dh] — (bs, Dh) are the minor dims so each grid step's
 block is a legal Mosaic tile; block_tables [S, MB]; seen [S]. Output matches q.
 GQA runs natively: grid is over KV heads, each step attends the whole
 ``rep = H // KV`` query-head group against one KV block.
+
+int8 KV (``k_scale``/``v_scale`` given): pools are int8 with per-token fp32
+scales in side pools [NB, KV, 1, bs] — the scale tile is a [1, bs] lane row
+DMA'd through the SAME block-table index map as its page, so HBM reads stay
+int8-sized and the dequant fuses into the flash loop in VMEM. No transposes:
+``k``'s per-token scale folds into the score *columns* after the QK dot
+(``sij * ks``), ``v``'s folds into ``p``'s columns before the PV dot
+(``(p * vs) @ v``) — both are lane-broadcast multiplies.
 """
 
 import functools
@@ -34,9 +42,14 @@ NEG_INF = -1e9
 LANES = 128
 
 
-def _kernel(bt_ref, seen_ref, qlen_ref, jcap_ref, q_ref, k_ref, v_ref, o_ref,
-            m_scr, l_scr, acc_scr, *, bs, nb_grid, rep, q_tokens, scale,
-            window):
+def _kernel(bt_ref, seen_ref, qlen_ref, jcap_ref, *refs, bs, nb_grid, rep,
+            q_tokens, scale, window, quantized):
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
     s, h, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -57,8 +70,16 @@ def _kernel(bt_ref, seen_ref, qlen_ref, jcap_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0]                           # [rep*Q, Dh]
         k = k_ref[0, 0]                           # [bs, Dh]
         v = v_ref[0, 0]
+        if quantized:
+            # int8 page tiles dequantize HERE, in VMEM — fp KV never exists
+            # in HBM. The QK dot runs on the raw int8 values (widened to the
+            # q dtype; +-127 is exact in bf16) and each key's scale folds
+            # into its score column afterwards.
+            k = k.astype(q.dtype)
         sij = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32) * scale
+        if quantized:
+            sij = sij * ks_ref[0, 0]              # [rep*Q, bs] * [1, bs]
         # causal over the ragged sequence: key pos <= seen + qi
         kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, sij.shape, 1)
         qi = jax.lax.broadcasted_iota(jnp.int32, sij.shape, 0) % q_tokens
@@ -75,8 +96,17 @@ def _kernel(bt_ref, seen_ref, qlen_ref, jcap_ref, q_ref, k_ref, v_ref, o_ref,
         l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_cur, l_scr.shape)
-        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        if quantized:
+            # per-token v scale folds into p's columns before the PV dot:
+            # (p * vs) @ v_int == p @ (v_int * vs^T) without the transpose
+            pv = jax.lax.dot_general((p * vs_ref[0, 0]).astype(jnp.float32),
+                                     v.astype(jnp.float32),
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        else:
+            pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
         acc_scr[...] = acc_scr[...] * alpha + pv
 
     @pl.when(j == nb_grid - 1)
@@ -87,7 +117,8 @@ def _kernel(bt_ref, seen_ref, qlen_ref, jcap_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
-              softmax_scale=None, window=None, interpret=False):
+              k_scale=None, v_scale=None, softmax_scale=None, window=None,
+              interpret=False):
     """Blocked-flash attention over paged KV. See module docstring for shapes.
 
     SPMD: routed through the kernel dispatcher — sequences (the ``S`` batch
@@ -95,6 +126,8 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
     KV heads (and with them the grouped query heads) shard over the TP axis,
     which slices the pools' ``KV`` dim while the block pool itself (``NB``)
     stays replicated so global block-table indices remain valid per shard.
+    The int8 scale pools shard exactly like their pages (KV dim on the TP
+    axis, NB replicated).
 
     No free block knobs (the KV block size comes from the pool layout), but
     the dispatch still routes through the tuning table so coverage and the
@@ -103,11 +136,14 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
     from deepspeed_tpu.ops import registry
     from deepspeed_tpu.ops.registry import sharded_kernel_call
 
+    quantized = k_scale is not None
     block_config = registry.resolve_block_config(
         "paged_mha", {"bs": k_pool.shape[2], "dh": q.shape[-1]}, q.dtype)
 
-    def call(q_, kp_, vp_, bt_, sn_, ql_):
+    def call(q_, kp_, vp_, bt_, sn_, ql_, *scales):
+        ks_, vs_ = scales if quantized else (None, None)
         return _paged_mha_local(q_, kp_, vp_, bt_, sn_, ql_,
+                                k_scale=ks_, v_scale=vs_,
                                 softmax_scale=softmax_scale, window=window,
                                 interpret=interpret)
 
@@ -115,21 +151,27 @@ def paged_mha(q, k_pool, v_pool, block_tables, seen, q_len, *,
         (_, _, h, _), (_, kv, _, _) = shard_shapes[0], shard_shapes[1]
         return kv >= 1 and h % kv == 0
 
+    inputs = [q, k_pool, v_pool, block_tables, seen, q_len]
+    roles = [("data", None, "head", None), (None, "head", None, None),
+             (None, "head", None, None), ("data", None), ("data",), ("data",)]
+    if quantized:
+        inputs += [k_scale, v_scale]
+        roles += [(None, "head", None, None), (None, "head", None, None)]
     return sharded_kernel_call(
-        call, [q, k_pool, v_pool, block_tables, seen, q_len],
-        [("data", None, "head", None), (None, "head", None, None),
-         (None, "head", None, None), ("data", None), ("data",), ("data",)],
+        call, inputs, roles,
         ("data", None, "head", None), accept=accept, name="paged_mha",
         block_config=block_config)
 
 
 def _paged_mha_local(q, k_pool, v_pool, block_tables, seen, q_len, *,
-                     softmax_scale=None, window=None, interpret=False):
+                     k_scale=None, v_scale=None, softmax_scale=None,
+                     window=None, interpret=False):
     S, Q, H, Dh = q.shape
     NB, KV, bs, _ = k_pool.shape
     MB = block_tables.shape[1]
     rep = H // KV
     scale = softmax_scale if softmax_scale is not None else Dh ** -0.5
+    quantized = k_scale is not None
 
     # [S, Q, H, Dh] -> [S, KV, rep*Q, Dh]: rows grouped by kv head
     qt = q.reshape(S, Q, KV, rep, Dh).transpose(0, 2, 3, 1, 4) \
@@ -144,16 +186,25 @@ def _paged_mha_local(q, k_pool, v_pool, block_tables, seen, q_len, *,
         jc = jnp.minimum(j, jcap_ref[s])
         return (bt[s, jc], h, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, rep * Q, Dh),
+                     lambda s, h, j, bt, sn, ql, jc: (s, h, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bs, Dh), kv_index, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bs, Dh), kv_index, memory_space=pltpu.VMEM),
+    ]
+    inputs = [qt, k_pool, v_pool]
+    if quantized:
+        # scale pools [NB, KV, 1, bs]: the [1, bs] tile rides the same
+        # block-table index map as its page, one lane row per grid step
+        in_specs += [pl.BlockSpec((1, 1, 1, bs), kv_index,
+                                  memory_space=pltpu.VMEM)] * 2
+        inputs += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(S, KV, MB),
-        in_specs=[
-            pl.BlockSpec((1, 1, rep * Q, Dh),
-                         lambda s, h, j, bt, sn, ql, jc: (s, h, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bs, Dh), kv_index, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bs, Dh), kv_index, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, rep * Q, Dh),
                                lambda s, h, j, bt, sn, ql, jc: (s, h, 0, 0),
                                memory_space=pltpu.VMEM),
@@ -165,15 +216,15 @@ def _paged_mha_local(q, k_pool, v_pool, block_tables, seen, q_len, *,
     )
     kernel = functools.partial(_kernel, bs=bs, nb_grid=MB, rep=rep,
                                q_tokens=Q, scale=scale,
-                               window=int(window) if window else None)
+                               window=int(window) if window else None,
+                               quantized=quantized)
     # qt reshaped so kv-head is a real leading dim for the spec: [S*KV, rep*Q, Dh]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, rep * Q, Dh), q.dtype),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), seen, q_len, jcap,
-      qt, k_pool, v_pool)
+    )(block_tables.astype(jnp.int32), seen, q_len, jcap, *inputs)
     return out.reshape(S, KV, rep, Q, Dh).transpose(0, 3, 1, 2, 4) \
               .reshape(S, Q, H, Dh)
 

@@ -6,10 +6,15 @@ Buffers are recycled from a fixed pool (reference buffer_count semantics).
 """
 
 import os
+import time
 
 import numpy as np
 
 from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+# module-level alias so tests can inject a fake clock without patching
+# time.perf_counter globally (jax reads the real clock internally)
+_now = time.perf_counter
 
 
 class AsyncTensorSwapper:
@@ -27,6 +32,7 @@ class AsyncTensorSwapper:
         self.buffer_count = buffer_count
         self._inflight_writes = 0
         self._inflight_reads = 0
+        self.wait_seconds = 0.0   # cumulative drain stall (injectable clock)
 
     def path_for(self, key):
         return os.path.join(self.swap_dir, f"{key}.swp")
@@ -51,7 +57,9 @@ class AsyncTensorSwapper:
         return os.path.exists(self.path_for(key))
 
     def wait(self):
+        t0 = _now()
         n = self.handle.wait()
+        self.wait_seconds += _now() - t0
         self._inflight_writes = 0
         self._inflight_reads = 0
         return n

@@ -1,0 +1,79 @@
+"""Host-DRAM KV page swapper: async double-buffered device->host spills.
+
+Sibling of ``AsyncTensorSwapper`` (NVMe aio) for the serving KV tier: parked
+prefix-cache blocks spill their pages to host DRAM instead of being evicted,
+so the prefix cache becomes effectively unbounded (ZeRO-Inference/Infinity
+offload lineage — cold state belongs one tier down, moved off the hot path).
+
+The pipeline shape mirrors the aio swapper's two-deep buffering, adapted to
+jax's async dispatch: the caller dispatches the device->host *gather* (a
+copying ``jnp.take``) and hands the still-in-flight device arrays to
+``submit``. Nothing blocks until the pending queue exceeds ``buffer_count``
+entries, at which point the oldest entry is *landed* — fetched to host numpy
+through the injected accounted-fetch callable — and its device buffers drop.
+Decode steps dispatched between submit and landing overlap the copies.
+
+``restore`` of a still-pending payload lands it first; a landed payload is
+plain numpy. Payloads are single-use (the allocator's spill-handle contract).
+"""
+
+from collections import deque
+
+
+class _Payload:
+    """One spilled block's pages: device arrays until landed, numpy after."""
+
+    __slots__ = ("arrays", "landed")
+
+    def __init__(self, arrays):
+        self.arrays = arrays   # tuple of device arrays, then numpy
+        self.landed = False
+
+
+class HostKVSwapper:
+
+    def __init__(self, fetch, buffer_count=2, land_wrapper=None):
+        """``fetch(arrays, what)`` -> host numpy tuple: the accounted
+        device->host fetch (the engine's ``host_fetch`` when wired, so the
+        host-sync ratchet sees every landing). ``land_wrapper(thunk)``, when
+        set, runs each landing's fetch thunk — the caller decides whether to
+        time it (telemetry enabled) or run it bare, so the disabled path
+        stays clock-free."""
+        self._fetch = fetch
+        self._buffer_count = max(1, int(buffer_count))
+        self._pending = deque()      # _Payload entries, oldest first
+        self._land_wrapper = land_wrapper
+        self.landings = 0
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def submit(self, arrays):
+        """Enqueue in-flight device gathers as a new payload; lands the
+        oldest entries beyond the double-buffer depth. Returns the payload
+        (the allocator's opaque spill record)."""
+        p = _Payload(tuple(arrays))
+        self._pending.append(p)
+        while len(self._pending) > self._buffer_count:
+            self._land(self._pending.popleft())
+        return p
+
+    def land(self, payload):
+        """Force a specific payload onto host (restore of a pending spill)."""
+        if not payload.landed:
+            self._pending.remove(payload)
+            self._land(payload)
+        return payload.arrays
+
+    def drain(self):
+        """Land everything pending (shutdown / barrier)."""
+        while self._pending:
+            self._land(self._pending.popleft())
+
+    def _land(self, payload):
+        thunk = lambda: self._fetch(payload.arrays, "kv_cache/spill")  # noqa: E731
+        payload.arrays = thunk() if self._land_wrapper is None \
+            else self._land_wrapper(thunk)
+        payload.landed = True
+        self.landings += 1

@@ -9,9 +9,14 @@ handle. Master fp32 weights stay in host DRAM (the reference's DRAM tier);
 moments — 2/3 of optimizer bytes — go to NVMe.
 """
 
+import time
+
 import numpy as np
 
 from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+
+# injectable clock alias (see async_swapper.py)
+_now = time.perf_counter
 
 
 class PartitionedOptimizerSwapper:
@@ -22,6 +27,7 @@ class PartitionedOptimizerSwapper:
         self._sizes = {}          # key -> element count
         self._buffers = {}        # key currently resident -> (m, v)
         self._prefetched = None   # key with a read in flight
+        self.fetch_stall_seconds = 0.0  # drains the pipeline didn't hide
 
     def register(self, key, n, async_op=False):
         """Declare a leaf's moment buffers (initialized to zeros on NVMe).
@@ -48,7 +54,9 @@ class PartitionedOptimizerSwapper:
         leaf's moments while the caller computes."""
         if key not in self._buffers:
             self._issue_read(key)
+        t0 = _now()
         self.swapper.wait()  # drain the read (and any pending writebacks)
+        self.fetch_stall_seconds += _now() - t0
         self._prefetched = None
         buf = self._buffers[key]
         n = self._sizes[key]

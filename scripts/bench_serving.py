@@ -21,6 +21,14 @@ additionally embeds the full telemetry summary in each payload's ``extra``
   prefill-token reduction, prefix hit rate, and TTFT comparison the prefix
   cache is judged on (gated by perf_gate's prefix checks).
 
+- ``--long-context`` — KV capacity-tiering workload: seeded long prompts
+  (32k–128k on TPU; scaled down on CPU) over a shared prefix, driven at an
+  EQUAL KV HBM byte budget with fp then int8 KV pages, host-DRAM spill tier
+  on. Reports concurrent max-context sequences per chip (the >= 2x int8
+  capacity ratchet), swap-in stall seconds, the swap accounting identity,
+  and the prefill reduction across a spill/restore round trip — gated by
+  perf_gate's ``check_longctx_baseline`` and ``--max-swap-stall-growth``.
+
 - ``--replay --fleet`` — serving-fleet replay: the same seeded trace runs
   twice — once against a single scheduler at its saturation rate, then
   against an ``SLORouter`` over a ``PrefillDecodeFleet`` (prefill/decode
@@ -31,6 +39,7 @@ additionally embeds the full telemetry summary in each payload's ``extra``
   fleet checks.
 
 Usage: python scripts/bench_serving.py [--replay] [--prefix-mix] [--fleet]
+           [--long-context] [--longctx-max T]
            [--requests N] [--seed S] [--arrival poisson|burst] [--rate R]
            [--burst-size B] [--prompt T] [--new T]
            [--prefix-pools P] [--prefix-len L]
@@ -58,17 +67,22 @@ def _embed_telemetry(extra):
 
 
 def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
-                 num_kv_blocks=None, prefix_caching=False):
+                 num_kv_blocks=None, prefix_caching=False, kv_dtype="fp",
+                 host_kv_blocks=0, model_and_params=None):
     import jax
     import numpy as np
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
     from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
     from deepspeed_tpu.models.llama import LlamaForCausalLM
 
-    model = LlamaForCausalLM(cfg)
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (1, 8)).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    if model_and_params is None:
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (1, 8)).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": ids})["params"]
+    else:
+        model, params = model_and_params
 
     block = 32 if on_tpu else 8
     max_ctx = prompt_len + new_tokens + block
@@ -79,7 +93,9 @@ def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
             "max_ragged_sequence_count": max(4, n_req) + 1,  # +1 warmup
             "max_ragged_batch_size": budget,
             "max_context": max_ctx,
-            "num_kv_blocks": num_kv_blocks},
+            "num_kv_blocks": num_kv_blocks,
+            "kv_dtype": kv_dtype,
+            "host_kv_blocks": host_kv_blocks},
         "kv_cache": {"block_size": block,
                      "cache_dtype": "bf16" if on_tpu else "fp32"},
         "prefix_caching": prefix_caching})
@@ -367,6 +383,219 @@ def prefix_mix_bench(args, on_tpu):
         "metric": "serving_replay_tokens_per_sec_per_chip",
         "value": round(total / c["wall"] / max(n_chips, 1), 1),
         "unit": "tokens/s/chip (prefill+decode)",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+    bench.emit(payload)
+    return payload
+
+
+def long_context_bench(args, on_tpu):
+    """Long-context KV capacity tiering: seeded long prompts over shared
+    prefix pools, driven twice at an EQUAL KV HBM byte budget — fp pages,
+    then int8 pages + per-row fp32 scales — both with prefix caching and
+    the host-DRAM spill tier on. Each leg runs three deterministic waves:
+    warm (park the shared prefixes), pressure (private long prompts force
+    the parked blocks through the spill path), reuse (shared-prefix
+    requests revive spilled chains from host DRAM). The payload reports
+    the capacity ratchet (concurrent sequences per chip at the shared
+    budget, fp vs int8) plus the host-tier numbers from the pressured fp
+    leg: swap-in stall seconds, the swap accounting identity
+    (swapped_out == swapped_in + swap_dropped + resident_host_blocks),
+    host occupancy, and ``swap_outs_live == 0`` — no live sequence ever
+    paid for pressure while parked blocks could. Gated by perf_gate's
+    ``check_longctx_baseline`` / ``--max-swap-stall-growth``."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=args.longctx_max + 256,
+                          remat=False)
+        block = 32
+        prefix_len = args.prefix_len or 32768       # 32k shared prefix
+        suffix_scale, max_suffix = 16384, args.longctx_max - prefix_len
+        new_tokens = args.new
+        n_req, n_filler = args.requests, 2
+        budget = 512
+    else:
+        # CPU leg: the same three-wave shape at toy scale (the prefix-mix
+        # pattern) — tiny model, 64-token "long" prefixes, a pool tight
+        # enough that wave 2 must spill wave 1's parked prefix blocks
+        cfg = LlamaConfig.tiny(remat=False)
+        block = 8
+        prefix_len = args.prefix_len or 64
+        suffix_scale, max_suffix = 12, 24
+        new_tokens = 2
+        n_req, n_filler = min(args.requests, 6), 2
+        budget = 48
+    prefix_len -= prefix_len % block  # block-aligned prefixes share fully
+    max_ctx = prefix_len + max_suffix + new_tokens + block
+
+    # equal HBM budget: size the fp pool to hold ~1.5 max-context sequences
+    # (so wave-2 pressure exists), then give the int8 leg the SAME bytes
+    num_layers = cfg.num_hidden_layers
+    kv_heads = cfg.num_key_value_heads
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    fp_elt = 2.0 if on_tpu else 4.0                 # bf16 / fp32 pages
+    q_elt = 1.0 + 4.0 / head_dim                    # int8 page + fp32 scale
+    blk_tokens = 2 * num_layers * block * kv_heads * head_dim
+    ctx_blocks = -(-max_ctx // block)
+    fp_blocks = int(ctx_blocks * 1.5)
+    budget_bytes = int(fp_blocks * blk_tokens * fp_elt)
+    q_blocks = int(budget_bytes // (blk_tokens * q_elt))
+    host_blocks = 4 * ctx_blocks
+
+    seed_gen = np.random.default_rng(args.seed)
+    pool_prefix = seed_gen.integers(
+        0, cfg.vocab_size, prefix_len).astype(np.int32)
+    suffix_lens = np.clip(seed_gen.lognormal(
+        np.log(suffix_scale), 0.6, n_req), 4, max_suffix).astype(np.int64)
+    reuse_prompts = [np.concatenate([
+        pool_prefix,
+        seed_gen.integers(0, cfg.vocab_size,
+                          int(suffix_lens[i])).astype(np.int32)])
+        for i in range(n_req)]
+    filler_prompts = [seed_gen.integers(
+        0, cfg.vocab_size,
+        prefix_len + max_suffix).astype(np.int32) for _ in range(n_filler)]
+    prompt_total = int(sum(len(p) for p in reuse_prompts)
+                       + sum(len(p) for p in filler_prompts) + prefix_len + 4)
+
+    legs = {}
+    for label, kv_dtype, blocks in (("fp", "fp", fp_blocks),
+                                    ("int8", "int8", q_blocks)):
+        model, sched = _build_stack(
+            cfg, n_req + n_filler + 1, prefix_len + max_suffix, new_tokens,
+            budget, on_tpu, num_kv_blocks=blocks, prefix_caching=True,
+            kv_dtype=kv_dtype, host_kv_blocks=host_blocks)
+        engine = sched._engine
+        t0 = time.perf_counter()
+        _precompile_batch_grid(sched, n_req + n_filler + 1, budget)
+        print(f"long-context[{label}]: warmup/compile "
+              f"{time.perf_counter()-t0:.1f}s", file=sys.stderr)
+        sched.prefill_tokens_executed = 0
+        sched.prefill_tokens_saved = 0
+        cache = engine._state.prefix_cache
+        cache.evict(cache.evictable_blocks)
+        cache.hits = cache.misses = cache.tokens_saved = 0
+        cache.insertions = cache.evictions = 0
+        telemetry.reset()
+        telemetry.configure(enabled=True, sample_sync=False,
+                            chrome_trace_path=os.environ.get(
+                                "DS_TPU_TELEMETRY_TRACE", ""))
+        t0 = time.perf_counter()
+        # wave 1 — warm: park the shared prefix blocks
+        sched.submit(10_000, np.concatenate(
+            [pool_prefix,
+             seed_gen.integers(0, cfg.vocab_size, 4).astype(np.int32)]),
+            max_new_tokens=new_tokens)
+        sched.run_to_completion()
+        # wave 2 — pressure: private max-length prompts spill the parked
+        # prefix chain into the host tier
+        for i, p in enumerate(filler_prompts):
+            sched.submit(20_000 + i, p, max_new_tokens=new_tokens)
+            sched.run_to_completion()
+        spilled_after_pressure = engine.kv_stats()["kv_spilled"]
+        # wave 3 — reuse: shared-prefix requests revive the spilled chain
+        for i, p in enumerate(reuse_prompts):
+            sched.submit(i, p, max_new_tokens=new_tokens)
+            sched.run_to_completion()
+        wall = time.perf_counter() - t0
+        if engine._state.kv_cache.swapper is not None:
+            engine._state.kv_cache.swapper.drain()  # flush deferred landings
+
+        stats = engine.kv_stats()
+        srv = telemetry.summary()["serving"]
+        hists = srv["histograms"]
+
+        def hist_total(name):
+            h = hists.get(name)
+            return (h["count"] * h["mean_s"], h["p50_s"]) if h else (0.0, 0.0)
+
+        swap_in_stall, swap_in_p50 = hist_total("serving/kv_swap_in_s")
+        swap_out_stall, _ = hist_total("serving/kv_swap_out_s")
+        tm = telemetry.get_telemetry()
+        ttft = tm.hist_percentiles("serving/ttft_s", (0.5, 0.99)) or (0.0, 0.0)
+        tpot = tm.hist_percentiles("serving/tpot_s", (0.5, 0.99)) or (0.0, 0.0)
+        executed = sched.prefill_tokens_executed
+        saved = sched.prefill_tokens_saved
+        kv = engine._state.kv_cache
+        pool_bytes = kv.k_pool.nbytes + kv.v_pool.nbytes
+        if kv.quantized:
+            pool_bytes += kv.k_scale.nbytes + kv.v_scale.nbytes
+        legs[label] = {
+            "blocks": blocks, "pool_bytes": int(pool_bytes), "wall": wall,
+            "concurrent_seqs": blocks // ctx_blocks,
+            "spilled": stats["kv_spilled"],
+            "spilled_after_pressure": spilled_after_pressure,
+            "restored": stats["kv_restored"],
+            "dropped": stats["kv_dropped"],
+            "resident_host": stats["host_kv_blocks"],
+            "host_occupancy": stats["host_kv_occupancy"],
+            "swap_outs_live": stats["swap_outs_live"],
+            "swap_in_stall": swap_in_stall, "swap_in_p50": swap_in_p50,
+            "swap_out_stall": swap_out_stall,
+            "ttft": ttft, "tpot": tpot,
+            "executed": executed, "saved": saved,
+            "hit_rate": cache.hit_rate,
+        }
+    fp, q = legs["fp"], legs["int8"]
+    n_chips = jax.device_count()
+    reduction = fp["saved"] / (fp["saved"] + fp["executed"]) \
+        if fp["saved"] + fp["executed"] else 0.0
+    extra = {
+        # capacity ratchet: same bytes, how many max-context sequences fit
+        "concurrent_sequences_per_chip": round(
+            q["concurrent_seqs"] / max(n_chips, 1), 4),
+        "concurrent_sequences_per_chip_fp": round(
+            fp["concurrent_seqs"] / max(n_chips, 1), 4),
+        "capacity_multiplier": round(
+            q["concurrent_seqs"] / fp["concurrent_seqs"], 4)
+        if fp["concurrent_seqs"] else 0.0,
+        "kv_hbm_budget_bytes": budget_bytes,
+        "fp_blocks": fp["blocks"], "int8_blocks": q["blocks"],
+        "fp_pool_bytes": fp["pool_bytes"], "int8_pool_bytes": q["pool_bytes"],
+        "max_context_tokens": max_ctx, "blocks_per_sequence": ctx_blocks,
+        # host-tier numbers from the pressured fp leg (equal budget -> it
+        # must spill; the int8 leg's headroom is the capacity win)
+        "swapped_out": fp["spilled"], "swapped_in": fp["restored"],
+        "swap_dropped": fp["dropped"],
+        "resident_host_blocks": fp["resident_host"],
+        "host_kv_occupancy": round(fp["host_occupancy"], 6),
+        "host_kv_capacity_blocks": host_blocks,
+        "swap_outs_live": fp["swap_outs_live"],
+        "swap_in_stall_s": round(fp["swap_in_stall"], 6),
+        "swap_in_p50_s": round(fp["swap_in_p50"], 6),
+        "swap_out_stall_s": round(fp["swap_out_stall"], 6),
+        "spilled_after_pressure": fp["spilled_after_pressure"],
+        # serving latency (fp leg headline; int8 leg for comparison)
+        "ttft_p50_s": round(fp["ttft"][0], 6),
+        "ttft_p99_s": round(fp["ttft"][1], 6),
+        "tpot_p50_s": round(fp["tpot"][0], 6),
+        "tpot_p99_s": round(fp["tpot"][1], 6),
+        "ttft_p50_int8_s": round(q["ttft"][0], 6),
+        "ttft_p99_int8_s": round(q["ttft"][1], 6),
+        # prefix reuse across the spill/restore round trip
+        "prefill_reduction": round(reduction, 6),
+        "prefill_tokens_saved": int(fp["saved"]),
+        "executed_prefill_tokens": int(fp["executed"]),
+        "prefix_hit_rate": round(fp["hit_rate"], 6),
+        "int8_swapped_out": q["spilled"], "int8_swapped_in": q["restored"],
+        "requests": n_req, "fillers": n_filler, "seed": args.seed,
+        "prefix_len": prefix_len, "prompt_tokens_total": prompt_total,
+        "wall_s": round(fp["wall"] + q["wall"], 2), "chips": n_chips,
+        "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}",
+    }
+    _embed_telemetry(extra)
+    payload = {
+        "metric": "serving_longctx_concurrent_seqs_per_chip",
+        "value": round(q["concurrent_seqs"] / max(n_chips, 1), 4),
+        "unit": "max-context sequences/chip at the fp leg's KV HBM budget",
         "vs_baseline": None,
         "extra": extra,
     }
@@ -721,6 +950,13 @@ def main():
     ap.add_argument("--prefix-len", type=int, default=0,
                     help="shared prefix length in tokens; 0 = per-platform "
                          "default (--prefix-mix)")
+    ap.add_argument("--long-context", action="store_true",
+                    help="long-context KV tiering workload: seeded long "
+                         "prompts over a shared prefix, fp vs int8 KV at an "
+                         "equal HBM budget with the host-DRAM spill tier on")
+    ap.add_argument("--longctx-max", type=int, default=131072,
+                    help="max prompt length for the TPU --long-context leg "
+                         "(CPU runs scale down automatically)")
     ap.add_argument("--fleet", action="store_true",
                     help="with --replay: single-replica saturation leg, then "
                          "SLORouter over a prefill/decode fleet at 2x the "
@@ -751,7 +987,9 @@ def main():
                             chrome_trace_path=os.environ.get(
                                 "DS_TPU_TELEMETRY_TRACE", ""))
 
-    metric = ("serving_fleet_replay_tokens_per_sec_per_chip"
+    metric = ("serving_longctx_concurrent_seqs_per_chip"
+              if args.long_context
+              else "serving_fleet_replay_tokens_per_sec_per_chip"
               if args.replay and args.fleet
               else "serving_replay_tokens_per_sec_per_chip" if args.replay
               else "splitfuse_serving_tokens_per_sec")
@@ -763,6 +1001,14 @@ def main():
                     "extra": {"error": f"{type(e).__name__}: {e}"[:300]}})
         return
     on_tpu = devs[0].platform in ("tpu", "axon")
+    if args.long_context:
+        try:
+            long_context_bench(args, on_tpu)
+        except Exception as e:
+            bench.emit({"metric": metric, "value": 0.0,
+                        "unit": "sequences/chip", "vs_baseline": None,
+                        "extra": {"error": f"{type(e).__name__}: {e}"[:400]}})
+        return
     if args.replay:
         try:
             if args.fleet:

@@ -48,10 +48,18 @@ ordered percentiles for both legs, shed rate in [0, 1], every shipped KV
 page bound) plus the fleet acceptance ratchet — saturation-rate multiplier
 >= 2x the single replica, shed rate <= 0.1, at least one real handoff,
 fleet TTFT p99 no worse than the saturated single replica
-(``check_fleet_baseline``) — then exits 0/2 without comparing. The tier-1
-lane runs ``--dry-run`` against the repo's own BASELINE.json so a malformed
-baseline, summary, or tuning table fails fast on CPU
-(docs/OBSERVABILITY.md).
+(``check_fleet_baseline``) — and validates the checked-in long-context KV
+tiering baseline (``onchip_results/serving_longctx_baseline.json``):
+payload shape (finite ordered percentiles, host occupancy in [0, 1], the
+swap accounting identity ``swapped_out == swapped_in + swap_dropped +
+resident_host_blocks``) plus the tiering acceptance ratchet — int8
+capacity multiplier >= 2x at the fp leg's KV HBM budget, at least one
+spill and one restore recorded, zero live swap-outs, a positive prefill
+reduction across the spill/restore round trip (``check_longctx_baseline``;
+stall growth between runs gates via ``--max-swap-stall-growth``) — then
+exits 0/2 without comparing. The tier-1 lane runs ``--dry-run`` against
+the repo's own BASELINE.json so a malformed baseline, summary, or tuning
+table fails fast on CPU (docs/OBSERVABILITY.md).
 """
 
 import argparse
@@ -90,6 +98,10 @@ GATES = {
     # multiplier over the monolithic single replica shrinking means the
     # disaggregation dividend regressed
     "rate_multiplier": ("down", "max_rate_multiplier_drop"),
+    # long-context tiering (bench_serving --long-context): total seconds
+    # stalled restoring spilled KV blocks from host DRAM growing means the
+    # swap path got slower (or restores stopped overlapping decode)
+    "swap_in_stall_s": ("up", "max_swap_stall_growth"),
 }
 
 #: extra/doc keys lifted verbatim into the metric dict when positive
@@ -103,6 +115,10 @@ PREFIX_KEYS = ("prefix_hit_rate", "prefill_reduction")
 #: fleet replay payload keys (bench_serving --fleet --replay); lifted only
 #: when present (the rate multiplier rides the fleet payload's extra)
 FLEET_KEYS = ("rate_multiplier",)
+
+#: long-context tiering payload keys (bench_serving --long-context); lifted
+#: only when present
+LONGCTX_KEYS = ("swap_in_stall_s",)
 
 
 def load_doc(path):
@@ -161,7 +177,7 @@ def extract_metrics(doc):
                     m["peak_hbm_bytes"] = v
             except (TypeError, ValueError):
                 pass
-        for key in SERVING_KEYS + PREFIX_KEYS + FLEET_KEYS:
+        for key in SERVING_KEYS + PREFIX_KEYS + FLEET_KEYS + LONGCTX_KEYS:
             if key in src and key not in m:
                 try:
                     v = float(src[key])
@@ -473,6 +489,63 @@ def validate_fleet_payload(doc):
     return None
 
 
+def validate_longctx_payload(doc):
+    """Shape-check a bench_serving --long-context payload: a SUCCESSFUL run
+    (value > 0) must carry finite ordered latency percentiles, a host-tier
+    occupancy in [0, 1], non-negative stall seconds, and the swap
+    accounting identity — every block swapped out is either swapped back
+    in, explicitly dropped (host tier full), or still resident on host
+    (``swapped_out == swapped_in + swap_dropped + resident_host_blocks``; a
+    mismatch means the spill path leaked or resurrected blocks). Pure dict
+    checks — runs in the tier-1 dry-run lane without jax. Returns an error
+    string or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "serving_longctx" not in str(doc.get("metric", "")):
+        return None
+    try:
+        if float(doc.get("value", 0)) <= 0:
+            return None
+    except (TypeError, ValueError):
+        return None
+    extra = doc.get("extra")
+    if not isinstance(extra, dict):
+        return "long-context payload has no extra dict"
+    def bad_num(v):
+        return not isinstance(v, (int, float)) or isinstance(v, bool) or \
+            not (v == v and abs(v) != float("inf"))
+    for key in ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
+                "swap_in_stall_s", "swap_out_stall_s", "host_kv_occupancy",
+                "swapped_out", "swapped_in", "swap_dropped",
+                "resident_host_blocks", "swap_outs_live",
+                "capacity_multiplier", "concurrent_sequences_per_chip",
+                "concurrent_sequences_per_chip_fp", "prefill_reduction"):
+        if bad_num(extra.get(key)):
+            return f"long-context payload: extra[{key!r}] missing or " \
+                   f"not finite (got {extra.get(key)!r})"
+    for prefix in ("ttft", "tpot"):
+        if extra[f"{prefix}_p50_s"] > extra[f"{prefix}_p99_s"]:
+            return f"long-context payload: {prefix} p50 > p99"
+    if not 0.0 <= extra["host_kv_occupancy"] <= 1.0:
+        return "long-context payload: host_kv_occupancy outside [0, 1]"
+    for key in ("swap_in_stall_s", "swap_out_stall_s", "swapped_out",
+                "swapped_in", "swap_dropped", "resident_host_blocks"):
+        if extra[key] < 0:
+            return f"long-context payload: extra[{key!r}] negative"
+    if extra["swapped_out"] != extra["swapped_in"] + extra["swap_dropped"] \
+            + extra["resident_host_blocks"]:
+        return (f"long-context payload: swapped_out {extra['swapped_out']} "
+                f"!= swapped_in {extra['swapped_in']} + dropped "
+                f"{extra['swap_dropped']} + resident "
+                f"{extra['resident_host_blocks']} — the host tier leaked "
+                f"or resurrected KV blocks")
+    if extra["capacity_multiplier"] <= 0:
+        return "long-context payload: capacity_multiplier not positive"
+    if not -1.0 <= extra["prefill_reduction"] <= 1.0:
+        return "long-context payload: prefill_reduction outside [-1, 1]"
+    return None
+
+
 def _load_overlap_module():
     """Load telemetry/overlap.py standalone (stdlib-only at module scope,
     same pattern as kernel_table) so overlap validation runs in the tier-1
@@ -704,6 +777,69 @@ def check_fleet_baseline(baseline_path=None):
             "single_ttft_p99_s": extra["single_ttft_p99_s"]}, errors
 
 
+#: long-context tiering acceptance for the checked-in baseline: at the fp
+#: leg's KV HBM budget the int8 pool must fit >= 2x the max-context
+#: sequences, the recorded run must actually have spilled AND revived
+#: prefix blocks through the host tier, and no live sequence may have paid
+#: the preemption path while parked blocks could spill instead
+LONGCTX_MIN_CAPACITY_MULTIPLIER = 2.0
+LONGCTX_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                     "serving_longctx_baseline.json")
+
+
+def check_longctx_baseline(baseline_path=None):
+    """Validate the checked-in ``--long-context`` tiering baseline: payload
+    shape (``validate_longctx_payload`` incl. the swap accounting
+    identity), then the acceptance ratchet — int8 capacity multiplier >=
+    ``LONGCTX_MIN_CAPACITY_MULTIPLIER`` at the equal HBM budget, at least
+    one spill AND one restore recorded (the run exercised the tier), zero
+    live swap-outs, and a positive prefill reduction across the
+    spill/restore round trip. Pure dict checks over recorded values
+    (wall-clock legs cannot be re-derived jax-free). Returns
+    (report, errors) for the dry-run lane."""
+    path = baseline_path or LONGCTX_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no long-context baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable long-context baseline {path}"]
+    err = validate_longctx_payload(doc)
+    if err:
+        return {}, [f"longctx baseline: {err}"]
+    extra = doc.get("extra", {}) if isinstance(doc, dict) else {}
+    if "swapped_out" not in extra:
+        return {}, ["longctx baseline payload carries no tiering fields "
+                    "(regenerate with bench_serving --long-context)"]
+    errors = []
+    mult = extra["capacity_multiplier"]
+    if mult < LONGCTX_MIN_CAPACITY_MULTIPLIER:
+        errors.append(
+            f"longctx baseline: capacity multiplier {mult} < "
+            f"{LONGCTX_MIN_CAPACITY_MULTIPLIER} — int8 KV pages no longer "
+            f"fit 2x the sequences at the fp leg's HBM budget")
+    if extra["swapped_out"] < 1:
+        errors.append("longctx baseline: no KV blocks spilled — the run "
+                      "never pressured the host tier")
+    if extra["swapped_in"] < 1:
+        errors.append("longctx baseline: no KV blocks restored — spilled "
+                      "prefix chains never revived")
+    if extra["swap_outs_live"] != 0:
+        errors.append(
+            f"longctx baseline: {extra['swap_outs_live']} live swap-outs — "
+            f"a live sequence paid for pressure while parked blocks could "
+            f"spill (pressure order broken)")
+    if extra["prefill_reduction"] <= 0:
+        errors.append("longctx baseline: prefill reduction not positive — "
+                      "restored prefix chains saved no prefill work")
+    return {"capacity_multiplier": mult,
+            "concurrent_sequences_per_chip":
+                extra["concurrent_sequences_per_chip"],
+            "swapped_out": extra["swapped_out"],
+            "swapped_in": extra["swapped_in"],
+            "swap_in_stall_s": extra["swap_in_stall_s"],
+            "prefill_reduction": extra["prefill_reduction"]}, errors
+
+
 def check_overlap_analytic():
     """Drive the overlap analyzer end-to-end jax-free: build the analytic
     serialized schedule from a fixed collective inventory, attribute it,
@@ -829,6 +965,9 @@ def main(argv=None):
     ap.add_argument("--max-rate-multiplier-drop", type=float, default=0.10,
                     help="allowed relative drop in the fleet saturation-"
                          "rate multiplier (--fleet --replay payloads)")
+    ap.add_argument("--max-swap-stall-growth", type=float, default=0.25,
+                    help="allowed relative growth in host-tier swap-in "
+                         "stall seconds (--long-context payloads)")
     ap.add_argument("--dry-run", action="store_true",
                     help="validate inputs (parse + summary schema) only")
     args = ap.parse_args(argv)
@@ -842,7 +981,8 @@ def main(argv=None):
         if doc is None:
             return 2
         err = validate_summary(doc) or validate_serving_payload(doc) \
-            or validate_fleet_payload(doc) or validate_overlap_payload(doc)
+            or validate_fleet_payload(doc) or validate_longctx_payload(doc) \
+            or validate_overlap_payload(doc)
         if err:
             print(f"perf_gate: {label}: {err}", file=sys.stderr)
             return 2
@@ -866,11 +1006,14 @@ def main(argv=None):
         fleet_report, fleet_errors = check_fleet_baseline()
         for err in fleet_errors:
             print(f"perf_gate: fleet: {err}", file=sys.stderr)
+        longctx_report, longctx_errors = check_longctx_baseline()
+        for err in longctx_errors:
+            print(f"perf_gate: longctx: {err}", file=sys.stderr)
         lint_report, lint_errors = check_lint_baseline()
         for err in lint_errors:
             print(f"perf_gate: lint: {err}", file=sys.stderr)
         errors = table_errors + qgz_errors + overlap_errors + sched_errors \
-            + prefix_errors + fleet_errors + lint_errors
+            + prefix_errors + fleet_errors + longctx_errors + lint_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
@@ -879,6 +1022,7 @@ def main(argv=None):
                           "overlap_schedule": sched_report,
                           "prefix_cache": prefix_report,
                           "fleet": fleet_report,
+                          "longctx": longctx_report,
                           "lint": lint_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
@@ -894,7 +1038,8 @@ def main(argv=None):
         for k, v in extract_metrics(docs["summary"]).items():
             cand_m.setdefault(k, v)
 
-    thresholds = {"max_tokens_drop": args.max_tokens_drop,
+    thresholds = {"max_swap_stall_growth": args.max_swap_stall_growth,
+                  "max_tokens_drop": args.max_tokens_drop,
                   "max_mfu_drop": args.max_mfu_drop,
                   "max_goodput_drop": args.max_goodput_drop,
                   "max_hbm_growth": args.max_hbm_growth,

@@ -907,3 +907,185 @@ def test_bench_serving_fleet_cpu_acceptance(tmp_path):
     p.write_text(json.dumps(doc))
     r = _run([PERF_GATE, "--baseline", str(p), "--dry-run"])
     assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+# ---------------------------------------------------------------------------
+# long-context tiering gates (bench_serving --long-context /
+# check_longctx_baseline)
+# ---------------------------------------------------------------------------
+
+def _longctx_payload(mult=4.0, out=32, inn=8, dropped=0, resident=24,
+                     live=0, occupancy=0.46, stall=0.13, reduction=0.55,
+                     ttft99=0.135):
+    """A --long-context payload: the capacity ratchet fields, the pressured
+    fp leg's swap accounting (internally consistent by default:
+    swapped_out == swapped_in + dropped + resident, zero live swap-outs,
+    multiplier over the 2x ratchet), and finite ordered percentiles."""
+    return {"metric": "serving_longctx_concurrent_seqs_per_chip",
+            "value": 4.0,
+            "unit": "max-context sequences/chip at the fp leg's KV HBM "
+                    "budget",
+            "vs_baseline": None,
+            "extra": {"concurrent_sequences_per_chip": 4.0,
+                      "concurrent_sequences_per_chip_fp": 1.0,
+                      "capacity_multiplier": mult,
+                      "kv_hbm_budget_bytes": 77824,
+                      "fp_blocks": 19, "int8_blocks": 60,
+                      "swapped_out": out, "swapped_in": inn,
+                      "swap_dropped": dropped,
+                      "resident_host_blocks": resident,
+                      "host_kv_occupancy": occupancy,
+                      "host_kv_capacity_blocks": 52,
+                      "swap_outs_live": live,
+                      "swap_in_stall_s": stall, "swap_in_p50_s": 0.0016,
+                      "swap_out_stall_s": 0.0008,
+                      "ttft_p50_s": 0.0039, "ttft_p99_s": ttft99,
+                      "tpot_p50_s": 0.0027, "tpot_p99_s": 0.0027,
+                      "prefill_reduction": reduction,
+                      "prefill_tokens_saved": 384,
+                      "executed_prefill_tokens": 316,
+                      "prefix_hit_rate": 0.667, "requests": 6}}
+
+
+def test_perf_gate_dry_run_validates_longctx_payload_shape(tmp_path):
+    """--dry-run shape-checks a successful long-context payload without
+    jax: finite ordered percentiles, host occupancy in [0, 1], and the
+    swap accounting identity. Error payloads (value 0) are exempt."""
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_longctx_payload()))
+    r = _run([PERF_GATE, "--baseline", str(good), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    metrics = json.loads(r.stdout)["metrics"]["baseline"]
+    assert metrics["swap_in_stall_s"] == 0.13
+
+    doc = _longctx_payload()
+    del doc["extra"]["resident_host_blocks"]
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "resident_host_blocks" in r.stderr
+
+    doc = _longctx_payload(ttft99=0.001)  # p50 0.0039 > p99 0.001
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "p50 > p99" in r.stderr
+
+    doc = _longctx_payload(occupancy=1.5)
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "host_kv_occupancy" in r.stderr
+
+    # 32 != 8 + 0 + 20: the host tier leaked 4 blocks
+    doc = _longctx_payload(resident=20)
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "swapped_out" in r.stderr
+
+    err_doc = {"metric": "serving_longctx_concurrent_seqs_per_chip",
+               "value": 0.0, "unit": "sequences/chip", "vs_baseline": None,
+               "extra": {"error": "RuntimeError: backend init UNAVAILABLE"}}
+    errp = tmp_path / "err.json"
+    errp.write_text(json.dumps(err_doc))
+    r = _run([PERF_GATE, "--baseline", str(errp), "--dry-run"])
+    assert r.returncode == 0
+
+
+def test_perf_gate_swap_stall_gate(tmp_path):
+    """swap_in_stall_s gates upward: stall growth past
+    --max-swap-stall-growth regresses (restores stopped overlapping or the
+    swap path got slower)."""
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_longctx_payload()))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(base)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    compared = {v["metric"] for v in json.loads(r.stdout)["verdicts"]}
+    assert "swap_in_stall_s" in compared
+    # 0.13 -> 0.20 (+54%, threshold 25%)
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_longctx_payload(stall=0.20)))
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand)])
+    assert r.returncode == 3, (r.stdout, r.stderr)
+    bad = {v["metric"] for v in json.loads(r.stdout)["verdicts"]
+           if v["regressed"]}
+    assert bad == {"swap_in_stall_s"}
+    r = _run([PERF_GATE, "--baseline", str(base), "--candidate", str(cand),
+              "--max-swap-stall-growth", "0.60"])
+    assert r.returncode == 0
+
+
+def test_perf_gate_longctx_baseline_ratchet(tmp_path):
+    """check_longctx_baseline enforces the tiering acceptance ratchet:
+    capacity multiplier >= 2x, at least one spill AND one restore, zero
+    live swap-outs, positive prefill reduction."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_pg_longctx", PERF_GATE)
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_longctx_payload()))
+    report, errs = pg.check_longctx_baseline(str(good))
+    assert errs == [] and report["capacity_multiplier"] == 4.0
+
+    low = tmp_path / "low.json"
+    low.write_text(json.dumps(_longctx_payload(mult=1.8)))
+    _, errs = pg.check_longctx_baseline(str(low))
+    assert any("capacity multiplier" in e for e in errs)
+
+    low.write_text(json.dumps(_longctx_payload(out=0, inn=0, resident=0)))
+    _, errs = pg.check_longctx_baseline(str(low))
+    assert any("spilled" in e for e in errs)
+    assert any("restored" in e for e in errs)
+
+    low.write_text(json.dumps(_longctx_payload(live=2)))
+    _, errs = pg.check_longctx_baseline(str(low))
+    assert any("live swap-outs" in e for e in errs)
+
+    low.write_text(json.dumps(_longctx_payload(reduction=0.0)))
+    _, errs = pg.check_longctx_baseline(str(low))
+    assert any("prefill reduction" in e for e in errs)
+
+    # no baseline file -> skip, not error (pre-tiering checkouts)
+    report, errs = pg.check_longctx_baseline(str(tmp_path / "absent.json"))
+    assert errs == [] and "skipped" in report
+
+    # the repo's own checked-in baseline passes the ratchet
+    report, errs = pg.check_longctx_baseline()
+    assert errs == [], errs
+    assert report["capacity_multiplier"] >= \
+        pg.LONGCTX_MIN_CAPACITY_MULTIPLIER
+    assert report["swapped_out"] >= 1 and report["swapped_in"] >= 1
+    assert report["prefill_reduction"] > 0
+
+
+@pytest.mark.slow
+def test_bench_serving_longctx_cpu_acceptance(tmp_path):
+    """The long-context tiering workload end to end on CPU: one payload
+    whose capacity and swap-accounting fields are internally consistent,
+    accepted by perf_gate dry-run shape validation."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "bench_serving.py"),
+         "--long-context", "--seed", "3"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payloads = [json.loads(ln) for ln in r.stdout.splitlines()
+                if ln.startswith("{")]
+    assert len(payloads) == 1
+    doc = payloads[0]
+    assert doc["metric"] == "serving_longctx_concurrent_seqs_per_chip"
+    assert doc["value"] > 0
+    ex = doc["extra"]
+    assert ex["capacity_multiplier"] >= 2.0
+    assert ex["swapped_out"] == ex["swapped_in"] + ex["swap_dropped"] + \
+        ex["resident_host_blocks"]
+    assert ex["swapped_out"] >= 1 and ex["swapped_in"] >= 1
+    assert ex["swap_outs_live"] == 0
+    assert 0 <= ex["host_kv_occupancy"] <= 1
+    assert ex["prefill_reduction"] > 0
+    assert 0 < ex["ttft_p50_s"] <= ex["ttft_p99_s"]
+    p = tmp_path / "longctx.json"
+    p.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(p), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)

@@ -1,14 +1,18 @@
 """Prefix-cached paged KV (copy-on-write block sharing).
 
-Pins the three allocator states (free / live / cached) and their invariant
-``free + live + cached == total``, the chain-digest prefix cache (strict-
-prefix matching, park/revive/evict lifecycle, insert dedup, children-first
-LRU order), the O(free) incremental allocator stats against a sorted-scan
-reference, a randomized property test over allocate/share/deref/flush/evict,
-and — at the engine level — physical block sharing plus bit-exact generation
-parity cache-on vs cache-off (greedy and seeded sampling, including
-preemption interleavings) on the 8-device CPU mesh. Eviction of idle cached
-blocks must run BEFORE the scheduler host-swaps any live victim.
+Pins the four allocator states (free / live / cached / host) and their
+invariants — device side ``free + live + cached == num_blocks`` always, and
+``free + live + cached + host == total`` with the host-DRAM spill tier — the
+chain-digest prefix cache (strict-prefix matching, park/revive/evict
+lifecycle, insert dedup, children-first LRU order, LRU-ordered spill to
+host), the O(free) incremental allocator stats against a sorted-scan
+reference, a randomized property test over
+allocate/share/deref/flush/evict/spill/restore (including
+no-resurrection-of-consumed-spill-handles), and — at the engine level —
+physical block sharing plus bit-exact generation parity cache-on vs
+cache-off (greedy and seeded sampling, including preemption interleavings)
+on the 8-device CPU mesh. Eviction of idle cached blocks must run BEFORE
+the scheduler host-swaps any live victim.
 """
 
 import numpy as np
@@ -34,12 +38,15 @@ def served():
 
 
 def make_engine(cfg, model, params, prefix_caching=False, num_kv_blocks=64,
-                max_tokens=16, max_context=128):
+                max_tokens=16, max_context=128, kv_dtype="fp",
+                host_kv_blocks=0):
     return InferenceEngineV2(model, params, config={
         "state_manager": {"max_ragged_sequence_count": 4,
                           "max_ragged_batch_size": max_tokens,
                           "max_context": max_context,
-                          "num_kv_blocks": num_kv_blocks},
+                          "num_kv_blocks": num_kv_blocks,
+                          "kv_dtype": kv_dtype,
+                          "host_kv_blocks": host_kv_blocks},
         "kv_cache": {"block_size": 8, "cache_dtype": "fp32"},
         "prefix_caching": prefix_caching})
 
@@ -51,14 +58,16 @@ def make_engine(cfg, model, params, prefix_caching=False, num_kv_blocks=64,
 def test_allocator_refcount_lifecycle_and_double_free():
     a = BlockedAllocator(8)
     b1, b2 = a.allocate(2)
-    assert a.counts() == {"free": 6, "live": 2, "cached": 0, "total": 8}
+    assert a.counts() == {"free": 6, "live": 2, "cached": 0, "host": 0,
+                          "total": 8}
     a.ref([b1])
     assert a.refcount(b1) == 2
     a.free([b1])  # shared: one holder left, stays live
     assert a.refcount(b1) == 1
     assert a.counts()["live"] == 2
     a.free([b1])
-    assert a.counts() == {"free": 7, "live": 1, "cached": 0, "total": 8}
+    assert a.counts() == {"free": 7, "live": 1, "cached": 0, "host": 0,
+                          "total": 8}
     with pytest.raises(ValueError, match="double free"):
         a.free([b1])
     with pytest.raises(ValueError, match="non-live"):
@@ -159,7 +168,8 @@ def test_prefix_cache_strict_prefix_match_and_lifecycle():
     a.free([blocks[2]])  # uncommitted tail: straight to the free list
     a.free([blocks[1]])
     a.free([blocks[0]])
-    assert a.counts() == {"free": 14, "live": 0, "cached": 2, "total": 16}
+    assert a.counts() == {"free": 14, "live": 0, "cached": 2, "host": 0,
+                          "total": 16}
     assert c.evictable_blocks == 2
 
     # a hit revives parked blocks
@@ -179,7 +189,8 @@ def test_prefix_cache_strict_prefix_match_and_lifecycle():
     # allocator-driven eviction under pool pressure: 15 free + 1 parked
     out = a.allocate(16)
     assert len(out) == 16 and c.evictions == 2
-    assert a.counts() == {"free": 0, "live": 16, "cached": 0, "total": 16}
+    assert a.counts() == {"free": 0, "live": 16, "cached": 0, "host": 0,
+                          "total": 16}
     with pytest.raises(ValueError, match="only 0 free"):
         a.allocate(1)
 
@@ -196,22 +207,45 @@ def test_prefix_cache_insert_dedup_returns_canonical():
     assert d2 == d and canon2 == b_first
     assert a.refcount(b_first) == 2  # dedup took a reference for the caller
     a.free([b_dup])  # caller drops its private copy
-    assert a.counts() == {"free": 7, "live": 1, "cached": 0, "total": 8}
+    assert a.counts() == {"free": 7, "live": 1, "cached": 0, "host": 0,
+                          "total": 8}
 
 
 # ---------------------------------------------------------------------------
 # randomized property test
 # ---------------------------------------------------------------------------
 
-def test_random_share_flush_evict_preserve_invariants():
-    """Random allocate/share/flush/evict through the PrefixCache, checking
-    after every op: free + live + cached == total, the free list holds no
+class _StubSpiller:
+    """Page-mover stand-in for allocator/cache property tests: records the
+    spill/restore traffic and hands back verifiable payloads."""
+
+    def __init__(self):
+        self.spill_calls = 0
+        self.restore_calls = 0
+
+    def spill_block(self, block):
+        self.spill_calls += 1
+        return ("pages", block)
+
+    def restore_block(self, payload, block):
+        assert payload[0] == "pages"
+        self.restore_calls += 1
+
+
+def test_random_share_flush_evict_spill_preserve_invariants():
+    """Random allocate/share/flush/evict/spill/restore through the
+    PrefixCache over a host-capable allocator, checking after every op:
+    device side ``free + live + cached == num_blocks`` (hard), the census
+    ``free + live + cached + host == total``, the swap accounting identity
+    ``spilled == restored + dropped + resident``, the free list holds no
     duplicates and only refcount-0 blocks, refcounts never negative, and the
-    cache's evictable count equals the allocator's parked count."""
+    cache's evictable/host counts equal the allocator's."""
     rng = np.random.default_rng(42)
-    total, bs = 24, 4
-    a = BlockedAllocator(total)
+    total, bs, host_cap = 24, 4, 6
+    a = BlockedAllocator(total, host_capacity=host_cap)
     c = PrefixCache(a, bs)
+    sp = _StubSpiller()
+    c.bind_spiller(sp)
     live = {}   # uid -> block list
     streams = []
     next_uid, next_tok = 0, 0
@@ -225,12 +259,20 @@ def test_random_share_flush_evict_preserve_invariants():
     def check():
         cnt = a.counts()
         assert cnt["free"] + cnt["live"] + cnt["cached"] == total
+        assert cnt["free"] + cnt["live"] + cnt["cached"] + cnt["host"] \
+            == cnt["total"] == total + cnt["host"]
+        assert cnt["host"] <= host_cap
         assert min(cnt.values()) >= 0
+        hs = a.host_swap_stats()
+        assert hs["spilled"] == hs["restored"] + hs["dropped"] + hs["resident"]
+        assert hs["spilled"] == sp.spill_calls
+        assert hs["restored"] == sp.restore_calls == c.restores
         free_list = list(a._free)
         assert len(free_list) == len(set(free_list)), "free-list duplicate"
         assert all(a.refcount(b) == 0 for b in free_list)
         assert all(a.refcount(b) >= 0 for b in range(total))
         assert c.evictable_blocks == cnt["cached"]
+        assert c.host_cached_blocks == cnt["host"]
         assert a.stats()["free"] == cnt["free"]
 
     for _ in range(400):
@@ -247,12 +289,17 @@ def test_random_share_flush_evict_preserve_invariants():
                 toks = fresh(k * bs)
             streams.append(toks)
             matched, digests = c.lookup_chain(np.append(toks, np.int32(0)))
-            need = k - len(matched)
+            # acquire first: host-resident links restore (consuming free
+            # blocks) and the chain may truncate when the pool is tight
+            blocks = list(c.acquire_chain(matched, digests)) if matched \
+                else []
+            digests = list(digests[:len(blocks)])
+            need = k - len(blocks)
             if a.free_blocks + c.evictable_blocks < need:
+                if blocks:
+                    a.free(list(reversed(blocks)))
+                check()
                 continue
-            if matched:
-                c.acquire_chain(matched, digests)
-            blocks, digests = list(matched), list(digests)
             for b in (a.allocate(need) if need else []):
                 i = len(blocks)
                 parent = digests[-1] if digests else b""
@@ -267,15 +314,98 @@ def test_random_share_flush_evict_preserve_invariants():
             uid = list(live)[int(rng.integers(len(live)))]
             a.free(list(reversed(live.pop(uid))))  # children park first
         else:
+            # pressure: parked LRU blocks spill to host while it has room,
+            # then evict outright
             c.evict(int(rng.integers(1, 4)))
         check()
 
+    assert sp.spill_calls > 0, "400 steps must exercise the spill tier"
+    assert sp.restore_calls > 0, "reused streams must restore host blocks"
     for uid in list(live):
         a.free(list(reversed(live.pop(uid))))
         check()
     c.evict(c.evictable_blocks)
-    assert a.counts() == {"free": total, "live": 0, "cached": 0,
-                          "total": total}
+    cnt = a.counts()
+    assert cnt["free"] == total and cnt["live"] == 0 and cnt["cached"] == 0
+    assert cnt["host"] == c.host_cached_blocks
+    check()
+
+
+def test_host_tier_spill_restore_guards_and_no_resurrection():
+    """Spill handles are single-shot: restore consumes, a second restore (or
+    restore-after-drop) raises — swapped-out refs cannot resurrect. Spill is
+    legal only from the parked state, and a full host tier refuses."""
+    a = BlockedAllocator(8, host_capacity=1)
+    c = PrefixCache(a, block_size=4)
+    b1, b2 = a.allocate(2)
+    with pytest.raises(ValueError, match="non-parked"):
+        a.spill(b1, "pages")  # live, not parked
+    d1, _ = c.insert(b"", np.arange(4, dtype=np.int32), b1)
+    c.insert(d1, np.arange(4, 8, dtype=np.int32), b2)
+    a.free([b2])  # park both (children first)
+    a.free([b1])
+    ref = a.spill(b1, "pages-b1")
+    assert a.counts()["host"] == 1 and a.counts()["free"] == 7
+    with pytest.raises(ValueError, match="host tier full"):
+        a.spill(b2, "pages-b2")  # parked, but capacity is 1
+    assert a.restore(ref) == "pages-b1"
+    with pytest.raises(ValueError, match="non-host record"):
+        a.restore(ref)  # consumed: no resurrection
+    with pytest.raises(ValueError, match="non-host record"):
+        a.drop_host(ref)
+    hs = a.host_swap_stats()
+    assert hs == {"spilled": 1, "restored": 1, "dropped": 0, "resident": 0,
+                  "capacity": 1}
+
+
+def test_prefix_cache_spills_lru_first_and_restores_on_match():
+    """Eviction pressure demotes the LEAST recently parked block to host
+    first; a later chain match transparently restores it into a fresh
+    device block with the contents the spiller preserved."""
+    a = BlockedAllocator(8, host_capacity=4)
+    c = PrefixCache(a, block_size=4)
+    sp = _StubSpiller()
+    c.bind_spiller(sp)
+    toks = np.arange(8, dtype=np.int32)
+    b0, b1 = a.allocate(2)
+    d0, _ = c.insert(b"", toks[:4], b0)
+    c.insert(d0, toks[4:8], b1)
+    a.free([b1])
+    a.free([b0])  # park order: b1 (LRU) then b0
+    assert c.evict(1) == 1
+    assert sp.spill_calls == 1 and a.host_blocks == 1
+    # the leaf b1 parked FIRST, so it spilled first (children-first flush
+    # order makes leaves LRU) — its digest is still matchable
+    got, digs = c.lookup_chain(toks.tolist() + [0])
+    assert got[0] == b0 and got[1] is None, \
+        "host-resident link must appear as None in a pure lookup"
+    resolved = c.acquire_chain(got, digs)
+    assert len(resolved) == 2 and resolved[1] is not None
+    assert sp.restore_calls == 1 and a.host_blocks == 0
+    assert c.restores == 1
+    cnt = a.counts()
+    assert cnt["live"] == 2 and cnt["host"] == 0
+
+
+def test_full_host_tier_falls_back_to_plain_eviction():
+    """When the host tier has no room the cache must evict outright (never
+    silently drop a spill) so the accounting identity stays exact."""
+    a = BlockedAllocator(8, host_capacity=1)
+    c = PrefixCache(a, block_size=4)
+    sp = _StubSpiller()
+    c.bind_spiller(sp)
+    parent = b""
+    blocks = a.allocate(3)
+    for i, b in enumerate(blocks):
+        toks = np.arange(i * 4, (i + 1) * 4, dtype=np.int32)
+        parent, _ = c.insert(parent, toks, b)
+    a.free(list(reversed(blocks)))
+    assert c.evict(3) == 3
+    assert sp.spill_calls == 1          # host capacity 1
+    assert c.evictions == 2             # remainder evicted, not dropped
+    hs = a.host_swap_stats()
+    assert hs["spilled"] == 1 and hs["dropped"] == 0
+    assert a.counts()["free"] == 8
 
 
 # ---------------------------------------------------------------------------

@@ -290,6 +290,79 @@ def test_disabled_serving_hooks_zero_overhead(served, monkeypatch):
     assert telemetry.summary() == {"enabled": False}
 
 
+def test_disabled_swap_hooks_zero_clock_reads(served, monkeypatch):
+    """The KV host-tier swap timers must be free when telemetry is off: a
+    workload that spills AND restores through the host tier performs zero
+    clock reads in kv_cache (``kv_cache._now`` patched to raise) and leaves
+    the swap histograms unrecorded."""
+    from deepspeed_tpu.inference.v2.ragged import kv_cache as kvc_mod
+
+    cfg, model, params = served
+    assert not telemetry.enabled()
+
+    def _boom():
+        raise AssertionError(
+            "disabled swap path must not read the clock")
+    monkeypatch.setattr(kvc_mod, "_now", _boom)
+
+    engine = InferenceEngineV2(model, params, config={
+        "state_manager": {"max_ragged_sequence_count": 4,
+                          "max_ragged_batch_size": 16,
+                          "max_context": 128, "num_kv_blocks": 12,
+                          "host_kv_blocks": 16},
+        "kv_cache": {"block_size": 8, "cache_dtype": "fp32"},
+        "prefix_caching": True})
+    sched = SplitFuseScheduler(engine, token_budget=16)
+    rng = np.random.default_rng(21)
+    warm = rng.integers(0, cfg.vocab_size, 40).astype(np.int32)
+    sched.submit(0, warm, max_new_tokens=2)
+    sched.run_to_completion()   # parks warm's full blocks
+    sched.submit(1, rng.integers(0, cfg.vocab_size, 60).astype(np.int32),
+                 max_new_tokens=2)
+    sched.run_to_completion()   # pressure: parked blocks spill to host
+    assert engine.kv_stats()["kv_spilled"] >= 1
+    sched.submit(2, np.concatenate(
+        [warm, rng.integers(0, cfg.vocab_size, 6).astype(np.int32)]),
+        max_new_tokens=2)
+    sched.run_to_completion()   # shared prefix restores from the host tier
+    assert engine.kv_stats()["kv_restored"] >= 1
+    assert telemetry.summary() == {"enabled": False}
+
+
+def test_swap_hists_recorded_when_enabled(served):
+    """The enabled counterpart: the same spill/restore workload lands
+    ``serving/kv_swap_out_s`` and ``serving/kv_swap_in_s`` samples and the
+    ``serving/host_kv_blocks`` gauge."""
+    cfg, model, params = served
+    telemetry.configure(enabled=True, sample_sync=False,
+                        jax_annotations=False)
+    engine = InferenceEngineV2(model, params, config={
+        "state_manager": {"max_ragged_sequence_count": 4,
+                          "max_ragged_batch_size": 16,
+                          "max_context": 128, "num_kv_blocks": 12,
+                          "host_kv_blocks": 16},
+        "kv_cache": {"block_size": 8, "cache_dtype": "fp32"},
+        "prefix_caching": True})
+    sched = SplitFuseScheduler(engine, token_budget=16)
+    rng = np.random.default_rng(21)
+    warm = rng.integers(0, cfg.vocab_size, 40).astype(np.int32)
+    sched.submit(0, warm, max_new_tokens=2)
+    sched.run_to_completion()
+    sched.submit(1, rng.integers(0, cfg.vocab_size, 60).astype(np.int32),
+                 max_new_tokens=2)
+    sched.run_to_completion()
+    sched.submit(2, np.concatenate(
+        [warm, rng.integers(0, cfg.vocab_size, 6).astype(np.int32)]),
+        max_new_tokens=2)
+    sched.run_to_completion()
+    srv = telemetry.summary()["serving"]
+    out_h = srv["histograms"]["serving/kv_swap_out_s"]
+    in_h = srv["histograms"]["serving/kv_swap_in_s"]
+    assert out_h["count"] >= 1 and np.isfinite(out_h["p50_s"])
+    assert in_h["count"] >= 1 and np.isfinite(in_h["p50_s"])
+    assert srv["gauges"]["serving/host_kv_blocks"]["peak"] >= 1
+
+
 # ---------------------------------------------------------------------------
 # replica skew gauge
 # ---------------------------------------------------------------------------
