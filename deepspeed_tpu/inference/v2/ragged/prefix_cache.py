@@ -128,18 +128,35 @@ class PrefixCache:
     def acquire_chain(self, blocks, digests):
         """Take references on a matched chain (parked blocks revive,
         host-resident blocks swap back into fresh device blocks) and record
-        the hit. Returns the resolved device block ids — a prefix of the
-        match when the pool can't hold a restore (the chain truncates there
-        and the dropped tail simply re-prefills)."""
+        the hit — or a miss when nothing resolves. Returns the resolved
+        device block ids — a prefix of the match when the pool can't hold a
+        restore (the chain truncates there and the dropped tail simply
+        re-prefills).
+
+        Device-resident links are pinned live BEFORE any restore runs:
+        ``_restore`` allocates, and allocation pressure re-enters ``evict``,
+        which may spill/free any still-parked block — including a
+        not-yet-acquired link of this very chain, leaving ``blocks`` holding
+        a stale id (worst case reallocated mid-loop to another sequence:
+        silent cross-sequence KV corruption). Pinned links have refcount
+        >= 1 and sit outside the LRU, so reentrant eviction cannot touch
+        them; links past a truncation point are un-pinned (re-parked)."""
+        for b, d in zip(blocks, digests):
+            if b is not None:
+                self._acquire(b, d)
         resolved = []
         for b, d in zip(blocks, digests):
             if b is None:
                 b = self._restore(d)
                 if b is None:
-                    break  # no device room: shorten the match, keep going
-            else:
-                self._acquire(b, d)
+                    break  # no device room: truncate the match here
             resolved.append(b)
+        for b in blocks[len(resolved):]:
+            if b is not None:
+                self._alloc.free([b])  # un-pin: refcount-0 links re-park
+        if not resolved:
+            self.misses += 1
+            return []
         self.hits += 1
         self.tokens_saved += len(resolved) * self.block_size
         return resolved

@@ -110,9 +110,14 @@ class DSStateManager:
         call this every scheduler step (the PR 4 ``sample_memory`` sync-free
         pattern applied to the KV pool). ``occupancy`` counts blocks *live
         under sequences*; idle prefix-cached blocks are reclaimable and
-        reported separately (``cached_blocks``/``evictable_blocks``)."""
+        reported separately (``cached_blocks``/``evictable_blocks``), and
+        host-resident blocks hold no HBM at all — ``total_blocks``/
+        ``occupancy``/``occupied_blocks`` are the DEVICE census
+        (``num_blocks``, never the host-grown ``counts()`` total), so
+        spilling can't inflate the ratcheted ``serving/kv_occupancy``
+        gauge; the host tier reports via the ``host_kv_*`` fields."""
         a = self.kv_cache.allocator_stats()
-        total, free = a["total"], a["free"]
+        total, free = self.kv_cache.allocator.num_blocks, a["free"]
         parked = self.kv_cache.allocator.cached_blocks
         occupancy = 1.0 - (free + parked) / total if total else 0.0
         if occupancy > self.peak_occupancy:

@@ -225,6 +225,14 @@ def test_prefix_blocks_spill_and_revive_without_live_swaps(served, kv_dtype):
     stats = engine.kv_stats()
     assert stats["kv_spilled"] >= 1, "pressure must spill parked blocks"
     assert stats["host_kv_blocks"] >= 1
+    # host-resident blocks hold no HBM: total/occupancy/occupied stay the
+    # DEVICE census, so spilling can't inflate the ratcheted occupancy gauge
+    alloc = engine._state.kv_cache.allocator
+    assert stats["total_blocks"] == alloc.num_blocks
+    assert stats["occupied_blocks"] == alloc.live_blocks
+    assert stats["occupancy"] == pytest.approx(
+        alloc.live_blocks / alloc.num_blocks)
+    assert 0.0 <= stats["peak_occupancy"] <= 1.0
     sched.submit(2, reuse, max_new_tokens=4)
     out = sched.run_to_completion()[2].tolist()
     stats = engine.kv_stats()

@@ -387,6 +387,72 @@ def test_prefix_cache_spills_lru_first_and_restores_on_match():
     assert cnt["live"] == 2 and cnt["host"] == 0
 
 
+def test_acquire_chain_pins_links_before_reentrant_restore_eviction():
+    """``_restore`` allocates, and allocation pressure re-enters ``evict``:
+    with zero free blocks the eviction victim must be an UNRELATED parked
+    block, never a not-yet-acquired device link of the chain being acquired
+    — the stale-id path would ref a freed (or worse, reallocated) block and
+    silently attach another prompt's KV pages to the sequence."""
+    a = BlockedAllocator(3, host_capacity=4)
+    c = PrefixCache(a, block_size=4)
+    sp = _StubSpiller()
+    c.bind_spiller(sp)
+    toks = np.arange(8, dtype=np.int32)
+    b0, b1, u = a.allocate(3)
+    d0, _ = c.insert(b"", toks[:4], b0)
+    d1, _ = c.insert(d0, toks[4:8], b1)
+    c.insert(b"", np.arange(100, 104, dtype=np.int32), u)  # unrelated chain
+    a.free([b0])  # park order: b0 is LRU-first, then b1, then u
+    a.free([b1])
+    a.free([u])
+    assert c.evict(1) == 1 and a.host_blocks == 1  # d0 -> host
+    x = a.allocate(1)[0]  # soak the freed id: zero free blocks remain
+    got, digs = c.lookup_chain(np.append(toks, np.int32(0)))
+    assert got[0] is None and got[1] == b1
+    resolved = c.acquire_chain(got, digs)
+    # the restore's allocate had to evict something — b1 (next in LRU
+    # order, but pinned by the in-flight acquisition) was immune, so the
+    # unrelated u spilled instead and the chain resolved intact
+    assert len(resolved) == 2 and resolved[1] == b1
+    assert resolved[0] not in (b1, x)
+    assert c._map[d0] == resolved[0] and c._map[d1] == b1
+    assert sp.spill_calls == 2 and sp.restore_calls == 1
+    assert a.refcount(b1) == 1 and a.refcount(resolved[0]) == 1
+    assert a.refcount(x) == 1
+    assert c.hits == 1 and c.misses == 0
+    assert a.counts() == {"free": 0, "live": 3, "cached": 0, "host": 1,
+                          "total": 4}
+
+
+def test_acquire_chain_failed_restore_unpins_and_counts_miss():
+    """When no link resolves (the chain's first link is host-resident and
+    the pool can't host the restore even after eviction) the acquisition is
+    a MISS — ``hit_rate`` must not credit it — and device links pinned
+    ahead of the failed restore re-park, still matchable."""
+    a = BlockedAllocator(2, host_capacity=4)
+    c = PrefixCache(a, block_size=4)
+    sp = _StubSpiller()
+    c.bind_spiller(sp)
+    toks = np.arange(8, dtype=np.int32)
+    b0, b1 = a.allocate(2)
+    d0, _ = c.insert(b"", toks[:4], b0)
+    c.insert(d0, toks[4:8], b1)
+    a.free([b0])  # park parent first: d0 spills before d1
+    a.free([b1])
+    assert c.evict(1) == 1 and a.host_blocks == 1  # d0 -> host
+    x = a.allocate(1)[0]  # zero free: a restore cannot find device room
+    got, digs = c.lookup_chain(np.append(toks, np.int32(0)))
+    assert got == [None, b1]
+    assert c.acquire_chain(got, digs) == []
+    assert c.hits == 0 and c.misses == 1 and c.hit_rate == 0.0
+    # d0's host record survived the failed restore (no half-consumed
+    # handle), b1 re-parked, and the unrelated live block was untouched
+    assert c.host_cached_blocks == 1 and sp.restore_calls == 0
+    assert c.evictable_blocks == 1 and a.refcount(x) == 1
+    assert a.counts() == {"free": 0, "live": 1, "cached": 1, "host": 1,
+                          "total": 3}
+
+
 def test_full_host_tier_falls_back_to_plain_eviction():
     """When the host tier has no room the cache must evict outright (never
     silently drop a spill) so the accounting identity stays exact."""
