@@ -2,14 +2,17 @@
 
 from deepspeed_tpu.checkpoint.universal import (
     ds_to_universal, get_fp32_state_dict_from_zero_checkpoint,
-    load_universal_checkpoint, save_universal_checkpoint)
+    latest_universal_tag, load_universal_checkpoint, read_universal_meta,
+    save_universal_checkpoint, topology_remap)
 from deepspeed_tpu.checkpoint.ds_interop import (
     DeepSpeedCheckpoint, ds_checkpoint_to_universal,
     get_fp32_state_dict_from_ds_checkpoint, load_deepspeed_checkpoint,
     read_deepspeed_checkpoint)
 
 __all__ = ["ds_to_universal", "get_fp32_state_dict_from_zero_checkpoint",
-           "load_universal_checkpoint", "save_universal_checkpoint",
+           "latest_universal_tag", "load_universal_checkpoint",
+           "read_universal_meta", "save_universal_checkpoint",
+           "topology_remap",
            "ds_checkpoint_to_universal",
            "get_fp32_state_dict_from_ds_checkpoint",
            "load_deepspeed_checkpoint", "read_deepspeed_checkpoint",

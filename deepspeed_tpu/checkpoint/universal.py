@@ -20,6 +20,7 @@ qwZ quantization.
 
 import json
 import os
+import shutil
 
 import numpy as np
 
@@ -30,6 +31,9 @@ from deepspeed_tpu.utils.tensor_fragment import (moment_leaves, opt_param_paths,
 
 UNIVERSAL_ARRAYS = "universal_fragments.npz"
 UNIVERSAL_META = "universal_meta.json"
+#: pointer file naming the newest durably-published universal tag — written
+#: with the same tmp+fsync+rename dance as the engine's 'latest'
+LATEST_UNIVERSAL = "latest_universal"
 
 
 def _keyed(tree):
@@ -71,13 +75,38 @@ def _streamed_slots(engine):
     return slots
 
 
+def _topology_meta(topology):
+    """The saving topology, recorded so a load at a different world can name
+    the remap it performed (:func:`topology_remap`)."""
+    return {
+        "world_size": topology.world_size(),
+        "axes": {a: topology.get_dim(a) for a in topology.axis_names},
+        "zero_hierarchy": topology.zero_hierarchy,
+    }
+
+
 def save_universal_checkpoint(engine, out_dir, tag=None):
     """Write universal fragments from a live engine (the online equivalent of
     reference ``ds_to_universal.py`` main). ``tag`` becomes a subdirectory,
-    mirroring ``save_checkpoint``'s dir/tag layout."""
+    mirroring ``save_checkpoint``'s dir/tag layout.
+
+    Crash-consistent: fragments + meta are written into a ``.tmp.<pid>``
+    sibling, fsynced, then atomically swapped into place (the checkpoint
+    engine's publish dance, same ``ckpt.publish`` fault point) — a crash at
+    ANY instant leaves either the previous complete tag or the new one,
+    never a torn npz. With ``tag``, the :data:`LATEST_UNIVERSAL` pointer in
+    the parent dir is updated (atomically) only AFTER the tag is durable,
+    so the elastic reshard path always restores from a complete tag."""
+    from deepspeed_tpu.runtime.checkpoint_engine.native_engine import (
+        _publish_dir, atomic_write_text)
+    root = out_dir
     if tag is not None:
         out_dir = os.path.join(out_dir, str(tag))
-    os.makedirs(out_dir, exist_ok=True)
+    parent = os.path.dirname(os.path.abspath(out_dir))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{os.path.abspath(out_dir)}.tmp.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)  # stale crash leftovers
+    os.makedirs(tmp)
     blobs = {}
     masters = engine.get_model_parameters(dtype=np.float32)  # gathers all tiers
     keyed = _keyed(masters)
@@ -114,7 +143,6 @@ def save_universal_checkpoint(engine, out_dir, tag=None):
                                        opt_param_paths(engine)).items():
         blobs[fk] = np.asarray(jax.device_get(leaf), dtype=np.float32)
 
-    np.savez(os.path.join(out_dir, UNIVERSAL_ARRAYS), **blobs)
     meta = {
         "counters": {
             "global_steps": engine.global_steps,
@@ -126,11 +154,76 @@ def save_universal_checkpoint(engine, out_dir, tag=None):
         # optax bias-correction step (distinct from global_steps when fp16
         # overflow skips occurred)
         "optimizer_step": _opt_step_count(engine.state.opt_state),
+        "topology": _topology_meta(engine.topology),
         "format": "deepspeed_tpu_universal_v1",
     }
-    with open(os.path.join(out_dir, UNIVERSAL_META), "w") as f:
-        json.dump(meta, f)
+    try:
+        for name, writer in ((UNIVERSAL_ARRAYS,
+                              lambda f: np.savez(f, **blobs)),
+                             (UNIVERSAL_META,
+                              lambda f: f.write(json.dumps(meta)))):
+            mode = "wb" if name.endswith(".npz") else "w"
+            with open(os.path.join(tmp, name), mode) as f:
+                writer(f)
+                f.flush()
+                os.fsync(f.fileno())
+        _publish_dir(tmp, out_dir)  # trips the ckpt.publish fault point
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if tag is not None:
+        atomic_write_text(os.path.join(root, LATEST_UNIVERSAL), str(tag))
     return out_dir
+
+
+def latest_universal_tag(root):
+    """The newest durably-published universal tag under ``root``, or None.
+    Reads the :data:`LATEST_UNIVERSAL` pointer; falls back to scanning for
+    complete tag dirs (both fragment files present — torn ``.tmp.`` dirs
+    are never candidates) newest-mtime-first when the pointer is missing
+    or stale."""
+    ptr = os.path.join(root, LATEST_UNIVERSAL)
+    if os.path.exists(ptr):
+        with open(ptr) as f:
+            tag = f.read().strip()
+        if tag and os.path.exists(os.path.join(root, tag, UNIVERSAL_META)):
+            return tag
+    candidates = []
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            d = os.path.join(root, name)
+            if ".tmp." in name or ".old." in name or not os.path.isdir(d):
+                continue
+            if os.path.exists(os.path.join(d, UNIVERSAL_ARRAYS)) and \
+                    os.path.exists(os.path.join(d, UNIVERSAL_META)):
+                candidates.append((os.path.getmtime(d), name))
+    return max(candidates)[1] if candidates else None
+
+
+def read_universal_meta(universal_dir):
+    with open(os.path.join(universal_dir, UNIVERSAL_META)) as f:
+        return json.load(f)
+
+
+def topology_remap(meta, topology):
+    """Describe the topology remap a load of ``meta`` onto ``topology``
+    performs (the elastic reshard path's accounting record): fragments are
+    name-keyed and fp32, so the remap is exact — this computes the world /
+    per-axis deltas, it does not transform data."""
+    saved = meta.get("topology") or {}
+    new_axes = {a: topology.get_dim(a) for a in topology.axis_names}
+    old_axes = saved.get("axes", {})
+    return {
+        "from_world": saved.get("world_size"),
+        "to_world": topology.world_size(),
+        "resharded": bool(saved) and saved.get("world_size") !=
+            topology.world_size(),
+        "axis_deltas": {a: (old_axes.get(a), new_axes[a])
+                        for a in new_axes
+                        if old_axes.get(a) != new_axes[a]},
+        "zero_hierarchy": (saved.get("zero_hierarchy"),
+                           topology.zero_hierarchy),
+    }
 
 
 def ds_to_universal(ckpt_dir, out_dir, engine):

@@ -116,7 +116,11 @@ def timed_op(fn):
                                   time.perf_counter() - t0, axis=axis,
                                   traced=True, wire_bytes=wire_bytes)
             return result
-        # host-level (non-traced) collective: where real comm faults strike
+        # host-level (non-traced) collective: where real comm faults strike.
+        # comm.partition models a whole slice dropping off the DCN fabric —
+        # the elastic reshard path (resilience/elastic_reshard.py) catches
+        # the InjectedFault and shrinks to the survivors instead of dying
+        _faults.maybe_fail("comm.partition", detail=fn.__name__)
         _faults.maybe_fail("comm.collective", detail=fn.__name__)
         if (log is None or not log.enabled) and not tm_on:
             return fn(*args, **kwargs)

@@ -25,7 +25,8 @@ from deepspeed_tpu.elasticity.elasticity import (ElasticityError,
                                                  compute_elastic_config)
 from deepspeed_tpu.launcher.runner import (build_ssh_command, node_env,
                                            parse_hostfile)
-from deepspeed_tpu.resilience import EXIT_CLEAN_PREEMPTION
+from deepspeed_tpu.resilience import (EXIT_CLEAN_PREEMPTION,
+                                      EXIT_RESHARD_SLICE_LOSS)
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.retry import BackoffPolicy, retry_call
 
@@ -38,16 +39,25 @@ class DSElasticAgent:
     Exit-code contract (docs/RESILIENCE.md): a worker exiting with
     :data:`EXIT_CLEAN_PREEMPTION` (83) performed a clean preemption
     hand-off — state is checkpointed — so the relaunch does NOT count
-    against ``max_restarts``. Any other non-zero exit is a failure and
-    burns restart budget. Relaunch delays follow the shared exponential
-    backoff + full jitter policy (utils/retry.py) instead of a fixed sleep,
-    so a flapping resource isn't hammered in lock-step.
+    against ``max_restarts``. A worker exiting
+    :data:`EXIT_RESHARD_SLICE_LOSS` (84) detected a reshardable slice loss
+    and saved an emergency universal checkpoint — the agent **shrinks**:
+    hard-crashed hosts are excluded and the survivors are relaunched at the
+    reduced world size, also budget-free (the fault is the platform's).
+    Excluded hosts are **re-admitted** — the expand leg — when the
+    membership source changes content (operator healed the hostfile) or an
+    injectable ``host_probe(host)`` reports them healthy again. Any other
+    non-zero exit is a failure and burns restart budget. Relaunch delays
+    follow the shared exponential backoff + full jitter policy
+    (utils/retry.py) instead of a fixed sleep, so a flapping resource isn't
+    hammered in lock-step.
     """
 
     def __init__(self, user_script, user_args=(), ds_config=None,
                  hostfile=None, hosts=None, master_addr="127.0.0.1",
                  master_port=29500, max_restarts=3, launcher="local",
-                 restart_backoff=1.0, backoff=None):
+                 restart_backoff=1.0, backoff=None, allow_reshard=True,
+                 host_probe=None, reshard_grace=10.0):
         assert (hostfile is None) != (hosts is None), \
             "pass exactly one of hostfile / hosts"
         self.user_script = user_script
@@ -65,17 +75,50 @@ class DSElasticAgent:
         self.backoff = backoff if backoff is not None else BackoffPolicy(
             base=restart_backoff, factor=2.0,
             max_delay=max(restart_backoff, 30.0), jitter="full")
+        self.allow_reshard = allow_reshard
+        self.host_probe = host_probe  # injectable: host -> bool (healthy?)
+        self.reshard_grace = reshard_grace  # s to let survivors flag exit 84
         self.restarts = 0       # failures charged against max_restarts
         self.preemptions = 0    # clean preemptions (budget-free relaunches)
+        self.reshards = 0       # slice-loss reshards (budget-free)
         self.restart_reasons = []
+        self.restart_counts = collections.Counter()
         self.world_history = []
+        self._excluded = []        # hosts dropped by a shrink, launch order
+        self._excluded_sig = None  # membership snapshot at exclusion time
 
     # -- membership ------------------------------------------------------
-    def current_hosts(self):
+    def _host_pool(self):
         if self.static_hosts is not None:
             return list(self.static_hosts)
-        pool = parse_hostfile(self.hostfile)
-        return list(pool)
+        return list(parse_hostfile(self.hostfile))
+
+    def current_hosts(self):
+        """The live membership: the host pool minus shrink-excluded hosts.
+        Re-admission (the expand leg of the shrink/expand state machine):
+        a changed pool CONTENT (the operator rewrote the hostfile after
+        healing the slice) clears all exclusions; otherwise each excluded
+        host is individually re-probed via ``host_probe`` when provided."""
+        pool = self._host_pool()
+        if self._excluded:
+            if tuple(pool) != self._excluded_sig:
+                logger.info(f"elastic agent: membership changed; re-admitting "
+                            f"{self._excluded}")
+                self._excluded = []
+            elif self.host_probe is not None:
+                healed = [h for h in self._excluded if self.host_probe(h)]
+                if healed:
+                    logger.info(f"elastic agent: probe healed {healed}; "
+                                f"re-admitting")
+                    self._excluded = [h for h in self._excluded
+                                      if h not in healed]
+        # exclusions are by launch position, not name: local drills reuse
+        # "localhost" aliases, so drop by identity in pool order
+        hosts = list(pool)
+        for h in self._excluded:
+            if h in hosts:
+                hosts.remove(h)
+        return hosts
 
     def _validate_world(self, n_hosts):
         ec = self.ds_config.get("elasticity", {})
@@ -94,6 +137,7 @@ class DSElasticAgent:
                            self.master_port)
             env["DS_ELASTIC_WORLD_SIZE"] = str(len(hosts))
             env["DS_ELASTIC_RESTART_COUNT"] = str(self.restarts)
+            env["DS_ELASTIC_RESHARD_COUNT"] = str(self.reshards)
             if resolved:
                 env["DS_ELASTIC_MICRO_BATCH"] = str(resolved["micro_batch"])
                 env["DS_ELASTIC_FINAL_BATCH"] = str(resolved["final_batch"])
@@ -152,13 +196,48 @@ class DSElasticAgent:
                     return 0  # clean gang exit
                 time.sleep(0.2)
 
+            # a hard death races the survivors' own detection of the slice
+            # loss: the SIGKILL'd hosts are observed first, while the
+            # survivors are still timing out their collectives. Give
+            # still-running workers a short grace window to flag the
+            # reshard themselves (exit 84) before the gang is torn down —
+            # otherwise every partial crash looks unflagged and burns
+            # restart budget instead of shrinking.
+            if self.allow_reshard and \
+                    any(rc not in (0, EXIT_CLEAN_PREEMPTION) for rc in bad):
+                deadline = time.time() + self.reshard_grace
+                while time.time() < deadline and \
+                        any(p.poll() is None for p in procs):
+                    time.sleep(0.1)
+            # classify each host's fate BEFORE killing the gang — kill
+            # overwrites the return codes the state machine keys on
+            rcs = [p.poll() for p in procs]
             self._kill(procs)
-            # exit-code contract: a gang where every failing worker exited
-            # EXIT_CLEAN_PREEMPTION checkpointed before dying — relaunch
-            # for free; anything else burns restart budget
+            # hard = hosts that actually died with the slice (SIGKILL /
+            # crash); flagged = survivors that DETECTED the loss, saved an
+            # emergency universal checkpoint, and exited 84 asking to be
+            # relaunched on the shrunken gang
+            hard = [h for h, rc in zip(hosts, rcs)
+                    if rc not in (None, 0, EXIT_CLEAN_PREEMPTION,
+                                  EXIT_RESHARD_SLICE_LOSS)]
+            flagged = any(rc == EXIT_RESHARD_SLICE_LOSS for rc in rcs)
+            # exit-code contract: every failing worker exited
+            # EXIT_CLEAN_PREEMPTION -> checkpointed before dying — relaunch
+            # for free; exit 84 is the explicit reshard signal — a worker
+            # VERIFIED the loss and saved an emergency universal checkpoint
+            # first, so shrinking is safe and budget-free (hard crashes
+            # alone stay plain failures: a worker bug must not silently
+            # shrink the job); anything else burns restart budget
             preempted = all(rc == EXIT_CLEAN_PREEMPTION for rc in bad)
-            reason = "preemption" if preempted else f"worker_exit_{bad[0]}"
+            reshard = not preempted and self.allow_reshard and flagged
+            if reshard:
+                reason = "reshard"
+            elif preempted:
+                reason = "preemption"
+            else:
+                reason = f"worker_exit_{bad[0]}"
             self.restart_reasons.append(reason)
+            self.restart_counts[reason] += 1
             self._record_restart(reason, len(hosts))
             if preempted:
                 self.preemptions += 1
@@ -172,6 +251,24 @@ class DSElasticAgent:
                     f"consuming restart budget "
                     f"({self.restarts}/{self.max_restarts} used)")
                 time.sleep(self.backoff.delay(1))
+                continue
+            if reshard:
+                self.reshards += 1
+                if self.reshards > max(10, 3 * self.max_restarts):
+                    logger.error("elastic agent: too many reshards; "
+                                 "giving up")
+                    return 1
+                if hard:
+                    self._excluded.extend(hard)
+                    self._excluded_sig = tuple(self._host_pool())
+                survivors = len(hosts) - len(hard)
+                logger.warning(
+                    f"elastic agent: reshardable slice loss (exit "
+                    f"{EXIT_RESHARD_SLICE_LOSS}, {len(hard)} hosts lost); "
+                    f"relaunching {survivors} survivors budget-free "
+                    f"(reshard #{self.reshards}); universal checkpoint "
+                    f"reshard-restores on the shrunken mesh")
+                time.sleep(self.backoff.delay(min(self.reshards, 4)))
                 continue
             self.restarts += 1
             if self.restarts > self.max_restarts:
@@ -192,8 +289,11 @@ class DSElasticAgent:
             from deepspeed_tpu import telemetry
             telemetry.record("Fault/worker", 1, kind="counter", reason=reason,
                              hosts=n_hosts, restarts=self.restarts,
-                             preemptions=self.preemptions)
+                             preemptions=self.preemptions,
+                             reshards=self.reshards)
             telemetry.count("elastic/restart", reason=reason)
+            telemetry.record("elastic/world_size", n_hosts, kind="gauge",
+                             event=reason)
         except Exception:
             pass
 

@@ -17,6 +17,12 @@ Four layers, all testable on CPU:
 - :mod:`~deepspeed_tpu.resilience.watchdog` — heartbeat thread that flags
   stalls, dumps all-thread stacks + the telemetry summary, and optionally
   aborts with :data:`EXIT_WATCHDOG_ABORT` for the elastic agent.
+- :mod:`~deepspeed_tpu.resilience.elastic_reshard` — elastic multi-slice
+  training: a ``slice.lost``/``comm.partition`` fault shrinks the job to
+  the surviving mesh (universal-checkpoint reshard-restore at the exact
+  step), and the reverse path re-expands; cross-process, workers report
+  :data:`EXIT_RESHARD_SLICE_LOSS` for the elastic agent's shrink/expand
+  state machine.
 
 This package imports only the standard library at module scope so the
 elastic agent and launcher can use it without pulling in jax.
@@ -24,11 +30,16 @@ elastic agent and launcher can use it without pulling in jax.
 
 from deepspeed_tpu.resilience import faults  # noqa: F401
 from deepspeed_tpu.resilience.faults import (  # noqa: F401
-    FaultInjector, InjectedFault, KNOWN_POINTS, maybe_fail, parse_spec)
+    FaultInjector, InjectedFault, KNOWN_POINTS, SLICE_LOSS_POINTS,
+    maybe_fail, parse_spec)
 from deepspeed_tpu.resilience.preemption import (  # noqa: F401
     EXIT_CLEAN_PREEMPTION, PreemptionHandler)
 from deepspeed_tpu.resilience.watchdog import (  # noqa: F401
     EXIT_WATCHDOG_ABORT, StepWatchdog, format_all_stacks)
+from deepspeed_tpu.resilience.elastic_reshard import (  # noqa: F401
+    ElasticReshardController, EXIT_RESHARD_SLICE_LOSS, SliceLostError,
+    build_topology_for, is_slice_loss, replan_for_world, run_elastic,
+    surviving_devices)
 
 
 class CorruptCheckpointError(IOError):

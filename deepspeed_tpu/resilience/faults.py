@@ -40,13 +40,25 @@ import time
 #: Every point the runtime is instrumented with — where it is called:
 #: ``ckpt.write``   NativeCheckpointEngine.save, between shard and manifest
 #: ``ckpt.publish`` both engines, between a complete tmp dir and the atomic
-#:                  os.replace that makes it the live tag
+#:                  os.replace that makes it the live tag (the universal
+#:                  checkpoint publish trips the same point)
 #: ``comm.collective`` comm.py timed_op, host-level (non-traced) calls
+#: ``comm.partition`` comm.py timed_op, same site — models a network
+#:                  partition (a DCN slice dropping out of the gang); the
+#:                  elastic reshard path treats it as a slice loss
 #: ``io.host``      checkpoint host-side npz/file writes (retry-wrapped)
 #: ``step.hang``    top of DeepSpeedEngine.step()
+#: ``slice.lost``   DeepSpeedEngine.step(), next to step.hang — a whole
+#:                  slice dying mid-step (resilience/elastic_reshard.py)
 #: ``worker.exit``  comm.init_distributed (every worker's first runtime call)
-KNOWN_POINTS = ("ckpt.write", "ckpt.publish", "comm.collective", "io.host",
-                "step.hang", "worker.exit")
+KNOWN_POINTS = ("ckpt.write", "ckpt.publish", "comm.collective",
+                "comm.partition", "io.host", "step.hang", "slice.lost",
+                "worker.exit")
+
+#: points the elastic reshard path interprets as "a slice is gone" —
+#: an :class:`InjectedFault` from any of these is translated into a
+#: shrink-to-survivors reshard instead of a crash
+SLICE_LOSS_POINTS = ("slice.lost", "comm.partition")
 
 ENV_SPEC = "DS_TPU_FAULTS"
 ENV_SEED = "DS_TPU_FAULT_SEED"

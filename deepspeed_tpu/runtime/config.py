@@ -140,6 +140,23 @@ class WatchdogConfig(DeepSpeedConfigModel):
     dump_file = ""      # also write the hang report here ("" = log only)
 
 
+class ElasticReshardConfig(DeepSpeedConfigModel):
+    """``resilience.elastic`` — slice-loss hand-off for elastic multi-slice
+    training (resilience/elastic_reshard.py, docs/RESILIENCE.md). With
+    ``enabled``, a slice-loss fault surfacing at the step boundary
+    (``slice.lost`` / ``comm.partition``) makes the engine write an
+    emergency *universal* checkpoint (topology-independent, so the
+    relaunched gang can reshard it onto the survivors) and exit with
+    ``exit_code`` — the elastic agent's "reshardable slice loss" contract,
+    budget-free like a clean preemption but relaunched at a REDUCED world.
+    Disabled (the default), the fault propagates to the caller — the
+    in-process :class:`ElasticReshardController` path."""
+    enabled = False
+    save_dir = ""       # "" -> the last save_checkpoint dir this run used
+    exit_code = 84      # resilience.EXIT_RESHARD_SLICE_LOSS
+    n_slices = 2        # how many equal device slices the world divides into
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """``resilience`` section — fault injection, preemption-aware save and
     the step watchdog (deepspeed_tpu/resilience, docs/RESILIENCE.md).
@@ -149,6 +166,7 @@ class ResilienceConfig(DeepSpeedConfigModel):
     fault_seed = 0
     preemption = PreemptionConfig()
     watchdog = WatchdogConfig()
+    elastic = ElasticReshardConfig()
 
 
 class FlopsProfilerConfig(DeepSpeedConfigModel):

@@ -1755,6 +1755,12 @@ class DeepSpeedEngine:
         self._step_applied = False
         _faults.set_step(self.global_steps)
         _faults.maybe_fail("step.hang")
+        try:
+            # a whole slice dying mid-step: BEFORE the apply, so the fault
+            # can never leave a half-applied optimizer step behind
+            _faults.maybe_fail("slice.lost")
+        except _faults.InjectedFault as e:
+            self._handle_slice_loss(e)
         from deepspeed_tpu import telemetry
         _span = telemetry.span_begin(STEP_GLOBAL_TIMER)
         if self.wall_clock_breakdown:
@@ -1849,6 +1855,40 @@ class DeepSpeedEngine:
                            f"save_dir configured or used yet — exiting "
                            f"{cfg.exit_code} WITHOUT an emergency checkpoint")
         raise SystemExit(int(cfg.exit_code))
+
+    def _handle_slice_loss(self, fault):
+        """A slice-loss fault (``slice.lost`` / ``comm.partition``) reached
+        the step boundary. With ``resilience.elastic.enabled`` the engine
+        performs the process-level hand-off: emergency *universal*
+        checkpoint (topology-independent, so the relaunched gang can
+        reshard it onto the survivors) then ``SystemExit(84)`` — the
+        elastic agent's "reshardable slice loss" exit code
+        (docs/RESILIENCE.md). Disabled, the fault propagates so an
+        in-process ElasticReshardController can catch it and reshard
+        without a relaunch."""
+        ecfg = self.config.resilience_config.elastic
+        if not ecfg.enabled:
+            raise fault
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.checkpoint.universal import save_universal_checkpoint
+        telemetry.record("Fault/slice_lost", 1, kind="counter",
+                         point=fault.point, step=self.global_steps)
+        save_dir = ecfg.save_dir or self._last_save_dir
+        if save_dir:
+            with telemetry.span("recovery/emergency_save",
+                                step=self.global_steps):
+                path = save_universal_checkpoint(
+                    self, save_dir, tag=f"ustep{self.global_steps}")
+            logger.warning(
+                f"slice loss ({fault.point}): emergency universal "
+                f"checkpoint {path}; exiting {ecfg.exit_code} "
+                f"(reshardable slice loss)")
+        else:
+            logger.warning(
+                f"slice loss ({fault.point}): no save_dir configured or "
+                f"used yet — exiting {ecfg.exit_code} WITHOUT an "
+                f"emergency checkpoint")
+        raise SystemExit(int(ecfg.exit_code))
 
     def _run_guards(self, old_state, stats):
         """Boundary-time correctness guards (runtime/guards.py): donation

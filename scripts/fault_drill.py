@@ -18,6 +18,18 @@ live training subprocess), pinning the acceptance behaviors the unit suite
 4. ``watchdog``         inject a ``step.hang`` stall into a process running
    the watchdog with ``abort`` on; the watchdog must dump stacks and
    hard-exit 85 within one heartbeat.
+5. ``slice-loss``       elastic shrink under the agent: a 4-host gang loses
+   its upper half mid-async-publish (SIGKILL), the survivors detect the
+   slice loss, save an emergency universal checkpoint, and exit 84
+   (reshardable slice loss); the elastic agent excludes the dead hosts and
+   relaunches the 2 survivors budget-free, which resume from the exact
+   checkpointed step — the loss trajectory continues.
+
+``--emit-elastic-baseline PATH`` additionally runs the in-process 8→4→8
+mesh reshard drill (resilience/elastic_reshard.py, 8 forced CPU devices)
+and writes its payload — the checked-in
+``onchip_results/elastic_drill_baseline.json`` that
+``perf_gate.py --dry-run`` ratchets (``check_elastic_baseline``).
 
 Usage:  python scripts/fault_drill.py [--drill NAME] [--keep]
 Exit 0 iff every selected drill passes.
@@ -227,12 +239,150 @@ def drill_watchdog(workdir):
           f"({len(report)} bytes) written")
 
 
+# per-"host" worker for the slice-loss drill: rank/world come from the
+# elastic agent's env contract; DS_ELASTIC_RESHARD_COUNT tells a worker
+# which gang generation it belongs to
+SLICE_WORKER = """
+import json, os, signal, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.universal import (latest_universal_tag,
+                                                load_universal_checkpoint,
+                                                save_universal_checkpoint)
+from deepspeed_tpu.resilience import faults
+from tests.simple_model import SimpleModel, random_batches
+
+out = sys.argv[1]
+rank = int(os.environ["RANK"])
+world = int(os.environ["DS_ELASTIC_WORLD_SIZE"])
+gen = int(os.environ.get("DS_ELASTIC_RESHARD_COUNT", "0"))
+ckpt = os.path.join(out, f"rank{{rank}}")
+cfg = {{"train_batch_size": 8,
+        "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+        "resilience": {{"elastic": {{"enabled": True, "save_dir": ckpt}}}}}}
+model = SimpleModel()
+batches = random_batches(4, 8)
+params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
+engine, _, _, _ = deepspeed_tpu.initialize(
+    model=model, model_parameters=params, config=cfg)
+
+if gen == 0:
+    losses = {{}}
+    for i in range(2):  # steps 0, 1 commit with a durable tag each
+        loss = engine(batches[i]); engine.backward(loss); engine.step()
+        losses[i] = float(loss)
+        save_universal_checkpoint(engine, ckpt, tag=f"ustep{{engine.global_steps}}")
+    loss = engine(batches[2])  # step 2's forward — the step the slice kills
+    losses[2] = float(loss)
+    engine.backward(loss)
+    with open(os.path.join(out, f"gen0_rank{{rank}}.json"), "w") as f:
+        json.dump({{"losses": losses, "world": world}}, f)
+    if rank >= world // 2:
+        # the dying half: SIGKILL mid-async-publish (the publish window is
+        # held open by a sleep fault) — exactly how a slice disappears
+        faults.configure("ckpt.publish:once!sleep120")
+        engine.save_checkpoint(os.path.join(out, f"async{{rank}}"),
+                               async_save=True)
+        time.sleep(0.5)  # let the worker thread reach the publish stall
+        os.kill(os.getpid(), signal.SIGKILL)
+    # the surviving half detects the loss mid-step: slice.lost fires before
+    # the apply, the engine emergency-saves and exits 84
+    time.sleep(1.0)  # let the upper half die first
+    faults.configure("slice.lost:once")
+    engine.step()
+    sys.exit(97)  # unreachable: step() must SystemExit(84)
+
+# gen 1: the survivors' gang at half world — resume and continue
+tag = latest_universal_tag(ckpt)
+assert tag == "ustep2", f"latest tag {{tag}} != ustep2"
+load_universal_checkpoint(engine, os.path.join(ckpt, tag))
+assert engine.global_steps == 2, engine.global_steps
+with open(os.path.join(out, f"gen0_rank{{rank}}.json")) as f:
+    gen0 = json.load(f)
+losses = {{}}
+for i in range(2, 4):  # replay step 2 (never applied), continue through 3
+    loss = engine(batches[i]); engine.backward(loss); engine.step()
+    losses[i] = float(loss)
+with open(os.path.join(out, f"gen1_rank{{rank}}.json"), "w") as f:
+    json.dump({{"losses": losses, "world": world, "resumed_at": 2,
+               "gen0_loss2": gen0["losses"]["2"]}}, f)
+sys.exit(0)
+"""
+
+
+def drill_slice_loss(workdir):
+    """SIGKILL half the simulated hosts mid-async-publish; the elastic
+    agent must classify the survivors' exit-84, exclude the dead hosts,
+    relaunch at half world budget-free, and the relaunched gang must resume
+    from the exact checkpointed step with the loss trajectory continuing."""
+    import json
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.utils.retry import BackoffPolicy
+    out = os.path.join(workdir, "gang")
+    os.makedirs(out, exist_ok=True)
+    worker = os.path.join(workdir, "slice_worker.py")
+    with open(worker, "w") as f:
+        f.write(SLICE_WORKER.format(repo=REPO))
+    agent = DSElasticAgent(worker, user_args=[out], hosts=["localhost"] * 4,
+                           max_restarts=1,
+                           backoff=BackoffPolicy(base=0.05, factor=1.0,
+                                                 max_delay=0.05,
+                                                 jitter="none"))
+    rc = agent.run()
+    assert rc == 0, f"agent exited {rc}"
+    assert agent.world_history == [4, 2], agent.world_history
+    assert agent.restart_counts["reshard"] == 1, dict(agent.restart_counts)
+    assert agent.reshards == 1 and agent.restarts == 0, (
+        agent.reshards, agent.restarts)
+    for rank in (0, 1):
+        with open(os.path.join(out, f"gen1_rank{rank}.json")) as f:
+            g1 = json.load(f)
+        assert g1["world"] == 2 and g1["resumed_at"] == 2
+        # the replayed step-2 forward after restore matches the loss the
+        # first gang computed before dying — the trajectory continued
+        assert g1["losses"]["2"] == g1["gen0_loss2"], (
+            g1["losses"]["2"], g1["gen0_loss2"])
+    print(f"  4-host gang lost its upper half; agent relaunched 2 "
+          f"survivors budget-free (reasons={agent.restart_reasons}); "
+          f"resumed at step 2 with bitwise loss continuity")
+
+
 DRILLS = {
     "kill-async-save": drill_kill_async_save,
     "bitflip": drill_bitflip,
     "preemption": drill_preemption,
     "watchdog": drill_watchdog,
+    "slice-loss": drill_slice_loss,
 }
+
+
+def emit_elastic_baseline(path):
+    """Run the in-process 8→4→8 mesh reshard drill and write its payload —
+    the baseline ``perf_gate.py check_elastic_baseline`` ratchets."""
+    import json
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from deepspeed_tpu.resilience.elastic_reshard import run_elastic_drill
+    workdir = tempfile.mkdtemp(prefix="elastic_baseline_")
+    try:
+        payload = run_elastic_drill(os.path.join(workdir, "uni"))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"elastic baseline written to {path}: "
+          f"worlds={payload['world_sequence']} "
+          f"steps_lost={payload['steps_lost']} "
+          f"bitwise={payload['restore_loss_bitwise_equal']}")
+    ok = (payload["world_sequence"] == [8, 4, 8]
+          and payload["steps_lost"] == 0
+          and payload["restore_loss_bitwise_equal"])
+    return 0 if ok else 1
 
 
 def main(argv=None):
@@ -241,7 +391,12 @@ def main(argv=None):
                     help="run one drill (default: all)")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch directories for inspection")
+    ap.add_argument("--emit-elastic-baseline", metavar="PATH", default=None,
+                    help="run the in-process 8→4→8 reshard drill and write "
+                         "the perf_gate elastic baseline payload, then exit")
     args = ap.parse_args(argv)
+    if args.emit_elastic_baseline:
+        return emit_elastic_baseline(args.emit_elastic_baseline)
     names = [args.drill] if args.drill else list(DRILLS)
     failures = []
     for name in names:

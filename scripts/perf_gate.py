@@ -56,8 +56,12 @@ resident_host_blocks``) plus the tiering acceptance ratchet — int8
 capacity multiplier >= 2x at the fp leg's KV HBM budget, at least one
 spill and one restore recorded, zero live swap-outs, a positive prefill
 reduction across the spill/restore round trip (``check_longctx_baseline``;
-stall growth between runs gates via ``--max-swap-stall-growth``) — then
-exits 0/2 without comparing. The tier-1 lane runs ``--dry-run`` against
+stall growth between runs gates via ``--max-swap-stall-growth``) — and
+validates the checked-in elastic-reshard drill baseline
+(``onchip_results/elastic_drill_baseline.json``): world sequence 8→4→8,
+zero steps lost or double-applied, bitwise-equal restore-step losses, and
+each reshard leg under the wall-clock ceiling
+(``check_elastic_baseline``) — then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run`` against
 the repo's own BASELINE.json so a malformed baseline, summary, or tuning
 table fails fast on CPU (docs/OBSERVABILITY.md).
 """
@@ -840,6 +844,77 @@ def check_longctx_baseline(baseline_path=None):
             "prefill_reduction": extra["prefill_reduction"]}, errors
 
 
+#: elastic reshard drill acceptance for the checked-in baseline
+#: (onchip_results/elastic_drill_baseline.json, regenerated with
+#: ``scripts/fault_drill.py --emit-elastic-baseline``): the 8→4→8 CPU
+#: drill must lose zero steps, double-apply none, restore bitwise at every
+#: reshard, and keep each reshard leg under the wall-clock ceiling
+ELASTIC_MAX_RESHARD_S = 30.0
+ELASTIC_WORLD_SEQUENCE = [8, 4, 8]
+ELASTIC_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                     "elastic_drill_baseline.json")
+
+
+def check_elastic_baseline(baseline_path=None):
+    """Validate the checked-in elastic-reshard drill baseline: the recorded
+    run shrank 8→4 on a mid-step slice loss and re-expanded 4→8
+    (``world_sequence``), lost zero steps and double-applied none across
+    both reshards, restored the loss bitwise at every reshard step, kept
+    the optimizer step count equal to the step budget, and each reshard
+    leg's wall-seconds ratchets under :data:`ELASTIC_MAX_RESHARD_S`. Pure
+    dict checks over recorded values (the drill itself needs jax + 8 CPU
+    devices). Returns (report, errors) for the dry-run lane."""
+    path = baseline_path or ELASTIC_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no elastic drill baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable elastic drill baseline {path}"]
+    if not isinstance(doc, dict) or doc.get("drill") != "elastic-reshard-8-4-8":
+        return {}, ["elastic baseline: not an elastic-reshard drill payload "
+                    "(regenerate with fault_drill.py --emit-elastic-baseline)"]
+    required = ("world_sequence", "steps_lost", "steps_double_applied",
+                "restore_loss_bitwise_equal", "reshard_s", "steps",
+                "final_optimizer_step")
+    missing = [k for k in required if k not in doc]
+    if missing:
+        return {}, [f"elastic baseline: missing fields {missing}"]
+    errors = []
+    if list(doc["world_sequence"]) != ELASTIC_WORLD_SEQUENCE:
+        errors.append(
+            f"elastic baseline: world sequence {doc['world_sequence']} != "
+            f"{ELASTIC_WORLD_SEQUENCE} — the drill did not shrink to the "
+            f"surviving half and re-expand")
+    if doc["steps_lost"] != 0:
+        errors.append(f"elastic baseline: {doc['steps_lost']} steps lost — "
+                      f"the reshard dropped part of the loss trajectory")
+    if doc["steps_double_applied"] != 0:
+        errors.append(
+            f"elastic baseline: {doc['steps_double_applied']} steps "
+            f"double-applied — the restore replayed a committed step")
+    if not doc["restore_loss_bitwise_equal"]:
+        errors.append("elastic baseline: restore-step loss not bitwise "
+                      "equal to the full-world reference — the universal "
+                      "reshard-restore altered state")
+    if doc["final_optimizer_step"] != doc["steps"]:
+        errors.append(
+            f"elastic baseline: optimizer step count "
+            f"{doc['final_optimizer_step']} != step budget {doc['steps']}")
+    reshard_s = doc["reshard_s"]
+    for leg in ("shrink", "expand"):
+        if leg not in reshard_s:
+            errors.append(f"elastic baseline: no {leg} reshard recorded")
+        elif not 0 < reshard_s[leg] <= ELASTIC_MAX_RESHARD_S:
+            errors.append(
+                f"elastic baseline: {leg} reshard took {reshard_s[leg]}s "
+                f"(ceiling {ELASTIC_MAX_RESHARD_S}s)")
+    return {"world_sequence": list(doc["world_sequence"]),
+            "steps_lost": doc["steps_lost"],
+            "steps_double_applied": doc["steps_double_applied"],
+            "restore_loss_bitwise_equal": doc["restore_loss_bitwise_equal"],
+            "reshard_s": reshard_s}, errors
+
+
 def check_overlap_analytic():
     """Drive the overlap analyzer end-to-end jax-free: build the analytic
     serialized schedule from a fixed collective inventory, attribute it,
@@ -1009,11 +1084,15 @@ def main(argv=None):
         longctx_report, longctx_errors = check_longctx_baseline()
         for err in longctx_errors:
             print(f"perf_gate: longctx: {err}", file=sys.stderr)
+        elastic_report, elastic_errors = check_elastic_baseline()
+        for err in elastic_errors:
+            print(f"perf_gate: elastic: {err}", file=sys.stderr)
         lint_report, lint_errors = check_lint_baseline()
         for err in lint_errors:
             print(f"perf_gate: lint: {err}", file=sys.stderr)
         errors = table_errors + qgz_errors + overlap_errors + sched_errors \
-            + prefix_errors + fleet_errors + longctx_errors + lint_errors
+            + prefix_errors + fleet_errors + longctx_errors \
+            + elastic_errors + lint_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
@@ -1023,6 +1102,7 @@ def main(argv=None):
                           "prefix_cache": prefix_report,
                           "fleet": fleet_report,
                           "longctx": longctx_report,
+                          "elastic": elastic_report,
                           "lint": lint_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))

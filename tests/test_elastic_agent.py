@@ -100,3 +100,134 @@ def test_invalid_world_size_rejected(tmp_path):
                                "max_gpus": 2}},
                            max_restarts=0, launcher="local")
     assert agent.run() == 1  # 3 hosts not in the compatible set
+
+
+# ---------------------------------------------------------------------------
+# shrink/expand state machine (exit 84 — reshardable slice loss)
+# ---------------------------------------------------------------------------
+
+def _fast_backoff():
+    from deepspeed_tpu.utils.retry import BackoffPolicy
+    return BackoffPolicy(base=0.02, factor=1.0, max_delay=0.02, jitter="none")
+
+
+def test_reshard_shrinks_to_survivors_budget_free(tmp_path):
+    """Half the gang SIGKILLs (the lost slice), the other half exits 84:
+    the agent excludes the dead hosts and relaunches the survivors at half
+    world WITHOUT burning restart budget, recording the 'reshard' reason
+    separately from preemption."""
+    w = write_worker(tmp_path, """
+        import os, sys
+        out = sys.argv[1]
+        rank = int(os.environ["RANK"])
+        world = int(os.environ["DS_ELASTIC_WORLD_SIZE"])
+        if os.environ["DS_ELASTIC_RESHARD_COUNT"] == "0":
+            sys.exit(9 if rank >= world // 2 else 84)
+        open(os.path.join(out, f"gen1_ws{world}_r{rank}"), "w").close()
+    """)
+    agent = DSElasticAgent(w, [str(tmp_path)], hosts=["localhost"] * 4,
+                           max_restarts=1, backoff=_fast_backoff())
+    assert agent.run() == 0
+    assert agent.world_history == [4, 2]
+    assert agent.reshards == 1 and agent.restarts == 0
+    assert agent.restart_reasons == ["reshard"]
+    assert agent.restart_counts["reshard"] == 1
+    assert agent.restart_counts["preemption"] == 0
+    gen1 = sorted(f for f in os.listdir(tmp_path) if f.startswith("gen1_"))
+    assert gen1 == ["gen1_ws2_r0", "gen1_ws2_r1"]
+
+
+def test_reshard_exit_84_without_host_loss_relaunches_same_world(tmp_path):
+    """Exit 84 with no hard-crashed host (e.g. a transient partition the
+    workers flagged): relaunch the same membership, still budget-free."""
+    w = write_worker(tmp_path, """
+        import os, sys
+        out = sys.argv[1]
+        world = os.environ["DS_ELASTIC_WORLD_SIZE"]
+        if os.environ["DS_ELASTIC_RESHARD_COUNT"] == "0":
+            sys.exit(84)
+        open(os.path.join(out, f"gen1_ws{world}_r{os.environ['RANK']}"),
+             "w").close()
+    """)
+    agent = DSElasticAgent(w, [str(tmp_path)], hosts=["localhost"] * 2,
+                           max_restarts=0, backoff=_fast_backoff())
+    assert agent.run() == 0
+    assert agent.world_history == [2, 2]
+    assert agent.reshards == 1 and agent.restarts == 0
+
+
+def test_reshard_disabled_burns_budget(tmp_path):
+    """allow_reshard=False restores the old contract: a partial crash is a
+    plain failure charged against max_restarts."""
+    w = write_worker(tmp_path, """
+        import os, sys
+        if os.environ["DS_ELASTIC_RESTART_COUNT"] == "0":
+            sys.exit(9 if os.environ["RANK"] == "1" else 84)
+    """)
+    agent = DSElasticAgent(w, [str(tmp_path)], hosts=["localhost"] * 2,
+                           max_restarts=1, allow_reshard=False,
+                           backoff=_fast_backoff())
+    assert agent.run() == 0
+    assert agent.restarts == 1 and agent.reshards == 0
+    assert all(r != "reshard" for r in agent.restart_reasons)
+
+
+def test_excluded_hosts_readmitted_by_probe(tmp_path):
+    """The expand leg: once the injectable host probe reports the excluded
+    hosts healthy, the next relaunch runs at full world again."""
+    w = write_worker(tmp_path, """
+        import os, sys
+        out = sys.argv[1]
+        rank = int(os.environ["RANK"])
+        world = int(os.environ["DS_ELASTIC_WORLD_SIZE"])
+        gen = os.environ["DS_ELASTIC_RESHARD_COUNT"]
+        open(os.path.join(out, f"gen{gen}_ws{world}_r{rank}"), "w").close()
+        if gen == "0":
+            sys.exit(9 if rank >= world // 2 else 84)
+        if gen == "1" and world == 2:
+            sys.exit(84)  # flag again: by now the probe heals the slice
+    """)
+    probe_calls = []
+
+    def probe(host):
+        probe_calls.append(host)
+        return len(probe_calls) > 2  # unhealthy at first, then healed
+
+    agent = DSElasticAgent(w, [str(tmp_path)], hosts=["localhost"] * 4,
+                           max_restarts=0, host_probe=probe,
+                           backoff=_fast_backoff())
+    assert agent.run() == 0
+    assert agent.world_history == [4, 2, 4]
+    assert agent.reshards == 2 and agent.restarts == 0
+    assert probe_calls  # exclusions were actually re-probed
+    gen2 = sorted(f for f in os.listdir(tmp_path) if f.startswith("gen2_"))
+    assert gen2 == [f"gen2_ws4_r{r}" for r in range(4)]
+
+
+def test_excluded_hosts_readmitted_on_membership_change(tmp_path):
+    """Rewriting the hostfile (the operator healed the slice) clears the
+    exclusions even without a probe."""
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("localhost slots=1\nlocalhost2 slots=1\n")
+    w = write_worker(tmp_path, """
+        import os, sys
+        out, hostfile = sys.argv[1], sys.argv[2]
+        rank = int(os.environ["RANK"])
+        world = int(os.environ["DS_ELASTIC_WORLD_SIZE"])
+        gen = os.environ["DS_ELASTIC_RESHARD_COUNT"]
+        open(os.path.join(out, f"gen{gen}_ws{world}_r{rank}"), "w").close()
+        if gen == "0":
+            sys.exit(9 if rank == 1 else 84)
+        if gen == "1" and world == 1:
+            # operator heals the pool: content change re-admits everything
+            open(hostfile, "w").write(
+                "localhost slots=1\\nlocalhost3 slots=1\\n")
+            sys.exit(84)
+    """)
+    agent = DSElasticAgent(w, [str(tmp_path), str(hostfile)],
+                           hostfile=str(hostfile), max_restarts=0,
+                           launcher="local", backoff=_fast_backoff())
+    assert agent.run() == 0
+    assert agent.world_history[0] == 2 and agent.world_history[1] == 1
+    assert agent.world_history[-1] >= 2  # healed membership re-admitted
+    assert agent.reshards == 2 and agent.restarts == 0

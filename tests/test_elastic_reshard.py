@@ -1,0 +1,224 @@
+"""Elastic multi-slice reshard (resilience/elastic_reshard.py): the 8→4→8
+CPU drill — kill half the slice set mid-step, continue on the survivors
+from the checkpointed step with the loss trajectory intact, re-expand to
+the original partition layout — plus the topology/checkpoint helpers the
+reshard path is built from."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.universal import (latest_universal_tag,
+                                                read_universal_meta,
+                                                save_universal_checkpoint,
+                                                topology_remap,
+                                                _opt_step_count)
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.elastic_reshard import (
+    ElasticReshardController, SliceLostError, build_topology_for,
+    run_elastic, run_elastic_drill, slice_devices, surviving_devices)
+from tests.simple_model import SimpleModel, random_batches
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    groups.reset()
+    yield
+    faults.reset()
+    groups.reset()
+
+
+# --------------------------------------------------------------- helpers
+
+def test_slice_devices_partitioning():
+    devs = list(range(8))
+    slices = slice_devices(devs, 2)
+    assert slices == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert surviving_devices(devs, [1], 2) == [0, 1, 2, 3]
+    assert surviving_devices(devs, [0], 4) == [2, 3, 4, 5, 6, 7]
+    with pytest.raises(ValueError):
+        slice_devices(devs, 3)  # 8 devices don't split into 3 slices
+    with pytest.raises(SliceLostError):
+        surviving_devices(devs, [0, 1], 2)  # every slice gone
+
+
+def test_build_topology_preserves_model_axes():
+    """Shrink is dp-only: tp survives the reshard, and a survivor count
+    that can't carry the model-parallel layout fails loud."""
+    devs = jax.devices()
+    like = MeshTopology(tp=2, devices=devs)
+    topo = build_topology_for(devs[:4], like=like)
+    assert (topo.tp_size, topo.dp_size) == (2, 2)
+    like3 = MeshTopology(tp=8, devices=devs)
+    with pytest.raises(SliceLostError, match="model-parallel"):
+        build_topology_for(devs[:4], like=like3)
+
+
+def test_build_topology_clamps_hpz_shard_size():
+    """The hpZ shard group is re-derived for the survivors: it clamps to a
+    divisor of the new dp world, collapsing to plain ZeRO when the
+    survivors fit a single shard group."""
+    devs = jax.devices()
+    like = MeshTopology(devices=devs, zero_shard_size=4,
+                        zero_hierarchy="hpz")
+    assert (like.dp_size, like.dpr_size) == (4, 2)
+    shrunk = build_topology_for(devs[:4], like=like)
+    # 4 survivors == one shard group: the hierarchy collapses
+    assert shrunk.zero_hierarchy is None and shrunk.dp_size == 4
+    regrown = build_topology_for(devs, like=like)
+    assert (regrown.zero_hierarchy, regrown.dp_size, regrown.dpr_size) == \
+        ("hpz", 4, 2)
+
+
+def test_topology_remap_accounting(tmp_path):
+    model = SimpleModel()
+    b = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), b)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    save_universal_checkpoint(engine, str(tmp_path), tag="ustep0")
+    meta = read_universal_meta(str(tmp_path / "ustep0"))
+    assert meta["topology"]["world_size"] == 8
+    groups.reset()
+    remap = topology_remap(meta, MeshTopology(devices=jax.devices()[:4]))
+    assert remap["resharded"] and (remap["from_world"], remap["to_world"]) \
+        == (8, 4)
+    assert remap["axis_deltas"]["dp"] == (8, 4)
+
+
+def test_latest_universal_tag_pointer_and_fallback(tmp_path):
+    root = tmp_path / "uni"
+    assert latest_universal_tag(str(root)) is None
+    model = SimpleModel()
+    b = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), b)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    save_universal_checkpoint(engine, str(root), tag="ustep0")
+    loss = engine(b); engine.backward(loss); engine.step()
+    save_universal_checkpoint(engine, str(root), tag="ustep1")
+    assert latest_universal_tag(str(root)) == "ustep1"
+    # pointer gone -> fallback scans complete tag dirs, newest first
+    os.remove(str(root / "latest_universal"))
+    assert latest_universal_tag(str(root)) == "ustep1"
+    # a torn tag (missing meta) is never a candidate
+    os.remove(str(root / "ustep1" / "universal_meta.json"))
+    assert latest_universal_tag(str(root)) == "ustep0"
+
+
+# ------------------------------------------------------------- e2e drill
+
+@pytest.fixture(scope="module")
+def drill_payload(tmp_path_factory):
+    """One full 8→4→8 drill shared by the acceptance assertions below
+    (the drill trains 3 runs; split the checks, not the work)."""
+    d = tmp_path_factory.mktemp("elastic_drill")
+    return run_elastic_drill(str(d / "uni"))
+
+
+def test_drill_continues_on_survivors_bitwise(drill_payload):
+    """(a) after the mid-step slice loss, training continues on the
+    4-device survivor mesh from the checkpointed step, the replayed
+    restore-step loss is bitwise identical to the full-world reference,
+    and the trajectory stays continuous."""
+    p = drill_payload
+    assert p["world_sequence"][:2] == [8, 4]
+    assert p["steps_lost"] == 0
+    assert p["restore_loss_bitwise_equal"] is True
+    assert p["restore_steps"] == [p["fail_at_step"], p["expand_at"]]
+    # every step of the trajectory within float32 reduction-order noise
+    assert p["trajectory_max_rel_err"] < 1e-5
+    # losses recorded for every step — nothing skipped across two reshards
+    assert sorted(int(k) for k in p["losses"]) == list(range(p["steps"]))
+
+
+def test_drill_reexpands_to_original_layout(drill_payload):
+    """(b) re-expansion restores the original 8-way partition layout."""
+    p = drill_payload
+    assert p["world_sequence"] == [8, 4, 8]
+    assert p["reshard_count"] == 2
+    assert set(p["reshard_s"]) == {"shrink", "expand"}
+    assert all(s > 0 for s in p["reshard_s"].values())
+
+
+def test_drill_no_step_double_applied(drill_payload):
+    """(c) the optimizer step count is strictly monotonic — the killed
+    step was never half-applied, and no committed step replayed."""
+    p = drill_payload
+    assert p["steps_double_applied"] == 0
+    assert p["final_optimizer_step"] == p["steps"]
+
+
+# -------------------------------------------------------- controller API
+
+def _build_engine_factory(config):
+    model = SimpleModel(hidden_dim=32)
+    batch = random_batches(1, 8)[0]
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+
+    def build(topo):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=dict(config),
+            mesh=topo)
+        return engine
+    return build
+
+
+def test_controller_comm_partition_triggers_shrink(tmp_path):
+    """comm.partition (a DCN partition) is a slice-loss signal too: the
+    controller reshards instead of crashing."""
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 1}}
+    ctl = ElasticReshardController(_build_engine_factory(cfg),
+                                   str(tmp_path / "uni"))
+    ctl.start()
+    batches = random_batches(3, 8)
+    assert ctl.train_step(batches[0]) is not None
+    faults.configure("comm.partition:once")
+    # route one host-level collective through the comm shim inside the
+    # step — the site comm.partition instruments (CPU engines trace their
+    # collectives, so the drill supplies the host-path call)
+    real_step = ctl.engine.step
+
+    def step_with_host_collective():
+        from deepspeed_tpu.comm import comm
+        comm.all_reduce(np.ones(4, dtype=np.float32))
+        return real_step()
+
+    ctl.engine.step = step_with_host_collective
+    result = run_elastic(ctl, batches)
+    assert ctl.world_history[0] == 8 and 4 in ctl.world_history
+    assert ctl.reshard_events[0]["kind"] == "shrink"
+    # step 0 ran before run_elastic; steps 1-2 (incl. the replay) inside
+    assert sorted(result["losses"]) == [1, 2]
+
+
+def test_controller_replays_exact_step_after_shrink(tmp_path):
+    """The restore rewinds global_steps to the last durable tag, so the
+    batch whose step never applied is replayed — once."""
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}}}
+    ctl = ElasticReshardController(_build_engine_factory(cfg),
+                                   str(tmp_path / "uni"))
+    ctl.start()
+    batches = random_batches(4, 8)
+    faults.configure("slice.lost:once@step1")
+    result = run_elastic(ctl, batches)
+    assert result["opt_steps"] == [1, 2, 3, 4]  # strictly monotonic
+    assert _opt_step_count(ctl.engine.state.opt_state) == 4
+    ev = ctl.reshard_events[0]
+    assert ev["kind"] == "shrink" and ev["step"] == 1 and ev["tag"] == "ustep1"

@@ -1058,6 +1058,95 @@ def test_perf_gate_longctx_baseline_ratchet(tmp_path):
     assert report["prefill_reduction"] > 0
 
 
+# ---------------------------------------------------------------------------
+# elastic-reshard drill gate (fault_drill --emit-elastic-baseline /
+# check_elastic_baseline)
+# ---------------------------------------------------------------------------
+
+def _elastic_payload(worlds=(8, 4, 8), lost=0, doubled=0, bitwise=True,
+                     opt_step=6, shrink=0.4, expand=0.1):
+    """An elastic drill baseline payload: the 8→4→8 world sequence, the
+    trajectory accounting (nothing lost, nothing double-applied, bitwise
+    restore), and both reshard legs' wall-seconds."""
+    return {"drill": "elastic-reshard-8-4-8", "steps": 6,
+            "fail_at_step": 2, "expand_at": 4,
+            "world_sequence": list(worlds), "reshard_count": 2,
+            "reshard_s": {"shrink": shrink, "expand": expand},
+            "steps_lost": lost, "steps_double_applied": doubled,
+            "restore_loss_bitwise_equal": bitwise,
+            "final_optimizer_step": opt_step, "restore_steps": [2, 4],
+            "trajectory_max_rel_err": 1.1e-7}
+
+
+def test_perf_gate_elastic_baseline_ratchet(tmp_path):
+    """check_elastic_baseline enforces the elasticity acceptance ratchet:
+    the recorded drill shrank 8→4 and re-expanded 4→8, lost zero steps,
+    double-applied none, restored the loss bitwise, and kept each reshard
+    leg under the wall-clock ceiling."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_pg_elastic", PERF_GATE)
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_elastic_payload()))
+    report, errs = pg.check_elastic_baseline(str(good))
+    assert errs == [] and report["world_sequence"] == [8, 4, 8]
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_elastic_payload(worlds=(8, 4))))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("world sequence" in e for e in errs)
+
+    bad.write_text(json.dumps(_elastic_payload(lost=2)))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("steps lost" in e for e in errs)
+
+    bad.write_text(json.dumps(_elastic_payload(doubled=1)))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("double-applied" in e for e in errs)
+
+    bad.write_text(json.dumps(_elastic_payload(bitwise=False)))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("bitwise" in e for e in errs)
+
+    bad.write_text(json.dumps(_elastic_payload(opt_step=5)))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("optimizer step count" in e for e in errs)
+
+    bad.write_text(json.dumps(
+        _elastic_payload(shrink=pg.ELASTIC_MAX_RESHARD_S + 1)))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("ceiling" in e for e in errs)
+
+    doc = _elastic_payload()
+    del doc["reshard_s"]["expand"]
+    bad.write_text(json.dumps(doc))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("no expand reshard" in e for e in errs)
+
+    doc = _elastic_payload()
+    del doc["steps_lost"]
+    bad.write_text(json.dumps(doc))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("missing fields" in e for e in errs)
+
+    bad.write_text(json.dumps({"drill": "something-else"}))
+    _, errs = pg.check_elastic_baseline(str(bad))
+    assert any("not an elastic-reshard drill" in e for e in errs)
+
+    # no baseline file -> skip, not error (pre-elasticity checkouts)
+    report, errs = pg.check_elastic_baseline(str(tmp_path / "absent.json"))
+    assert errs == [] and "skipped" in report
+
+    # the repo's own checked-in baseline passes the ratchet
+    report, errs = pg.check_elastic_baseline()
+    assert errs == [], errs
+    assert report["world_sequence"] == pg.ELASTIC_WORLD_SEQUENCE
+    assert report["steps_lost"] == 0 and report["steps_double_applied"] == 0
+    assert report["restore_loss_bitwise_equal"] is True
+
+
 @pytest.mark.slow
 def test_bench_serving_longctx_cpu_acceptance(tmp_path):
     """The long-context tiering workload end to end on CPU: one payload

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 import deepspeed_tpu
 from deepspeed_tpu.resilience import (EXIT_CLEAN_PREEMPTION,
+                                      EXIT_RESHARD_SLICE_LOSS,
                                       EXIT_WATCHDOG_ABORT,
                                       CorruptCheckpointError, InjectedFault,
                                       PreemptionHandler, StepWatchdog, faults)
@@ -400,6 +401,93 @@ def test_comm_collective_fault_point():
     with pytest.raises(InjectedFault, match="all_reduce"):
         comm.all_reduce(np.ones(4, dtype=np.float32))
     assert faults.trip_count("comm.collective") == 1
+
+
+def test_parse_spec_slice_loss_grammar():
+    """The elastic fault points ride the existing grammar — windows, modes
+    and actions all apply; typos stay loud (a drill that silently doesn't
+    arm proves nothing)."""
+    by_point = {r.point: r for r in faults.parse_spec(
+        "slice.lost:once@step5; comm.partition:n2@step1-9")}
+    r = by_point["slice.lost"]
+    assert (r.mode, r.lo, r.hi, r.action) == ("once", 5, 5, "raise")
+    r = by_point["comm.partition"]
+    assert (r.mode, r.nth, r.lo, r.hi) == ("nth", 2, 1, 9)
+    assert set(faults.SLICE_LOSS_POINTS) <= set(faults.KNOWN_POINTS)
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.parse_spec("slice.gone:once")
+    with pytest.raises(ValueError, match="bad fault spec"):
+        faults.parse_spec("slice.lost@step5")
+
+
+def test_comm_partition_fault_point():
+    """comm.partition trips at the comm shim, same site as comm.collective
+    — models a DCN partition dropping a slice out of the gang."""
+    from deepspeed_tpu.comm import comm
+    faults.configure("comm.partition:once")
+    with pytest.raises(InjectedFault) as ei:
+        comm.all_reduce(np.ones(4, dtype=np.float32))
+    assert ei.value.point == "comm.partition"
+    assert faults.trip_count("comm.partition") == 1
+
+
+def test_slice_lost_fault_point_no_half_applied_step():
+    """slice.lost fires BEFORE the optimizer apply: the fault can never
+    leave a half-applied step behind (elastic disabled -> it propagates)."""
+    engine = make_engine()
+    faults.configure("slice.lost:once")
+    b = random_batches(1, 8)[0]
+    loss = engine(b)
+    engine.backward(loss)
+    with pytest.raises(InjectedFault) as ei:
+        engine.step()
+    assert ei.value.point == "slice.lost"
+    assert engine.global_steps == 0  # the apply never ran
+
+
+def test_slice_lost_elastic_saves_and_exits_84(tmp_path):
+    """With resilience.elastic enabled the engine performs the process-level
+    hand-off: emergency universal checkpoint (durable tag + pointer), then
+    SystemExit with the reshardable-slice-loss code."""
+    from deepspeed_tpu.checkpoint.universal import latest_universal_tag
+    engine = make_engine({"resilience": {"elastic": {
+        "enabled": True, "save_dir": str(tmp_path / "emergency")}}})
+    train_steps(engine, 2)
+    faults.configure("slice.lost:once")
+    b = random_batches(1, 8, seed=9)[0]
+    loss = engine(b)
+    engine.backward(loss)
+    with pytest.raises(SystemExit) as ei:
+        engine.step()
+    assert ei.value.code == EXIT_RESHARD_SLICE_LOSS == 84
+    root = str(tmp_path / "emergency")
+    tag = latest_universal_tag(root)
+    assert tag == "ustep2"  # saved at the last committed step
+    assert os.path.exists(os.path.join(root, tag, "universal_fragments.npz"))
+
+
+def test_universal_publish_crash_preserves_prior_tag(tmp_path):
+    """The universal save is crash-consistent: a crash at the publish
+    instant leaves the previous durable tag AND the latest pointer intact,
+    and no torn tmp dir survives for the reshard path to trip over."""
+    from deepspeed_tpu.checkpoint.universal import (latest_universal_tag,
+                                                    save_universal_checkpoint)
+    engine = make_engine()
+    train_steps(engine, 1)
+    root = str(tmp_path / "uni")
+    save_universal_checkpoint(engine, root, tag="ustep1")
+    assert latest_universal_tag(root) == "ustep1"
+    train_steps(engine, 1, seed=3)
+    faults.configure("ckpt.publish:once")
+    with pytest.raises(InjectedFault):
+        save_universal_checkpoint(engine, root, tag="ustep2")
+    faults.reset()
+    assert latest_universal_tag(root) == "ustep1"
+    assert not os.path.exists(os.path.join(root, "ustep2"))
+    assert not [d for d in os.listdir(root) if ".tmp." in d]
+    # and the surviving tag still restores
+    from deepspeed_tpu.checkpoint import load_universal_checkpoint
+    assert load_universal_checkpoint(engine, os.path.join(root, "ustep1")) > 0
 
 
 # ---------------------------------------------------------------------------
