@@ -628,7 +628,197 @@ def run_bench():
     emit(payload)
 
 
+def _moe_stack(d_model, n_layers, num_experts, k, wire_bits):
+    """GPT-2-ish block stack with a dropless expert-parallel MoE FFN every
+    other layer — the --moe bench model. Returns a flax module whose apply
+    gives (logits-shaped output, summed aux loss, last MoE layer's
+    exp_counts)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.moe.sharded_moe import MOELayer
+
+    class ExpertFFN(nn.Module):
+        hidden: int = d_model
+        GMM_COMPAT = ("w1", "w3", "w2")
+
+        def gmm_shapes(self, d):
+            return {"w1": (d, self.hidden), "w3": (d, self.hidden),
+                    "w2": (self.hidden, d)}
+
+        @nn.compact
+        def __call__(self, x):
+            h = (nn.silu(nn.Dense(self.hidden, use_bias=False, name="w1")(x))
+                 * nn.Dense(self.hidden, use_bias=False, name="w3")(x))
+            return nn.Dense(d_model, use_bias=False, name="w2")(h)
+
+    class Block(nn.Module):
+        moe: bool = False
+
+        @nn.compact
+        def __call__(self, x):
+            h = nn.LayerNorm()(x)
+            B, T, D = h.shape
+            q = nn.Dense(D, use_bias=False, name="q")(h)
+            kk = nn.Dense(D, use_bias=False, name="k")(h)
+            v = nn.Dense(D, use_bias=False, name="v")(h)
+            att = jnp.einsum("btd,bsd->bts", q, kk) / jnp.sqrt(D)
+            att = jax.nn.softmax(
+                jnp.where(jnp.tril(jnp.ones((T, T), bool)), att, -1e9), -1)
+            x = x + nn.Dense(D, use_bias=False, name="o")(
+                jnp.einsum("bts,bsd->btd", att, v))
+            h = nn.LayerNorm()(x)
+            if self.moe:
+                y, l_aux, counts = MOELayer(
+                    ExpertFFN, num_experts, k, drop_tokens=False,
+                    dispatch_mode="gmm", a2a_wire_bits=wire_bits,
+                    name="moe")(h)
+                return x + y, l_aux, counts
+            return x + ExpertFFN(name="ffn")(h), 0.0, None
+
+    class Stack(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            aux, counts = 0.0, None
+            for i in range(n_layers):
+                x, la, c = Block(moe=(i % 2 == 1), name=f"block_{i}")(x)
+                aux = aux + la
+                if c is not None:
+                    counts = c
+            return x, aux, counts
+
+    return Stack()
+
+
+def run_moe_bench():
+    """--moe leg: dropless expert-parallel MoE micro-step throughput on an
+    8-device (dp x ep) mesh, with the quantized-a2a wire-byte ratios, the
+    per-step MoE gauges, and the analytic chunked-a2a overlap report (the
+    ``check_moe_baseline`` ratchet source) embedded in ``extra``. Emits ONE
+    JSON line; ``python bench.py --moe | tail -1 >
+    onchip_results/moe_overlap_baseline.json`` is the baseline regen recipe
+    (docs/MOE.md)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    n_dev = len(jax.devices())
+    kind = jax.devices()[0].device_kind
+    if n_dev < 8:
+        raise RuntimeError(f"--moe needs 8 devices, have {n_dev}")
+    # telemetry is always on for this leg: the traced comm records ARE the
+    # wire-byte payload (trace-time, no steady-state sync)
+    telemetry.configure(enabled=True, sample_sync=False)
+
+    d_model, n_layers, experts, k, seq, batch = 256, 4, 4, 2, 128, 8
+    wire_bits = 8
+    model = _moe_stack(d_model, n_layers, experts, k, wire_bits)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, seq, d_model)).astype(np.float32)
+
+    groups.reset()
+    groups.initialize(mesh_topology=MeshTopology(dp=-1, ep=2))
+    try:
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        step = jax.jit(lambda p, xx: model.apply({"params": p}, xx))
+        out, aux, counts = step(params, x)   # compile + trace-time comm
+        jax.block_until_ready(out)
+        n_steps = 5
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            out, aux, counts = step(params, x)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+    finally:
+        groups.reset()
+
+    tokens = batch * seq * n_steps
+    tok_per_sec = tokens / dt
+    host_counts = [int(c) for c in np.asarray(jax.device_get(counts))]
+
+    summ = telemetry.summary()
+    comm = summ.get("comm", {}).get("ops", {})
+    wire, comm_ops, a2a_wire_total = {}, [], 0
+    for op, per_axis in comm.items():
+        for axis, st in per_axis.items():
+            comm_ops.append({"op": op, "axis": axis, "bytes": st["bytes"],
+                             "wire_bytes": st["wire_bytes"],
+                             "count": st["count"]})
+            if op.startswith("a2a_"):
+                a2a_wire_total += st["wire_bytes"]
+            if st.get("wire_bytes", st["bytes"]) != st["bytes"]:
+                wire[f"{op}@{axis}"] = {
+                    "bytes": st["bytes"], "wire_bytes": st["wire_bytes"],
+                    "ratio": round(st["wire_bytes"] / st["bytes"], 4)
+                    if st["bytes"] else 0.0}
+    # the three standard gauges, from the fetched post-step routing stats
+    telemetry.record_moe_step(host_counts, sum(host_counts), dropped=0,
+                              a2a_wire_bytes=a2a_wire_total)
+
+    # analytic chunked-a2a overlap on the v5e target (the checked-in
+    # baseline is chip-free: deterministic roofline, not wall clock)
+    from deepspeed_tpu.autotuning import kernel_tuner
+    from deepspeed_tpu.runtime.zero import overlap_schedule as _osched
+    slug = "tpu_v5e"
+    tokens_step = batch * seq
+    # matmul flops per step: attn projections + dense/expert FFN rows
+    flops = tokens_step * n_layers * 8 * d_model * d_model \
+        + tokens_step * (n_layers // 2) * 6 * d_model * d_model * (1 + k)
+    compute_s = kernel_tuner.roofline_compute_seconds(
+        float(flops), 0.0, device_kind=slug)
+    axis_sizes = {"dp": 4, "ep": 2}
+    specs = _osched.fill_comm_seconds(comm_ops, device_kind=slug,
+                                      axis_sizes=axis_sizes)
+    plan, exposed, ranking = _osched.best_moe_a2a_chunks(compute_s, specs)
+    ov_rep = _osched.moe_scheduled_report({}, specs, plan,
+                                          device_kind=slug,
+                                          axis_sizes=axis_sizes,
+                                          compute_s=compute_s)
+    ov_rep["a2a_chunks_ranking"] = ranking
+
+    payload = {
+        "metric": "moe_dropless_ep2_tokens_per_sec",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "extra": {
+            "device": kind, "devices": n_dev, "d_model": d_model,
+            "n_layers": n_layers, "num_experts": experts, "k": k,
+            "seq": seq, "batch": batch, "steps": n_steps,
+            "dropless": True, "a2a_wire_bits": wire_bits,
+            "loss_aux": float(jax.device_get(aux)),
+            "exp_counts": host_counts,
+            "expert_load_max_frac": round(
+                max(host_counts) / max(sum(host_counts), 1), 4),
+            "drop_rate": 0.0,
+            "wire_bytes": wire,
+            "overlap": ov_rep,
+            "telemetry": {"moe": summ.get("moe", {"gauges": {}})},
+        },
+    }
+    # refresh the gauges into the embedded summary (record_moe_step ran
+    # after summary() above)
+    payload["extra"]["telemetry"]["moe"] = telemetry.summary().get("moe")
+    emit(payload)
+
+
 def main():
+    if "--moe" in sys.argv:
+        try:
+            run_moe_bench()
+        except Exception as e:
+            print(traceback.format_exc(limit=6), file=sys.stderr)
+            emit({"metric": "moe_dropless_ep2_tokens_per_sec", "value": 0.0,
+                  "unit": "tokens/s", "vs_baseline": 0.0,
+                  "extra": {"error": f"{type(e).__name__}: {e}"[:500]}})
+        return
     # honor an explicit CPU pin IN PYTHON: the axon sitecustomize ignores
     # JAX_PLATFORMS from the environment, so a CPU smoke run would otherwise
     # probe (and potentially hang on) the TPU tunnel

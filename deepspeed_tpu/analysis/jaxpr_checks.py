@@ -39,8 +39,8 @@ except ImportError:  # pragma: no cover - older jax
 
 __all__ = [
     "iter_eqns", "check_upcasts", "check_collectives", "check_callbacks",
-    "check_program", "collective_inventory", "check_plan_drift",
-    "trace_jaxpr",
+    "check_program", "check_moe_wire", "collective_inventory",
+    "check_plan_drift", "trace_jaxpr",
 ]
 
 #: collective primitives and how they map onto the overlap plan's op names
@@ -67,11 +67,20 @@ _CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback"}
 #: kept in sync by test_jaxpr_checks (drift here would silently un-gate)
 _PREFETCH_OPS = ("all_gather", "gather")
 _BUCKET_OPS = ("reduce_scatter", "psum_scatter", "all_to_all", "exchange")
+_MOE_DISPATCH_OPS = ("a2a_dispatch",)
+_MOE_COMBINE_OPS = ("a2a_combine",)
 
 
 def op_class(op):
-    """prefetch | bucket | tail — the overlap schedule's cost classes."""
+    """prefetch | bucket | tail | moe_dispatch | moe_combine — the overlap
+    schedule's cost classes."""
     name = str(op).lower()
+    # moe classes first: "a2a_*" must not fall through to the generic
+    # "all_to_all"/"exchange" bucket class
+    if any(k in name for k in _MOE_DISPATCH_OPS):
+        return "moe_dispatch"
+    if any(k in name for k in _MOE_COMBINE_OPS):
+        return "moe_combine"
     if any(k in name for k in _PREFETCH_OPS):
         return "prefetch"
     if any(k in name for k in _BUCKET_OPS):
@@ -221,6 +230,59 @@ def check_callbacks(closed, allow=()):
             "message": (f"{prim} traced into the program ({target[:80]}) — "
                         f"a host round-trip every step; hoist it out of the "
                         f"hot path or move it to telemetry"),
+        })
+    return findings
+
+
+def check_moe_wire(closed, wire_bits, inter_axis=None):
+    """JX004: the MoE expert all-to-all's traced wire precision vs what the
+    layer was CONFIGURED to send. With ``a2a_wire_bits`` set, the dispatch
+    and combine payloads must cross the wire as byte-wide integers (the
+    block-quantized q tensor); an fp32 payload means the quantization was
+    configured but never reached the collective — 4x the DCN bytes the
+    perf gate priced.
+
+    Two findings: (a) ``wire_bits`` set but NO byte-wide all_to_all traced
+    anywhere; (b) ``inter_axis`` given and the float elements crossing it
+    outnumber the byte-wide elements (scales are a ~1/group_size sliver —
+    float payload dominating means the data leg itself is fp)."""
+    if not wire_bits:
+        return []
+    int_elems = 0
+    inter_float_elems = 0
+    inter_int_elems = 0
+    for eqn, _axes, path in iter_eqns(closed):
+        if eqn.primitive.name != "all_to_all":
+            continue
+        aval = eqn.invars[0].aval
+        n = int(np.prod(aval.shape or (1,)))
+        byte_wide = (np.dtype(aval.dtype).kind in "iu"
+                     and np.dtype(aval.dtype).itemsize == 1)
+        if byte_wide:
+            int_elems += n
+        if inter_axis is not None and inter_axis in _axis_names(eqn):
+            if byte_wide:
+                inter_int_elems += n
+            elif np.dtype(aval.dtype).kind == "f":
+                inter_float_elems += n
+    findings = []
+    if int_elems == 0:
+        findings.append({
+            "check": "JX004", "severity": "error",
+            "eqn": "all_to_all (program-wide)",
+            "message": (f"a2a_wire_bits={wire_bits} configured but no "
+                        f"byte-wide all_to_all traced — the quantized wire "
+                        f"never materialized; every leg is full precision"),
+        })
+    elif inter_axis is not None and inter_float_elems > max(inter_int_elems,
+                                                            1):
+        findings.append({
+            "check": "JX004", "severity": "error",
+            "eqn": f"all_to_all over {inter_axis!r}",
+            "message": (f"float elements over {inter_axis!r} "
+                        f"({inter_float_elems}) exceed the byte-wide payload "
+                        f"({inter_int_elems}) — the fp data leg rides the "
+                        f"axis int{wire_bits} was configured for"),
         })
     return findings
 

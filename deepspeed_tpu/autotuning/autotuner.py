@@ -205,6 +205,8 @@ class Autotuner:
                 "prefetch_depth": int(overlap["prefetch_depth"]),
                 "grad_buckets": int(overlap["grad_buckets"]),
             }
+            if overlap.get("a2a_chunks"):
+                cfg["overlap"]["a2a_chunks"] = int(overlap["a2a_chunks"])
         cfg.pop("train_batch_size", None)
         cfg["train_micro_batch_size_per_gpu"] = mbs
         cfg["gradient_accumulation_steps"] = \
@@ -230,6 +232,39 @@ class Autotuner:
         if dp_world > 1:
             op = "reduce_scatter" if stage >= 2 else "all_reduce"
             ops.append({"op": op, "axis": "dp", "bytes": int(4 * n)})
+        return ops
+
+    def _moe_comm_ops(self, mbs):
+        """The expert dispatch/combine all-to-all inventory an MoE step
+        implies, per device per step — present only when the config's ``moe``
+        section declares experts and an ep world to exchange over. Every
+        routed token row crosses the wire twice (dispatch out, combine back),
+        ``top_k`` rows per token per MoE layer; seconds come from the same
+        roofline as the ZeRO collectives (``fill_comm_seconds``), and the
+        planner sweeps ``a2a_chunks`` over the result
+        (``overlap_schedule.best_moe_a2a_chunks``)."""
+        moe = self.base_config.get("moe") or {}
+        experts = int(moe.get("num_experts", 0) or 0)
+        ep = int(moe.get("expert_parallel_size", 0) or 0)
+        d_model = int(moe.get("hidden_size", 0) or 0)
+        if experts <= 1 or ep <= 1 or d_model <= 0:
+            return []
+        seq = int(moe.get("seq_len", 1) or 1)
+        k = int(moe.get("top_k", 1) or 1)
+        layers = int(moe.get("num_moe_layers", 1) or 1)
+        mixed = (self.base_config.get("bf16", {}).get("enabled")
+                 or self.base_config.get("fp16", {}).get("enabled"))
+        itemsize = 2 if mixed else 4
+        nbytes = int(mbs) * seq * k * d_model * itemsize * layers
+        wire_bits = moe.get("a2a_wire_bits")
+        wire = (nbytes * int(wire_bits) // (8 * itemsize)
+                if wire_bits else None)
+        ops = []
+        for op in ("a2a_dispatch", "a2a_combine"):
+            spec = {"op": op, "axis": "ep", "bytes": nbytes}
+            if wire is not None:
+                spec["wire_bytes"] = wire
+            ops.append(spec)
         return ops
 
     def _overlap_n_layers(self, default=8):
@@ -588,6 +623,29 @@ class Autotuner:
                     plan.to_dict(), exposed_comm_s=round(exposed, 9),
                     serialized_comm_s=round(serialized, 9))
                 t += exposed
+            # MoE co-decision: sweep a2a_chunks on the expert a2a inventory
+            # on top of the (depth, buckets) the main sweep just chose
+            moe_ops = self._moe_comm_ops(mbs)
+            if moe_ops:
+                ep_world = int((self.base_config.get("moe") or {})
+                               .get("expert_parallel_size", 1) or 1)
+                moe_specs = overlap_schedule.fill_comm_seconds(
+                    moe_ops, device_kind=slug,
+                    axis_sizes={"dp": dp_world, "ep": ep_world})
+                moe_serialized = sum(float(s["seconds"])
+                                     * max(int(s.get("count", 1)), 1)
+                                     for s in moe_specs)
+                base_plan = (overlap_schedule.OverlapPlan.from_dict(
+                    entry["overlap"]) if entry.get("overlap") else None)
+                mplan, mexposed, _ = overlap_schedule.best_moe_a2a_chunks(
+                    t, moe_specs, base_plan=base_plan)
+                if not entry.get("overlap"):
+                    entry["overlap"] = mplan.to_dict()
+                entry["overlap"]["a2a_chunks"] = mplan.a2a_chunks
+                entry["overlap"]["moe_exposed_comm_s"] = round(mexposed, 9)
+                entry["overlap"]["moe_serialized_comm_s"] = \
+                    round(moe_serialized, 9)
+                t += mexposed
             entry["feasible"] = True
             entry["score"] = t / max(mbs, 1)  # seconds/sample proxy
 

@@ -32,6 +32,8 @@ class MoE(nn.Module):
     use_residual: bool = False
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
+    dispatch_mode: str = "indices"
+    a2a_wire_bits: Optional[int] = None
 
     @nn.compact
     def __call__(self, hidden_states, train=True):
@@ -50,6 +52,8 @@ class MoE(nn.Module):
             self.expert_factory, self.num_experts, self.k,
             self.capacity_factor, self.eval_capacity_factor, self.min_capacity,
             self.noisy_gate_policy, self.drop_tokens,
+            dispatch_mode=self.dispatch_mode,
+            a2a_wire_bits=self.a2a_wire_bits,
             name="deepspeed_moe")(hidden_states, train)
         if self.use_residual:
             # PR-MoE: dense residual expert mixed via learned 2-way coefficient

@@ -291,6 +291,12 @@ class MOELayer(nn.Module):
         MixtralExpertMLP); the expert params are created at vmap-identical
         flax paths so checkpoints/HF interop are unchanged. Same RoutingPlan,
         same numerics (dropped choices contribute zero-weighted rows).
+        Composes with an ep mesh: the expert stacks shard over 'ep' and each
+        shard exchanges routed rows with its peers through the explicit
+        dispatch/combine all-to-all (``_gmm_ep_forward``), optionally with a
+        quantized wire (``a2a_wire_bits``). With ``drop_tokens=False`` this
+        is the DROPLESS path: capacity is never consulted, no token is
+        dropped, no padding beyond the m-tile (docs/MOE.md).
     """
     expert_factory: Callable[[], nn.Module]
     num_experts: int
@@ -301,6 +307,11 @@ class MOELayer(nn.Module):
     noisy_gate_policy: Optional[str] = None
     drop_tokens: bool = True
     dispatch_mode: str = "indices"
+    # wire precision of the expert-parallel dispatch/combine all-to-all
+    # (gmm mode under an ep mesh): None = full precision (the ICI default),
+    # 8/4 = quantized wire via the qwZ/qgZ kernel pair. Forward-only —
+    # see runtime/comm/coalesced_collectives.expert_all_to_all.
+    a2a_wire_bits: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, train=True):
@@ -393,15 +404,18 @@ class MOELayer(nn.Module):
                                 name="experts")()
         from deepspeed_tpu.parallel import groups
         topo = groups._TOPOLOGY
-        if topo is not None and (topo.ep_size > 1 or topo.tp_size > 1):
-            # the ragged kernel computes over the FULL expert stack on each
-            # data replica; running it under an ep/tp-sharded mesh would make
-            # GSPMD all-gather every expert onto every chip each step. Use
-            # dispatch_mode="indices" for expert/tensor parallelism.
+        ep = topo.ep_size if topo is not None else 1
+        if topo is not None and topo.tp_size > 1:
+            # the ragged kernel has no tp decomposition; a tp-sharded mesh
+            # would make GSPMD all-gather the expert stacks every step
             raise ValueError(
-                "dispatch_mode='gmm' does not compose with ep/tp meshes yet "
-                f"(mesh has ep={topo.ep_size}, tp={topo.tp_size}); use "
-                "dispatch_mode='indices'")
+                "dispatch_mode='gmm' does not compose with tp meshes "
+                f"(mesh has tp={topo.tp_size}); use dispatch_mode='indices'")
+        if ep > 1 and self.num_experts % ep != 0:
+            raise ValueError(
+                f"dispatch_mode='gmm' under expert parallelism needs "
+                f"num_experts ({self.num_experts}) divisible by the mesh's "
+                f"ep axis ({ep})")
         from deepspeed_tpu.ops.pallas import grouped_gemm as gg
         if not gg.is_supported(D, shapes[names[0]][-1]):
             raise ValueError(
@@ -412,9 +426,15 @@ class MOELayer(nn.Module):
         w1 = kernels[names[0]].astype(x.dtype)
         w3 = kernels[names[1]].astype(x.dtype)
         w2 = kernels[names[2]].astype(x.dtype)
-        out = gg.moe_ffn_gmm(xf, plan.gates, plan.experts, w1, w2, w3,
-                             n_experts=self.num_experts, dtype=x.dtype,
-                             interpret=interpret)
+        if ep > 1:
+            out = _gmm_ep_forward(xf, plan, w1, w2, w3, topo,
+                                  n_experts=self.num_experts,
+                                  a2a_wire_bits=self.a2a_wire_bits,
+                                  dtype=x.dtype, interpret=interpret)
+        else:
+            out = gg.moe_ffn_gmm(xf, plan.gates, plan.experts, w1, w2, w3,
+                                 n_experts=self.num_experts, dtype=x.dtype,
+                                 interpret=interpret)
         return out.reshape(x.shape), plan.l_aux, plan.exp_counts
 
     def _dispatch_shardings(self):
@@ -432,3 +452,102 @@ class MOELayer(nn.Module):
             # remains free to shard the E/C dims over the data axes
             return token, None
         return token, topo.sharding("ep", None, None)
+
+
+def _gmm_ep_forward(xf, plan, w1, w2, w3, topo, *, n_experts, a2a_wire_bits,
+                    dtype, interpret):
+    """Expert-parallel grouped-GEMM forward: tokens stay sharded over the
+    flattened data axes, the stacked expert kernels shard over 'ep', and each
+    shard exchanges its routed rows with its ep peers through the explicit
+    dispatch/combine all-to-all (reference ``_AllToAll``,
+    ``sharded_moe.py:455``) around the local ragged FFN.
+
+    An explicit shard_map rather than ``sharded_kernel_call``: the tokens and
+    the weights need DIFFERENT specs (data axes vs 'ep'), and the a2a must be
+    a real in-body collective — GSPMD cannot be trusted to place it."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.utils import jax_compat
+
+    tok2 = P(("dpr", "dp", "ep", "sp"), None)
+    wspec = P("ep", None, None)
+
+    def body(xl, gl, el, w1l, w2l, w3l):
+        return _moe_gmm_ep_shard(xl, gl, el, w1l, w2l, w3l,
+                                 n_experts=n_experts, ep_axis="ep",
+                                 bits=a2a_wire_bits, dtype=dtype,
+                                 interpret=interpret)
+
+    fn = jax_compat.shard_map(
+        body, mesh=topo.mesh,
+        in_specs=(tok2, tok2, tok2, wspec, wspec, wspec),
+        out_specs=tok2, check_vma=False)
+    return fn(xf, plan.gates, plan.experts, w1, w2, w3)
+
+
+def _moe_gmm_ep_shard(xl, gl, el, w1, w2, w3, *, n_experts, ep_axis, bits,
+                      dtype, interpret):
+    """One ep shard's dropless dispatch → local grouped FFN → combine.
+
+    xl [Sl, D] local tokens; gl/el [Sl, k] local gates/expert ids (GLOBAL
+    expert numbering); w1/w3 [E/ep, D, F], w2 [E/ep, F, D] — this shard's
+    contiguous slice of the expert stack (expert e lives on peer e // E_local
+    — ``moe/utils.moe_param_specs`` layout).
+
+    Statically shaped: the per-peer send buffer holds the worst case (every
+    local row routed to one peer). Empty slots carry zero rows tagged with
+    the sentinel local id ``E_local``; they ride the last local expert's
+    group as padding (zero FFN input → zero output) and their results are
+    never gathered back. Differentiable end to end when ``bits`` is None —
+    scatter/gather/all_to_all all transpose cleanly."""
+    from jax import lax
+
+    from deepspeed_tpu.ops.pallas import grouped_gemm as gg
+    from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+        expert_all_to_all,
+    )
+
+    ep = lax.axis_size(ep_axis)
+    E_local = n_experts // ep
+    Sl, D = xl.shape
+    k = el.shape[-1]
+    R = Sl * k
+
+    # moe_scatter by destination PEER (not expert): stable-sort the local
+    # (token, choice) rows by their expert's owning shard
+    flat_e = el.reshape(-1).astype(jnp.int32)            # [R] global ids
+    dest = flat_e // E_local                             # [R] owning peer
+    token_of = jnp.arange(R, dtype=jnp.int32) // k
+    order = jnp.argsort(dest, stable=True)
+    xs = jnp.take(xl, jnp.take(token_of, order), axis=0)  # [R, D]
+    es = jnp.take(flat_e % E_local, order)               # [R] local ids
+    ds = jnp.take(dest, order)                           # [R]
+    counts = jnp.zeros((ep,), jnp.int32).at[dest].add(1)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(R, dtype=jnp.int32) - jnp.take(starts, ds)
+
+    send_x = jnp.zeros((ep, R, D), xl.dtype).at[ds, pos].set(xs)
+    send_e = jnp.full((ep, R), E_local, jnp.int32).at[ds, pos].set(es)
+
+    recv_x = expert_all_to_all(send_x, ep_axis, bits=bits, op="a2a_dispatch")
+    recv_e = lax.all_to_all(send_e, ep_axis, split_axis=0, concat_axis=0,
+                            tiled=False)
+
+    # sentinel padding rows fold into the last local expert's group; their
+    # zero inputs produce zero outputs and nobody reads them back
+    rows_e = jnp.minimum(recv_e.reshape(ep * R), E_local - 1)
+    y_rows = gg.moe_ffn_gmm_rows(recv_x.reshape(ep * R, D), rows_e,
+                                 w1, w2, w3, n_experts=E_local, dtype=dtype,
+                                 interpret=interpret)
+
+    back = expert_all_to_all(y_rows.reshape(ep, R, D), ep_axis, bits=bits,
+                             op="a2a_combine")
+
+    # moe_gather: read each routed row back from the slot it was sent from,
+    # unsort, and gate-combine the k choices in fp32
+    ys = back[ds, pos]                                   # [R, D]
+    inv = jnp.argsort(order, stable=True)
+    y = jnp.take(ys, inv, axis=0).reshape(Sl, k, D)
+    return jnp.sum(y.astype(jnp.float32) * gl[..., None],
+                   axis=1).astype(dtype)

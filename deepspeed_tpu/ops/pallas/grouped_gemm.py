@@ -117,6 +117,47 @@ def moe_ffn_gmm(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
         ("data", None), name="moe_ffn_gmm", block_config=block_config)
 
 
+def moe_ffn_gmm_rows(x_rows, row_experts, w1, w2, w3, *, n_experts, dtype,
+                     interpret=False, tiling=None):
+    """Per-row grouped expert FFN: row ``i`` runs through expert
+    ``row_experts[i]`` — silu(x@w1) * (x@w3) @ w2, outputs in input row
+    order. No gate weighting and no k-slot combine: the expert-parallel
+    all-to-all path calls this on the RECEIVING shard and weights rows back
+    on the sender, so the per-row result is the unit of exchange.
+
+    Direct call, no ``sharded_kernel_call``: the caller sits inside a
+    manual-axes ``shard_map`` body where every mesh axis is already bound,
+    so the registry could only fall back ("no_live_role") anyway.
+
+    x_rows [R, D]; row_experts [R] int32 in [0, n_experts); w1/w3
+    [E, D, F]; w2 [E, F, D] -> [R, D].
+    """
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+    R, D = x_rows.shape
+    E = n_experts
+    tm, tk, tn = tiling if tiling is not None else (ROW_ALIGN, 128, 128)
+
+    order = jnp.argsort(row_experts, stable=True)
+    xs = jnp.take(x_rows, order, axis=0)                 # [R, D] grouped
+    group_sizes = jnp.zeros((E,), jnp.int32).at[row_experts].add(1)
+    pad = (-R) % tm
+    if pad:
+        xs = jnp.concatenate([xs, jnp.zeros((pad, D), xs.dtype)], axis=0)
+        group_sizes = group_sizes.at[E - 1].add(pad)
+
+    def grouped(lhs, rhs):
+        return gmm(lhs, rhs, group_sizes,
+                   preferred_element_type=jnp.float32,
+                   tiling=(tm, tk, tn),
+                   interpret=interpret).astype(dtype)
+
+    h = jax.nn.silu(grouped(xs, w1)) * grouped(xs, w3)   # [R+pad, F]
+    y = grouped(h, w2)[:R]                               # [R, D]
+    inv = jnp.argsort(order, stable=True)
+    return jnp.take(y, inv, axis=0)
+
+
 def _moe_ffn_gmm_local(x, top_vals, top_idx, w1, w2, w3, *, n_experts, dtype,
                        interpret=False, tiling=None):
     from jax.experimental.pallas.ops.tpu.megablox import gmm

@@ -112,6 +112,65 @@ def exchange_reduce(blocks, axis, bits, group_size=2048, return_error=False):
     return out
 
 
+def expert_all_to_all(x, axis, bits=None, group_size=2048,
+                      op="a2a_dispatch"):
+    """MoE expert dispatch/combine all-to-all of per-peer payload blocks.
+
+    ``x``: [peers, ...] — block j is this rank's payload for peer j along
+    ``axis``; returns [peers, ...] where block j is what peer j sent here.
+
+    ``bits`` None keeps the payload's own dtype on the wire (the ICI
+    default: wire bytes == payload bytes). ``bits`` set routes each peer
+    block through the qwZ/qgZ kernel pair — only packed ints + fp32 group
+    scales cross the link (the DCN leg). Either way telemetry records the
+    exchange under ``op`` ("a2a_dispatch" / "a2a_combine" — the overlap
+    scheduler's MoE stream classes) with logical fp32 bytes and true wire
+    bytes.
+
+    The quantized leg is forward-only (round-to-nearest has no useful VJP);
+    training paths keep ``bits=None`` unless they carry their own error
+    feedback like ``exchange_reduce`` callers do."""
+    P = x.shape[0]
+    if bits is None:
+        _record_wire(op, axis, x.size, x.size * x.dtype.itemsize)
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+    blocks = x.reshape(P, -1).astype(jnp.float32)
+    m = blocks.shape[1]
+    q, s = block_quantize(blocks, num_bits=bits, group_size=group_size,
+                          local=True)
+    _record_wire(op, axis, x.size, P * wire_nbytes(m, bits, group_size))
+    qx = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=False)
+    sx = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=False)
+    out = block_dequantize(qx, sx, num_bits=bits, group_size=group_size,
+                           out_len=m, local=True)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def moe_hierarchical_a2a(x, intra_axis="ep", inter_axis="dpr", inter_bits=8,
+                         group_size=2048, op="a2a_dispatch"):
+    """hpZ-split expert all-to-all over a two-level expert world.
+
+    ``x``: [inter, intra, ...] — block (a, b) is this rank's payload for the
+    peer at inter index ``a`` (DCN) and intra index ``b`` (ICI). Returns
+    [inter, intra, ...] where block (a, b) holds what THAT peer sent here.
+
+    Stage 1 exchanges full precision over ``intra_axis`` (ICI — bytes are
+    nearly free); stage 2 exchanges ``inter_bits`` over ``inter_axis`` (DCN
+    — the leg ``perf_gate check_moe_wire`` caps at ≤ 0.5x fp32). Same
+    hierarchy split as qgZ/hpZ in :func:`all_to_all_quant_reduce`, but
+    payload-preserving (no reduce) — expert tokens must arrive intact."""
+    # stage 1 (ICI, fp): lead with the intra destination. Result is
+    # [intra_src, inter_dest, ...]: each intra peer now holds the slab its
+    # group routed to this intra index, still grouped by inter destination.
+    y = expert_all_to_all(jnp.swapaxes(x, 0, 1), intra_axis, bits=None,
+                          group_size=group_size, op=op)
+    # stage 2 (DCN, quantized): lead with the inter destination. Result is
+    # [inter_src, intra_src, ...] — payload from every (a, b) peer.
+    return expert_all_to_all(jnp.swapaxes(y, 0, 1), inter_axis,
+                             bits=inter_bits, group_size=group_size, op=op)
+
+
 def all_to_all_quant_reduce(x, intra_axis="dp", inter_axis=None,
                             intra_bits=4, inter_bits=8, group_size=2048,
                             dtype=jnp.float32):

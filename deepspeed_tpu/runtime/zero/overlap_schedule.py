@@ -56,9 +56,14 @@ _OVERLAP = None
 DEFAULT_LATENCY_S = 1e-6
 
 # op-name classes the scheduler knows how to move. Everything else (grad-norm
-# all_reduce, MoE dispatch, ...) stays serialized after backward — exposed.
+# all_reduce, ...) stays serialized after backward — exposed. The MoE expert
+# all-to-all gets its own pair of classes: dispatch can lead the expert GEMM
+# it feeds, combine trails it — a different dependence shape from either the
+# param prefetch or the grad buckets (see :func:`moe_scheduled_intervals`).
 _PREFETCH_OPS = ("all_gather", "gather")
 _BUCKET_OPS = ("reduce_scatter", "psum_scatter", "all_to_all", "exchange")
+_MOE_DISPATCH_OPS = ("a2a_dispatch",)
+_MOE_COMBINE_OPS = ("a2a_combine",)
 
 
 def _ov():
@@ -70,6 +75,12 @@ def _ov():
 
 def _op_class(op):
     name = str(op or "").lower()
+    # moe classes first: "a2a_*" must not fall through to the generic
+    # "all_to_all"/"exchange" bucket class
+    if any(k in name for k in _MOE_DISPATCH_OPS):
+        return "moe_dispatch"
+    if any(k in name for k in _MOE_COMBINE_OPS):
+        return "moe_combine"
     if any(k in name for k in _PREFETCH_OPS):
         return "prefetch"
     if any(k in name for k in _BUCKET_OPS):
@@ -83,7 +94,8 @@ class OverlapPlan:
     ``fwd_fraction`` shape the analytic timeline only."""
 
     def __init__(self, prefetch_depth=1, grad_buckets=2, n_layers=8,
-                 fwd_fraction=1.0 / 3.0, latency_s=DEFAULT_LATENCY_S):
+                 fwd_fraction=1.0 / 3.0, latency_s=DEFAULT_LATENCY_S,
+                 a2a_chunks=1):
         if prefetch_depth < 0:
             raise ValueError(f"prefetch_depth must be >= 0, got {prefetch_depth}")
         if grad_buckets < 1:
@@ -92,18 +104,22 @@ class OverlapPlan:
             raise ValueError(f"n_layers must be >= 1, got {n_layers}")
         if not 0.0 < fwd_fraction < 1.0:
             raise ValueError(f"fwd_fraction must be in (0, 1), got {fwd_fraction}")
+        if a2a_chunks < 1:
+            raise ValueError(f"a2a_chunks must be >= 1, got {a2a_chunks}")
         self.prefetch_depth = int(prefetch_depth)
         self.grad_buckets = int(grad_buckets)
         self.n_layers = int(n_layers)
         self.fwd_fraction = float(fwd_fraction)
         self.latency_s = float(latency_s)
+        self.a2a_chunks = int(a2a_chunks)
 
     def to_dict(self):
         return {"prefetch_depth": self.prefetch_depth,
                 "grad_buckets": self.grad_buckets,
                 "n_layers": self.n_layers,
                 "fwd_fraction": round(self.fwd_fraction, 6),
-                "latency_s": self.latency_s}
+                "latency_s": self.latency_s,
+                "a2a_chunks": self.a2a_chunks}
 
     @classmethod
     def from_dict(cls, d):
@@ -111,11 +127,13 @@ class OverlapPlan:
                    grad_buckets=d.get("grad_buckets", 2),
                    n_layers=d.get("n_layers", 8),
                    fwd_fraction=d.get("fwd_fraction", 1.0 / 3.0),
-                   latency_s=d.get("latency_s", DEFAULT_LATENCY_S))
+                   latency_s=d.get("latency_s", DEFAULT_LATENCY_S),
+                   a2a_chunks=d.get("a2a_chunks", 1))
 
     def __repr__(self):
         return (f"OverlapPlan(depth={self.prefetch_depth}, "
-                f"buckets={self.grad_buckets}, layers={self.n_layers})")
+                f"buckets={self.grad_buckets}, layers={self.n_layers}, "
+                f"a2a_chunks={self.a2a_chunks})")
 
 
 def _split_spec(spec, m, latency_s):
@@ -161,8 +179,10 @@ def scheduled_intervals(compute_s, comm_ops, plan, device="analytic:0"):
 
     gathers, buckets, tail = [], [], []
     for spec in comm_ops:
-        {"prefetch": gathers, "bucket": buckets,
-         "tail": tail}[_op_class(spec.get("op"))].append(spec)
+        # unknown classes (incl. moe dispatch/combine in a non-moe timeline)
+        # stay serialized at the tail — exposed, never silently dropped
+        cls = _op_class(spec.get("op"))
+        {"prefetch": gathers, "bucket": buckets}.get(cls, tail).append(spec)
 
     # split each class across its pipeline stages
     gather_chunks = [[] for _ in range(L)]
@@ -264,6 +284,96 @@ def plan_exposure(compute_s, comm_ops, plan, device="analytic:0"):
     return att["totals"]["exposed_comm_s"]
 
 
+def moe_scheduled_intervals(compute_s, comm_ops, plan, device="analytic:0"):
+    """The MoE-step timeline ``plan.a2a_chunks`` implies — the expert-parallel
+    counterpart of :func:`scheduled_intervals`.
+
+    ``compute_s`` is the expert GEMM block; the dispatch all-to-all feeds it
+    and the combine all-to-all drains it, so with one chunk the step is fully
+    serialized: dispatch, then experts, then combine — the worst case the
+    ratchet baseline records. Splitting into ``A = a2a_chunks`` chunks
+    pipelines them: every dispatch chunk is ready at step start (routing
+    precedes expert compute) and issues immediately on the serialized
+    collective stream; expert chunk ``c`` waits on dispatch chunk ``c`` and
+    its predecessor; combine chunk ``c`` issues the moment expert chunk ``c``
+    retires. Steady-state dispatch hides under the previous expert chunk and
+    combine under the next — only the fill (first dispatch) and drain (last
+    combine) stay exposed. Per-chunk latency is re-paid on every split
+    (:func:`_split_spec`), so more chunks is not free — the planner's
+    trade-off. Unclassified ops serialize at the tail as ever."""
+    ov = _ov()
+    A = plan.a2a_chunks
+    lat = plan.latency_s
+
+    dispatch, combine, tail = [], [], []
+    for spec in comm_ops:
+        cls = _op_class(spec.get("op"))
+        {"moe_dispatch": dispatch,
+         "moe_combine": combine}.get(cls, tail).append(spec)
+
+    disp_chunks = [[] for _ in range(A)]
+    for spec in dispatch:
+        for c, ch in enumerate(_split_spec(spec, A, lat)):
+            disp_chunks[c].append(ch)
+    comb_chunks = [[] for _ in range(A)]
+    for spec in combine:
+        for c, ch in enumerate(_split_spec(spec, A, lat)):
+            comb_chunks[c].append(ch)
+
+    compute_s = float(compute_s)
+    slab = compute_s / A
+
+    ivs = []
+    comm_free = 0.0
+
+    def issue(chunks, ready, tag):
+        nonlocal comm_free
+        done = ready
+        for c in chunks:
+            start = max(ready, comm_free)
+            end = start + float(c["seconds"])
+            ivs.append(ov.make_interval(
+                f"comm:{c['op']}/{tag}", start, end, kind="comm",
+                device=device, op=c["op"], axis=c.get("axis"),
+                nbytes=c.get("bytes", 0), wire_bytes=c.get("wire_bytes")))
+            comm_free = done = end
+        return done
+
+    # all dispatch chunks are ready at t=0 — queue them ahead of any combine
+    # so a trailing combine never blocks the next chunk's dispatch
+    d_done = [issue(disp_chunks[c], 0.0, f"dispatch{c:02d}")
+              for c in range(A)]
+
+    prev_end = 0.0
+    last_done = 0.0
+    for c in range(A):
+        start = max(prev_end, d_done[c])
+        end = start + slab
+        if slab > 0:
+            ivs.append(ov.make_interval(f"compute/expert{c:02d}", start, end,
+                                        kind="compute", device=device))
+        prev_end = end
+        done = issue(comb_chunks[c], end, f"combine{c:02d}")
+        last_done = max(last_done, done, end)
+
+    ready = last_done
+    for spec in tail:
+        secs = float(spec["seconds"])
+        for _ in range(max(int(spec.get("count", 1)), 1)):
+            issue([dict(spec, seconds=secs, count=1)], ready, "tail")
+            ready = comm_free
+    return {device: ivs}
+
+
+def moe_plan_exposure(compute_s, comm_ops, plan, device="analytic:0"):
+    """Exposed-comm seconds of one plan on an MoE inventory — the a2a_chunks
+    scoring primitive."""
+    per_device = moe_scheduled_intervals(compute_s, comm_ops, plan,
+                                         device=device)
+    att = _ov().attribute(per_device)
+    return att["totals"]["exposed_comm_s"]
+
+
 def scheduled_report(cost, comm_ops, plan, device_kind="tpu_v5e",
                      axis_sizes=None, top_k=10, compute_s=None):
     """Chip-free overlap report for the *scheduled* program, with the
@@ -300,6 +410,40 @@ def scheduled_report(cost, comm_ops, plan, device_kind="tpu_v5e",
     return report
 
 
+def moe_scheduled_report(cost, comm_ops, plan, device_kind="tpu_v5e",
+                         axis_sizes=None, top_k=10, compute_s=None):
+    """Chip-free overlap report for the *scheduled* MoE step — the
+    :func:`scheduled_report` twin built on :func:`moe_scheduled_intervals`,
+    with the fully-serialized worst case riding in ``report["schedule"]`` for
+    ``perf_gate check_moe_baseline`` to ratchet."""
+    ov = _ov()
+    if compute_s is None:
+        from deepspeed_tpu.autotuning import kernel_tuner
+        compute_s = kernel_tuner.roofline_compute_seconds(
+            float(cost.get("flops", 0.0) or 0.0),
+            float(cost.get("bytes accessed", 0.0) or 0.0),
+            device_kind=device_kind)
+    specs = fill_comm_seconds(comm_ops, device_kind=device_kind,
+                              axis_sizes=axis_sizes)
+    serialized = ov.attribute(ov.analytic_intervals(compute_s, specs))
+    ser_exposed = serialized["totals"]["exposed_comm_s"]
+
+    per_device = moe_scheduled_intervals(compute_s, specs, plan)
+    report = ov.overlap_report(per_device, mode="analytic", top_k=top_k,
+                               device_kind=device_kind)
+    exposed = report["exposed_comm_s"]
+    reduction = ((ser_exposed - exposed) / ser_exposed
+                 if ser_exposed > 0 else 0.0)
+    report["schedule"] = dict(
+        plan.to_dict(),
+        compute_s=round(float(compute_s), 9),
+        comm_ops=[{k: v for k, v in s.items()} for s in specs],
+        serialized_exposed_comm_s=round(ser_exposed, 9),
+        exposed_reduction_fraction=round(reduction, 6),
+    )
+    return report
+
+
 def validate_schedule(sched):
     """Structural check of a report's ``schedule`` block (stdlib-only —
     perf_gate re-derives the baseline from exactly these fields). Returns a
@@ -311,6 +455,10 @@ def validate_schedule(sched):
         v = sched.get(k)
         if not isinstance(v, int) or isinstance(v, bool) or v < 0:
             errs.append(f"schedule.{k} missing or invalid (got {v!r})")
+    # optional (pre-moe baselines omit it; from_dict defaults to 1)
+    v = sched.get("a2a_chunks", 1)
+    if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+        errs.append(f"schedule.a2a_chunks invalid (got {v!r})")
     for k in ("compute_s", "serialized_exposed_comm_s", "fwd_fraction"):
         v = sched.get(k)
         if not isinstance(v, (int, float)) or isinstance(v, bool) \
@@ -336,6 +484,7 @@ def validate_schedule(sched):
 
 DEFAULT_DEPTHS = (0, 1, 2)
 DEFAULT_BUCKETS = (1, 2, 4)
+DEFAULT_A2A_CHUNKS = (1, 2, 4)
 
 
 def candidate_plans(hints=None, n_layers=8, depths=DEFAULT_DEPTHS,
@@ -394,6 +543,38 @@ def best_plan(compute_s, comm_ops, hints=None, n_layers=8,
     return plan, top["exposed_comm_s"], ranking
 
 
+def best_moe_a2a_chunks(compute_s, comm_ops, base_plan=None,
+                        chunks=DEFAULT_A2A_CHUNKS):
+    """Sweep ``a2a_chunks`` on an MoE inventory (dispatch/combine a2a ops vs
+    the expert GEMM block); returns ``(plan, exposed_s, ranking)`` like
+    :func:`best_plan`. ``base_plan`` carries the non-moe dimensions (depth,
+    buckets) the main sweep already decided — chunk count is co-decided on
+    top, not instead."""
+    base = base_plan if base_plan is not None else OverlapPlan()
+    ranking = []
+    for a in sorted({max(1, int(a)) for a in chunks}):
+        plan = OverlapPlan(prefetch_depth=base.prefetch_depth,
+                           grad_buckets=base.grad_buckets,
+                           n_layers=base.n_layers,
+                           fwd_fraction=base.fwd_fraction,
+                           latency_s=base.latency_s, a2a_chunks=a)
+        exposed = moe_plan_exposure(compute_s, comm_ops, plan)
+        ranking.append({"a2a_chunks": a,
+                        "exposed_comm_s": round(exposed, 9)})
+    if not ranking:
+        raise ValueError("no a2a_chunks candidates to rank")
+    # ties break toward fewer chunks — fewer launches, less latency re-paid
+    ranking.sort(key=lambda r: (r["exposed_comm_s"], r["a2a_chunks"]))
+    top = ranking[0]
+    plan = OverlapPlan(prefetch_depth=base.prefetch_depth,
+                       grad_buckets=base.grad_buckets,
+                       n_layers=base.n_layers,
+                       fwd_fraction=base.fwd_fraction,
+                       latency_s=base.latency_s,
+                       a2a_chunks=top["a2a_chunks"])
+    return plan, top["exposed_comm_s"], ranking
+
+
 # ---------------------------------------------------------------------------
 # runtime: the double-buffered layer loop (jax, lazy)
 # ---------------------------------------------------------------------------
@@ -442,3 +623,47 @@ def scheduled_scan(block_fn, carry, n_blocks, fetch, prefetch_depth=1,
         body = jax.checkpoint(body, prevent_cse=False)
     (out, _), _ = lax.scan(body, (carry, buf), jnp.arange(n_blocks))
     return out
+
+
+def moe_chunked_scan(expert_fn, dispatch, n_chunks, depth=1, remat=True):
+    """Chunked-expert streaming loop — the MoE twin of :func:`scheduled_scan`.
+
+    ``dispatch(c)`` performs chunk ``c``'s dispatch all-to-all and returns the
+    exchanged rows; ``expert_fn(rows, c)`` runs the expert GEMM on them (and
+    typically the combine a2a) and returns the chunk's output. With ``depth``
+    D >= 1 the loop issues ``dispatch(c + D)`` *before* ``expert_fn`` consumes
+    chunk ``c`` — the next chunk's a2a has no data dependence on the current
+    chunk's GEMM, so XLA's async-collective scheduling can run them
+    concurrently: the ``a2a_chunks`` knob of :func:`moe_scheduled_intervals`
+    made real program order. Depth 0 degrades to the serialized
+    dispatch-at-use loop. Returns the stacked ``[n_chunks, ...]`` outputs in
+    chunk order."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_chunks = int(n_chunks)
+    depth = max(int(depth), 0)
+    if depth == 0:
+        def body(_, c):
+            return None, expert_fn(dispatch(c), c)
+        if remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        _, ys = lax.scan(body, None, jnp.arange(n_chunks))
+        return ys
+
+    depth = min(depth, max(n_chunks - 1, 1))
+    # pipeline fill: the first D chunks' dispatches issue before the loop
+    buf = tuple(dispatch(jnp.int32(min(k, n_chunks - 1))) for k in range(depth))
+
+    def body(buf, c):
+        # issue the lookahead dispatch FIRST — independent of this chunk's GEMM
+        # (tail iterations re-dispatch the last chunk; the value is unused)
+        nxt = dispatch(jnp.minimum(c + depth, n_chunks - 1))
+        y = expert_fn(buf[0], c)
+        return buf[1:] + (nxt,), y
+
+    if remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    _, ys = lax.scan(body, buf, jnp.arange(n_chunks))
+    return ys

@@ -107,6 +107,29 @@ def fleet_gauge(name, value, **tags):
     _GLOBAL.fleet_gauge(name, value, **tags)
 
 
+def moe_gauge(name, value, **tags):
+    """Record an expert-routing gauge (load fraction, drop rate, a2a wire)."""
+    _GLOBAL.moe_gauge(name, value, **tags)
+
+
+def record_moe_step(exp_counts, total_routed, dropped=0, a2a_wire_bytes=None):
+    """Record one step's expert-routing stats as the three standard MoE
+    gauges. ``exp_counts``: per-expert PRE-drop assignment counts (host-side
+    concrete values — fetch before calling, never at trace time);
+    ``total_routed``: total (token, expert) assignments; ``dropped``: count
+    that exceeded capacity (0 on the dropless path); ``a2a_wire_bytes``: the
+    step's expert all-to-all wire bytes when known."""
+    if not _GLOBAL.enabled:
+        return
+    counts = [float(c) for c in exp_counts]
+    total = float(total_routed) or 1.0
+    _GLOBAL.moe_gauge("moe/expert_load_max_frac",
+                      max(counts) / total if counts else 0.0)
+    _GLOBAL.moe_gauge("moe/drop_rate", float(dropped) / total)
+    if a2a_wire_bytes is not None:
+        _GLOBAL.moe_gauge("moe/a2a_wire_bytes", float(a2a_wire_bytes))
+
+
 def record_handoff(uid, pages, nbytes, seconds, src="prefill", dst="decode",
                    bound=None):
     """Record one prefill->decode KV page handoff (bytes/latency/pages)."""

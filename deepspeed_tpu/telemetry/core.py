@@ -298,6 +298,8 @@ class Telemetry:
         # fleet stream (router admission + prefill/decode handoffs)
         self.fleet_counters = {}   # admission outcome -> count
         self.fleet_gauges = {}     # name -> [last, peak]
+        # moe stream (expert load / drop / a2a wire gauges)
+        self.moe_gauges = {}       # name -> [last, peak]
         self.fleet_handoff = {"count": 0, "pages_shipped": 0,
                               "pages_bound": 0, "bytes": 0, "total_s": 0.0}
         # goodput ledger (seconds per category; idle derived at summary time)
@@ -751,6 +753,39 @@ class Telemetry:
                             "total_s": round(h["total_s"], 6)}}
 
     # ------------------------------------------------------------------
+    # moe stream (docs/OBSERVABILITY.md "MoE")
+    # ------------------------------------------------------------------
+    def moe_gauge(self, name, value, **tags):
+        """Record one expert-routing gauge sample ("moe/expert_load_max_frac",
+        "moe/drop_rate", "moe/a2a_wire_bytes", ...): keeps last + peak, emits
+        a Chrome counter track and a JSONL line. Host-side concrete values
+        only — called post-step on fetched routing stats, never at trace
+        time."""
+        if not self.enabled:
+            return
+        v = float(value)
+        with self._lock:
+            g = self.moe_gauges.get(name)
+            if g is None:
+                self.moe_gauges[name] = [v, v]
+            else:
+                g[0] = v
+                if v > g[1]:
+                    g[1] = v
+            self.trace_events.append(
+                {"name": name, "ph": "C", "cat": "moe",
+                 "ts": round((_now() - self._epoch) * 1e6, 3),
+                 "pid": os.getpid(), "args": {"value": v}})
+            self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
+                              "tags": tags or {}})
+
+    def _moe_summary(self):
+        # caller holds self._lock
+        gauges = {name: {"last": round(g[0], 6), "peak": round(g[1], 6)}
+                  for name, g in sorted(self.moe_gauges.items())}
+        return {"gauges": gauges}
+
+    # ------------------------------------------------------------------
     # memory stream
     # ------------------------------------------------------------------
     def record_memory(self, point, stats=None, device_index=0, **tags):
@@ -1048,7 +1083,8 @@ class Telemetry:
                    "memory": memory,
                    "ledger": self._ledger_summary(),
                    "serving": self._serving_summary(),
-                   "fleet": self._fleet_summary()}
+                   "fleet": self._fleet_summary(),
+                   "moe": self._moe_summary()}
             if self.overlap_report is not None:
                 out["overlap"] = self.overlap_report
             return out

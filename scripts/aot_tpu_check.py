@@ -395,6 +395,58 @@ def multichip_programs(topo):
         rep = NamedSharding(mesh, P())
         return fn, abstract, (tok, tok, tok, rep, rep, rep)
 
+    def moe_gmm_ep2_dropless():
+        # dropless expert parallelism: routed rows sort by owning peer,
+        # ride the explicit dispatch all-to-all into the per-row grouped
+        # GEMM, and come back through the combine a2a — no capacity dim
+        # anywhere, so the whole chain must lower with ragged group sizes
+        from deepspeed_tpu.moe import sharded_moe
+        from deepspeed_tpu.utils import jax_compat
+
+        mesh = Mesh(np.array(topo.devices).reshape(2, 2), ("dp", "ep"))
+        T, D, F, E, k = 64, 256, 512, 4, 2
+
+        def body(xl, gl, el, w1l, w2l, w3l):
+            return sharded_moe._moe_gmm_ep_shard(
+                xl, gl, el, w1l, w2l, w3l, n_experts=E, ep_axis="ep",
+                bits=None, dtype=jnp.bfloat16, interpret=False)
+
+        tok = P(("dp", "ep"))
+        fn = jax_compat.shard_map(
+            body, mesh=mesh,
+            in_specs=(tok, tok, tok, P("ep"), P("ep"), P("ep")),
+            out_specs=tok, check_vma=False)
+        abstract = (jax.ShapeDtypeStruct((T, D), jnp.bfloat16),
+                    jax.ShapeDtypeStruct((T, k), jnp.float32),
+                    jax.ShapeDtypeStruct((T, k), jnp.int32),
+                    jax.ShapeDtypeStruct((E, D, F), jnp.bfloat16),
+                    jax.ShapeDtypeStruct((E, F, D), jnp.bfloat16),
+                    jax.ShapeDtypeStruct((E, D, F), jnp.bfloat16))
+        toksh = NamedSharding(mesh, tok)
+        epsh = NamedSharding(mesh, P("ep"))
+        return fn, abstract, (toksh, toksh, toksh, epsh, epsh, epsh)
+
+    def moe_quant_a2a_ep2():
+        # hierarchy-split expert a2a: full-precision exchange over the ICI
+        # 'ep' ring, int8 + per-group scales over the DCN 'dpr' hop — the
+        # block quant/dequant Pallas kernels must lower inside the
+        # manual-axes shard_map, like qgz_hpz_grad_exchange
+        from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+            moe_hierarchical_a2a)
+        from deepspeed_tpu.utils import jax_compat
+
+        mesh = Mesh(np.array(topo.devices).reshape(2, 2), ("dpr", "ep"))
+
+        def body(x):
+            y = moe_hierarchical_a2a(x, intra_axis="ep", inter_axis="dpr",
+                                     inter_bits=8)
+            return jnp.sum(y.astype(jnp.float32))
+
+        fn = jax_compat.shard_map(body, mesh=mesh, in_specs=(P(),),
+                                  out_specs=P(), check_vma=False)
+        abstract = (jax.ShapeDtypeStruct((2, 2, 16, 2048), jnp.float32),)
+        return fn, abstract, (NamedSharding(mesh, P()),)
+
     def serving_ragged_tp2():
         # FastGen TP serving: the bench_serving ragged decode step under
         # tp=2 x dp=2 — paged_mha (inside lax.scan over layers) must
@@ -477,6 +529,8 @@ def multichip_programs(topo):
             ("llama_tp2xdp2_zero_fwd_bwd", llama_tp2_dp2),
             ("flash_ulysses_sp2_fwd_bwd", flash_ulysses_sp2),
             ("moe_gmm_ep2_fwd", moe_gmm_ep2),
+            ("moe_gmm_ep2_dropless", moe_gmm_ep2_dropless),
+            ("moe_quant_a2a_ep2", moe_quant_a2a_ep2),
             ("serving_ragged_tp2", serving_ragged_tp2)]
 
 

@@ -61,7 +61,14 @@ validates the checked-in elastic-reshard drill baseline
 (``onchip_results/elastic_drill_baseline.json``): world sequence 8→4→8,
 zero steps lost or double-applied, bitwise-equal restore-step losses, and
 each reshard leg under the wall-clock ceiling
-(``check_elastic_baseline``) — then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run`` against
+(``check_elastic_baseline``) — and traces the MoE hierarchical expert
+all-to-all on 8 forced-host CPU devices requiring the quantized DCN leg's
+wire bytes <= 0.5x fp32 with the ICI leg full precision
+(``check_moe_wire``), and re-derives the checked-in MoE scheduled overlap
+baseline (``onchip_results/moe_overlap_baseline.json``) jax-free,
+requiring the chunked a2a/expert pipeline's exposed seconds to reproduce
+and to sit >= 30% below its serialized worst case
+(``check_moe_baseline``) — then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run`` against
 the repo's own BASELINE.json so a malformed baseline, summary, or tuning
 table fails fast on CPU (docs/OBSERVABILITY.md).
 """
@@ -358,6 +365,79 @@ def check_qgz_wire():
     elif dcn["ratio"] > QGZ_WIRE_MAX_RATIO:
         errors.append(f"qgz DCN wire ratio {dcn['ratio']} > "
                       f"{QGZ_WIRE_MAX_RATIO}")
+    return report, errors
+
+
+#: MoE expert a2a acceptance: wire bytes of the quantized DCN dispatch/
+#: combine leg relative to fp32 (int8 + fp32 group scales ≈ 0.26); the ICI
+#: leg must stay full precision (payload-preserving token exchange)
+MOE_WIRE_MAX_RATIO = 0.5
+
+
+def check_moe_wire():
+    """Trace (compile nothing, execute nothing) the hierarchical MoE expert
+    all-to-all on 8 forced-host CPU devices and require the DCN (``dpr``)
+    leg's wire bytes <= ``MOE_WIRE_MAX_RATIO`` x the logical fp32 bytes
+    while the ICI (``ep``) leg stays full precision. Same trace-only idiom
+    as :func:`check_qgz_wire` — the collectives record ``wire_bytes``
+    telemetry at trace time under the "a2a_dispatch" op.
+
+    Returns (report, errors); skipped without error when jax is missing or
+    the host cannot present 8 devices."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        import jax
+    except Exception as e:  # pragma: no cover - jax is baked into the image
+        return {"skipped": f"jax unavailable: {e}"}, []
+    if len(jax.devices()) < 8:
+        return {"skipped": f"needs 8 devices, have {len(jax.devices())}"}, []
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+        moe_hierarchical_a2a)
+
+    telemetry.configure(enabled=True, sample_sync=False)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dpr", "ep"))
+    # [inter, intra, rows, d_model] per-peer token slabs
+    tok = jax.ShapeDtypeStruct((4, 2, 16, 2048), jnp.float32)
+    fn = jax.shard_map(
+        lambda x: moe_hierarchical_a2a(x, intra_axis="ep", inter_axis="dpr",
+                                       inter_bits=8),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
+    jax.jit(fn).lower(tok)   # trace-time record_comm only
+
+    ops = telemetry.summary().get("comm", {}).get("ops", {})
+    report, errors = {}, []
+    a2a = ops.get("a2a_dispatch", {})
+    if not a2a:
+        return report, ["moe trace recorded no a2a_dispatch telemetry"]
+    for axis, st in sorted(a2a.items()):
+        ratio = (st["wire_bytes"] / st["bytes"]) if st["bytes"] else 0.0
+        report[axis] = {"bytes": st["bytes"],
+                        "wire_bytes": st["wire_bytes"],
+                        "ratio": round(ratio, 4)}
+    dcn = report.get("dpr")
+    ici = report.get("ep")
+    if dcn is None:
+        errors.append("moe trace recorded no DCN (dpr) a2a leg")
+    elif dcn["ratio"] > MOE_WIRE_MAX_RATIO:
+        errors.append(f"moe DCN a2a wire ratio {dcn['ratio']} > "
+                      f"{MOE_WIRE_MAX_RATIO}")
+    if ici is None:
+        errors.append("moe trace recorded no ICI (ep) a2a leg")
+    elif ici["wire_bytes"] != ici["bytes"]:
+        errors.append(
+            f"moe ICI a2a leg is not full precision "
+            f"(wire {ici['wire_bytes']} != logical {ici['bytes']}) — "
+            "quantization belongs on the DCN leg only")
     return report, errors
 
 
@@ -665,6 +745,68 @@ def check_overlap_schedule(baseline_path=None):
             if serialized > 0 else 0.0,
             "prefetch_depth": plan.prefetch_depth,
             "grad_buckets": plan.grad_buckets}, errors
+
+
+MOE_OVERLAP_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                         "moe_overlap_baseline.json")
+
+
+def check_moe_baseline(baseline_path=None):
+    """Re-derive the checked-in MoE scheduled overlap baseline jax-free and
+    hold it to the ratchet: rebuild the chunked dispatch/expert/combine
+    timeline from the recorded ``extra.overlap.schedule`` block, require the
+    recomputed exposed seconds to match the recorded value, and require
+    exposed <= ``OVERLAP_SCHEDULE_MAX_RATIO`` x the serialized worst case —
+    :func:`check_overlap_schedule`'s twin over
+    ``moe_scheduled_intervals``/``moe_plan_exposure``. Returns
+    (report, errors) for the dry-run lane."""
+    path = baseline_path or MOE_OVERLAP_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no moe scheduled baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable moe scheduled baseline {path}"]
+    ov = doc.get("extra", {}).get("overlap") if isinstance(doc, dict) else None
+    sched = ov.get("schedule") if isinstance(ov, dict) else None
+    if not isinstance(sched, dict):
+        return {}, ["moe baseline has no extra.overlap.schedule block"]
+    try:
+        osched = _load_overlap_schedule_module()
+    except Exception as e:
+        return {}, [f"cannot load overlap_schedule module: {e}"]
+    errors = [f"schedule block: {e}"
+              for e in osched.validate_schedule(sched)]
+    if errors:
+        return {}, errors
+    moe_classes = {"moe_dispatch", "moe_combine"}
+    if not any(osched._op_class(s.get("op")) in moe_classes
+               for s in sched["comm_ops"]):
+        return {}, ["moe baseline schedule has no a2a_dispatch/a2a_combine "
+                    "ops — not an MoE inventory"]
+    plan = osched.OverlapPlan.from_dict(sched)
+    recomputed = osched.moe_plan_exposure(sched["compute_s"],
+                                          sched["comm_ops"], plan)
+    recorded = float(ov.get("exposed_comm_s", doc.get("value", -1.0)))
+    serialized = float(sched["serialized_exposed_comm_s"])
+    tol = max(1e-9, 1e-4 * max(serialized, recorded))
+    if abs(recomputed - recorded) > tol:
+        errors.append(
+            f"recomputed moe exposed {recomputed:.3e}s does not match the "
+            f"recorded baseline {recorded:.3e}s — the schedule block and "
+            f"payload value drifted apart (regenerate with "
+            f"python bench.py --moe)")
+    if serialized > 0 and recomputed > OVERLAP_SCHEDULE_MAX_RATIO * serialized:
+        errors.append(
+            f"moe scheduled exposed {recomputed:.3e}s > "
+            f"{OVERLAP_SCHEDULE_MAX_RATIO} x serialized {serialized:.3e}s — "
+            f"the chunked a2a pipeline no longer hides >= "
+            f"{1 - OVERLAP_SCHEDULE_MAX_RATIO:.0%} of the worst case")
+    return {"exposed_comm_s": round(recomputed, 9),
+            "serialized_exposed_comm_s": serialized,
+            "reduction_fraction": round(
+                (serialized - recomputed) / serialized, 6)
+            if serialized > 0 else 0.0,
+            "a2a_chunks": plan.a2a_chunks}, errors
 
 
 #: prefix-cache acceptance for the checked-in shared-prefix replay baseline:
@@ -1069,12 +1211,18 @@ def main(argv=None):
         qgz_report, qgz_errors = check_qgz_wire()
         for err in qgz_errors:
             print(f"perf_gate: qgz_wire: {err}", file=sys.stderr)
+        moe_wire_report, moe_wire_errors = check_moe_wire()
+        for err in moe_wire_errors:
+            print(f"perf_gate: moe_wire: {err}", file=sys.stderr)
         overlap_report, overlap_errors = check_overlap_analytic()
         for err in overlap_errors:
             print(f"perf_gate: overlap: {err}", file=sys.stderr)
         sched_report, sched_errors = check_overlap_schedule()
         for err in sched_errors:
             print(f"perf_gate: overlap_schedule: {err}", file=sys.stderr)
+        moe_base_report, moe_base_errors = check_moe_baseline()
+        for err in moe_base_errors:
+            print(f"perf_gate: moe_baseline: {err}", file=sys.stderr)
         prefix_report, prefix_errors = check_prefix_baseline()
         for err in prefix_errors:
             print(f"perf_gate: prefix_cache: {err}", file=sys.stderr)
@@ -1090,15 +1238,18 @@ def main(argv=None):
         lint_report, lint_errors = check_lint_baseline()
         for err in lint_errors:
             print(f"perf_gate: lint: {err}", file=sys.stderr)
-        errors = table_errors + qgz_errors + overlap_errors + sched_errors \
+        errors = table_errors + qgz_errors + moe_wire_errors \
+            + overlap_errors + sched_errors + moe_base_errors \
             + prefix_errors + fleet_errors + longctx_errors \
             + elastic_errors + lint_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
                           "qgz_wire": qgz_report,
+                          "moe_wire": moe_wire_report,
                           "overlap": overlap_report,
                           "overlap_schedule": sched_report,
+                          "moe_baseline": moe_base_report,
                           "prefix_cache": prefix_report,
                           "fleet": fleet_report,
                           "longctx": longctx_report,

@@ -47,5 +47,5 @@ def test_all_pallas_kernels_lower_for_v5e(tmp_path):
     # "NotImplementedError: Mosaic kernels cannot be automatically
     # partitioned"
     assert {"llama_tp2xdp2_zero_fwd_bwd", "flash_ulysses_sp2_fwd_bwd",
-            "moe_gmm_ep2_fwd", "serving_ragged_tp2",
-            "qgz_hpz_grad_exchange"} <= names
+            "moe_gmm_ep2_fwd", "moe_gmm_ep2_dropless", "moe_quant_a2a_ep2",
+            "serving_ragged_tp2", "qgz_hpz_grad_exchange"} <= names

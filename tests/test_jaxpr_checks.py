@@ -129,13 +129,16 @@ def test_check_program_composes_all_three():
 # ---------------------------------------------------------------------------
 
 def test_op_class_mirrors_overlap_schedule():
-    # jaxpr_checks hand-copies the prefetch/bucket/tail mapping so the
+    # jaxpr_checks hand-copies the prefetch/bucket/tail/moe mapping so the
     # stdlib CLI never imports the runtime; this is the sync guard
     from deepspeed_tpu.runtime.zero.overlap_schedule import _op_class
     for op in ("all_gather", "gather", "reduce_scatter", "psum_scatter",
                "all_to_all", "exchange", "all_reduce", "ppermute",
-               "halo", "send"):
+               "halo", "send", "a2a_dispatch", "a2a_combine"):
         assert jc.op_class(op) == _op_class(op), op
+    # and the moe ops must NOT fall into the generic bucket class
+    assert jc.op_class("a2a_dispatch") == "moe_dispatch"
+    assert jc.op_class("a2a_combine") == "moe_combine"
 
 
 def test_merge_inventories_sums_ops_and_classes():
@@ -246,6 +249,106 @@ def test_plan_drift_against_traced_inventory(scheduled_traces):
     blind = {"comm_ops": [{"op": ghost_op, "count": 8}]}
     res = jc.check_plan_drift(blind, merged)
     assert not res["ok"] and res["missing_in_plan"], res
+
+
+# ---------------------------------------------------------------------------
+# MoE micro-step (ISSUE 15): bound a2a + wire precision
+# ---------------------------------------------------------------------------
+
+def _trace_moe_shard(bits):
+    """jaxpr of the dropless ep micro-step (shard_map'd _moe_gmm_ep_shard),
+    exactly as _gmm_ep_forward wires it — make_jaxpr only."""
+    from deepspeed_tpu.moe.sharded_moe import _moe_gmm_ep_shard
+
+    mesh = jax.make_mesh((4, 2), ("dp", "ep"))
+    S, D, F, E, k = 32, 256, 256, 4, 2
+
+    def body(xl, gl, el, w1l, w2l, w3l):
+        return _moe_gmm_ep_shard(xl, gl, el, w1l, w2l, w3l, n_experts=E,
+                                 ep_axis="ep", bits=bits, dtype=jnp.float32,
+                                 interpret=True)
+
+    tok = P(("dp", "ep"), None)
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(tok, tok, tok, P("ep"), P("ep"), P("ep")),
+                       out_specs=tok, check_vma=False)
+    return jax.make_jaxpr(fn)(
+        jnp.zeros((S, D), jnp.float32), jnp.zeros((S, k), jnp.float32),
+        jnp.zeros((S, k), jnp.int32), jnp.zeros((E, D, F), jnp.float32),
+        jnp.zeros((E, F, D), jnp.float32), jnp.zeros((E, D, F), jnp.float32))
+
+
+def test_moe_micro_step_a2a_is_bound_and_clean():
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    closed = _trace_moe_shard(bits=None)
+    # every dispatch/combine all_to_all is shard_map-bound, no callbacks
+    assert jc.check_program(closed, dtype="float32") == []
+    inv = jc.collective_inventory(closed)
+    assert inv["ops"].get("all_to_all", 0) >= 3  # x out, ids, y back
+
+
+def test_moe_unsharded_a2a_is_flagged():
+    # the same body traced WITHOUT a shard_map binding 'ep' — the unbound
+    # dispatch/combine a2a the lint lane must catch
+    from deepspeed_tpu.moe.sharded_moe import _moe_gmm_ep_shard
+
+    S, D, F, E, k = 16, 128, 128, 4, 2
+
+    def body(xl, gl, el, w1l, w2l, w3l):
+        return _moe_gmm_ep_shard(xl, gl, el, w1l, w2l, w3l, n_experts=E,
+                                 ep_axis="ep", bits=None, dtype=jnp.float32,
+                                 interpret=True)
+
+    closed = jax.make_jaxpr(body, axis_env=[("ep", 2)])(
+        jnp.zeros((S, D), jnp.float32), jnp.zeros((S, k), jnp.float32),
+        jnp.zeros((S, k), jnp.int32),
+        jnp.zeros((E // 2, D, F), jnp.float32),
+        jnp.zeros((E // 2, F, D), jnp.float32),
+        jnp.zeros((E // 2, D, F), jnp.float32))
+    findings = jc.check_collectives(closed)
+    assert findings and all(f["check"] == "JX002" for f in findings)
+    assert any("all_to_all" in f["eqn"] for f in findings)
+    # vouching for the externally-bound axis silences it
+    assert jc.check_collectives(closed, extra_bound=("ep",)) == []
+
+
+def test_moe_wire_quantized_vs_fp_leg():
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    # int8 configured AND traced -> clean
+    assert jc.check_moe_wire(_trace_moe_shard(bits=8), wire_bits=8) == []
+    # int8 configured but the trace ships fp -> JX004, loudly
+    findings = jc.check_moe_wire(_trace_moe_shard(bits=None), wire_bits=8)
+    assert len(findings) == 1 and findings[0]["check"] == "JX004"
+    assert "never materialized" in findings[0]["message"]
+    # no bits configured -> nothing to check
+    assert jc.check_moe_wire(_trace_moe_shard(bits=None), wire_bits=None) == []
+
+
+def test_moe_hierarchical_wire_int8_rides_dcn_only():
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+        moe_hierarchical_a2a)
+
+    mesh = jax.make_mesh((4, 2), ("dpr", "ep"))
+
+    def trace(inter_bits):
+        fn = jax.shard_map(
+            lambda x: moe_hierarchical_a2a(x, intra_axis="ep",
+                                           inter_axis="dpr",
+                                           inter_bits=inter_bits),
+            mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+        return jax.make_jaxpr(fn)(
+            jnp.zeros((4, 2, 16, 2048), jnp.float32))
+
+    closed = trace(8)
+    assert jc.check_program(closed, dtype="float32") == []
+    assert jc.check_moe_wire(closed, wire_bits=8, inter_axis="dpr") == []
+    # fp over DCN where int8 was configured -> the (b) finding
+    findings = jc.check_moe_wire(trace(None), wire_bits=8, inter_axis="dpr")
+    assert len(findings) == 1 and findings[0]["check"] == "JX004"
 
 
 @pytest.fixture(scope="module")

@@ -77,6 +77,13 @@ def test_disabled_noop_fast_path(tmp_path, monkeypatch):
     telemetry.serving_gauge("serving/running", 3)
     telemetry.record_request_phase(0, "decode", 0.0, 0.01, tokens=1)
 
+    # moe-stream entry points (ISSUE 15) ride the same guarantee: no
+    # iteration over exp_counts, no gauge state, no sink writes
+    telemetry.moe_gauge("moe/expert_load_max_frac", 0.5)
+    telemetry.record_moe_step([4, 4, 8, 0], 16, dropped=2,
+                              a2a_wire_bytes=1 << 20)
+    assert telemetry.get_telemetry().moe_gauges == {}
+
     # the memory/ledger hooks must be no-ops too — zero device reads
     from deepspeed_tpu.telemetry.core import Telemetry
 
@@ -234,14 +241,58 @@ def test_summary_schema_validation():
     telemetry.record_compile("p1", 2.0, topology="v5e:2x2", cache="miss")
     telemetry.record_compile("p2", 0.1, topology="v5e:2x2", cache="hit")
     telemetry.count("steps", phase="train")
+    telemetry.record_moe_step([4, 4, 8, 0], 16, dropped=0,
+                              a2a_wire_bytes=1 << 20)
     s = telemetry.summary()
     jsonschema.validate(s, schema)
+    assert set(s["moe"]["gauges"]) == {"moe/expert_load_max_frac",
+                                       "moe/drop_rate", "moe/a2a_wire_bytes"}
     assert s["compile"]["cache_hits"] == 1 and s["compile"]["cache_misses"] == 1
     # a malformed outcome must be rejected — the schema actually constrains
     bad = json.loads(json.dumps(s))
     bad["dispatch"]["flash_mha"]["exploded"] = bad["dispatch"]["flash_mha"].pop("sharded")
     with pytest.raises(jsonschema.ValidationError):
         jsonschema.validate(bad, schema)
+
+
+# ---------------------------------------------------------------------------
+# moe stream (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_moe_gauge_last_and_peak(tmp_path):
+    jl = tmp_path / "m.jsonl"
+    telemetry.configure(enabled=True, jsonl_path=str(jl))
+    telemetry.moe_gauge("moe/expert_load_max_frac", 0.5)
+    telemetry.moe_gauge("moe/expert_load_max_frac", 0.25, step=2)
+    s = telemetry.summary()
+    g = s["moe"]["gauges"]["moe/expert_load_max_frac"]
+    assert g == {"last": 0.25, "peak": 0.5}
+    # Chrome counter track + JSONL line per sample
+    events = [e for e in telemetry.get_telemetry().trace_events
+              if e.get("cat") == "moe"]
+    assert len(events) == 2 and all(e["ph"] == "C" for e in events)
+    telemetry.close()
+    lines = [json.loads(ln) for ln in jl.read_text().splitlines()]
+    moe_lines = [ln for ln in lines
+                 if ln.get("name") == "moe/expert_load_max_frac"]
+    assert len(moe_lines) == 2
+    assert moe_lines[1]["tags"] == {"step": 2}
+
+
+def test_record_moe_step_standard_gauges():
+    telemetry.configure(enabled=True)
+    # 16 (token, choice) assignments, 2 of which overflowed capacity
+    telemetry.record_moe_step([4, 4, 8, 0], 16, dropped=2,
+                              a2a_wire_bytes=2048)
+    g = telemetry.summary()["moe"]["gauges"]
+    assert g["moe/expert_load_max_frac"]["last"] == pytest.approx(0.5)
+    assert g["moe/drop_rate"]["last"] == pytest.approx(2 / 16)
+    assert g["moe/a2a_wire_bytes"]["last"] == 2048.0
+    # dropless step: drop_rate pins to 0, wire gauge optional
+    telemetry.record_moe_step([8, 8, 0, 0], 16)
+    g = telemetry.summary()["moe"]["gauges"]
+    assert g["moe/drop_rate"]["last"] == 0.0
+    assert g["moe/a2a_wire_bytes"]["last"] == 2048.0  # unchanged
 
 
 def test_monitor_events_bridge():
