@@ -39,8 +39,8 @@ except ImportError:  # pragma: no cover - older jax
 
 __all__ = [
     "iter_eqns", "check_upcasts", "check_collectives", "check_callbacks",
-    "check_program", "check_moe_wire", "collective_inventory",
-    "check_plan_drift", "trace_jaxpr",
+    "check_program", "check_moe_wire", "check_verify_prefill_parity",
+    "collective_inventory", "check_plan_drift", "trace_jaxpr",
 ]
 
 #: collective primitives and how they map onto the overlap plan's op names
@@ -296,6 +296,62 @@ def check_program(closed, dtype="bfloat16", min_elems=4096,
         findings += check_upcasts(closed, min_elems=min_elems)
     findings += check_collectives(closed, extra_bound=extra_bound)
     findings += check_callbacks(closed, allow=allow_callbacks)
+    return findings
+
+
+def _scan_signatures(closed):
+    """(printed body jaxpr, location) of every ``scan`` eqn in trace order."""
+    sigs = []
+    for eqn, _axes, path in iter_eqns(closed):
+        if eqn.primitive.name == "scan":
+            sigs.append((str(eqn.params.get("jaxpr", "")), _eqn_loc(eqn, path)))
+    return sigs
+
+
+def check_verify_prefill_parity(prefill_closed, verify_closed):
+    """JX005: the speculative verify forward must lower through the SAME
+    layer ``scan`` as plain ragged prefill. The draft-then-verify design
+    only holds its bit-exactness oracle (and its cost model) if the verify
+    chunk rides the ragged prefill kernels — a forked trunk or a
+    dense-decode fallback would silently re-trace a different layer program
+    whose logits can drift from the plain decode stream. Both programs
+    close over the shared ``_ragged_trunk``, so their layer scans must
+    print identically; any divergence is a fork.
+
+    Pass the two ``jax.make_jaxpr`` traces (plain ``ragged_forward`` and
+    ``ragged_forward_verify``) over the same pool/table shapes."""
+    findings = []
+    pre = _scan_signatures(prefill_closed)
+    ver = _scan_signatures(verify_closed)
+    if not pre:
+        findings.append({
+            "check": "JX005", "severity": "error",
+            "eqn": "scan (prefill program)",
+            "message": "plain prefill traced no layer scan — cannot "
+                       "establish the kernel the verify forward must share",
+        })
+    if not ver:
+        findings.append({
+            "check": "JX005", "severity": "error",
+            "eqn": "scan (verify program)",
+            "message": "verify forward traced no layer scan — the draft "
+                       "chunk is not running the scanned ragged prefill "
+                       "kernels at all",
+        })
+    if findings:
+        return findings
+    if [s for s, _ in pre] != [s for s, _ in ver]:
+        where = next((loc for (sp, _), (sv, loc) in zip(pre, ver)
+                      if sp != sv), ver[0][1])
+        findings.append({
+            "check": "JX005", "severity": "error",
+            "eqn": where,
+            "message": (f"verify forward's layer scan diverges from plain "
+                        f"prefill ({len(pre)} vs {len(ver)} scans) — the "
+                        f"verify chunk is not lowering through the shared "
+                        f"ragged prefill kernel (trunk fork or dense-decode "
+                        f"fallback); bit-exact accept/reject is void"),
+        })
     return findings
 
 

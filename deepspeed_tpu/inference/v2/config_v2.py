@@ -43,6 +43,30 @@ class ModulesConfig(DeepSpeedConfigModel):
     linear = "auto"           # must stay "auto" here; see docstring
 
 
+class SpeculativeConfig(DeepSpeedConfigModel):
+    """Draft-then-verify decode knobs.
+
+    Self-speculation by default: an n-gram prompt-lookup drafter (zero extra
+    weights) proposes up to ``max_draft_tokens`` per decode row; the verify
+    round batches ``[last_token] + drafts`` through the same ragged prefill
+    kernel as a SplitFuse chunk and rolls the paged cursor back over any
+    rejected tail. Generation is bit-exact with plain decode either way
+    (test-pinned): accepted tokens are by construction exactly the tokens
+    plain decode would have emitted at those ``(seed, position)`` stream
+    points, so the knob only changes how many forwards the stream costs.
+    """
+    enabled = False
+    # max drafted tokens per sequence per round (verify chunk is this + 1)
+    max_draft_tokens = 4
+    # longest suffix n-gram the drafter matches against prompt+generated
+    ngram_max = 3
+    # second, smaller page-size class for draft-model KV: draft pages are
+    # parent blocks carved into ``draft_page_divisor`` sub-pages riding the
+    # same refcounted pool. 0 disables the class (self-speculation drafts
+    # no KV).
+    draft_page_divisor = 0
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     """Top-level v2 config (reference ``config_v2.py:29``)."""
     tensor_parallel = {"tp_size": 1}
@@ -54,3 +78,6 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     # way (test-pinned) but the knob gates all hashing/refcount bookkeeping
     # so the disabled path does zero extra work per step.
     prefix_caching = False
+    # draft-then-verify decode (see SpeculativeConfig). Default off: the
+    # disabled path does zero extra work per step (test-pinned).
+    speculative = SpeculativeConfig()

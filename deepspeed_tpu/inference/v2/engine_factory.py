@@ -74,7 +74,24 @@ def resolve_forward_fn(model, family=None):
     return ragged_forward
 
 
+def resolve_verify_fn(model, family=None):
+    """The k-token verify forward for a model family, or ``None`` when the
+    family has no speculative-verify implementation yet (the engine refuses
+    speculation rather than silently falling back to a different program)."""
+    if family is None:
+        name = type(model.config).__name__
+        family = {"MixtralConfig": "mixtral",
+                  "ParallelBlockConfig": "falcon",
+                  "OPTConfig": "opt"}.get(name, "llama")
+    if family in ("mixtral", "falcon", "phi", "opt"):
+        return None
+    from deepspeed_tpu.inference.v2.model_implementations.llama import (
+        ragged_forward_verify)
+    return ragged_forward_verify
+
+
 def build_engine(model, params, engine_config=None, family=None):
     """Build a ragged engine from an in-tree flax model + param tree."""
     return InferenceEngineV2(model, params, engine_config,
-                             forward_fn=resolve_forward_fn(model, family))
+                             forward_fn=resolve_forward_fn(model, family),
+                             verify_fn=resolve_verify_fn(model, family))

@@ -36,7 +36,8 @@ class InferenceEngineV2:
         config: ``RaggedInferenceEngineConfig`` or dict.
     """
 
-    def __init__(self, model, params, config=None, forward_fn=None):
+    def __init__(self, model, params, config=None, forward_fn=None,
+                 verify_fn=None):
         if not isinstance(config, RaggedInferenceEngineConfig):
             config = RaggedInferenceEngineConfig(config or {})
         self._config = config
@@ -47,10 +48,18 @@ class InferenceEngineV2:
             # standalone construction: infer via the factory's policy map
             from deepspeed_tpu.inference.v2.engine_factory import resolve_forward_fn
             forward_fn = resolve_forward_fn(model)
+        if verify_fn is None:
+            from deepspeed_tpu.inference.v2.engine_factory import resolve_verify_fn
+            verify_fn = resolve_verify_fn(model)
         if type(cfg).__name__ != "MixtralConfig" and \
                 not getattr(cfg, "scan_layers", True):
             raise ValueError("ragged llama engine requires scan_layers=True params")
         self._ragged_forward = forward_fn
+        self._verify_forward = verify_fn
+        if config.speculative.enabled and verify_fn is None:
+            raise ValueError(
+                "speculative.enabled requires a verify forward; "
+                f"{type(cfg).__name__} has none (resolve_verify_fn)")
         # module pins ride the STATIC model config (a frozen dataclass, jit
         # cache key), so two engines with different pins can never share a
         # compiled program traced under the other's selection. Names are
@@ -204,9 +213,19 @@ class InferenceEngineV2:
 
     # -- serving (reference engine_v2.py:107) ------------------------------
     def _forward_device(self, batch_uids: List[int],
-                        batch_tokens: List[np.ndarray]):
+                        batch_tokens: List[np.ndarray],
+                        verify_k: int = None, defer_commit=()):
         """Run one ragged forward; returns the FULL padded [S_max, vocab]
-        logits as a device array (no host transfer)."""
+        logits as a device array (no host transfer).
+
+        ``verify_k``: when set, dispatch the k-token verify forward instead
+        (same trunk, JX005-pinned) and return [S_max, verify_k, vocab]
+        logits covering the last ``verify_k`` chunk positions per row.
+        ``defer_commit``: uids whose prefix-cache block commit is postponed
+        (speculating rows — rejected chunk tails must be rolled back before
+        any block digest is registered, or a wrong draft would poison the
+        shared chain cache; the scheduler calls ``commit_prefix`` after
+        accept/rollback)."""
         verdict = self.can_schedule(batch_uids, [len(t) for t in batch_tokens])
         if not verdict.success:
             raise RuntimeError(f"cannot schedule batch: {verdict.reason}")
@@ -234,16 +253,25 @@ class InferenceEngineV2:
         kv = self._state.kv_cache
         # fwd_k/fwd_v are (int8, scale) pairs when kv_dtype="int8" — they
         # flow through the jitted forwards as pytree leaves
-        logits, k_pool, v_pool = self._ragged_forward(
-            self._model_config, self._params, kv.fwd_k, kv.fwd_v,
-            jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["q_len"]),
-            jnp.asarray(arrays["seen"]), jnp.asarray(arrays["block_tables"]))
+        if verify_k is not None:
+            if self._verify_forward is None:
+                raise RuntimeError("no verify forward for this model family")
+            logits, k_pool, v_pool = self._verify_forward(
+                self._model_config, self._params, kv.fwd_k, kv.fwd_v,
+                jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["q_len"]),
+                jnp.asarray(arrays["seen"]), jnp.asarray(arrays["block_tables"]),
+                int(verify_k))
+        else:
+            logits, k_pool, v_pool = self._ragged_forward(
+                self._model_config, self._params, kv.fwd_k, kv.fwd_v,
+                jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["q_len"]),
+                jnp.asarray(arrays["seen"]), jnp.asarray(arrays["block_tables"]))
         kv.update(k_pool, v_pool)
 
         for uid in batch_uids:
             seq = self._state.get_sequence(uid)
             seq.post_forward()
-            if caching:
+            if caching and uid not in defer_commit:
                 # register blocks as they FILL (not at flush) so concurrent
                 # requests sharing a prefix hit as early as possible
                 self._state.commit_cached_blocks(seq)
@@ -309,6 +337,65 @@ class InferenceEngineV2:
         return self.host_fetch(self.put_sampled_device(
             batch_uids, batch_tokens, temperatures, top_ks, top_ps, seeds,
             positions), "serving/sampled_ids")[:len(batch_uids)]
+
+    # -- speculative decode (draft-then-verify) ----------------------------
+    @property
+    def verify_supported(self) -> bool:
+        """Whether this engine's model family has a k-token verify forward
+        (speculative decode requires it; see ``resolve_verify_fn``)."""
+        return self._verify_forward is not None
+
+    def put_verify_device(self, batch_uids: List[int],
+                          batch_tokens: List[np.ndarray],
+                          temperatures, top_ks, top_ps, seeds,
+                          positions, k_max: int, defer_commit=()):
+        """``put_sampled_device`` for a verify round: one forward through
+        the SAME ragged prefill kernel, but the sampler draws target tokens
+        at the last ``k_max`` chunk positions per row (LAST-aligned: column
+        ``k_max-1`` is each row's ordinary last-token draw). ``positions``
+        gives each row's stream position for that FINAL column — column
+        ``c`` is then the token plain decode would emit at stream position
+        ``positions[s] - (k_max-1) + c``. Returns PADDED device
+        [S-bucket, k_max] int32 ids (rows past ``len(uids)`` are padding);
+        the scheduler fetches once per round and walks each row's accept
+        prefix on the host.
+
+        ``k_max`` is static (a per-engine pow2 bucket), so one compiled
+        verify program serves every round regardless of how many drafts
+        each drafter actually produced. ``defer_commit`` is forwarded to
+        ``_forward_device`` (see there).
+        """
+        from deepspeed_tpu.inference.v2.sampling import verify_rows_packed
+        logits = self._forward_device(batch_uids, batch_tokens,
+                                      verify_k=int(k_max),
+                                      defer_commit=defer_commit)
+        s_max = logits.shape[0]
+        n = len(batch_uids)
+        seeds = [int(s) & 0x7FFFFFFF for s in seeds]
+        fparams = np.zeros((2, s_max), np.float32)
+        fparams[0, :n] = temperatures
+        fparams[1, :n] = top_ps
+        iparams = np.zeros((3, s_max), np.int32)
+        iparams[0, :n] = top_ks
+        iparams[1, :n] = seeds
+        iparams[2, :n] = positions
+        return verify_rows_packed(logits, fparams, iparams)
+
+    def rollback(self, uid: int, n_tokens: int) -> None:
+        """Roll ``uid``'s paged cursor back ``n_tokens`` (the rejected tail
+        of a verify chunk): tail blocks that fall wholly past the new
+        cursor are dereferenced — shared prefix blocks survive (COW
+        boundary), this-round private allocations return to the pool."""
+        self._state.rollback_sequence(uid, n_tokens)
+
+    def commit_prefix(self, uid: int) -> None:
+        """Run the deferred prefix-cache block commit for a speculating row
+        (after accept/rollback, so only verified tokens can enter the
+        chain-digest cache). No-op when caching is off."""
+        if self._state.prefix_cache is not None:
+            seq = self._state.get_sequence(uid)
+            if seq is not None:
+                self._state.commit_cached_blocks(seq)
 
     def flush(self, uid: int) -> None:
         """Retire a sequence, freeing its KV blocks (reference :242)."""

@@ -368,13 +368,19 @@ class PrefillDecodeFleet:
         return self.results()
 
     def load_report(self):
-        """Per-replica load by role + transport accounting."""
+        """Per-replica load by role + transport accounting.
+        ``tokens_per_round`` is each replica's live accept-rate EWMA (1.0
+        unless it speculates) — the signal the SLO router divides its
+        backlog-rounds estimate by. A speculating decode side is just a
+        ``decode_engine_config`` with ``speculative.enabled``; the configs
+        flow through ``build_replica`` untouched."""
         per = []
         for role, side in (("prefill", self.prefill),
                            ("decode", self.decode)):
             for i, (mesh, sched) in enumerate(side):
                 per.append({"replica": f"{role}{i}", "role": role,
                             "active": sched.active_count(),
+                            "tokens_per_round": sched.tokens_per_round(),
                             "kv_occupancy":
                                 sched.kv_stats()["occupancy"]})
         return {"replicas": per, "transport": self.transport.stats()}

@@ -111,11 +111,19 @@ class SLORouter:
     def predicted_ttft(self, index, prompt_len, affinity_tokens=0):
         """Predicted submit->first-token seconds on replica ``index``:
         rounds to burn through (backlog + this prompt - cached prefix) at
-        the replica's token budget, times the measured per-round seconds,
-        amplified when its KV pool is near capacity."""
+        the replica's per-round throughput, times the measured per-round
+        seconds, amplified when its KV pool is near capacity.
+
+        Per-round throughput is the token budget times the replica's live
+        ``tokens_per_round`` accept-rate EWMA (1.0 without speculation): a
+        speculating replica retires several backlog tokens per decode round,
+        and modeling it at 1/round would systematically over-predict its
+        TTFT and starve it of placements it can actually serve fastest."""
         t = self._targets[index]
         owed = self._backlog[index] + max(prompt_len - affinity_tokens, 1)
-        rounds = math.ceil(owed / max(t.budget, 1))
+        tpr_fn = getattr(t, "tokens_per_round", None)
+        tpr = max(1.0, float(tpr_fn())) if tpr_fn is not None else 1.0
+        rounds = math.ceil(owed / (max(t.budget, 1) * tpr))
         ttft = rounds * self._step_seconds()
         if t.kv_stats()["occupancy"] >= self._occ_high:
             ttft *= self._occ_penalty

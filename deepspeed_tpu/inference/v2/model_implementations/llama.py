@@ -164,12 +164,16 @@ def _paged_attention_dense(q, k_pool, v_pool, block_tables, seen, block_size,
     return jax.vmap(one_seq)(q, block_tables, seen)
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
-def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
-                   block_tables):
-    """One ragged forward step.
+def _ragged_trunk(cfg, params, k_pool, v_pool, tokens, q_len, seen,
+                  block_tables):
+    """Shared embedding -> scanned-layers -> final-norm trunk.
 
-    Returns (last-token logits [S, V], new k_pool, new v_pool).
+    Both ``ragged_forward`` (plain: last-token logits) and
+    ``ragged_forward_verify`` (speculative: last-``k_max``-token logits)
+    close over this SAME function, so both lower through the identical
+    layer ``scan`` — and in particular the identical paged-attention kernel
+    call. Lint rule JX005 pins that property on the jaxprs; do not fork the
+    trunk per caller. Returns (normed hidden [S, Q, D], k_pool, v_pool).
     """
     S, Q = tokens.shape
     H, KV, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -213,8 +217,60 @@ def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
     x, (k_pool, v_pool) = jax.lax.scan(layer_step, x, (layers, k_pool, v_pool))
 
     x = _rmsnorm(x, params["norm"]["scale"], cfg.rms_norm_eps)
+    return x, k_pool, v_pool
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+def ragged_forward(cfg, params, k_pool, v_pool, tokens, q_len, seen,
+                   block_tables):
+    """One ragged forward step.
+
+    Returns (last-token logits [S, V], new k_pool, new v_pool).
+    """
+    x, k_pool, v_pool = _ragged_trunk(cfg, params, k_pool, v_pool, tokens,
+                                      q_len, seen, block_tables)
     # logits_gather analog: only the last real token of each sequence
     last = jnp.take_along_axis(
         x, jnp.maximum(q_len - 1, 0)[:, None, None], axis=1)[:, 0]
     logits = last @ params["lm_head"].astype(cfg.dtype).T
     return logits.astype(jnp.float32), k_pool, v_pool
+
+
+@functools.partial(jax.jit, static_argnums=(0, 8), donate_argnums=(2, 3))
+def ragged_forward_verify(cfg, params, k_pool, v_pool, tokens, q_len, seen,
+                          block_tables, k_max):
+    """One ragged forward returning per-row logits for the last ``k_max``
+    chunk positions instead of just the last token — the verify half of
+    draft-then-verify decode. The trunk (embed -> layer scan -> norm) is
+    byte-identical to ``ragged_forward``'s, so a verify round runs the same
+    ragged paged-attention kernel as plain prefill (JX005-pinned); only the
+    logits gather widens.
+
+    Columns are LAST-aligned: for row ``s`` with chunk length ``q_len[s]``,
+    output column ``c`` holds the logits after chunk position
+    ``q_len[s] - k_max + c`` (clamped into the chunk) — column ``k_max-1``
+    is always the row's ordinary last-token logits. A speculating row's
+    chunk (length ``m <= k_max``) therefore occupies the last ``m``
+    columns, while prefill/plain rows sharing the batch (chunks of any
+    length) read their last-token logits at column ``k_max-1`` exactly as
+    they would read ``ragged_forward``'s output.
+
+    Returns (logits [S, k_max, V] fp32, new k_pool, new v_pool).
+    """
+    x, k_pool, v_pool = _ragged_trunk(cfg, params, k_pool, v_pool, tokens,
+                                      q_len, seen, block_tables)
+    # per-column gather + matmul, each fenced to the exact [S, D] @ [D, V]
+    # shape the plain forward lowers: XLA would otherwise merge the columns
+    # into one batched dot whose different tiling perturbs low-order bits —
+    # and the bit-exactness oracle (greedy speculative == plain stream,
+    # test-pinned) tolerates zero drift. k_max is small (drafts + 1), so the
+    # unrolled columns cost less than one extra layer.
+    W = params["lm_head"].astype(cfg.dtype).T
+    cap = jnp.maximum(q_len - 1, 0)
+    cols = []
+    for c in range(k_max):
+        idx = jnp.clip(q_len - k_max + c, 0, cap)                 # [S]
+        g = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+        g = jax.lax.optimization_barrier(g)
+        cols.append((g @ W).astype(jnp.float32))
+    return jnp.stack(cols, axis=1), k_pool, v_pool

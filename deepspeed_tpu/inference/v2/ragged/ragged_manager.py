@@ -35,6 +35,14 @@ class DSStateManager:
                 # (pages move through the kv_cache's async swapper) before
                 # dropping anything
                 self.prefix_cache.bind_spiller(self.kv_cache)
+        # second, smaller page-size class for draft-model KV (speculative
+        # decode); carved lazily out of the same refcounted pool so census
+        # invariants and pool pressure see draft pages as ordinary tenants
+        self.draft_pages = None
+        spec = getattr(config, "speculative", None)
+        if spec is not None and getattr(spec, "draft_page_divisor", 0) > 1:
+            self.draft_pages = self.kv_cache.allocator.draft_pages(
+                spec.draft_page_divisor)
         self._seqs = {}
         self.swap_outs = 0  # host swap tier counters (kv_cache swap_out/in)
         self.swap_ins = 0
@@ -240,6 +248,38 @@ class DSStateManager:
                 self.kv_cache.free([seq.kv_blocks[i]])
                 seq.kv_blocks[i] = canonical
             seq.digests.append(digest)
+
+    def rollback_sequence(self, uid, n_tokens):
+        """Roll a sequence's paged cursor back ``n_tokens`` — the rejected
+        tail of a speculative verify chunk. Tail blocks that fall wholly
+        past the new cursor are released via ``kv_cache.free`` (deref-aware:
+        a shared or cached block just drops one reference; only a private
+        refcount-1 block actually returns to the pool). The cursor never
+        crosses the committed-prefix boundary: digests registered in the
+        prefix cache cover full, immutable, possibly-shared blocks, and the
+        deferred-commit protocol (``engine.commit_prefix`` after rollback)
+        guarantees no rejected token was ever committed — so the guard below
+        is an invariant check, not a recovery path."""
+        seq = self._seqs.get(uid)
+        if seq is None:
+            raise ValueError(f"rollback of untracked sequence {uid}")
+        if n_tokens <= 0:
+            return
+        assert seq.in_flight_tokens == 0, "cannot roll back mid-forward"
+        assert not seq.is_swapped, "cannot roll back a swapped sequence"
+        bs = self.kv_block_size
+        new_seen = seq.seen_tokens - int(n_tokens)
+        assert new_seen >= 0, "rollback past start of sequence"
+        assert new_seen >= len(seq.digests) * bs, \
+            "rollback would cross the committed prefix-cache boundary"
+        keep = -(-new_seen // bs)
+        tail = seq.kv_blocks[keep:]
+        if tail:
+            del seq.kv_blocks[keep:]
+            self.kv_cache.free(tail)
+        seq.seen_tokens = new_seen
+        if self.prefix_cache is not None:
+            del seq.tokens[new_seen:]
 
     def flush_sequence(self, uid):
         """Drop a sequence and release its KV blocks (reference :110). With

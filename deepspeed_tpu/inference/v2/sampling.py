@@ -67,6 +67,38 @@ def sample_rows(logits, temps, top_ks, top_ps, seeds, positions):
 
 
 @jax.jit
+def verify_rows_packed(logits, fparams, iparams):
+    """Per-row, per-column sampling for a draft-then-verify round.
+
+    ``logits`` is ``[S, K, V]`` — the LAST-aligned ``K`` chunk positions of
+    each row, straight from ``ragged_forward_verify``. ``iparams[2]`` holds
+    each row's stream position for the FINAL column; column ``c`` is then
+    sampled at stream position ``iparams[2][s] - (K-1) + c`` with the row's
+    own ``(temp, top_k, top_p, seed)`` — i.e. exactly the draw plain decode
+    would make once the stream reaches that position. The host compares
+    these target tokens against the drafts to find the accepted prefix;
+    every emitted token therefore IS the plain-decode stream. Columns
+    before a row's chunk (or before stream position 0) are padding the
+    caller never reads.
+
+    ``fparams`` ``[2, S]`` float32 (temps, top_ps); ``iparams`` ``[3, S]``
+    int32 (top_ks, seeds, last-column stream positions).
+    Returns ``[S, K]`` int32.
+    """
+    k = logits.shape[1]
+    cols = jnp.arange(k, dtype=jnp.int32)
+
+    def row(lg, temp, top_k, top_p, seed, last_pos):
+        return jax.vmap(
+            lambda l, c: _row_sample(l, temp, top_k, top_p, seed,
+                                     last_pos - (k - 1) + c)
+        )(lg, cols)
+
+    return jax.vmap(row)(logits, fparams[0], iparams[0], fparams[1],
+                         iparams[1], iparams[2])
+
+
+@jax.jit
 def sample_rows_packed(logits, fparams, iparams):
     """``sample_rows`` with the five per-row parameter vectors packed into
     two host arrays — ``fparams`` ``[2, S]`` float32 (temps, top_ps) and

@@ -94,6 +94,42 @@ class SplitFuseScheduler:
         # per-request placement decisions (fleet router, replica skew)
         # never scan the request table
         self._active = 0
+        # draft-then-verify decode (config_v2 SpeculativeConfig): decode
+        # rows carry [last_token] + drafted tokens as a SplitFuse chunk
+        # through the verify forward; accepted prefixes commit their KV in
+        # place, rejected tails roll the paged cursor back. Off: zero extra
+        # work per step (every branch below is one bool test).
+        spec_cfg = getattr(engine._config, "speculative", None)
+        self._spec = bool(spec_cfg is not None and spec_cfg.enabled)
+        self._drafter = None
+        self._kmax = 0
+        if self._spec:
+            if not self._device_sampling:
+                raise ValueError(
+                    "speculative decode requires device_sampling=True "
+                    "(the verify sampler is the on-device k-token path)")
+            if not engine.verify_supported:
+                raise ValueError(
+                    "speculative decode requires an engine with a verify "
+                    "forward (engine_factory.resolve_verify_fn)")
+            from deepspeed_tpu.inference.v2.speculative import NgramDrafter
+            self._drafter = NgramDrafter(spec_cfg.ngram_max)
+            self._max_drafts = max(1, int(spec_cfg.max_draft_tokens))
+            # static verify width: pow2 bucket holding drafts + 1 so one
+            # compiled verify program serves every round
+            self._kmax = 1
+            while self._kmax < self._max_drafts + 1:
+                self._kmax *= 2
+        # speculation counters — plain ints, always on (bench harnesses and
+        # the router's tokens_per_round signal read them without telemetry)
+        self.speculated_tokens = 0
+        self.accepted_tokens = 0
+        self.rejected_tokens = 0
+        # EWMA of tokens committed per decode row per round — the fleet
+        # router divides its backlog-rounds estimate by this (a speculating
+        # replica retires several tokens per round; predicting 1/round
+        # systematically over-estimates its TTFT)
+        self._tokens_per_round_ewma = 1.0
         # prefill/decode disaggregation hook: called as on_finish(sched, req)
         # the moment a request completes, BEFORE the sequence flushes; a
         # truthy return means ownership (KV pages + remaining decode) moved
@@ -204,6 +240,11 @@ class SplitFuseScheduler:
         """Submitted-but-unfinished request count, O(1)."""
         return self._active
 
+    def tokens_per_round(self):
+        """EWMA of tokens committed per decode row per round, >= 1.0 (the
+        SLO router's TTFT divisor; exactly 1.0 without speculation)."""
+        return self._tokens_per_round_ewma
+
     def kv_stats(self):
         """This replica's host-side KV pool stats
         (``InferenceEngineV2.kv_stats`` — occupancy, free blocks, swaps)."""
@@ -264,9 +305,22 @@ class SplitFuseScheduler:
             if budget < 1:
                 break
             nxt = r.generated[-1]
+            chunk = [nxt]
+            if self._spec:
+                # drafts bounded by the verify width, the row's remaining
+                # token quota (emitting past max_new is wasted work), the
+                # context roof (the chunk's KV must fit: seen is pos-1, so
+                # at most max_ctx - pos drafts ride along), and the round's
+                # token budget
+                d_cap = min(self._max_drafts,
+                            r.max_new_tokens - len(r.generated) - 1,
+                            max_ctx - pos, budget - 1)
+                if d_cap > 0:
+                    chunk += self._drafter.draft(
+                        list(r.prompt) + r.generated, d_cap)[:d_cap]
             uids.append(r.uid)
-            chunks.append(np.asarray([nxt], np.int32))
-            budget -= 1
+            chunks.append(np.asarray(chunk, np.int32))
+            budget -= len(chunk)
         for r in self._requests.values():
             if r.done or not r.prefilling or r.preempted or r.uid in uids:
                 continue
@@ -390,12 +444,25 @@ class SplitFuseScheduler:
                         f"too small for the request?)")
             return None
         # shrink the proposal until the engine admits it (KV pressure):
-        # drop the largest chunk each time and RE-validate — put() would
-        # raise on an oversubscribed batch
+        # drafts shed first — a speculative decode row trims back to its
+        # plain 1-token chunk (the draft tail is opportunistic; the row
+        # still progresses), because ``_try_resume`` gates resume on
+        # 1-token growth and popping the row instead would re-preempt it
+        # and thrash the pool resume/preempt forever — then whole chunks
+        # drop largest-first and RE-validate; put() would raise on an
+        # oversubscribed batch
         while uids:
             verdict = self._engine.can_schedule(uids, [len(c) for c in chunks])
             if verdict.success:
                 break
+            if self._spec:
+                spec_rows = [i for i, u in enumerate(uids)
+                             if not self._requests[u].prefilling
+                             and len(chunks[i]) > 1]
+                if spec_rows:
+                    trim = max(spec_rows, key=lambda i: len(chunks[i]))
+                    chunks[trim] = chunks[trim][:1]
+                    continue
             biggest = int(np.argmax([len(c) for c in chunks]))
             uids.pop(biggest)
             chunks.pop(biggest)
@@ -428,7 +495,28 @@ class SplitFuseScheduler:
                                        t_fwd - r.submit_ts)
                         tm.record_request_phase(uid, "queued", r.submit_ts,
                                                 t_fwd - r.submit_ts)
-        if self._device_sampling:
+        if self._spec:
+            reqs = [self._requests[u] for u in uids]
+            # each row's LAST verify column samples at: the next stream
+            # position after the chunk for decode rows (len(generated)
+            # counts chunk[0], drafts follow), the first generated position
+            # for prefill rows (mid-prompt rows discard their ids anyway)
+            positions = [len(r.generated) if r.prefilling
+                         else len(r.generated) + len(c) - 1
+                         for r, c in zip(reqs, chunks)]
+            # rows that can roll back must not commit prefix-cache blocks
+            # until the accept walk ran (a rejected draft in the chain
+            # cache would poison every future match)
+            defer = {u for u, c in zip(uids, chunks) if len(c) > 1}
+            ids = self._engine.put_verify_device(
+                uids, chunks,
+                temperatures=[r.temperature for r in reqs],
+                top_ks=[r.top_k for r in reqs],
+                top_ps=[r.top_p for r in reqs],
+                seeds=[r.seed for r in reqs],
+                positions=positions, k_max=self._kmax, defer_commit=defer)
+            logits = None
+        elif self._device_sampling:
             reqs = [self._requests[u] for u in uids]
             ids = self._engine.put_sampled_device(
                 uids, chunks,
@@ -457,14 +545,18 @@ class SplitFuseScheduler:
             # the only device sync of the round, accounted so
             # engine.host_sync_count audits the one-fetch-per-round budget
             ids = self._engine.host_fetch(ids, "scheduler/sampled_ids")
+        spec = self._spec
         if enabled:
             t_done = _now()
             fwd_dur = t_done - t_fwd
             for row, uid in enumerate(uids):
-                tm.record_request_phase(
-                    uid, "prefill" if was_prefilling[row] else "decode",
-                    t_fwd, fwd_dur, tokens=len(chunks[row]))
+                phase = "prefill" if was_prefilling[row] else \
+                    ("speculate" if spec and len(chunks[row]) > 1 else "decode")
+                tm.record_request_phase(uid, phase, t_fwd, fwd_dur,
+                                        tokens=len(chunks[row]))
         finished = []
+        # per-round speculation tallies (gauges + the router EWMA)
+        n_decode_rows = decode_committed = drafted = accepted = occ_cols = 0
         for row, uid in enumerate(uids):
             r = self._requests[uid]
             if r.prefilling:
@@ -472,19 +564,67 @@ class SplitFuseScheduler:
                 r.prefill_pos += len(chunks[row])
                 if r.prefilling:
                     continue  # mid-prompt ids/logits are not a next token
-            tok = int(ids[row]) if logits is None else \
-                self._sample(r, logits[row])
-            r.generated.append(tok)
+                # final prefill chunk: the last verify column is the row's
+                # ordinary last-token sample
+                emitted = [int(ids[row, -1])] if spec else \
+                    [int(ids[row]) if logits is None
+                     else self._sample(r, logits[row])]
+            elif spec:
+                # accept walk: target column c is the token plain decode
+                # would emit after chunk position c; drafts match targets
+                # one position earlier, so j accepted drafts let the row
+                # emit j+1 plain-stream tokens from one forward
+                chunk = chunks[row]
+                n_drafts = len(chunk) - 1
+                n_decode_rows += 1
+                occ_cols += len(chunk)
+                targets = [int(t) for t in
+                           ids[row, self._kmax - len(chunk):]]
+                j = 0
+                while j < n_drafts and int(chunk[1 + j]) == targets[j]:
+                    j += 1
+                drafted += n_drafts
+                accepted += j
+                self.speculated_tokens += n_drafts
+                self.accepted_tokens += j
+                self.rejected_tokens += n_drafts - j
+                emitted = targets[:j + 1]
+                # truncate at the row's quota and at eos — tokens past
+                # either would never exist in the plain stream
+                emitted = emitted[:r.max_new_tokens - len(r.generated)]
+                if r.eos_token_id is not None and r.eos_token_id in emitted:
+                    emitted = emitted[:emitted.index(r.eos_token_id) + 1]
+                # rejected/unused tail leaves the paged cursor: the chunk
+                # wrote len(chunk) KV tokens, the plain stream keeps
+                # len(emitted) of them (chunk[0] + the accepted drafts;
+                # emitted[-1] is next round's chunk[0], not yet in KV)
+                rollback = len(chunk) - len(emitted)
+                if rollback:
+                    self._engine.rollback(uid, rollback)
+                if n_drafts and self._prefix_caching:
+                    self._engine.commit_prefix(uid)  # deferred past rollback
+                decode_committed += len(emitted)
+            else:
+                emitted = [int(ids[row]) if logits is None
+                           else self._sample(r, logits[row])]
+            first = not r.generated
+            r.generated.extend(emitted)
             if enabled:
-                if len(r.generated) == 1:
+                if first:
                     # TTFT spans submit->first generated token; a request
                     # submitted before telemetry came on anchors at t_fwd
                     tm.record_hist("serving/ttft_s",
                                    t_done - (r.submit_ts or t_fwd))
                 elif r.last_token_ts:
-                    tm.record_hist("serving/tpot_s", t_done - r.last_token_ts)
+                    # the round's gap amortized over every emitted token,
+                    # one hist entry per token — counts stay token-aligned
+                    # and the mean reflects the speculative speedup
+                    gap = (t_done - r.last_token_ts) / len(emitted)
+                    for _ in emitted:
+                        tm.record_hist("serving/tpot_s", gap)
                 r.last_token_ts = t_done
-            if (r.eos_token_id is not None and tok == r.eos_token_id) or \
+            if (r.eos_token_id is not None and
+                    r.eos_token_id == r.generated[-1]) or \
                     len(r.generated) >= r.max_new_tokens:
                 r.done = True
                 self._active -= 1
@@ -502,6 +642,22 @@ class SplitFuseScheduler:
                     tm.serving_event("finished")
                     tm.record_request_phase(uid, "finish", t_done,
                                             new_tokens=len(r.generated))
+        if spec and n_decode_rows:
+            # live accept-rate EWMA feeding SLORouter.predicted_ttft: tokens
+            # committed per decode row per round (>= 1 by construction)
+            self._tokens_per_round_ewma = max(1.0, (
+                0.9 * self._tokens_per_round_ewma
+                + 0.1 * (decode_committed / n_decode_rows)))
+            if enabled:
+                tm.serving_gauge("serving/verify_batch_occupancy",
+                                 occ_cols / (n_decode_rows * self._kmax))
+                if drafted:
+                    tm.serving_gauge("serving/accept_rate",
+                                     accepted / drafted)
+                    tm.serving_event("speculated_tokens", n=drafted)
+                    if drafted - accepted:
+                        tm.serving_event("rejected_tokens",
+                                         n=drafted - accepted)
         if enabled:
             running = waiting = preempted = 0
             uid_set = set(uids)

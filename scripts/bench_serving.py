@@ -21,6 +21,13 @@ additionally embeds the full telemetry summary in each payload's ``extra``
   prefill-token reduction, prefix hit rate, and TTFT comparison the prefix
   cache is judged on (gated by perf_gate's prefix checks).
 
+- ``--speculate`` — draft-then-verify decode: the same seeded
+  template-heavy greedy trace runs with speculation off then on (n-gram
+  prompt-lookup drafting, verification through the ragged prefill kernel).
+  Reports the wall-clock tokens/s multiplier, accept rate, verify-batch
+  occupancy, and the greedy bit-exactness flag — gated by perf_gate's
+  ``check_speculate_baseline`` (multiplier >= 1.5x, parity must hold).
+
 - ``--long-context`` — KV capacity-tiering workload: seeded long prompts
   (32k–128k on TPU; scaled down on CPU) over a shared prefix, driven at an
   EQUAL KV HBM byte budget with fp then int8 KV pages, host-DRAM spill tier
@@ -39,7 +46,7 @@ additionally embeds the full telemetry summary in each payload's ``extra``
   fleet checks.
 
 Usage: python scripts/bench_serving.py [--replay] [--prefix-mix] [--fleet]
-           [--long-context] [--longctx-max T]
+           [--speculate] [--long-context] [--longctx-max T]
            [--requests N] [--seed S] [--arrival poisson|burst] [--rate R]
            [--burst-size B] [--prompt T] [--new T]
            [--prefix-pools P] [--prefix-len L]
@@ -68,7 +75,7 @@ def _embed_telemetry(extra):
 
 def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
                  num_kv_blocks=None, prefix_caching=False, kv_dtype="fp",
-                 host_kv_blocks=0, model_and_params=None):
+                 host_kv_blocks=0, model_and_params=None, speculative=None):
     import jax
     import numpy as np
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
@@ -88,7 +95,7 @@ def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
     max_ctx = prompt_len + new_tokens + block
     if num_kv_blocks is None:
         num_kv_blocks = max(64, (max_ctx // block + 2) * n_req)
-    engine = InferenceEngineV2(model, params, config={
+    config = {
         "state_manager": {
             "max_ragged_sequence_count": max(4, n_req) + 1,  # +1 warmup
             "max_ragged_batch_size": budget,
@@ -98,7 +105,10 @@ def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
             "host_kv_blocks": host_kv_blocks},
         "kv_cache": {"block_size": block,
                      "cache_dtype": "bf16" if on_tpu else "fp32"},
-        "prefix_caching": prefix_caching})
+        "prefix_caching": prefix_caching}
+    if speculative is not None:
+        config["speculative"] = speculative
+    engine = InferenceEngineV2(model, params, config=config)
     return model, SplitFuseScheduler(engine, token_budget=budget)
 
 
@@ -383,6 +393,121 @@ def prefix_mix_bench(args, on_tpu):
         "metric": "serving_replay_tokens_per_sec_per_chip",
         "value": round(total / c["wall"] / max(n_chips, 1), 1),
         "unit": "tokens/s/chip (prefill+decode)",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+    bench.emit(payload)
+    return payload
+
+
+def speculate_bench(args, on_tpu):
+    """Draft-then-verify replay: the SAME seeded template-heavy greedy trace
+    runs twice — speculation off, then on (n-gram self-speculation drafting
+    through the ragged verify kernel) — and the payload reports the
+    wall-clock tokens/s multiplier the second leg buys, the accept rate,
+    verify-batch occupancy, and the greedy bit-exactness flag (speculate leg
+    stream == plain leg stream, the correctness oracle). The workload is a
+    tiled 4-token pattern: template-heavy in the way the prompt-lookup
+    drafter exploits, and single-row so both legs pad to the same ragged
+    token bucket and the comparison isolates round-count savings. Emits one
+    ``serving_speculate_tokens_per_sec_multiplier`` payload gated by
+    perf_gate's ``check_speculate_baseline`` (multiplier >= 1.5x)."""
+    import numpy as np
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=4096, remat=False)
+        tile_reps, max_new, budget = 64, max(args.new, 96), 256
+    else:
+        # tiny() shape, but with room for the 40-token prompt + 96 new
+        cfg = LlamaConfig(vocab_size=512, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=256, remat=False)
+        tile_reps, max_new, budget = 10, 96, 32
+    seed = args.seed or 31
+    max_drafts = 7  # k_max buckets to 8 either way; wider drafts are free
+    gen = np.random.default_rng(seed)
+    prompt = np.tile(gen.integers(0, cfg.vocab_size, 4).astype(np.int32),
+                     tile_reps)
+    reps = 3  # sequential timed repetitions per leg; min wall wins
+
+    legs = {}
+    for label, spec in (
+            ("plain", None),
+            ("speculate", {"enabled": True,
+                           "max_draft_tokens": max_drafts})):
+        model, sched = _build_stack(cfg, reps, len(prompt), max_new, budget,
+                                    on_tpu, speculative=spec)
+        t0 = time.perf_counter()
+        sched.submit(10_000, prompt, max_new_tokens=max_new)
+        sched.run_to_completion()
+        print(f"speculate[{label}]: warmup/compile "
+              f"{time.perf_counter()-t0:.1f}s", file=sys.stderr)
+        sched.speculated_tokens = 0
+        sched.accepted_tokens = 0
+        sched.rejected_tokens = 0
+        telemetry.reset()
+        telemetry.configure(enabled=True, sample_sync=False,
+                            chrome_trace_path=os.environ.get(
+                                "DS_TPU_TELEMETRY_TRACE", ""))
+        walls = []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            sched.submit(r, prompt, max_new_tokens=max_new)
+            sched.run_to_completion()
+            walls.append(time.perf_counter() - t0)
+        serving = telemetry.summary()["serving"]
+        occ = serving["gauges"].get("serving/verify_batch_occupancy", {})
+        ar = serving["gauges"].get("serving/accept_rate", {})
+        legs[label] = {
+            "wall": min(walls), "walls": walls,
+            "stream": [int(t) for t in sched.results()[0]],
+            "speculated": int(sched.speculated_tokens),
+            "accepted": int(sched.accepted_tokens),
+            "rejected": int(sched.rejected_tokens),
+            "tokens_per_round": float(sched.tokens_per_round()),
+            "verify_occ_peak": float(occ.get("peak", 0.0)),
+            "accept_rate_gauge": float(ar.get("last", 0.0)),
+        }
+        print(f"speculate[{label}]: walls="
+              f"{[round(w, 3) for w in walls]} "
+              f"tokens_per_round={legs[label]['tokens_per_round']:.2f}",
+              file=sys.stderr)
+    pl, sp = legs["plain"], legs["speculate"]
+    multiplier = pl["wall"] / sp["wall"] if sp["wall"] else 0.0
+    accept_rate = sp["accepted"] / max(sp["speculated"], 1)
+    parity = pl["stream"] == sp["stream"]
+    decoded = len(sp["stream"]) * reps
+    extra = {
+        "tokens_per_sec_multiplier": round(multiplier, 4),
+        "accept_rate": round(accept_rate, 6),
+        "verify_batch_occupancy": round(sp["verify_occ_peak"], 6),
+        "greedy_parity": bool(parity),
+        "speculated_tokens": sp["speculated"],
+        "accepted_tokens": sp["accepted"],
+        "rejected_tokens": sp["rejected"],
+        "tokens_per_round": round(sp["tokens_per_round"], 4),
+        "decode_tokens_per_sec": round(decoded / sp["wall"], 1),
+        "decode_tokens_per_sec_plain": round(decoded / pl["wall"], 1),
+        "wall_s": round(sp["wall"], 4),
+        "wall_plain_s": round(pl["wall"], 4),
+        "walls_s": [round(w, 4) for w in sp["walls"]],
+        "walls_plain_s": [round(w, 4) for w in pl["walls"]],
+        "repetitions": reps, "seed": seed,
+        "prompt_len": int(len(prompt)), "new_tokens": max_new,
+        "max_draft_tokens": max_drafts, "token_budget": budget,
+        "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}",
+    }
+    _embed_telemetry(extra)
+    payload = {
+        "metric": "serving_speculate_tokens_per_sec_multiplier",
+        "value": round(multiplier, 4),
+        "unit": "x (plain wall / speculate wall, same greedy trace)",
         "vs_baseline": None,
         "extra": extra,
     }
@@ -950,6 +1075,11 @@ def main():
     ap.add_argument("--prefix-len", type=int, default=0,
                     help="shared prefix length in tokens; 0 = per-platform "
                          "default (--prefix-mix)")
+    ap.add_argument("--speculate", action="store_true",
+                    help="draft-then-verify leg: the same seeded greedy "
+                         "trace with speculation off then on; reports the "
+                         "tokens/s multiplier, accept rate, and the greedy "
+                         "bit-exactness flag")
     ap.add_argument("--long-context", action="store_true",
                     help="long-context KV tiering workload: seeded long "
                          "prompts over a shared prefix, fp vs int8 KV at an "
@@ -987,7 +1117,9 @@ def main():
                             chrome_trace_path=os.environ.get(
                                 "DS_TPU_TELEMETRY_TRACE", ""))
 
-    metric = ("serving_longctx_concurrent_seqs_per_chip"
+    metric = ("serving_speculate_tokens_per_sec_multiplier"
+              if args.speculate
+              else "serving_longctx_concurrent_seqs_per_chip"
               if args.long_context
               else "serving_fleet_replay_tokens_per_sec_per_chip"
               if args.replay and args.fleet
@@ -1001,6 +1133,14 @@ def main():
                     "extra": {"error": f"{type(e).__name__}: {e}"[:300]}})
         return
     on_tpu = devs[0].platform in ("tpu", "axon")
+    if args.speculate:
+        try:
+            speculate_bench(args, on_tpu)
+        except Exception as e:
+            bench.emit({"metric": metric, "value": 0.0,
+                        "unit": "x", "vs_baseline": None,
+                        "extra": {"error": f"{type(e).__name__}: {e}"[:400]}})
+        return
     if args.long_context:
         try:
             long_context_bench(args, on_tpu)

@@ -57,6 +57,14 @@ capacity multiplier >= 2x at the fp leg's KV HBM budget, at least one
 spill and one restore recorded, zero live swap-outs, a positive prefill
 reduction across the spill/restore round trip (``check_longctx_baseline``;
 stall growth between runs gates via ``--max-swap-stall-growth``) — and
+validates the checked-in speculative-decode baseline
+(``onchip_results/serving_speculate_baseline.json``): payload shape
+(accept rate and verify-batch occupancy in [0, 1], the speculation counter
+identity ``speculated == accepted + rejected``, a boolean parity flag)
+plus the acceptance ratchet — tokens/s multiplier >= 1.5x plain decode on
+the template-heavy greedy replay, greedy parity True (the bit-exactness
+oracle), at least one token drafted and accepted
+(``check_speculate_baseline``) — and
 validates the checked-in elastic-reshard drill baseline
 (``onchip_results/elastic_drill_baseline.json``): world sequence 8→4→8,
 zero steps lost or double-applied, bitwise-equal restore-step losses, and
@@ -630,6 +638,62 @@ def validate_longctx_payload(doc):
     return None
 
 
+def validate_speculate_payload(doc):
+    """Shape-check a bench_serving --speculate payload: a SUCCESSFUL run
+    (value > 0) must carry a finite tokens/s multiplier consistent with the
+    recorded walls, an accept rate and verify-batch occupancy in [0, 1],
+    the speculation counter identity (``speculated == accepted +
+    rejected``), a tokens-per-round >= 1, and a boolean greedy-parity flag.
+    Pure dict checks — runs in the tier-1 dry-run lane without jax.
+    Returns an error string or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "serving_speculate" not in str(doc.get("metric", "")):
+        return None
+    try:
+        if float(doc.get("value", 0)) <= 0:
+            return None
+    except (TypeError, ValueError):
+        return None
+    extra = doc.get("extra")
+    if not isinstance(extra, dict):
+        return "speculate payload has no extra dict"
+    def bad_num(v):
+        return not isinstance(v, (int, float)) or isinstance(v, bool) or \
+            not (v == v and abs(v) != float("inf"))
+    for key in ("tokens_per_sec_multiplier", "accept_rate",
+                "verify_batch_occupancy", "speculated_tokens",
+                "accepted_tokens", "rejected_tokens", "tokens_per_round",
+                "wall_s", "wall_plain_s"):
+        if bad_num(extra.get(key)):
+            return f"speculate payload: extra[{key!r}] missing or " \
+                   f"not finite (got {extra.get(key)!r})"
+    if not isinstance(extra.get("greedy_parity"), bool):
+        return "speculate payload: greedy_parity missing or not a boolean"
+    if not 0.0 <= extra["accept_rate"] <= 1.0:
+        return "speculate payload: accept_rate outside [0, 1]"
+    if not 0.0 <= extra["verify_batch_occupancy"] <= 1.0:
+        return "speculate payload: verify_batch_occupancy outside [0, 1]"
+    if extra["tokens_per_sec_multiplier"] <= 0:
+        return "speculate payload: tokens_per_sec_multiplier not positive"
+    for key in ("speculated_tokens", "accepted_tokens", "rejected_tokens"):
+        if extra[key] < 0:
+            return f"speculate payload: extra[{key!r}] negative"
+    if extra["speculated_tokens"] != \
+            extra["accepted_tokens"] + extra["rejected_tokens"]:
+        return (f"speculate payload: speculated_tokens "
+                f"{extra['speculated_tokens']} != accepted "
+                f"{extra['accepted_tokens']} + rejected "
+                f"{extra['rejected_tokens']} — the verify loop lost or "
+                f"double-counted drafted tokens")
+    if extra["tokens_per_round"] < 1.0:
+        return "speculate payload: tokens_per_round below 1 — a decode " \
+               "round always commits at least the plain-decode token"
+    if extra["wall_s"] <= 0 or extra["wall_plain_s"] <= 0:
+        return "speculate payload: non-positive wall seconds"
+    return None
+
+
 def _load_overlap_module():
     """Load telemetry/overlap.py standalone (stdlib-only at module scope,
     same pattern as kernel_table) so overlap validation runs in the tier-1
@@ -986,6 +1050,65 @@ def check_longctx_baseline(baseline_path=None):
             "prefill_reduction": extra["prefill_reduction"]}, errors
 
 
+#: speculative-decode acceptance for the checked-in baseline: on the
+#: prefix-heavy greedy replay the draft-then-verify leg must beat plain
+#: decode by >= 1.5x wall-clock at bit-exact output (greedy parity), with
+#: a sane accept rate and at least one drafted token — a drop below the
+#: ratchet means drafting or verify-batching regressed
+SPECULATE_MIN_MULTIPLIER = 1.5
+SPECULATE_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                       "serving_speculate_baseline.json")
+
+
+def check_speculate_baseline(baseline_path=None):
+    """Validate the checked-in ``--speculate`` baseline: payload shape
+    (``validate_speculate_payload`` incl. the speculation counter
+    identity), then the acceptance ratchet — tokens/s multiplier >=
+    ``SPECULATE_MIN_MULTIPLIER`` on the template-heavy greedy replay,
+    greedy parity True (the speculate leg reproduced the plain stream
+    token-for-token — the bit-exactness oracle), accept rate in (0, 1],
+    and at least one token actually drafted. Pure dict checks over
+    recorded values (wall-clock legs cannot be re-derived jax-free).
+    Returns (report, errors) for the dry-run lane."""
+    path = baseline_path or SPECULATE_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no speculate baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable speculate baseline {path}"]
+    err = validate_speculate_payload(doc)
+    if err:
+        return {}, [f"speculate baseline: {err}"]
+    extra = doc.get("extra", {}) if isinstance(doc, dict) else {}
+    if "tokens_per_sec_multiplier" not in extra:
+        return {}, ["speculate baseline payload carries no speculation "
+                    "fields (regenerate with bench_serving --speculate)"]
+    errors = []
+    mult = extra["tokens_per_sec_multiplier"]
+    if mult < SPECULATE_MIN_MULTIPLIER:
+        errors.append(
+            f"speculate baseline: tokens/s multiplier {mult} < "
+            f"{SPECULATE_MIN_MULTIPLIER} — draft-then-verify no longer "
+            f"pays for its verify overhead on the prefix-heavy replay")
+    if extra["greedy_parity"] is not True:
+        errors.append(
+            "speculate baseline: greedy parity broken — the speculate leg "
+            "diverged from the plain greedy stream (accept/rollback is "
+            "committing tokens plain decode would not have emitted)")
+    if extra["speculated_tokens"] < 1:
+        errors.append("speculate baseline: no tokens drafted — the run "
+                      "never exercised the draft-then-verify path")
+    if extra["accepted_tokens"] < 1:
+        errors.append("speculate baseline: no drafted token accepted — "
+                      "the drafter never matched the model's stream")
+    return {"tokens_per_sec_multiplier": mult,
+            "accept_rate": extra["accept_rate"],
+            "verify_batch_occupancy": extra["verify_batch_occupancy"],
+            "greedy_parity": extra["greedy_parity"],
+            "speculated_tokens": extra["speculated_tokens"],
+            "tokens_per_round": extra["tokens_per_round"]}, errors
+
+
 #: elastic reshard drill acceptance for the checked-in baseline
 #: (onchip_results/elastic_drill_baseline.json, regenerated with
 #: ``scripts/fault_drill.py --emit-elastic-baseline``): the 8→4→8 CPU
@@ -1199,7 +1322,7 @@ def main(argv=None):
             return 2
         err = validate_summary(doc) or validate_serving_payload(doc) \
             or validate_fleet_payload(doc) or validate_longctx_payload(doc) \
-            or validate_overlap_payload(doc)
+            or validate_speculate_payload(doc) or validate_overlap_payload(doc)
         if err:
             print(f"perf_gate: {label}: {err}", file=sys.stderr)
             return 2
@@ -1232,6 +1355,9 @@ def main(argv=None):
         longctx_report, longctx_errors = check_longctx_baseline()
         for err in longctx_errors:
             print(f"perf_gate: longctx: {err}", file=sys.stderr)
+        spec_report, spec_errors = check_speculate_baseline()
+        for err in spec_errors:
+            print(f"perf_gate: speculate: {err}", file=sys.stderr)
         elastic_report, elastic_errors = check_elastic_baseline()
         for err in elastic_errors:
             print(f"perf_gate: elastic: {err}", file=sys.stderr)
@@ -1241,7 +1367,7 @@ def main(argv=None):
         errors = table_errors + qgz_errors + moe_wire_errors \
             + overlap_errors + sched_errors + moe_base_errors \
             + prefix_errors + fleet_errors + longctx_errors \
-            + elastic_errors + lint_errors
+            + spec_errors + elastic_errors + lint_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
@@ -1253,6 +1379,7 @@ def main(argv=None):
                           "prefix_cache": prefix_report,
                           "fleet": fleet_report,
                           "longctx": longctx_report,
+                          "speculate": spec_report,
                           "elastic": elastic_report,
                           "lint": lint_report,
                           "metrics": {label: extract_metrics(doc)

@@ -393,3 +393,77 @@ def test_serving_decode_step_is_clean(serving_decode_trace):
     # per-token stall the host_sync audit could never see) and no
     # unbound collectives
     assert jc.check_program(serving_decode_trace, dtype="float32") == []
+
+
+# ---------------------------------------------------------------------------
+# JX005 — the speculative verify forward rides the prefill scan (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def verify_parity_traces():
+    """(plain ragged_forward jaxpr, ragged_forward_verify jaxpr) over the
+    same tiny engine and the same padded batch shapes — make_jaxpr only."""
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.v2.ragged.ragged_wrapper import \
+        RaggedBatchWrapper
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(scan_layers=True, remat=False)
+    model = LlamaForCausalLM(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    engine = InferenceEngineV2(model, params, config={
+        "state_manager": {"max_ragged_sequence_count": 4,
+                          "max_ragged_batch_size": 32,
+                          "max_context": 64, "num_kv_blocks": 16},
+        "kv_cache": {"block_size": 8, "cache_dtype": "fp32"}})
+    assert engine.verify_supported
+
+    seq = engine._state.get_or_create_sequence(1)
+    engine._state.ensure_capacity(seq, 4)
+    sm = engine._config.state_manager
+    wrapper = RaggedBatchWrapper(sm.max_ragged_sequence_count,
+                                 sm.max_ragged_batch_size,
+                                 engine._max_blocks_per_seq,
+                                 engine._state.kv_cache.trash_block)
+    wrapper.insert_sequence(1, np.array([2, 3, 4, 5], np.int32), 0,
+                            seq.kv_blocks)
+    arrays = wrapper.build()
+    kv = engine._state.kv_cache
+    args = (engine._params, kv.k_pool, kv.v_pool,
+            jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["q_len"]),
+            jnp.asarray(arrays["seen"]), jnp.asarray(arrays["block_tables"]))
+    mc = engine._model_config
+    plain = jax.make_jaxpr(partial(engine._ragged_forward, mc))(*args)
+    verify = jax.make_jaxpr(
+        lambda *a: engine._verify_forward(mc, *a, 4))(*args)
+    return plain, verify
+
+
+def test_verify_forward_shares_prefill_scan(verify_parity_traces):
+    # the bit-exactness oracle's structural half: draft verification lowers
+    # through the IDENTICAL layer scan as plain ragged prefill — no trunk
+    # fork, no dense-decode fallback — and the program is itself clean
+    plain, verify = verify_parity_traces
+    assert jc.check_verify_prefill_parity(plain, verify) == []
+    assert jc.check_program(verify, dtype="float32") == []
+
+
+def test_verify_parity_flags_fork_and_fallback():
+    def stacked(x):
+        return jax.lax.scan(lambda c, t: (c + t, c), x[0], x)[0]
+
+    def forked(x):
+        return jax.lax.scan(lambda c, t: (c * t, c), x[0], x)[0]
+
+    ja = jax.make_jaxpr(stacked)(jnp.arange(4.0))
+    jb = jax.make_jaxpr(forked)(jnp.arange(4.0))
+    assert jc.check_verify_prefill_parity(ja, ja) == []
+    findings = jc.check_verify_prefill_parity(ja, jb)
+    assert len(findings) == 1 and findings[0]["check"] == "JX005"
+    assert "diverges" in findings[0]["message"]
+    # a verify program with no scan at all is the dense-decode fallback
+    dense = jax.make_jaxpr(lambda x: x * 2)(jnp.arange(4.0))
+    findings = jc.check_verify_prefill_parity(ja, dense)
+    assert findings and findings[0]["check"] == "JX005"
+    assert "no layer scan" in findings[0]["message"]

@@ -1059,6 +1059,129 @@ def test_perf_gate_longctx_baseline_ratchet(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# speculative-decode gates (bench_serving --speculate /
+# check_speculate_baseline)
+# ---------------------------------------------------------------------------
+
+def _speculate_payload(mult=2.4, accept=0.78, occ=1.0, parity=True,
+                       speculated=294, accepted=231, rejected=63,
+                       tpr=5.4, wall=0.085, wall_plain=0.204):
+    """A --speculate payload: the multiplier ratchet field, the speculation
+    counter identity (internally consistent by default: speculated ==
+    accepted + rejected), and the greedy-parity oracle flag."""
+    return {"metric": "serving_speculate_tokens_per_sec_multiplier",
+            "value": mult,
+            "unit": "x (plain wall / speculate wall, same greedy trace)",
+            "vs_baseline": None,
+            "extra": {"tokens_per_sec_multiplier": mult,
+                      "accept_rate": accept,
+                      "verify_batch_occupancy": occ,
+                      "greedy_parity": parity,
+                      "speculated_tokens": speculated,
+                      "accepted_tokens": accepted,
+                      "rejected_tokens": rejected,
+                      "tokens_per_round": tpr,
+                      "wall_s": wall, "wall_plain_s": wall_plain,
+                      "repetitions": 3, "seed": 31,
+                      "prompt_len": 40, "new_tokens": 96,
+                      "max_draft_tokens": 7, "token_budget": 32}}
+
+
+def test_perf_gate_dry_run_validates_speculate_payload_shape(tmp_path):
+    """--dry-run shape-checks a successful --speculate payload without jax:
+    finite fields, accept rate and occupancy in [0, 1], the speculation
+    counter identity, and a boolean parity flag. Error payloads (value 0)
+    are exempt."""
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_speculate_payload()))
+    r = _run([PERF_GATE, "--baseline", str(good), "--dry-run"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+    doc = _speculate_payload()
+    del doc["extra"]["accept_rate"]
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "accept_rate" in r.stderr
+
+    doc = _speculate_payload(accept=1.5)
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "accept_rate" in r.stderr
+
+    # 294 != 231 + 50: the verify loop lost 13 drafted tokens
+    doc = _speculate_payload(rejected=50)
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "speculated_tokens" in r.stderr
+
+    doc = _speculate_payload(parity="yes")
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "greedy_parity" in r.stderr
+
+    doc = _speculate_payload(tpr=0.8)
+    bad.write_text(json.dumps(doc))
+    r = _run([PERF_GATE, "--baseline", str(bad), "--dry-run"])
+    assert r.returncode == 2 and "tokens_per_round" in r.stderr
+
+    err_doc = {"metric": "serving_speculate_tokens_per_sec_multiplier",
+               "value": 0.0, "unit": "x", "vs_baseline": None,
+               "extra": {"error": "RuntimeError: backend init UNAVAILABLE"}}
+    errp = tmp_path / "err.json"
+    errp.write_text(json.dumps(err_doc))
+    r = _run([PERF_GATE, "--baseline", str(errp), "--dry-run"])
+    assert r.returncode == 0
+
+
+def test_perf_gate_speculate_baseline_ratchet(tmp_path):
+    """check_speculate_baseline enforces the speculation acceptance
+    ratchet: tokens/s multiplier >= 1.5x, greedy parity True, and at least
+    one token drafted AND accepted."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_pg_spec", PERF_GATE)
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_speculate_payload()))
+    report, errs = pg.check_speculate_baseline(str(good))
+    assert errs == [] and report["tokens_per_sec_multiplier"] == 2.4
+
+    low = tmp_path / "low.json"
+    low.write_text(json.dumps(_speculate_payload(mult=1.2)))
+    _, errs = pg.check_speculate_baseline(str(low))
+    assert any("multiplier" in e for e in errs)
+
+    low.write_text(json.dumps(_speculate_payload(parity=False)))
+    _, errs = pg.check_speculate_baseline(str(low))
+    assert any("parity" in e for e in errs)
+
+    low.write_text(json.dumps(_speculate_payload(
+        speculated=0, accepted=0, rejected=0)))
+    _, errs = pg.check_speculate_baseline(str(low))
+    assert any("drafted" in e for e in errs)
+
+    low.write_text(json.dumps(_speculate_payload(
+        speculated=5, accepted=0, rejected=5)))
+    _, errs = pg.check_speculate_baseline(str(low))
+    assert any("accepted" in e for e in errs)
+
+    # no baseline file -> skip, not error (pre-speculation checkouts)
+    report, errs = pg.check_speculate_baseline(str(tmp_path / "absent.json"))
+    assert errs == [] and "skipped" in report
+
+    # the repo's own checked-in baseline passes the ratchet
+    report, errs = pg.check_speculate_baseline()
+    assert errs == [], errs
+    assert report["tokens_per_sec_multiplier"] >= \
+        pg.SPECULATE_MIN_MULTIPLIER
+    assert report["greedy_parity"] is True
+    assert 0.0 < report["accept_rate"] <= 1.0
+    assert report["speculated_tokens"] >= 1
+
+
+# ---------------------------------------------------------------------------
 # elastic-reshard drill gate (fault_drill --emit-elastic-baseline /
 # check_elastic_baseline)
 # ---------------------------------------------------------------------------

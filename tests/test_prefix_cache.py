@@ -233,22 +233,27 @@ class _StubSpiller:
 
 
 def test_random_share_flush_evict_spill_preserve_invariants():
-    """Random allocate/share/flush/evict/spill/restore through the
-    PrefixCache over a host-capable allocator, checking after every op:
-    device side ``free + live + cached == num_blocks`` (hard), the census
-    ``free + live + cached + host == total``, the swap accounting identity
-    ``spilled == restored + dropped + resident``, the free list holds no
-    duplicates and only refcount-0 blocks, refcounts never negative, and the
-    cache's evictable/host counts equal the allocator's."""
+    """Random allocate/share/flush/evict/spill/restore PLUS speculative
+    advance/rollback through the PrefixCache over a host-capable allocator,
+    checking after every op: device side ``free + live + cached ==
+    num_blocks`` (hard), the census ``free + live + cached + host ==
+    total``, the swap accounting identity ``spilled == restored + dropped +
+    resident``, the free list holds no duplicates and only refcount-0
+    blocks, refcounts never negative, draft-tail blocks stay private
+    (refcount exactly 1, never cached), rollback never frees a block
+    another chain holds, and the cache's evictable/host counts equal the
+    allocator's."""
     rng = np.random.default_rng(42)
     total, bs, host_cap = 24, 4, 6
     a = BlockedAllocator(total, host_capacity=host_cap)
     c = PrefixCache(a, bs)
     sp = _StubSpiller()
     c.bind_spiller(sp)
-    live = {}   # uid -> block list
+    live = {}   # uid -> committed chain blocks (shareable through the cache)
+    tails = {}  # uid -> private speculative tail blocks (refcount-1 only)
     streams = []
     next_uid, next_tok = 0, 0
+    advances = rollbacks = 0
 
     def fresh(n):
         nonlocal next_tok
@@ -274,10 +279,14 @@ def test_random_share_flush_evict_spill_preserve_invariants():
         assert c.evictable_blocks == cnt["cached"]
         assert c.host_cached_blocks == cnt["host"]
         assert a.stats()["free"] == cnt["free"]
+        spec_tail = [b for t in tails.values() for b in t]
+        assert len(spec_tail) == len(set(spec_tail))
+        assert all(a.refcount(b) == 1 for b in spec_tail), \
+            "draft-tail blocks are private to their row — never shared"
 
     for _ in range(400):
         op = rng.random()
-        if op < 0.5:
+        if op < 0.4:
             # new sequence of k full blocks, possibly reusing a prior stream
             k = int(rng.integers(1, 4))
             if streams and rng.random() < 0.6:
@@ -310,9 +319,36 @@ def test_random_share_flush_evict_spill_preserve_invariants():
                 digests.append(d)
             live[next_uid] = blocks
             next_uid += 1
+        elif op < 0.55 and live:
+            # speculative advance: a verify chunk's KV grows the chain with
+            # PRIVATE draft blocks — ordinary refcount-1 tenants of the same
+            # pool, never inserted into the chain-digest cache (their
+            # contents are unverified)
+            uid = list(live)[int(rng.integers(len(live)))]
+            n = int(rng.integers(1, 3))
+            if a.free_blocks + c.evictable_blocks >= n:
+                tails.setdefault(uid, []).extend(a.allocate(n))
+                advances += 1
+        elif op < 0.65 and any(tails.values()):
+            # rejected drafts: roll the cursor back over a suffix of the
+            # private tail; the committed (possibly shared) chain blocks
+            # keep their refcounts untouched
+            holders = [u for u, t in tails.items() if t]
+            uid = holders[int(rng.integers(len(holders)))]
+            t = tails[uid]
+            k = int(rng.integers(1, len(t) + 1))
+            before = [a.refcount(b) for b in live[uid]]
+            victims = t[len(t) - k:]
+            del t[len(t) - k:]
+            a.free(list(reversed(victims)))
+            assert [a.refcount(b) for b in live[uid]] == before, \
+                "rollback must never free a block another chain holds"
+            rollbacks += 1
         elif op < 0.85 and live:
             uid = list(live)[int(rng.integers(len(live)))]
-            a.free(list(reversed(live.pop(uid))))  # children park first
+            tail = tails.pop(uid, [])
+            # tail frees first (it extends the chain), then children park
+            a.free(list(reversed(live.pop(uid) + tail)))
         else:
             # pressure: parked LRU blocks spill to host while it has room,
             # then evict outright
@@ -321,8 +357,11 @@ def test_random_share_flush_evict_spill_preserve_invariants():
 
     assert sp.spill_calls > 0, "400 steps must exercise the spill tier"
     assert sp.restore_calls > 0, "reused streams must restore host blocks"
+    assert advances > 10 and rollbacks > 10, \
+        "400 steps must exercise speculative advance AND rollback"
     for uid in list(live):
-        a.free(list(reversed(live.pop(uid))))
+        tail = tails.pop(uid, [])
+        a.free(list(reversed(live.pop(uid) + tail)))
         check()
     c.evict(c.evictable_blocks)
     cnt = a.counts()

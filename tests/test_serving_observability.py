@@ -391,3 +391,107 @@ def test_replica_group_load_report(served):
     assert "serving/replica_skew" in telemetry.summary()["serving"]["gauges"]
     out = group.run_to_completion()
     assert len(out) == 4
+
+
+# ---------------------------------------------------------------------------
+# speculative decode hooks
+# ---------------------------------------------------------------------------
+
+def _spec_engine(model, params, num_kv_blocks=64):
+    return InferenceEngineV2(model, params, config={
+        "state_manager": {"max_ragged_sequence_count": 4,
+                          "max_ragged_batch_size": 16,
+                          "max_context": 128,
+                          "num_kv_blocks": num_kv_blocks},
+        "kv_cache": {"block_size": 8, "cache_dtype": "fp32"},
+        "speculative": {"enabled": True, "max_draft_tokens": 4}})
+
+
+def _template_prompt(cfg, seed, reps=10):
+    rng = np.random.default_rng(seed)
+    return np.tile(rng.integers(0, cfg.vocab_size, 4), reps).astype(np.int32)
+
+
+def test_disabled_spec_hooks_zero_overhead(served, monkeypatch):
+    """Telemetry disabled, a SPECULATING run (drafts composed, verify
+    chunks dispatched, accept walks + rollbacks retired) performs zero
+    clock reads in the scheduler and zero allocations inside the telemetry
+    core — the accept-rate EWMA and the always-on draft counters must not
+    ride the telemetry path."""
+    import tracemalloc
+    from deepspeed_tpu.inference.v2 import scheduler as sched_mod
+
+    cfg, model, params = served
+    assert not telemetry.enabled()
+    engine = _spec_engine(model, params)
+    sched = SplitFuseScheduler(engine, token_budget=16)
+
+    def _boom():
+        raise AssertionError(
+            "disabled speculative path must not read the clock")
+    monkeypatch.setattr(sched_mod, "_now", _boom)
+
+    sched.submit(0, _template_prompt(cfg, 5), max_new_tokens=6)
+    sched.step()  # warm the prefill jit caches outside the window
+
+    sched.submit(1, _template_prompt(cfg, 5) + 1, max_new_tokens=8)
+    tracemalloc.start()
+    snap0 = tracemalloc.take_snapshot()
+    while sched.has_work:
+        sched.step()
+    snap1 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    core_filter = [tracemalloc.Filter(True, telemetry_core.__file__)]
+    grown = [st for st in
+             snap1.filter_traces(core_filter).compare_to(
+                 snap0.filter_traces(core_filter), "lineno")
+             if st.size_diff > 0]
+    assert not grown, f"telemetry core allocated when disabled: {grown}"
+    # the router's load signal stays live with telemetry off
+    assert sched.speculated_tokens > 0
+    assert sched.tokens_per_round() >= 1.0
+    assert telemetry.summary() == {"enabled": False}
+
+
+def test_spec_stream_lands_gauges_events_and_phase(served, tmp_path):
+    """Enabled counterpart: a speculating run lands the
+    ``speculated_tokens``/``rejected_tokens`` counters, the
+    ``serving/accept_rate`` and ``serving/verify_batch_occupancy`` gauges,
+    a ``req/speculate`` phase in the request lanes, and the summary still
+    validates against summary.schema.json."""
+    cfg, model, params = served
+    tr = tmp_path / "trace.json"
+    telemetry.configure(enabled=True, chrome_trace_path=str(tr),
+                        sample_sync=False, jax_annotations=False)
+    engine = _spec_engine(model, params)
+    sched = SplitFuseScheduler(engine, token_budget=16)
+    sched.submit(0, _template_prompt(cfg, 5), max_new_tokens=6)
+    sched.submit(1, _template_prompt(cfg, 5) + 1, max_new_tokens=8)
+    out = sched.run_to_completion()
+    assert len(out[0]) == 6 and len(out[1]) == 8
+    assert sched.accepted_tokens > 0, "template workload must accept drafts"
+
+    s = telemetry.summary()
+    srv = s["serving"]
+    assert srv["requests"]["speculated_tokens"] >= 1
+    assert srv["requests"]["speculated_tokens"] == sched.speculated_tokens
+    assert srv["requests"].get("rejected_tokens", 0) == sched.rejected_tokens
+    acc = srv["gauges"]["serving/accept_rate"]
+    assert 0.0 <= acc["last"] <= 1.0 and 0.0 <= acc["peak"] <= 1.0
+    occ = srv["gauges"]["serving/verify_batch_occupancy"]
+    assert 0.0 < occ["peak"] <= 1.0
+    jsonschema = pytest.importorskip("jsonschema")
+    import os
+    schema_path = os.path.join(
+        os.path.dirname(telemetry_core.__file__), "summary.schema.json")
+    with open(schema_path) as f:
+        jsonschema.validate(s, json.load(f))
+
+    path = telemetry.export_chrome_trace()
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    spec_evts = [e for e in events if e["name"] == "req/speculate"]
+    assert spec_evts, "verify rounds must land as a speculate lane phase"
+    assert all(e["args"]["tokens"] >= 2 for e in spec_evts), \
+        "a speculate phase is by definition a multi-token decode chunk"
+    assert all(t >= 0x10000 for t in {e["tid"] for e in spec_evts})
