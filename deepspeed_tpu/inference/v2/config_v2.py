@@ -81,3 +81,13 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     # draft-then-verify decode (see SpeculativeConfig). Default off: the
     # disabled path does zero extra work per step (test-pinned).
     speculative = SpeculativeConfig()
+    # per-class serving SLO latency targets, keyed by class name::
+    #
+    #     {"interactive": {"ttft_target_s": 0.5, "tpot_target_s": 0.05},
+    #      "batch": {"ttft_target_s": 5.0, "tpot_target_s": 0.5}}
+    #
+    # The scheduler installs these into telemetry (set_slo_classes) at
+    # construction; requests tagged ``submit(..., slo_class=...)`` then feed
+    # per-class attainment counters and burn-rate gauges
+    # (docs/SERVING.md "SLO classes"). Empty = no per-class tracking.
+    slo_classes = {}

@@ -176,11 +176,12 @@ class PrefillDecodeFleet:
 
     def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None,
                temperature=0.0, top_k=0, top_p=1.0, seed=None,
-               replica=None):
+               replica=None, slo_class=None):
         """Admit a request on a prefill replica (least-active when
         ``replica`` is None). The prefill leg is capped at ONE generated
         token; the remaining ``max_new_tokens`` run on the decode side
-        after the handoff."""
+        after the handoff. ``slo_class`` rides the whole hop chain — the
+        adopting decode scheduler keeps tagging the request's samples."""
         if seed is None:
             # drawn HERE, not in the prefill scheduler: prefill and decode
             # must share one deterministic sampling stream for bit-exactness
@@ -198,7 +199,8 @@ class PrefillDecodeFleet:
         with mesh:
             sched.submit(uid, prompt, max_new_tokens=1,
                          eos_token_id=eos_token_id, temperature=temperature,
-                         top_k=top_k, top_p=top_p, seed=seed)
+                         top_k=top_k, top_p=top_p, seed=seed,
+                         slo_class=slo_class)
         return replica
 
     def warm_transport(self, max_pages=None):
@@ -305,7 +307,8 @@ class PrefillDecodeFleet:
                              temperature=meta["temperature"],
                              top_k=meta["top_k"], top_p=meta["top_p"],
                              seed=meta["seed"], submit_ts=req.submit_ts,
-                             last_token_ts=req.last_token_ts)
+                             last_token_ts=req.last_token_ts,
+                             slo_class=req.slo_class)
         for req in reqs:
             self._route[req.uid] = ("decode", j)
 
@@ -383,4 +386,8 @@ class PrefillDecodeFleet:
                             "tokens_per_round": sched.tokens_per_round(),
                             "kv_occupancy":
                                 sched.kv_stats()["occupancy"]})
-        return {"replicas": per, "transport": self.transport.stats()}
+        rep = {"replicas": per, "transport": self.transport.stats()}
+        slo = telemetry.slo_snapshot()
+        if slo:
+            rep["slo_classes"] = slo
+        return rep

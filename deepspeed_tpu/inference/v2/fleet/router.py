@@ -184,13 +184,18 @@ class SLORouter:
                  f"every replica and router queue full", ttft)
 
     def _admit(self, uid, prompt, index, ttft, aff, max_new_tokens, kwargs):
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            # opens the request's cross-replica flow chain BEFORE the
+            # backend submit, so admit -> prefill -> handoff -> decode ->
+            # finish renders as one arrowed chain in the merged trace
+            tm.record_request_flow(uid, "admit", replica=index)
         self._backend.submit(uid, prompt, replica=index,
                              max_new_tokens=max_new_tokens, **kwargs)
         expected = len(prompt) + int(max_new_tokens)
         self._backlog[index] += expected
         self._placed[uid] = (index, expected)
         self.admitted += 1
-        tm = telemetry.get_telemetry()
         if tm.enabled:
             tm.fleet_event("admitted")
             if aff:
@@ -258,10 +263,31 @@ class SLORouter:
 
     def report(self):
         """Admission accounting (``admitted + rejected == submitted`` once
-        the queue is empty) + current backlog model."""
-        return {"submitted": self.submitted, "admitted": self.admitted,
-                "queued": self.queued, "rejected": self.rejected,
-                "shed_rate": self.shed_rate,
-                "queue_depth": len(self._queue),
-                "affinity_hits": self.affinity_hits,
-                "backlog_tokens": list(self._backlog)}
+        the queue is empty) + current backlog model. With telemetry on and
+        SLO classes configured, ``slo_classes`` carries each class's live
+        TTFT/TPOT percentiles and attainment (bench payloads embed this;
+        ``perf_gate --min-slo-attainment`` gates it)."""
+        rep = {"submitted": self.submitted, "admitted": self.admitted,
+               "queued": self.queued, "rejected": self.rejected,
+               "shed_rate": self.shed_rate,
+               "queue_depth": len(self._queue),
+               "affinity_hits": self.affinity_hits,
+               "backlog_tokens": list(self._backlog)}
+        tm = telemetry.get_telemetry()
+        snap = tm.slo_snapshot()
+        if snap:
+            slo = {}
+            for cls, entry in snap.items():
+                out = dict(entry)
+                pcts = {}
+                for metric in ("ttft", "tpot"):
+                    p = tm.hist_percentiles(f"serving/{metric}_s/{cls}")
+                    if p is not None:
+                        pcts[metric] = {"p50_s": round(p[0], 6),
+                                        "p95_s": round(p[1], 6),
+                                        "p99_s": round(p[2], 6)}
+                if pcts:
+                    out["percentiles"] = pcts
+                slo[cls] = out
+            rep["slo_classes"] = slo
+        return rep

@@ -128,7 +128,11 @@ class ReplicaGroup:
             per.append({"replica": i, "assigned": self._assigned[i],
                         "active": sched.active_count(),
                         "kv_occupancy": sched.kv_stats()["occupancy"]})
-        return {"replicas": per, "active_skew": self.active_skew()}
+        rep = {"replicas": per, "active_skew": self.active_skew()}
+        slo = telemetry.slo_snapshot()
+        if slo:
+            rep["slo_classes"] = slo
+        return rep
 
     @property
     def has_work(self):

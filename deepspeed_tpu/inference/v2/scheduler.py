@@ -40,6 +40,7 @@ class _Request:
     prompt: np.ndarray
     max_new_tokens: int
     eos_token_id: Optional[int]
+    slo_class: Optional[str] = None  # serving SLO class (config slo_classes)
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
@@ -135,12 +136,23 @@ class SplitFuseScheduler:
         # truthy return means ownership (KV pages + remaining decode) moved
         # to another scheduler — this one skips flush and terminal telemetry
         self.on_finish = None
+        # per-class SLO latency targets (config_v2.slo_classes), installed
+        # into telemetry once here so slo_observe knows the targets; requests
+        # tag themselves via submit(..., slo_class=...). The install survives
+        # telemetry.reset() (configuration, like the sinks).
+        self._slo_classes = dict(
+            getattr(engine._config, "slo_classes", None) or {})
+        if self._slo_classes:
+            telemetry.set_slo_classes(self._slo_classes)
 
     def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None,
-               temperature=0.0, top_k=0, top_p=1.0, seed=None):
+               temperature=0.0, top_k=0, top_p=1.0, seed=None,
+               slo_class=None):
         """Queue a request. ``temperature`` 0.0 = greedy; otherwise
         per-request top-k/top-p sampling. ``seed=None`` draws a fresh random
-        stream per request; pass an int for reproducible completions."""
+        stream per request; pass an int for reproducible completions.
+        ``slo_class`` tags the request's latency samples against that class's
+        targets (config ``slo_classes``; see docs/SERVING.md)."""
         if uid in self._requests:
             raise ValueError(f"uid {uid} already submitted")
         prompt = np.asarray(prompt, np.int32)
@@ -159,7 +171,12 @@ class SplitFuseScheduler:
         if seed is None:
             import secrets
             seed = secrets.randbits(31)
+        if slo_class is not None and self._slo_classes \
+                and slo_class not in self._slo_classes:
+            raise ValueError(f"unknown slo_class {slo_class!r} (configured: "
+                             f"{sorted(self._slo_classes)})")
         req = _Request(uid, prompt, int(max_new_tokens), eos_token_id,
+                       slo_class=slo_class,
                        temperature=float(temperature),
                        top_k=int(top_k), top_p=float(top_p),
                        seed=int(seed))
@@ -169,12 +186,14 @@ class SplitFuseScheduler:
             tm.serving_event("submitted")
             tm.record_request_phase(uid, "submit", req.submit_ts,
                                     prompt_tokens=len(prompt))
+            tm.record_request_flow(uid, "submit",
+                                   prompt_tokens=len(prompt))
         self._requests[uid] = req
         self._active += 1
 
     def adopt(self, uid, prompt, generated, max_new_tokens=16,
               eos_token_id=None, temperature=0.0, top_k=0, top_p=1.0,
-              seed=0, submit_ts=0.0, last_token_ts=0.0):
+              seed=0, submit_ts=0.0, last_token_ts=0.0, slo_class=None):
         """Adopt a mid-generation request whose KV pages were just imported
         into this scheduler's engine (prefill/decode disaggregation): the
         prompt is fully prefilled and ``generated`` holds the tokens the
@@ -196,6 +215,7 @@ class SplitFuseScheduler:
                 f"(seen={seq.seen_tokens if seq else None}, "
                 f"prompt={len(prompt)})")
         req = _Request(uid, prompt, int(max_new_tokens), eos_token_id,
+                       slo_class=slo_class,
                        temperature=float(temperature), top_k=int(top_k),
                        top_p=float(top_p), seed=int(seed),
                        prefill_pos=len(prompt), generated=generated)
@@ -209,6 +229,8 @@ class SplitFuseScheduler:
             tm.record_request_phase(uid, "adopt", t,
                                     seen_tokens=len(prompt),
                                     new_tokens=len(generated))
+            tm.record_request_flow(uid, "adopt",
+                                   new_tokens=len(generated))
         self._requests[uid] = req
         self._active += 1
 
@@ -233,6 +255,7 @@ class SplitFuseScheduler:
             tm.serving_event("cancelled")
             tm.record_request_phase(uid, "cancel", t,
                                     new_tokens=len(r.generated))
+            tm.record_request_flow(uid, "cancel", end=True)
         return True
 
     # -- public load signals (fleet router / ReplicaGroup) -----------------
@@ -301,6 +324,7 @@ class SplitFuseScheduler:
                     tm.serving_event("evicted")
                     tm.record_request_phase(r.uid, "evict", t_evict,
                                             seen_tokens=pos)
+                    tm.record_request_flow(r.uid, "evict", end=True)
                 continue
             if budget < 1:
                 break
@@ -495,6 +519,8 @@ class SplitFuseScheduler:
                                        t_fwd - r.submit_ts)
                         tm.record_request_phase(uid, "queued", r.submit_ts,
                                                 t_fwd - r.submit_ts)
+                    tm.record_request_flow(uid, "prefill",
+                                           tokens=len(chunks[row]))
         if self._spec:
             reqs = [self._requests[u] for u in uids]
             # each row's LAST verify column samples at: the next stream
@@ -613,8 +639,10 @@ class SplitFuseScheduler:
                 if first:
                     # TTFT spans submit->first generated token; a request
                     # submitted before telemetry came on anchors at t_fwd
-                    tm.record_hist("serving/ttft_s",
-                                   t_done - (r.submit_ts or t_fwd))
+                    ttft = t_done - (r.submit_ts or t_fwd)
+                    tm.record_hist("serving/ttft_s", ttft)
+                    if r.slo_class:
+                        tm.slo_observe(r.slo_class, "ttft", ttft)
                 elif r.last_token_ts:
                     # the round's gap amortized over every emitted token,
                     # one hist entry per token — counts stay token-aligned
@@ -622,6 +650,9 @@ class SplitFuseScheduler:
                     gap = (t_done - r.last_token_ts) / len(emitted)
                     for _ in emitted:
                         tm.record_hist("serving/tpot_s", gap)
+                    if r.slo_class:
+                        tm.slo_observe(r.slo_class, "tpot", gap,
+                                       n=len(emitted))
                 r.last_token_ts = t_done
             if (r.eos_token_id is not None and
                     r.eos_token_id == r.generated[-1]) or \
@@ -642,6 +673,7 @@ class SplitFuseScheduler:
                     tm.serving_event("finished")
                     tm.record_request_phase(uid, "finish", t_done,
                                             new_tokens=len(r.generated))
+                    tm.record_request_flow(uid, "finish", end=True)
         if spec and n_decode_rows:
             # live accept-rate EWMA feeding SLORouter.predicted_ttft: tokens
             # committed per decode row per round (>= 1 by construction)

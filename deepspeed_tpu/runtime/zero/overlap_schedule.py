@@ -51,6 +51,12 @@ import math
 # resolve it lazily (overlap.py is stdlib-only, so this never drags in jax).
 _OVERLAP = None
 
+# Same injection shape for the measured per-op cost store
+# (telemetry/profile_store.py): perf_gate/overlap_report plug their standalone
+# copy in; in-package callers resolve lazily; a missing store (or a standalone
+# load where the package import fails) degrades to the roofline, never errors.
+_PROFILE = None
+
 # matches kernel_tuner._COMM_LATENCY_S — the per-call launch/sync floor that
 # makes many small collectives cost more than one big one
 DEFAULT_LATENCY_S = 1e-6
@@ -71,6 +77,28 @@ def _ov():
     if _OVERLAP is None:
         from deepspeed_tpu.telemetry import overlap as _OVERLAP  # noqa: PLW0603
     return _OVERLAP
+
+
+def _profile():
+    global _PROFILE
+    if _PROFILE is None:
+        try:
+            from deepspeed_tpu.telemetry import profile_store as _PROFILE  # noqa: PLW0603
+        except ImportError:
+            return None
+    return _PROFILE
+
+
+def _count_resolution(op, reason):
+    """Per-resolve reason-code counter (measured | roofline_fallback) — a
+    no-op when telemetry is disabled or the package isn't importable (the
+    standalone perf_gate path)."""
+    try:
+        from deepspeed_tpu import telemetry
+    except ImportError:
+        return
+    if telemetry.enabled():
+        telemetry.count(f"overlap/cost_resolution/{reason}", op=str(op))
 
 
 def _op_class(op):
@@ -259,19 +287,39 @@ def scheduled_intervals(compute_s, comm_ops, plan, device="analytic:0"):
 
 
 def fill_comm_seconds(comm_ops, device_kind="tpu_v5e", axis_sizes=None):
-    """Per-call roofline seconds for inventory entries that lack them (same
-    model ``overlap.analytic_report`` uses). Needs jax only when something is
-    missing — checked-in baselines carry seconds and stay stdlib-only."""
+    """Per-call seconds for inventory entries that lack them — measured
+    first, roofline second.
+
+    Each priced entry consults the persisted per-op profile store
+    (``telemetry/profile_store.py``) before the analytic roofline
+    ``overlap.analytic_report`` uses, and is tagged with a
+    ``cost_source`` reason code (``"measured"`` on a store hit,
+    ``"roofline_fallback"`` otherwise); the same code lands on the
+    ``overlap/cost_resolution/*`` telemetry counter when enabled. Needs
+    jax only when the roofline actually fires — checked-in baselines
+    carry seconds and stay stdlib-only; a measured hit is stdlib-only
+    too."""
     specs = []
     for spec in comm_ops:
         spec = dict(spec)
         if "seconds" not in spec:
-            from deepspeed_tpu.autotuning import kernel_tuner
             count = max(int(spec.get("count", 1)), 1)
-            per_call = spec.get("bytes", 0) / count
-            n = (axis_sizes or {}).get(spec.get("axis"))
-            spec["seconds"] = kernel_tuner.comm_roofline_seconds(
-                spec["op"], per_call, n=n, device_kind=device_kind)
+            per_call_bytes = spec.get("bytes", 0) / count
+            ps = _profile()
+            measured = None
+            if ps is not None:
+                measured, _ = ps.resolve(spec["op"], per_call_bytes,
+                                         device_kind=device_kind)
+            if measured is not None:
+                spec["seconds"] = measured
+                spec["cost_source"] = "measured"
+            else:
+                from deepspeed_tpu.autotuning import kernel_tuner
+                n = (axis_sizes or {}).get(spec.get("axis"))
+                spec["seconds"] = kernel_tuner.comm_roofline_seconds(
+                    spec["op"], per_call_bytes, n=n, device_kind=device_kind)
+                spec["cost_source"] = "roofline_fallback"
+            _count_resolution(spec["op"], spec["cost_source"])
         specs.append(spec)
     return specs
 

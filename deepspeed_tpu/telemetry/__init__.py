@@ -97,6 +97,38 @@ def record_request_phase(uid, phase, t0, dur=None, **args):
     _GLOBAL.record_request_phase(uid, phase, t0, dur=dur, **args)
 
 
+def record_request_flow(uid, point, end=False, **args):
+    """One hop of a request's cross-replica flow chain (Chrome flow event:
+    first call opens with ph "s", later ones step "t", ``end=True`` "f")."""
+    _GLOBAL.record_request_flow(uid, point, end=end, **args)
+
+
+def record_series(name, value, **tags):
+    """One sample into the fixed-window ring time series ``name``."""
+    _GLOBAL.record_series(name, value, **tags)
+
+
+def series_windows(name):
+    """Live windows of series ``name`` (None when absent/disabled)."""
+    return _GLOBAL.series_windows(name)
+
+
+def set_slo_classes(classes):
+    """Install per-class SLO latency targets (survives ``reset()``)."""
+    _GLOBAL.set_slo_classes(classes)
+
+
+def slo_observe(slo_class, metric, value, n=1):
+    """One latency observation against an SLO class target ("ttft"/"tpot"):
+    per-class histogram, attainment counters, burn-rate gauges."""
+    _GLOBAL.slo_observe(slo_class, metric, value, n=n)
+
+
+def slo_snapshot():
+    """Live per-class attainment snapshot ({} when disabled)."""
+    return _GLOBAL.slo_snapshot()
+
+
 def fleet_event(event, n=1, **tags):
     """Count one fleet-router admission outcome (admitted/queued/rejected)."""
     _GLOBAL.fleet_event(event, n=n, **tags)

@@ -276,6 +276,10 @@ class Telemetry:
         self.memory_enabled = True
         self._flops_per_step = 0.0
         self._peak_flops = 0.0
+        # SLO class targets ({name: {"ttft_target_s", "tpot_target_s",
+        # "attainment_target"}}) — configuration like the sinks, so reset()
+        # keeps them; set_slo_classes replaces the whole set
+        self.slo_classes = {}
 
     def _reset_state(self):
         self._epoch = _now()
@@ -295,6 +299,13 @@ class Telemetry:
         self.serving_counters = {}  # lifecycle event -> count
         self.serving_gauges = {}   # name -> [last, peak]
         self._request_lanes = {}   # uid -> synthetic chrome tid
+        # time-series stream (telemetry/timeseries.py): name -> SeriesRing.
+        # Gauges and histograms feed their ring implicitly, so every
+        # {last,peak} stream also carries a windowed trajectory;
+        # record_series adds free-form ones.
+        self.series = {}
+        self.slo_stats = {}        # class -> metric -> [attained, violations]
+        self._flow_ids = {}        # uid -> chrome flow id (request chains)
         # fleet stream (router admission + prefill/decode handoffs)
         self.fleet_counters = {}   # admission outcome -> count
         self.fleet_gauges = {}     # name -> [last, peak]
@@ -556,20 +567,26 @@ class Telemetry:
             return
         v = max(float(value), 0.0)
         with self._lock:
-            h = self.hist_stats.get(name)
-            if h is None:
-                h = self.hist_stats[name] = {
-                    "counts": [0] * HIST_BUCKETS, "count": 0, "sum": 0.0,
-                    "min": float("inf"), "max": 0.0}
-            h["counts"][_hist_bucket(v)] += 1
-            h["count"] += 1
-            h["sum"] += v
-            if v < h["min"]:
-                h["min"] = v
-            if v > h["max"]:
-                h["max"] = v
+            self._record_hist_locked(name, v)
+            # every histogram sample also folds into its ring time series,
+            # so latency streams carry a trajectory (summary().timeseries)
+            self._record_series_locked(name, _now() - self._epoch, v)
             self._emit_jsonl({"name": name, "kind": "hist", "value": v,
                               "tags": tags or {}})
+
+    def _record_hist_locked(self, name, v):
+        h = self.hist_stats.get(name)
+        if h is None:
+            h = self.hist_stats[name] = {
+                "counts": [0] * HIST_BUCKETS, "count": 0, "sum": 0.0,
+                "min": float("inf"), "max": 0.0}
+        h["counts"][_hist_bucket(v)] += 1
+        h["count"] += 1
+        h["sum"] += v
+        if v < h["min"]:
+            h["min"] = v
+        if v > h["max"]:
+            h["max"] = v
 
     def hist_percentiles(self, name, qs=(0.5, 0.95, 0.99)):
         """Percentiles of histogram ``name`` as a tuple aligned with ``qs``,
@@ -579,6 +596,166 @@ class Telemetry:
             if not h or not h["count"]:
                 return None
             return tuple(_hist_quantile(h, q) for q in qs)
+
+    # ------------------------------------------------------------------
+    # time-series stream (telemetry/timeseries.py)
+    # ------------------------------------------------------------------
+    def _record_series_locked(self, name, rel_ts, v):
+        ring = self.series.get(name)
+        if ring is None:
+            from deepspeed_tpu.telemetry.timeseries import SeriesRing
+            ring = self.series[name] = SeriesRing()
+        ring.record(rel_ts, v)
+
+    def record_series(self, name, value, **tags):
+        """One sample into the fixed-window ring time series ``name``
+        (epoch-relative windows of ``timeseries.DEFAULT_WINDOW_S`` seconds,
+        O(1) memory — old windows fall off the ring). Gauges and histograms
+        feed their series implicitly; this is the entry point for free-form
+        trajectories. Disabled: a single boolean check, zero clock reads."""
+        if not self.enabled:
+            return
+        v = float(value)
+        with self._lock:
+            self._record_series_locked(name, _now() - self._epoch, v)
+            self._emit_jsonl({"name": name, "kind": "series", "value": v,
+                              "tags": tags or {}})
+
+    def series_windows(self, name):
+        """Live windows of series ``name`` (oldest first, see
+        ``SeriesRing.windows``), or None when the series does not exist or
+        telemetry is disabled."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            ring = self.series.get(name)
+            return None if ring is None else ring.windows()
+
+    def _timeseries_summary(self):
+        # caller holds self._lock
+        return {name: ring.summary()
+                for name, ring in sorted(self.series.items())}
+
+    # ------------------------------------------------------------------
+    # SLO classes (docs/SERVING.md "SLO classes")
+    # ------------------------------------------------------------------
+    def set_slo_classes(self, classes):
+        """Install per-class latency targets
+        (``{name: {"ttft_target_s": .., "tpot_target_s": ..,
+        "attainment_target": 0.99}}``). Configuration like the sinks —
+        survives ``reset()``; ``slo_observe`` consults it per sample."""
+        cleaned = {}
+        for name, spec in (classes or {}).items():
+            spec = dict(spec or {})
+            cleaned[str(name)] = {
+                "ttft_target_s": (float(spec["ttft_target_s"])
+                                  if spec.get("ttft_target_s") is not None
+                                  else None),
+                "tpot_target_s": (float(spec["tpot_target_s"])
+                                  if spec.get("tpot_target_s") is not None
+                                  else None),
+                "attainment_target": float(
+                    spec.get("attainment_target") or 0.99)}
+        with self._lock:
+            self.slo_classes = cleaned
+
+    @staticmethod
+    def _gauge_locked(gauges, name, v):
+        g = gauges.get(name)
+        if g is None:
+            gauges[name] = [v, v]
+        else:
+            g[0] = v
+            if v > g[1]:
+                g[1] = v
+
+    def slo_observe(self, slo_class, metric, value, n=1):
+        """Record one latency observation against class ``slo_class``'s
+        ``metric`` target ("ttft" | "tpot"): the per-class histogram
+        (``serving/<metric>_s/<class>``), the attainment counters
+        (``attained + violations == requests`` by construction), the
+        request/violation ring series, and the rolling burn-rate /
+        error-budget gauges derived from those series' windows (burn rate
+        1.0 = violating at exactly the budgeted rate; see
+        docs/OBSERVABILITY.md). Unknown classes and classes without a
+        target for ``metric`` only get the per-class histogram."""
+        if not self.enabled or not slo_class:
+            return
+        v = max(float(value), 0.0)
+        rel = _now() - self._epoch
+        with self._lock:
+            self._record_hist_locked(f"serving/{metric}_s/{slo_class}", v)
+            cls = self.slo_classes.get(slo_class)
+            target = (cls or {}).get(f"{metric}_target_s")
+            if target is None:
+                return
+            per = self.slo_stats.get(slo_class)
+            if per is None:
+                per = self.slo_stats[slo_class] = {}
+            st = per.get(metric)
+            if st is None:
+                st = per[metric] = [0, 0]
+            ok = v <= target
+            st[0 if ok else 1] += n
+            # one JSONL line per observation so multi-host tooling
+            # (scripts/trace_merge.py) can rebuild per-class attainment
+            # per host from the raw streams
+            self._emit_jsonl({"name": f"slo/{slo_class}/{metric}",
+                              "kind": "slo", "value": v,
+                              "tags": {"slo_class": slo_class,
+                                       "metric": metric, "n": n,
+                                       "attained": bool(ok),
+                                       "target_s": target}})
+            req_name = f"slo/{slo_class}/{metric}_requests"
+            viol_name = f"slo/{slo_class}/{metric}_violations"
+            self._record_series_locked(req_name, rel, float(n))
+            if not ok:
+                self._record_series_locked(viol_name, rel, float(n))
+            budget = max(1.0 - cls["attainment_target"], 1e-9)
+            req_ring = self.series[req_name]
+            viol_ring = self.series.get(viol_name)
+            # rolling burn rate: violation fraction over the LIVE windows,
+            # over the budgeted violation fraction
+            win_req = sum(w["count"] for w in req_ring.windows())
+            win_viol = (sum(w["count"] for w in viol_ring.windows())
+                        if viol_ring is not None else 0)
+            burn = (win_viol / win_req / budget) if win_req else 0.0
+            # lifetime error budget (total_count survives ring eviction,
+            # so this stays run-wide on long replays)
+            life_viol = viol_ring.total_count if viol_ring is not None else 0
+            consumed = ((life_viol / req_ring.total_count / budget)
+                        if req_ring.total_count else 0.0)
+            self._gauge_locked(self.serving_gauges,
+                               f"slo/{slo_class}/{metric}_burn_rate", burn)
+            self._gauge_locked(
+                self.serving_gauges,
+                f"slo/{slo_class}/{metric}_error_budget_remaining",
+                max(1.0 - consumed, 0.0))
+
+    def slo_snapshot(self):
+        """Per-class attainment snapshot (the live ``summary()["slo"]``
+        section); {} when disabled or nothing observed."""
+        if not self.enabled:
+            return {}
+        with self._lock:
+            return self._slo_summary()
+
+    def _slo_summary(self):
+        # caller holds self._lock
+        out = {}
+        for cls, per in sorted(self.slo_stats.items()):
+            spec = self.slo_classes.get(cls) or {}
+            entry = {"targets": {k: spec.get(k) for k in
+                                 ("ttft_target_s", "tpot_target_s")},
+                     "attainment_target": spec.get("attainment_target"),
+                     "metrics": {}}
+            for metric, (ok, viol) in sorted(per.items()):
+                total = ok + viol
+                entry["metrics"][metric] = {
+                    "requests": total, "attained": ok, "violations": viol,
+                    "attainment": round(ok / total, 6) if total else 1.0}
+            out[cls] = entry
+        return out
 
     def serving_event(self, event, n=1, **tags):
         """Count one request-lifecycle event ("submitted", "finished",
@@ -601,16 +778,12 @@ class Telemetry:
             return
         v = float(value)
         with self._lock:
-            g = self.serving_gauges.get(name)
-            if g is None:
-                self.serving_gauges[name] = [v, v]
-            else:
-                g[0] = v
-                if v > g[1]:
-                    g[1] = v
+            rel = _now() - self._epoch
+            self._gauge_locked(self.serving_gauges, name, v)
+            self._record_series_locked(name, rel, v)
             self.trace_events.append(
                 {"name": name, "ph": "C", "cat": "serving",
-                 "ts": round((_now() - self._epoch) * 1e6, 3),
+                 "ts": round(rel * 1e6, 3),
                  "pid": os.getpid(), "args": {"value": v}})
             self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
                               "tags": tags or {}})
@@ -646,6 +819,38 @@ class Telemetry:
             self._emit_jsonl({"name": f"serving/phase/{phase}",
                               "kind": "span", "value": dur or 0.0,
                               "tags": {"uid": uid, **args}})
+
+    def record_request_flow(self, uid, point, end=False, **args):
+        """One hop of request ``uid``'s cross-replica causal chain as a
+        Chrome flow event: the first call for a uid opens the chain (ph
+        "s"), later calls step it (ph "t"), ``end=True`` terminates it (ph
+        "f"). Every hop of a uid shares ONE flow id — derived from the uid,
+        not a local sequence, so the same request on the prefill and decode
+        replicas (different processes, different JSONLs) still shares the
+        id after ``scripts/trace_merge.py`` folds the files, and the
+        admit -> prefill -> handoff -> decode -> finish hops render as one
+        arrowed chain across replica tracks."""
+        if not self.enabled:
+            return
+        with self._lock:
+            rel = _now() - self._epoch
+            fid = self._flow_ids.get(uid)
+            if fid is None:
+                ph = "s"
+                fid = self._flow_ids[uid] = int(uid)
+            else:
+                ph = "f" if end else "t"
+            ev = {"name": "reqflow", "cat": "serving", "ph": ph, "id": fid,
+                  "ts": round(rel * 1e6, 3), "pid": os.getpid(),
+                  "tid": self._request_lanes.get(uid, 0),
+                  "args": {"uid": uid, "point": point, **args}}
+            if ph == "f":
+                ev["bp"] = "e"
+            self.trace_events.append(ev)
+            self._emit_jsonl({"name": f"serving/flow/{point}",
+                              "kind": "flow", "value": fid,
+                              "tags": {"uid": uid, "flow_phase": ph,
+                                       **args}})
 
     def _serving_summary(self):
         # caller holds self._lock
@@ -695,16 +900,12 @@ class Telemetry:
             return
         v = float(value)
         with self._lock:
-            g = self.fleet_gauges.get(name)
-            if g is None:
-                self.fleet_gauges[name] = [v, v]
-            else:
-                g[0] = v
-                if v > g[1]:
-                    g[1] = v
+            rel = _now() - self._epoch
+            self._gauge_locked(self.fleet_gauges, name, v)
+            self._record_series_locked(name, rel, v)
             self.trace_events.append(
                 {"name": name, "ph": "C", "cat": "fleet",
-                 "ts": round((_now() - self._epoch) * 1e6, 3),
+                 "ts": round(rel * 1e6, 3),
                  "pid": os.getpid(), "args": {"value": v}})
             self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
                               "tags": tags or {}})
@@ -737,6 +938,7 @@ class Telemetry:
         self.record_request_phase(uid, "handoff", t_end - seconds, seconds,
                                   pages=int(pages), bytes=int(nbytes),
                                   src=src, dst=dst)
+        self.record_request_flow(uid, "handoff", pages=int(pages))
 
     def _fleet_summary(self):
         # caller holds self._lock
@@ -765,16 +967,12 @@ class Telemetry:
             return
         v = float(value)
         with self._lock:
-            g = self.moe_gauges.get(name)
-            if g is None:
-                self.moe_gauges[name] = [v, v]
-            else:
-                g[0] = v
-                if v > g[1]:
-                    g[1] = v
+            rel = _now() - self._epoch
+            self._gauge_locked(self.moe_gauges, name, v)
+            self._record_series_locked(name, rel, v)
             self.trace_events.append(
                 {"name": name, "ph": "C", "cat": "moe",
-                 "ts": round((_now() - self._epoch) * 1e6, 3),
+                 "ts": round(rel * 1e6, 3),
                  "pid": os.getpid(), "args": {"value": v}})
             self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
                               "tags": tags or {}})
@@ -1084,7 +1282,9 @@ class Telemetry:
                    "ledger": self._ledger_summary(),
                    "serving": self._serving_summary(),
                    "fleet": self._fleet_summary(),
-                   "moe": self._moe_summary()}
+                   "moe": self._moe_summary(),
+                   "timeseries": self._timeseries_summary(),
+                   "slo": self._slo_summary()}
             if self.overlap_report is not None:
                 out["overlap"] = self.overlap_report
             return out
@@ -1158,6 +1358,12 @@ class Telemetry:
         if srv.get("requests"):
             lines.append("requests: " + "  ".join(
                 f"{k}={v}" for k, v in srv["requests"].items()))
+        for cls, e in s.get("slo", {}).items():
+            for metric, m in e["metrics"].items():
+                lines.append(
+                    f"slo[{cls}/{metric}]: {m['attained']}/{m['requests']} "
+                    f"attained ({m['attainment']:.1%}, "
+                    f"{m['violations']} violations)")
         flt = s.get("fleet", {})
         if flt.get("events"):
             lines.append("fleet: " + "  ".join(
@@ -1228,4 +1434,8 @@ class Telemetry:
         if flt.get("handoff", {}).get("count"):
             events.append((f"{p}Fleet/handoff_bytes",
                            flt["handoff"]["bytes"], step))
+        for cls, e in s.get("slo", {}).items():
+            for metric, m in e["metrics"].items():
+                events.append((f"{p}SLO/{cls}/{metric}_attainment",
+                               m["attainment"], step))
         return events

@@ -1,0 +1,245 @@
+"""Persisted measured per-op-seconds store — the cost-model override.
+
+Every scheduling heuristic in the tree (``overlap_schedule.py``'s exposure
+planner, ``tune_chip_free``'s config sweep) prices collectives and compute
+from an analytic roofline. This module is the measured alternative: a JSON
+table of per-call seconds keyed ``(op, shape-bucket, dtype)`` per device
+slug, populated from overlap trace-mode reports
+(``scripts/overlap_report.py --trace --emit-profile``) and — the moment
+silicon is available (ROADMAP item 6) — on-chip timing. Consumers resolve
+through :func:`resolve`, which returns the measured seconds on a hit and
+``(None, "roofline_fallback")`` on any miss, so a missing/stale store can
+never break a plan — it only costs modeling fidelity.
+
+Follows the ``autotuning/kernel_table.py`` pattern exactly: stdlib-only at
+module scope so ``scripts/perf_gate.py`` and ``scripts/overlap_report.py``
+can load it standalone via importlib (no jax, no package import), mtime-
+cached loads, atomic writes, env-var overrides:
+
+- ``DS_TPU_PROFILE_STORE``: table path override (wins over the default
+  ``onchip_results/profile_<device>.json``).
+- ``DS_TPU_PROFILE_STORE_DEVICE``: device slug override (CPU tests and
+  chip-free runs target e.g. ``tpu_v5e``).
+
+Shape buckets round byte counts up to the next power of two, so one
+measured entry covers the neighbourhood of message sizes the roofline
+would price within ~2x anyway; ``dtype`` is ``"any"`` for collectives
+(the wire layout is already folded into the measured seconds).
+
+Every entry carries a ``source`` tag (``trace_cpu`` | ``trace_tpu`` |
+``onchip`` | ``manual``) so a reader can tell a CPU-emulation seed from a
+silicon measurement at a glance.
+"""
+
+import json
+import os
+import threading
+
+FORMAT_VERSION = 1
+
+SOURCES = ("trace_cpu", "trace_tpu", "onchip", "manual")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: raw device_kind strings -> store slug (mirrors kernel_table aliases)
+_DEVICE_ALIASES = {
+    "tpu v5 lite": "tpu_v5e",
+    "tpu v5litepod": "tpu_v5e",
+    "tpu v5e": "tpu_v5e",
+    "v5e": "tpu_v5e",
+    "tpu v5": "tpu_v5p",
+    "tpu v5p": "tpu_v5p",
+    "v5p": "tpu_v5p",
+    "tpu v4": "tpu_v4",
+    "v4": "tpu_v4",
+    "tpu v6 lite": "tpu_v6e",
+    "tpu v6e": "tpu_v6e",
+    "v6e": "tpu_v6e",
+}
+
+_lock = threading.Lock()
+_cache = {}  # path -> (mtime_ns, parsed doc)
+
+
+def _pow2_ceil(x):
+    x = max(int(x), 1)
+    return 1 << (x - 1).bit_length()
+
+
+def normalize_device_kind(kind):
+    """Free-form device kind -> store slug (lowercased, underscored)."""
+    if not kind:
+        return "unknown"
+    k = str(kind).strip().lower()
+    if k in _DEVICE_ALIASES:
+        return _DEVICE_ALIASES[k]
+    return k.replace(" ", "_").replace("-", "_")
+
+
+def default_device_kind():
+    """Slug for the live backend, honouring
+    ``DS_TPU_PROFILE_STORE_DEVICE``."""
+    forced = os.environ.get("DS_TPU_PROFILE_STORE_DEVICE", "")
+    if forced:
+        return normalize_device_kind(forced)
+    try:  # lazy: this module must import without jax
+        import jax
+        return normalize_device_kind(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
+def bucket_key(op, nbytes, dtype="any"):
+    """``(op, byte-bucket, dtype)`` -> entry key string. ``nbytes`` is the
+    per-call payload, rounded up to the next power of two."""
+    if not op:
+        raise ValueError("op must be a non-empty string")
+    return f"{op}|b{_pow2_ceil(nbytes)}|{dtype or 'any'}"
+
+
+def store_path(device_kind):
+    return os.path.join(REPO_ROOT, "onchip_results",
+                        f"profile_{normalize_device_kind(device_kind)}.json")
+
+
+def validate_store(doc):
+    """Schema-check a parsed store doc. Returns a list of error strings
+    (empty = valid). Used by ``scripts/perf_gate.py --dry-run``."""
+    errs = []
+    if not isinstance(doc, dict):
+        return [f"store must be a JSON object, got {type(doc).__name__}"]
+    if doc.get("format_version") != FORMAT_VERSION:
+        errs.append(f"format_version must be {FORMAT_VERSION}, got "
+                    f"{doc.get('format_version')!r}")
+    if not isinstance(doc.get("device_kind"), str) or \
+            not doc.get("device_kind"):
+        errs.append("device_kind must be a non-empty string")
+    entries = doc.get("entries")
+    if not isinstance(entries, dict):
+        return errs + ["entries must be an object"]
+    for key, entry in entries.items():
+        if key.count("|") != 2:
+            errs.append(f"entry {key!r}: key must be op|b<bytes>|dtype")
+            continue
+        _, bucket, _ = key.split("|")
+        if not bucket.startswith("b") or not bucket[1:].isdigit():
+            errs.append(f"entry {key!r}: bucket must be b<int>, got "
+                        f"{bucket!r}")
+            continue
+        if not isinstance(entry, dict):
+            errs.append(f"entry {key!r}: value must be an object")
+            continue
+        sec = entry.get("seconds")
+        if not isinstance(sec, (int, float)) or isinstance(sec, bool) \
+                or sec <= 0:
+            errs.append(f"entry {key!r}: seconds must be a positive "
+                        f"number, got {sec!r}")
+        if entry.get("source") not in SOURCES:
+            errs.append(f"entry {key!r}: source must be one of "
+                        f"{list(SOURCES)}, got {entry.get('source')!r}")
+        cnt = entry.get("count", 1)
+        if not isinstance(cnt, int) or isinstance(cnt, bool) or cnt < 1:
+            errs.append(f"entry {key!r}: count must be a positive int, "
+                        f"got {cnt!r}")
+    return errs
+
+
+def load_store(device_kind=None, path=None):
+    """Load (and cache by mtime) the store for a device kind. Returns the
+    parsed doc, or None when no store exists or it fails validation (a
+    broken store must never break a plan). ``DS_TPU_PROFILE_STORE``
+    overrides the path outright."""
+    if path is None:
+        path = os.environ.get("DS_TPU_PROFILE_STORE", "")
+    if not path:
+        path = store_path(device_kind if device_kind is not None
+                          else default_device_kind())
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return None
+    with _lock:
+        cached = _cache.get(path)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if validate_store(doc):
+        doc = None
+    with _lock:
+        _cache[path] = (mtime, doc)
+    return doc
+
+
+def clear_cache():
+    with _lock:
+        _cache.clear()
+
+
+def lookup(op, nbytes, dtype="any", device_kind=None, path=None):
+    """Raw entry lookup. Returns the entry dict or None on miss."""
+    doc = load_store(device_kind=device_kind, path=path)
+    if doc is None:
+        return None
+    return doc["entries"].get(bucket_key(op, nbytes, dtype))
+
+
+def resolve(op, nbytes, dtype="any", device_kind=None, path=None):
+    """Measured-first resolution of one op's per-call seconds.
+
+    Returns ``(seconds_or_None, reason)`` where reason is ``"measured"``
+    (store hit) or ``"roofline_fallback"`` (no store / bucket miss —
+    caller must price from its analytic model).
+    """
+    entry = lookup(op, nbytes, dtype=dtype, device_kind=device_kind,
+                   path=path)
+    if entry is None:
+        return None, "roofline_fallback"
+    return float(entry["seconds"]), "measured"
+
+
+def make_entry(seconds, nbytes, source, count=1, extra=None):
+    """Build one store entry (per-call seconds + provenance)."""
+    entry = {"seconds": float(seconds), "bytes": int(nbytes),
+             "count": int(count), "source": source}
+    if extra:
+        entry.update(extra)
+    return entry
+
+
+def save_store(path, device_kind, entries, generated_by, extra=None):
+    """Write a store doc atomically (tmp + rename). ``entries`` maps bucket
+    keys to entry dicts (see :func:`make_entry`)."""
+    doc = {
+        "format_version": FORMAT_VERSION,
+        "device_kind": normalize_device_kind(device_kind),
+        "generated_by": generated_by,
+        "entries": dict(sorted(entries.items())),
+    }
+    if extra:
+        doc.update(extra)
+    errs = validate_store(doc)
+    if errs:
+        raise ValueError("refusing to write invalid profile store: " +
+                         "; ".join(errs[:5]))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    clear_cache()
+    return doc
+
+
+def merge_store(path, device_kind, new_entries, generated_by):
+    """Merge ``new_entries`` into an existing store (new keys win),
+    creating it when absent. Returns the written doc."""
+    doc = load_store(device_kind=device_kind, path=path)
+    entries = dict(doc["entries"]) if doc else {}
+    entries.update(new_entries)
+    return save_store(path, device_kind, entries, generated_by)

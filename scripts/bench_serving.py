@@ -73,9 +73,64 @@ def _embed_telemetry(extra):
     extra["telemetry"] = telemetry.summary()
 
 
+#: default two-class SLO mix for --replay / --fleet: an interactive class
+#: with tight targets and a throughput-oriented batch class (docs/SERVING.md
+#: "SLO classes"). Replay requests alternate classes deterministically so
+#: the same seed yields the same per-class populations. Targets are
+#: CPU-replay scale — 2x above the worst observed mid-run compile stall
+#: (~1.6 s on the CPU grid) so a one-off stall does not violate, tight
+#: enough that a real scheduling regression drags attainment under the
+#: perf gate's 0.9 ratchet (onchip_results/serving_slo_baseline.json).
+REPLAY_SLO_CLASSES = {
+    "interactive": {"ttft_target_s": 4.0, "tpot_target_s": 3.0,
+                    "attainment_target": 0.9},
+    "batch": {"ttft_target_s": 30.0, "tpot_target_s": 10.0,
+              "attainment_target": 0.9},
+}
+
+
+def _assign_slo_classes(n_req):
+    """Deterministic per-request class assignment (alternating)."""
+    names = sorted(REPLAY_SLO_CLASSES)  # ["batch", "interactive"]
+    return [names[(i + 1) % len(names)] for i in range(n_req)]
+
+
+def _slo_classes_extra(tm):
+    """Per-class attainment + TTFT/TPOT percentiles for a bench payload
+    (None when no SLO observations landed). perf_gate validates the shape
+    and gates the minimum attainment."""
+    from deepspeed_tpu import telemetry
+    snap = telemetry.slo_snapshot()
+    if not snap:
+        return None
+    out = {}
+    for cls, entry in snap.items():
+        e = dict(entry)
+        pcts = {}
+        for metric in ("ttft", "tpot"):
+            p = tm.hist_percentiles(f"serving/{metric}_s/{cls}")
+            if p is not None:
+                pcts[metric] = {"p50_s": round(p[0], 6),
+                                "p95_s": round(p[1], 6),
+                                "p99_s": round(p[2], 6)}
+        if pcts:
+            e["percentiles"] = pcts
+        out[cls] = e
+    return out
+
+
+def _min_attainment(slo):
+    """Worst per-class/per-metric attainment in a ``slo_classes`` section
+    (the number ``perf_gate --min-slo-attainment`` gates)."""
+    vals = [m["attainment"] for e in (slo or {}).values()
+            for m in e.get("metrics", {}).values()]
+    return min(vals) if vals else None
+
+
 def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
                  num_kv_blocks=None, prefix_caching=False, kv_dtype="fp",
-                 host_kv_blocks=0, model_and_params=None, speculative=None):
+                 host_kv_blocks=0, model_and_params=None, speculative=None,
+                 slo_classes=None):
     import jax
     import numpy as np
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
@@ -108,6 +163,8 @@ def _build_stack(cfg, n_req, prompt_len, new_tokens, budget, on_tpu,
         "prefix_caching": prefix_caching}
     if speculative is not None:
         config["speculative"] = speculative
+    if slo_classes:
+        config["slo_classes"] = dict(slo_classes)
     engine = InferenceEngineV2(model, params, config=config)
     return model, SplitFuseScheduler(engine, token_budget=budget)
 
@@ -199,16 +256,21 @@ def make_workload(n_req, seed, arrival="poisson", rate=4.0, burst_size=4,
     return prompt_lens, out_lens, arrivals
 
 
-def _drive_replay(sched, prompts, out_lens, arrivals):
+def _drive_replay(sched, prompts, out_lens, arrivals, slo_classes=None):
     """Open-loop wall-clock submission of a request trace against the live
-    scheduler (uids = trace indices). Returns the wall seconds."""
+    scheduler (uids = trace indices). ``slo_classes`` optionally maps each
+    trace index to its SLO class name. Returns the wall seconds."""
     n_req = len(prompts)
     t_start = time.perf_counter()
     nxt = 0
     while nxt < n_req or sched.has_work:
         now = time.perf_counter() - t_start
         while nxt < n_req and arrivals[nxt] <= now:
-            sched.submit(nxt, prompts[nxt], max_new_tokens=int(out_lens[nxt]))
+            kw = {}
+            if slo_classes is not None:
+                kw["slo_class"] = slo_classes[nxt]
+            sched.submit(nxt, prompts[nxt],
+                         max_new_tokens=int(out_lens[nxt]), **kw)
             nxt += 1
         if sched.has_work:
             sched.step()
@@ -800,7 +862,8 @@ def fleet_replay_bench(args, on_tpu):
                           "num_kv_blocks":
                               max(64, (max_ctx // block + 2) * n_req)},
         "kv_cache": {"block_size": block,
-                     "cache_dtype": "bf16" if on_tpu else "fp32"}}
+                     "cache_dtype": "bf16" if on_tpu else "fp32"},
+        "slo_classes": REPLAY_SLO_CLASSES}
     # prefill replicas cap the per-forward sequence count at the minimum
     # S bucket: forward cost scales with the PADDED sequence axis (sampling
     # rows, attention padding), and a prefill-only replica gains nothing
@@ -809,7 +872,9 @@ def fleet_replay_bench(args, on_tpu):
     prefill_cfg = {
         "state_manager": dict(eng_cfg["state_manager"],
                               max_ragged_sequence_count=4),
-        "kv_cache": dict(eng_cfg["kv_cache"])}
+        "kv_cache": dict(eng_cfg["kv_cache"]),
+        "slo_classes": REPLAY_SLO_CLASSES}
+    slo_assign = _assign_slo_classes(n_req)
 
     def measure(backend, scheds, arr, label):
         """Warm the batch-shape grid on every replica, then drive the trace
@@ -825,7 +890,8 @@ def fleet_replay_bench(args, on_tpu):
                             chrome_trace_path=os.environ.get(
                                 "DS_TPU_TELEMETRY_TRACE", ""))
         tm = telemetry.get_telemetry()
-        wall = _drive_replay(backend, prompts, out_lens, arr)
+        wall = _drive_replay(backend, prompts, out_lens, arr,
+                             slo_classes=slo_assign)
         results = backend.results()
         decoded = int(sum(len(v) for v in results.values()))
         ttft = tm.hist_percentiles("serving/ttft_s", (0.5, 0.99)) or (0.0, 0.0)
@@ -833,6 +899,7 @@ def fleet_replay_bench(args, on_tpu):
         return {"wall": wall, "decoded": decoded,
                 "completed": len(results),
                 "ttft": ttft, "tpot": tpot,
+                "slo": _slo_classes_extra(tm),
                 "handoff_p50": (tm.hist_percentiles("fleet/handoff_s",
                                                     (0.5,)) or (0.0,))[0]}
 
@@ -916,6 +983,13 @@ def fleet_replay_bench(args, on_tpu):
         "wall_s": round(fl["wall"], 2), "chips": n_chips,
         "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}",
     }
+    if fl["slo"]:
+        extra["slo_classes"] = fl["slo"]
+        attain = _min_attainment(fl["slo"])
+        if attain is not None:
+            extra["slo_min_attainment"] = round(attain, 6)
+    if single["slo"]:
+        extra["single_slo_classes"] = single["slo"]
     _embed_telemetry(extra)
     payload = {
         "metric": "serving_fleet_replay_tokens_per_sec_per_chip",
@@ -958,7 +1032,9 @@ def replay_bench(args, on_tpu):
         burst_size=args.burst_size, prompt_scale=prompt_scale,
         new_scale=new_scale, max_prompt=max_prompt, max_new=max_new)
     model, sched = _build_stack(cfg, n_req, int(max_prompt), int(max_new),
-                                budget, on_tpu)
+                                budget, on_tpu,
+                                slo_classes=REPLAY_SLO_CLASSES)
+    slo_assign = _assign_slo_classes(n_req)
     gen = np.random.default_rng(args.seed)
     prompts = [gen.integers(0, cfg.vocab_size, int(n)).astype(np.int32)
                for n in prompt_lens]
@@ -981,7 +1057,8 @@ def replay_bench(args, on_tpu):
                             "DS_TPU_TELEMETRY_TRACE", ""))
     tm = telemetry.get_telemetry()
 
-    wall = _drive_replay(sched, prompts, out_lens, arrivals)
+    wall = _drive_replay(sched, prompts, out_lens, arrivals,
+                         slo_classes=slo_assign)
 
     decoded = sum(len(r.generated) for u, r in sched._requests.items()
                   if u != 10_000)
@@ -1005,6 +1082,12 @@ def replay_bench(args, on_tpu):
         "wall_s": round(wall, 2), "chips": n_chips,
         "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}",
     }
+    slo = _slo_classes_extra(tm)
+    if slo:
+        extra["slo_classes"] = slo
+        attain = _min_attainment(slo)
+        if attain is not None:
+            extra["slo_min_attainment"] = round(attain, 6)
     _embed_telemetry(extra)
     payload = {
         "metric": "serving_replay_tokens_per_sec_per_chip",

@@ -157,6 +157,50 @@ def run_analytic(args):
     return report
 
 
+def emit_profile(report, args):
+    """Fold the report's sized collectives into the persisted per-op profile
+    store (telemetry/profile_store.py): per-call seconds = total_s / count,
+    bucketed by per-call payload bytes. Returns a small provenance dict for
+    the payload, or None when nothing was emitted."""
+    from deepspeed_tpu.telemetry import profile_store
+
+    entries = {}
+    for c in report.get("collectives", []):
+        count = max(int(c.get("count", 1) or 1), 1)
+        total_s = float(c.get("total_s", 0.0) or 0.0)
+        if total_s <= 0:
+            continue
+        per_call_s = total_s / count
+        per_call_b = int(c.get("bytes", 0) or 0) // count
+        key = profile_store.bucket_key(c["op"], per_call_b)
+        prev = entries.get(key)
+        if prev is not None and prev["count"] >= count:
+            continue  # keep the better-sampled measurement per bucket
+        entries[key] = profile_store.make_entry(
+            per_call_s, per_call_b, args.profile_source, count=count,
+            extra={"axis": c.get("axis")})
+    if not entries:
+        print("emit-profile: no sized collectives to record", file=sys.stderr)
+        return None
+
+    device = profile_store.default_device_kind()
+    path = (args.emit_profile
+            or os.environ.get("DS_TPU_PROFILE_STORE", "")
+            or profile_store.store_path(device))
+    mode = "--trace" if args.trace else "--analytic"
+    doc = profile_store.merge_store(
+        path, device, entries,
+        generated_by=f"scripts/overlap_report.py {mode} --emit-profile")
+    print(f"emit-profile: {len(entries)} entr"
+          f"{'y' if len(entries) == 1 else 'ies'} -> {path} "
+          f"(device {doc['device_kind']}, source {args.profile_source})",
+          file=sys.stderr)
+    return {"path": path, "device_kind": doc["device_kind"],
+            "source": args.profile_source,
+            "entries": len(doc["entries"]),
+            "keys": sorted(entries)}
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="compute/comm overlap exposure report")
@@ -192,6 +236,17 @@ def main():
     ap.add_argument("--advise", action="store_true",
                     help="print the top-K actionable prefetch hints with "
                          "their potential_saving_s")
+    ap.add_argument("--emit-profile", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="merge the report's measured per-op seconds into "
+                         "the profile store (telemetry/profile_store.py); "
+                         "PATH overrides the default "
+                         "onchip_results/profile_<device>.json "
+                         "(DS_TPU_PROFILE_STORE / "
+                         "DS_TPU_PROFILE_STORE_DEVICE honoured)")
+    ap.add_argument("--profile-source", default="trace_cpu",
+                    choices=["trace_cpu", "trace_tpu", "onchip", "manual"],
+                    help="provenance tag for --emit-profile entries")
     args = ap.parse_args()
 
     if args.analytic:
@@ -219,6 +274,10 @@ def main():
             print("  (none — nothing exposed next to independent compute)",
                   file=sys.stderr)
     extra = {"overlap": report}
+    if args.emit_profile is not None:
+        emitted = emit_profile(report, args)
+        if emitted is not None:
+            extra["profile_store"] = emitted
     if args.analytic:
         from deepspeed_tpu import telemetry
         if telemetry.enabled():

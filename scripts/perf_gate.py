@@ -76,7 +76,17 @@ wire bytes <= 0.5x fp32 with the ICI leg full precision
 baseline (``onchip_results/moe_overlap_baseline.json``) jax-free,
 requiring the chunked a2a/expert pipeline's exposed seconds to reproduce
 and to sit >= 30% below its serialized worst case
-(``check_moe_baseline``) — then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run`` against
+(``check_moe_baseline``) — and validates every checked-in measured-cost
+profile store (``onchip_results/profile_*.json``: schema via
+``profile_store.validate_store`` plus a resolver round trip requiring the
+``measured`` reason code, ``check_profile_store``) — and validates the
+checked-in SLO replay baseline
+(``onchip_results/serving_slo_baseline.json``): per-class attainment
+arithmetic (``attained + violations == requests``), worst per-class
+attainment >= 0.9, and >= 3 live time-series rings embedded
+(``check_slo_baseline``; live runs gate via ``--min-slo-attainment``, and
+every input doc's ``timeseries``/``slo_classes`` sections are
+shape-validated) — then exits 0/2 without comparing. The tier-1 lane runs ``--dry-run`` against
 the repo's own BASELINE.json so a malformed baseline, summary, or tuning
 table fails fast on CPU (docs/OBSERVABILITY.md).
 """
@@ -694,6 +704,161 @@ def validate_speculate_payload(doc):
     return None
 
 
+def _bad_num(v):
+    return not isinstance(v, (int, float)) or isinstance(v, bool) or \
+        not (v == v and abs(v) != float("inf"))
+
+
+def validate_timeseries_payload(doc):
+    """Shape-check the ``timeseries`` section of any embedded telemetry
+    summary (``telemetry/timeseries.py`` ring rollups): positive window
+    width, window counts >= 1, finite ordered min/mean/max, strictly
+    increasing window indices, and live window counts never exceeding the
+    lifetime total. Pure dict checks — runs in the tier-1 dry-run lane
+    without jax or jsonschema. Returns an error string or None."""
+    s = find_summary(doc)
+    ts = s.get("timeseries") if isinstance(s, dict) else None
+    if not isinstance(ts, dict):
+        return None  # nothing embedded — nothing to validate
+    for name, ring in ts.items():
+        if not isinstance(ring, dict):
+            return f"timeseries[{name!r}]: not a dict"
+        if _bad_num(ring.get("window_s")) or ring["window_s"] <= 0:
+            return f"timeseries[{name!r}]: window_s missing or not positive"
+        if not isinstance(ring.get("num_windows"), int) or \
+                ring["num_windows"] < 1:
+            return f"timeseries[{name!r}]: num_windows missing or < 1"
+        if not isinstance(ring.get("total_count"), int) or \
+                ring["total_count"] < 0:
+            return f"timeseries[{name!r}]: total_count missing or negative"
+        wins = ring.get("windows")
+        if not isinstance(wins, list):
+            return f"timeseries[{name!r}]: windows missing or not a list"
+        if len(wins) > ring["num_windows"]:
+            return f"timeseries[{name!r}]: more live windows than the ring"
+        prev_idx = None
+        live = 0
+        for w in wins:
+            if not isinstance(w, dict):
+                return f"timeseries[{name!r}]: window entry not a dict"
+            if not isinstance(w.get("count"), int) or w["count"] < 1:
+                return f"timeseries[{name!r}]: window count < 1 (sparse " \
+                       f"rings never keep empty windows)"
+            for k in ("sum", "min", "max", "mean"):
+                if _bad_num(w.get(k)):
+                    return f"timeseries[{name!r}]: window {k} not finite"
+            if not w["min"] <= w["mean"] <= w["max"]:
+                return f"timeseries[{name!r}]: window min/mean/max unordered"
+            idx = w.get("index")
+            if not isinstance(idx, int):
+                return f"timeseries[{name!r}]: window index missing"
+            if prev_idx is not None and idx <= prev_idx:
+                return f"timeseries[{name!r}]: window indices not " \
+                       f"strictly increasing"
+            prev_idx = idx
+            live += w["count"]
+        if live > ring["total_count"]:
+            return f"timeseries[{name!r}]: live window counts {live} exceed " \
+                   f"lifetime total_count {ring['total_count']}"
+    return None
+
+
+def validate_slo_payload(doc):
+    """Shape-check the per-SLO-class section riding a payload's extra
+    (``extra["slo_classes"]``, bench_serving --replay / --fleet) and the
+    summary's ``slo`` section: per-metric attainment arithmetic
+    (``attained + violations == requests``), attainment in [0, 1] and
+    consistent with the counters, ordered finite percentiles, and an
+    ``extra["slo_min_attainment"]`` that matches the derived worst class.
+    Pure dict checks — runs in the tier-1 dry-run lane without jax.
+    Returns an error string or None."""
+    if not isinstance(doc, dict):
+        return None
+    extra = doc.get("extra") if isinstance(doc.get("extra"), dict) else {}
+    sections = []
+    for src in (extra, find_summary(doc) or {}):
+        for key in ("slo_classes", "slo"):
+            sec = src.get(key) if isinstance(src, dict) else None
+            if isinstance(sec, dict) and sec and \
+                    not any(sec is s for s in sections):
+                sections.append(sec)
+    if not sections:
+        return None
+    worst = None
+    for sec in sections:
+        for cls, entry in sec.items():
+            if not isinstance(entry, dict):
+                return f"slo_classes[{cls!r}]: not a dict"
+            metrics = entry.get("metrics")
+            if not isinstance(metrics, dict) or not metrics:
+                return f"slo_classes[{cls!r}]: no metrics recorded"
+            for metric, st in metrics.items():
+                if not isinstance(st, dict):
+                    return f"slo_classes[{cls!r}][{metric!r}]: not a dict"
+                for k in ("requests", "attained", "violations"):
+                    if not isinstance(st.get(k), int) or st[k] < 0:
+                        return f"slo_classes[{cls!r}][{metric!r}]: {k} " \
+                               f"missing or negative"
+                if st["attained"] + st["violations"] != st["requests"]:
+                    return (f"slo_classes[{cls!r}][{metric!r}]: attained "
+                            f"{st['attained']} + violations "
+                            f"{st['violations']} != requests "
+                            f"{st['requests']} — attainment counters leaked")
+                att = st.get("attainment")
+                if _bad_num(att) or not 0.0 <= att <= 1.0:
+                    return f"slo_classes[{cls!r}][{metric!r}]: attainment " \
+                           f"missing or outside [0, 1]"
+                if st["requests"] and \
+                        abs(att - st["attained"] / st["requests"]) > 1e-3:
+                    return f"slo_classes[{cls!r}][{metric!r}]: attainment " \
+                           f"{att} inconsistent with its own counters"
+                if worst is None or att < worst:
+                    worst = att
+            pcts = entry.get("percentiles")
+            if pcts is not None:
+                if not isinstance(pcts, dict):
+                    return f"slo_classes[{cls!r}]: percentiles not a dict"
+                for metric, p in pcts.items():
+                    for k in ("p50_s", "p95_s", "p99_s"):
+                        if _bad_num(p.get(k)) if isinstance(p, dict) else True:
+                            return f"slo_classes[{cls!r}][{metric!r}]: " \
+                                   f"percentile {k} missing or not finite"
+                    if not p["p50_s"] <= p["p95_s"] <= p["p99_s"]:
+                        return f"slo_classes[{cls!r}][{metric!r}]: " \
+                               f"percentiles unordered"
+    floor = extra.get("slo_min_attainment")
+    if floor is not None:
+        if _bad_num(floor) or not 0.0 <= floor <= 1.0:
+            return "slo_min_attainment missing or outside [0, 1]"
+        if worst is not None and abs(floor - worst) > 1e-3:
+            return (f"slo_min_attainment {floor} does not match the worst "
+                    f"per-class attainment {worst} — the payload's headline "
+                    f"drifted from its own class table")
+    return None
+
+
+def _slo_min_attainment(doc):
+    """Worst per-class attainment carried by ``doc`` (the
+    ``extra.slo_min_attainment`` headline, else derived from
+    ``extra.slo_classes``); None when the doc has no SLO data."""
+    if not isinstance(doc, dict):
+        return None
+    extra = doc.get("extra") if isinstance(doc.get("extra"), dict) else {}
+    v = extra.get("slo_min_attainment")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    worst = None
+    sec = extra.get("slo_classes")
+    if isinstance(sec, dict):
+        for entry in sec.values():
+            for st in (entry.get("metrics") or {}).values():
+                att = st.get("attainment") if isinstance(st, dict) else None
+                if isinstance(att, (int, float)) and \
+                        (worst is None or att < worst):
+                    worst = float(att)
+    return worst
+
+
 def _load_overlap_module():
     """Load telemetry/overlap.py standalone (stdlib-only at module scope,
     same pattern as kernel_table) so overlap validation runs in the tier-1
@@ -1213,6 +1378,134 @@ def check_overlap_analytic():
                 report.get("critical_path", {}).get("ops", []))}, errors
 
 
+def _load_profile_store_module():
+    """Load telemetry/profile_store.py standalone (stdlib-only at module
+    scope, the kernel_table idiom) so the measured per-op cost stores are
+    validated in the tier-1 dry-run lane without the package or jax."""
+    import importlib.util
+    mod_path = os.path.join(REPO_ROOT, "deepspeed_tpu", "telemetry",
+                            "profile_store.py")
+    spec = importlib.util.spec_from_file_location("_profile_store", mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def check_profile_store(stores_dir=None):
+    """Validate every checked-in measured-cost profile store
+    (``onchip_results/profile_*.json``, schema via
+    ``profile_store.validate_store``) and round-trip one entry per store
+    through the resolver, requiring the ``measured`` reason code — a store
+    whose own keys resolve as ``roofline_fallback`` would silently disable
+    the measured-cost path in ``overlap_schedule``. Returns
+    (report, errors); skipped without error when no store is checked in."""
+    stores_dir = stores_dir or os.path.join(REPO_ROOT, "onchip_results")
+    try:
+        names = sorted(n for n in os.listdir(stores_dir)
+                       if n.startswith("profile_") and n.endswith(".json"))
+    except OSError:
+        names = []
+    if not names:
+        return {"skipped": f"no profile stores under {stores_dir}"}, []
+    try:
+        ps = _load_profile_store_module()
+    except Exception as e:
+        return {}, [f"cannot load profile_store module: {e}"]
+    report, errors = {"stores": {}}, []
+    for name in names:
+        path = os.path.join(stores_dir, name)
+        doc = load_doc(path)
+        if doc is None:
+            errors.append(f"{name}: unreadable")
+            continue
+        errs = ps.validate_store(doc)
+        entries = doc.get("entries", {}) if isinstance(doc, dict) else {}
+        report["stores"][name] = {"entries": len(entries), "errors": errs}
+        errors.extend(f"{name}: {e}" for e in errs)
+        if errs or not entries:
+            if not errs and not entries:
+                errors.append(f"{name}: store has no entries")
+            continue
+        # resolver round trip on the store's own first key (the bucket is
+        # already a power of two, so it maps back to itself)
+        key = sorted(entries)[0]
+        op, bucket, dtype = key.split("|")
+        seconds, reason = ps.resolve(op, int(bucket[1:]), dtype=dtype,
+                                     path=path)
+        report["stores"][name]["resolved"] = {
+            "key": key, "seconds": seconds, "reason": reason}
+        if reason != "measured" or seconds is None:
+            errors.append(
+                f"{name}: key {key} resolved as {reason!r} — the store's "
+                f"own entries must resolve with the 'measured' reason code")
+    return report, errors
+
+
+#: SLO replay acceptance for the checked-in baseline
+#: (onchip_results/serving_slo_baseline.json, regenerated with
+#: ``bench_serving --replay`` — the replay lane always tags requests with
+#: the two built-in SLO classes): every class's recorded attainment must
+#: clear the floor and the run must carry live time-series trajectories
+SLO_MIN_ATTAINMENT = 0.9
+SLO_MIN_SERIES = 3
+SLO_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                 "serving_slo_baseline.json")
+
+
+def check_slo_baseline(baseline_path=None):
+    """Validate the checked-in SLO replay baseline: payload shape
+    (``validate_serving_payload`` + ``validate_slo_payload`` incl. the
+    attainment arithmetic), then the acceptance ratchet — both built-in SLO
+    classes present with recorded requests, worst per-class attainment >=
+    ``SLO_MIN_ATTAINMENT``, and an embedded summary carrying >=
+    ``SLO_MIN_SERIES`` non-empty time-series rings (the trajectory plane
+    must actually have recorded). Pure dict checks over recorded values.
+    Returns (report, errors) for the dry-run lane."""
+    path = baseline_path or SLO_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no slo baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable slo baseline {path}"]
+    err = validate_serving_payload(doc) or validate_slo_payload(doc) \
+        or validate_timeseries_payload(doc)
+    if err:
+        return {}, [f"slo baseline: {err}"]
+    extra = doc.get("extra", {}) if isinstance(doc, dict) else {}
+    classes = extra.get("slo_classes")
+    if not isinstance(classes, dict) or not classes:
+        return {}, ["slo baseline payload carries no slo_classes section "
+                    "(regenerate with bench_serving --replay)"]
+    errors = []
+    if len(classes) < 2:
+        errors.append(f"slo baseline: only {len(classes)} SLO class(es) "
+                      f"recorded — the replay lane tags two")
+    for cls, entry in sorted(classes.items()):
+        if not any(st.get("requests", 0) > 0
+                   for st in (entry.get("metrics") or {}).values()):
+            errors.append(f"slo baseline: class {cls!r} recorded no requests")
+    worst = _slo_min_attainment(doc)
+    if worst is None:
+        errors.append("slo baseline: no attainment derivable")
+    elif worst < SLO_MIN_ATTAINMENT:
+        errors.append(
+            f"slo baseline: worst per-class attainment {worst} < "
+            f"{SLO_MIN_ATTAINMENT} — the serving path stopped meeting its "
+            f"recorded SLO targets")
+    s = find_summary(doc) or {}
+    series = s.get("timeseries") if isinstance(s, dict) else None
+    live = [n for n, ring in (series or {}).items()
+            if isinstance(ring, dict) and ring.get("windows")]
+    if len(live) < SLO_MIN_SERIES:
+        errors.append(
+            f"slo baseline: only {len(live)} non-empty time-series rings "
+            f"embedded (need >= {SLO_MIN_SERIES}) — the trajectory plane "
+            f"did not record")
+    return {"classes": sorted(classes),
+            "min_attainment": worst,
+            "live_series": len(live)}, errors
+
+
 #: graftlint ratchet: per-rule/per-file finding counts frozen by this doc
 #: may only go down (see docs/ANALYSIS.md; regenerate with
 #: scripts/graftlint.py --write-baseline)
@@ -1308,6 +1601,11 @@ def main(argv=None):
     ap.add_argument("--max-swap-stall-growth", type=float, default=0.25,
                     help="allowed relative growth in host-tier swap-in "
                          "stall seconds (--long-context payloads)")
+    ap.add_argument("--min-slo-attainment", type=float, default=None,
+                    help="fail (exit 3) when the candidate's worst "
+                         "per-SLO-class attainment (extra.slo_min_attainment "
+                         "/ extra.slo_classes) is below this floor; exit 2 "
+                         "when the candidate carries no SLO data")
     ap.add_argument("--dry-run", action="store_true",
                     help="validate inputs (parse + summary schema) only")
     args = ap.parse_args(argv)
@@ -1322,7 +1620,9 @@ def main(argv=None):
             return 2
         err = validate_summary(doc) or validate_serving_payload(doc) \
             or validate_fleet_payload(doc) or validate_longctx_payload(doc) \
-            or validate_speculate_payload(doc) or validate_overlap_payload(doc)
+            or validate_speculate_payload(doc) \
+            or validate_overlap_payload(doc) \
+            or validate_timeseries_payload(doc) or validate_slo_payload(doc)
         if err:
             print(f"perf_gate: {label}: {err}", file=sys.stderr)
             return 2
@@ -1364,10 +1664,17 @@ def main(argv=None):
         lint_report, lint_errors = check_lint_baseline()
         for err in lint_errors:
             print(f"perf_gate: lint: {err}", file=sys.stderr)
+        profile_report, profile_errors = check_profile_store()
+        for err in profile_errors:
+            print(f"perf_gate: profile_store: {err}", file=sys.stderr)
+        slo_report, slo_errors = check_slo_baseline()
+        for err in slo_errors:
+            print(f"perf_gate: slo: {err}", file=sys.stderr)
         errors = table_errors + qgz_errors + moe_wire_errors \
             + overlap_errors + sched_errors + moe_base_errors \
             + prefix_errors + fleet_errors + longctx_errors \
-            + spec_errors + elastic_errors + lint_errors
+            + spec_errors + elastic_errors + lint_errors \
+            + profile_errors + slo_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
@@ -1382,6 +1689,8 @@ def main(argv=None):
                           "speculate": spec_report,
                           "elastic": elastic_report,
                           "lint": lint_report,
+                          "profile_store": profile_report,
+                          "slo": slo_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
         return 2 if errors else 0
@@ -1409,6 +1718,19 @@ def main(argv=None):
                   "max_prefix_hit_drop": args.max_prefix_hit_drop,
                   "max_rate_multiplier_drop": args.max_rate_multiplier_drop}
     verdicts, regressed = compare(base_m, cand_m, thresholds)
+    if args.min_slo_attainment is not None:
+        att = _slo_min_attainment(docs["candidate"])
+        if att is None:
+            print("perf_gate: --min-slo-attainment given but the candidate "
+                  "carries no per-class SLO data", file=sys.stderr)
+            return 2
+        bad = att < args.min_slo_attainment
+        regressed |= bad
+        verdicts.append({"metric": "slo_min_attainment", "baseline":
+                         args.min_slo_attainment, "candidate": att,
+                         "delta": round(att - args.min_slo_attainment, 6),
+                         "threshold": args.min_slo_attainment,
+                         "direction": "down", "regressed": bad})
     result = {"compared": len(verdicts), "regressed": regressed,
               "verdicts": verdicts,
               "baseline_metrics": base_m, "candidate_metrics": cand_m}

@@ -12,6 +12,16 @@ Each host's records map to the merged trace as:
 - ``memory/*`` records                  -> a per-host ``hbm_bytes_in_use``
                                            counter track (``C`` events)
 - ``mfu`` / ``goodput`` gauges          -> per-host counter tracks
+- request-flow records (``kind: "flow"``) -> Chrome flow events (``s``/
+                                           ``t``/``f``); the flow ``id`` is
+                                           the record's uid-derived value,
+                                           NOT remapped per host, so one
+                                           request's admit -> prefill ->
+                                           handoff -> decode -> finish chain
+                                           binds across host tracks
+- SLO observations (``kind: "slo"``)    -> folded into the straggler
+                                           report's per-class attainment by
+                                           host (``slo_attainment_by_host``)
 - everything else                       -> instant events (``i``)
 
 Hosts have independent perf_counter epochs, so absolute timestamps are not
@@ -144,6 +154,39 @@ def align_offsets(per_host):
     return offsets, anchor
 
 
+def slo_attainment_by_host(per_host):
+    """Per-class SLO attainment rebuilt from each host's raw ``kind: "slo"``
+    observation records (one line per ``slo_observe``). Returns
+    ``{host: {slo_class: {metric: {requests, attained, violations,
+    attainment}}}}`` — empty dict when no host recorded SLO classes. A
+    fleet whose global attainment clears the bar can still hide one host
+    violating persistently; this is the per-host split that surfaces it."""
+    out = {}
+    for host, records in per_host.items():
+        per_cls = {}
+        for rec in records:
+            if rec.get("kind") != "slo":
+                continue
+            tags = rec.get("tags") or {}
+            cls = tags.get("slo_class")
+            metric = tags.get("metric")
+            if not cls or not metric:
+                continue
+            n = int(tags.get("n", 1))
+            st = per_cls.setdefault(cls, {}).setdefault(
+                metric, {"requests": 0, "attained": 0, "violations": 0})
+            st["requests"] += n
+            st["attained" if tags.get("attained") else "violations"] += n
+        for per in per_cls.values():
+            for st in per.values():
+                st["attainment"] = round(
+                    st["attained"] / st["requests"], 6) \
+                    if st["requests"] else 1.0
+        if per_cls:
+            out[host] = per_cls
+    return out
+
+
 def straggler_report(per_host, offsets, exposures=None):
     """Match the k-th occurrence of each collective key across hosts; skew
     of one matched set = max - min aligned timestamp. A host that is
@@ -194,6 +237,18 @@ def straggler_report(per_host, offsets, exposures=None):
         report["most_exposed_host"] = \
             ranked[0][0] if ranked and ranked[0][1]["exposed_comm_s"] > 0 \
             else None
+    slo = slo_attainment_by_host(per_host)
+    if slo:
+        report["slo_attainment_by_host"] = slo
+        # the host with the worst single-class attainment — the SLO analog
+        # of most_exposed_host
+        worst_h, worst_a = None, None
+        for h, per_cls in sorted(slo.items()):
+            for per in per_cls.values():
+                for st in per.values():
+                    if worst_a is None or st["attainment"] < worst_a:
+                        worst_h, worst_a = h, st["attainment"]
+        report["worst_slo_host"] = worst_h
     return report
 
 
@@ -244,6 +299,17 @@ def merged_trace_events(per_host, offsets, exposures=None):
                 events.append({**base, "name": name, "ph": "C", "cat": "ledger",
                                "ts": ts_us,
                                "args": {name: rec.get("value", 0.0)}})
+            elif kind == "flow":
+                # flow id stays the record's uid-derived value so one
+                # request's chain binds across the per-host pid remap
+                ph = tags.get("flow_phase", "t")
+                ev = {**base, "name": "reqflow", "ph": ph, "cat": "serving",
+                      "id": int(rec.get("value", 0)), "ts": ts_us,
+                      "args": {**tags,
+                               "point": name.rsplit("/", 1)[-1]}}
+                if ph == "f":
+                    ev["bp"] = "e"
+                events.append(ev)
             else:
                 events.append({**base, "name": name, "ph": "i", "s": "t",
                                "ts": ts_us,

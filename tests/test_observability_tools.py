@@ -733,10 +733,32 @@ def test_bench_serving_replay_cpu_acceptance(tmp_path):
     assert 0 < ex["peak_kv_occupancy"] <= 1.0
     assert ex["telemetry"]["serving"]["requests"]["finished"] == \
         ex["requests"]
+    # per-SLO-class section (PR 17): both built-in classes with attainment
+    # arithmetic intact and percentiles, a headline min attainment, and
+    # non-empty time-series rings for >= 3 gauges
+    slo = ex["slo_classes"]
+    assert set(slo) == {"interactive", "batch"}
+    for entry in slo.values():
+        for st in entry["metrics"].values():
+            assert st["attained"] + st["violations"] == st["requests"]
+            assert 0.0 <= st["attainment"] <= 1.0
+        pcts = entry["percentiles"]
+        assert pcts["ttft"]["p50_s"] <= pcts["ttft"]["p99_s"]
+    assert 0.0 <= ex["slo_min_attainment"] <= 1.0
+    series = ex["telemetry"]["timeseries"]
+    live = [n for n, ring in series.items() if ring["windows"]]
+    assert len(live) >= 3, sorted(series)
     p = tmp_path / "replay.json"
     p.write_text(json.dumps(doc))
     r = _run([PERF_GATE, "--baseline", str(p), "--candidate", str(p)])
     assert r.returncode == 0, (r.stdout, r.stderr)
+    # the attainment floor gates the same payload
+    r = _run([PERF_GATE, "--baseline", str(p), "--candidate", str(p),
+              "--min-slo-attainment", "0.5"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    r = _run([PERF_GATE, "--baseline", str(p), "--candidate", str(p),
+              "--min-slo-attainment", "1.01"])
+    assert r.returncode == 3, (r.stdout, r.stderr)
 
 
 # ---------------------------------------------------------------------------
