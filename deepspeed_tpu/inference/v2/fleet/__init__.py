@@ -6,10 +6,19 @@ PAPER.md §inference): ``SLORouter`` places by least-predicted-TTFT with
 prefix-digest affinity and sheds/queues with typed outcomes;
 ``PrefillDecodeFleet`` specializes replicas so prefill never competes with
 decode for a token budget, shipping finished KV pages between submeshes
-through ``KVPageTransport``. See docs/SERVING.md "Serving fleet".
+through ``KVPageTransport``. The elasticity layer (``lifecycle``) makes
+the fleet chaos-tolerant: replica lifecycle state machine, missed-
+heartbeat failure detection, bit-exact re-admission after replica loss,
+and the saturation-driven ``FleetAutoscaler``. See docs/SERVING.md
+"Serving fleet" and docs/RESILIENCE.md "Serving elasticity".
 """
 
+# lifecycle first: disagg imports it, and it must not round-trip through
+# this package (circular import otherwise)
+from deepspeed_tpu.inference.v2.fleet.lifecycle import (  # noqa: F401
+    DEAD, DRAINING, LIVE, FailureDetector, FleetAutoscaler,
+    ReplicaLifecycle)
 from deepspeed_tpu.inference.v2.fleet.router import (  # noqa: F401
     RequestAdmitted, RequestQueued, RequestRejected, SLORouter)
 from deepspeed_tpu.inference.v2.fleet.disagg import (  # noqa: F401
-    KVPageTransport, PrefillDecodeFleet)
+    HandoffError, KVPageTransport, PrefillDecodeFleet)

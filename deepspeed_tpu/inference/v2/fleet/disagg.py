@@ -41,8 +41,29 @@ import numpy as np
 import jax
 
 from deepspeed_tpu import telemetry
+from deepspeed_tpu.inference.v2.fleet import lifecycle as lc
 from deepspeed_tpu.inference.v2.replica_group import build_replica
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.faults import InjectedFault
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.retry import RetryError, retry_call
+
+
+class HandoffError(RuntimeError):
+    """A KV page handoff that could not complete after retries.
+
+    ``stage`` is ``"transfer"`` (retries exhausted BEFORE the export — the
+    source pages are still resident and must be flushed by the caller) or
+    ``"bind"`` (the export already released the source pages, so no retry
+    can help; the data is gone). Either way the fleet's recovery is the
+    same: the request falls back to re-prefill on the decode side instead
+    of the error raising through ``fleet.step()``."""
+
+    def __init__(self, uids, stage, detail=""):
+        super().__init__(f"handoff {stage} failed for uids {list(uids)}"
+                         + (f": {detail}" if detail else ""))
+        self.uids = list(uids)
+        self.stage = stage
 
 
 class KVPageTransport:
@@ -54,19 +75,43 @@ class KVPageTransport:
     (``block_until_ready`` — honesty over pipelining here; the handoff IS
     the disaggregation tax being measured)."""
 
-    def __init__(self):
+    def __init__(self, retries=2, retry_delay_s=0.01, rng=None, sleep=None):
         self.handoffs = 0
         self.transfers = 0
         self.pages_shipped = 0
         self.pages_bound = 0
         self.bytes_shipped = 0
         self.total_s = 0.0
+        self.retry_trips = 0
+        self.failed_handoffs = 0
+        # transient-failure hardening: the transfer attempt is wrapped in
+        # utils/retry.retry_call (rng/sleep injectable so drills pin exact
+        # schedules); retries fire only on the armed ``transport.drop``
+        # fault point — the in-process device_put itself cannot blip
+        self._retries = int(retries)
+        self._retry_delay_s = float(retry_delay_s)
+        self._rng = rng
+        self._sleep = sleep if sleep is not None else time.sleep
 
     def ship(self, uid, src_engine, dst_engine, src="prefill", dst="decode"):
         """Move ``uid``'s pages from ``src_engine`` to ``dst_engine``;
         returns the number of pages bound at the destination."""
         return self.ship_many([uid], src_engine, dst_engine,
                               src=src, dst=dst)
+
+    def _transfer(self, uids, src_engine, dst_engine, detail):
+        """One transfer attempt (the retryable unit). ``transport.drop``
+        fires BEFORE the export, so a retried attempt still finds the
+        source pages resident — past the export the source allocator has
+        released them and a retry could never reproduce the data."""
+        faults.maybe_fail("transport.drop", detail)
+        handle = src_engine.export_pages_many(uids)
+        sharding = dst_engine.kv_page_sharding
+        k = jax.device_put(handle["k"], sharding)
+        v = jax.device_put(handle["v"], sharding)
+        jax.block_until_ready((k, v))
+        handle["k"], handle["v"] = k, v
+        return handle
 
     def ship_many(self, uids, src_engine, dst_engine, src="prefill",
                   dst="decode"):
@@ -76,16 +121,29 @@ class KVPageTransport:
         dispatch cost is per ROUND, not per request. ``handoffs`` counts
         requests, ``transfers`` counts device copies; the transfer latency
         is apportioned to each request's telemetry lane by its page share.
-        Returns the total pages bound at the destination."""
+        Returns the total pages bound at the destination. Raises
+        :class:`HandoffError` when the transfer retries exhaust or the
+        destination bind fails — the fleet catches it and re-prefills the
+        requests on the decode side."""
         uids = list(uids)
+        detail = f"{src}->{dst}"
         t0 = time.perf_counter()
-        handle = src_engine.export_pages_many(uids)
-        sharding = dst_engine.kv_page_sharding
-        k = jax.device_put(handle["k"], sharding)
-        v = jax.device_put(handle["v"], sharding)
-        jax.block_until_ready((k, v))
-        handle["k"], handle["v"] = k, v
-        bound = dst_engine.import_pages_many(handle)
+        try:
+            handle = retry_call(
+                self._transfer, uids, src_engine, dst_engine, detail,
+                retries=self._retries, base_delay=self._retry_delay_s,
+                retry_on=(InjectedFault,), rng=self._rng, sleep=self._sleep,
+                on_retry=lambda a, e, d: self._count_retry())
+        except RetryError as e:
+            self.failed_handoffs += len(uids)
+            raise HandoffError(uids, "transfer", str(e)) from e
+        k, v = handle["k"], handle["v"]
+        try:
+            faults.maybe_fail("handoff.bind_fail", detail)
+            bound = dst_engine.import_pages_many(handle)
+        except InjectedFault as e:
+            self.failed_handoffs += len(uids)
+            raise HandoffError(uids, "bind", str(e)) from e
         dt = time.perf_counter() - t0
         nbytes = int(k.nbytes) + int(v.nbytes)
         self.handoffs += len(uids)
@@ -102,12 +160,20 @@ class KVPageTransport:
                                      src=src, dst=dst, bound=m["n"])
         return bound
 
+    def _count_retry(self):
+        self.retry_trips += 1
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_event("handoff_retry")
+
     def stats(self):
         return {"handoffs": self.handoffs,
                 "transfers": self.transfers,
                 "pages_shipped": self.pages_shipped,
                 "pages_bound": self.pages_bound,
                 "bytes_shipped": self.bytes_shipped,
+                "retry_trips": self.retry_trips,
+                "failed_handoffs": self.failed_handoffs,
                 "total_s": self.total_s}
 
 
@@ -123,16 +189,20 @@ class PrefillDecodeFleet:
             budget (prefill wants a LARGE budget — it only sees chunks).
         decode_engine_config / decode_token_budget: decode-side overrides
             (default: same config; budget defaults to the decode batch size
-            need, which is just the concurrent-sequence count). The decode
-            pool must be sized for the working set of in-flight sequences —
-            a handoff that cannot bind raises rather than silently re-runs
-            prefill.
+            need, which is just the concurrent-sequence count). Size the
+            decode pool for the working set of in-flight sequences — a
+            handoff that cannot bind anywhere falls back to re-prefill on
+            the decode side (bit-exact, but the prefill compute is paid
+            twice; ``handoff_fallbacks`` counts these).
+        heartbeat_timeout_s: failure-detector window — a replica that
+            completes no step for this long is declared dead and its
+            in-flight requests re-admit elsewhere.
     """
 
     def __init__(self, model, params, prefill_replicas=1, decode_replicas=1,
                  tp_size=1, engine_config=None, token_budget=None,
                  decode_engine_config=None, decode_token_budget=None,
-                 transport=None):
+                 transport=None, heartbeat_timeout_s=30.0):
         devices = jax.devices()
         need = (prefill_replicas + decode_replicas) * tp_size
         if need > len(devices):
@@ -140,6 +210,8 @@ class PrefillDecodeFleet:
                 f"fleet needs {need} devices ({prefill_replicas} prefill + "
                 f"{decode_replicas} decode, tp={tp_size}); "
                 f"only {len(devices)} available")
+        self.lifecycle = lc.ReplicaLifecycle()
+        self.detector = lc.FailureDetector(timeout_s=heartbeat_timeout_s)
         self.prefill = []
         for i in range(prefill_replicas):
             sub = devices[i * tp_size:(i + 1) * tp_size]
@@ -148,6 +220,7 @@ class PrefillDecodeFleet:
                                         token_budget=token_budget)
             sched.on_finish = functools.partial(self._on_prefill_finish, i)
             self.prefill.append((mesh, sched))
+            self.lifecycle.add(("prefill", i))
         off = prefill_replicas * tp_size
         self.decode = []
         for j in range(decode_replicas):
@@ -156,10 +229,35 @@ class PrefillDecodeFleet:
                 model, params, sub, tp_size=tp_size,
                 engine_config=decode_engine_config or engine_config,
                 token_budget=decode_token_budget or token_budget))
+            self.lifecycle.add(("decode", j))
         self.transport = transport or KVPageTransport()
         self._meta = {}   # uid -> decode-leg params (limits, sampling, seed)
         self._route = {}  # uid -> ("prefill" | "decode" | "done", index)
         self._pending_ships = []  # (prefill index, request) awaiting handoff
+        # elasticity state: the builder args are kept so the autoscaler can
+        # raise new decode replicas on spare devices; retired engines park
+        # in the warm pool and revive (at a NEW lifecycle key) compile-free
+        self._model, self._params = model, params
+        self._tp = tp_size
+        self._decode_cfg = decode_engine_config or engine_config
+        self._decode_budget = decode_token_budget or token_budget
+        self._devices = devices
+        self._next_device = need
+        self._warm_decode = []       # retired (mesh, sched) pairs, reusable
+        self._census_exempt = set()  # fault-dead keys: pages died with them
+        self._readmit_prefix = {}    # uid -> tokens emitted before readmit
+        self._readmit_owner = {}     # uid -> (role, index) holding the tail
+        self._recovered_done = {}    # uid -> full output (done at recovery)
+        self._recovered_finished = []  # uids to surface as finished
+        self._terminal = []  # fleet-level (uid, outcome) beyond the scheds
+        self._step_no = 0
+        # always-on elasticity counters (bench payloads read these with
+        # telemetry off)
+        self.replica_losses = 0
+        self.readmitted = 0
+        self.handoff_fallbacks = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
         logger.info(f"PrefillDecodeFleet: {prefill_replicas} prefill + "
                     f"{decode_replicas} decode replicas, tp={tp_size}")
 
@@ -171,8 +269,20 @@ class PrefillDecodeFleet:
 
     @property
     def has_work(self):
-        return any(s.has_work for _, s in self.prefill) or \
-            any(s.has_work for _, s in self.decode)
+        # dead replicas are excluded: their host tables still show the
+        # in-flight requests they lost (kept readable for recovery), and
+        # counting those would wedge run_to_completion forever
+        for role, side in (("prefill", self.prefill),
+                           ("decode", self.decode)):
+            for i, (_, sched) in enumerate(side):
+                if self.lifecycle.is_stepping((role, i)) and sched.has_work:
+                    return True
+        return bool(self._pending_ships) or bool(self._recovered_finished)
+
+    def target_alive(self, i):
+        """Router probe: prefill target ``i`` takes new placements only
+        while LIVE (draining and dead targets are skipped)."""
+        return self.lifecycle.is_live(("prefill", i))
 
     def submit(self, uid, prompt, max_new_tokens=16, eos_token_id=None,
                temperature=0.0, top_k=0, top_p=1.0, seed=None,
@@ -187,8 +297,14 @@ class PrefillDecodeFleet:
             # must share one deterministic sampling stream for bit-exactness
             seed = secrets.randbits(31)
         if replica is None:
-            replica = min(range(len(self.prefill)),
+            live = [i for (_, i) in self.lifecycle.live("prefill")]
+            if not live:
+                raise RuntimeError("no live prefill replica to admit onto")
+            replica = min(live,
                           key=lambda i: self.prefill[i][1].active_count())
+        elif not self.lifecycle.is_live(("prefill", replica)):
+            raise ValueError(f"prefill replica {replica} is "
+                             f"{self.lifecycle.state(('prefill', replica))}")
         self._meta[uid] = {"max_new_tokens": int(max_new_tokens),
                            "eos_token_id": eos_token_id,
                            "temperature": float(temperature),
@@ -225,11 +341,12 @@ class PrefillDecodeFleet:
 
     # -- handoff -----------------------------------------------------------
     def _pick_decode(self, need_blocks):
-        """Least-KV-occupancy decode replica that can bind ``need_blocks``
-        pages (``free_blocks`` counts evictable cached blocks — the
-        allocator evicts parked pages before declaring exhaustion)."""
+        """Least-KV-occupancy LIVE decode replica that can bind
+        ``need_blocks`` pages (``free_blocks`` counts evictable cached
+        blocks — the allocator evicts parked pages before declaring
+        exhaustion). Draining and dead replicas never take new work."""
         order = sorted(
-            range(len(self.decode)),
+            self.live_decode_indices(),
             key=lambda j: self.decode[j][1].kv_stats()["occupancy"])
         for j in order:
             if self.decode[j][1].engine.free_blocks >= need_blocks:
@@ -248,7 +365,10 @@ class PrefillDecodeFleet:
         if meta is None:
             return False  # not fleet-managed (defensive)
         tok = req.generated[-1]
-        if len(req.generated) >= meta["max_new_tokens"] or \
+        # pos_offset covers requests re-admitted ONTO a prefill replica
+        # (last-resort recovery): their local token count is a tail of the
+        # stream, so completion compares the stream total
+        if len(req.generated) + req.pos_offset >= meta["max_new_tokens"] or \
                 (meta["eos_token_id"] is not None and
                  tok == meta["eos_token_id"]):
             # wanted exactly one token, or EOS on the first: complete at
@@ -263,8 +383,10 @@ class PrefillDecodeFleet:
         are grouped per source replica into one ``ship_many`` transfer
         when a single decode pool can bind the whole group; otherwise the
         group falls back to per-request placement (spreading across
-        pools). Raises when even a single request cannot bind anywhere —
-        a handoff must never silently re-run prefill."""
+        pools). A request that cannot bind anywhere — pools exhausted, or
+        the transfer/bind itself failed past retries — falls back to
+        re-prefill on the decode side (``_handoff_fallback``) instead of
+        raising through ``fleet.step()``."""
         if not self._pending_ships:
             return
         pending, self._pending_ships = self._pending_ships, []
@@ -281,24 +403,35 @@ class PrefillDecodeFleet:
             for req, need in zip(reqs, pages):
                 j = self._pick_decode(need)
                 if j is None:
-                    raise RuntimeError(
-                        f"no decode replica can bind {need} KV pages for "
-                        f"uid {req.uid}: decode pools exhausted — size "
-                        f"decode-side num_kv_blocks for the in-flight "
-                        f"working set")
+                    logger.warning(
+                        f"fleet: no decode replica can bind {need} KV "
+                        f"pages for uid {req.uid}; falling back to "
+                        f"re-prefill on the decode side")
+                    self._handoff_fallback(index, req, "bind_capacity")
+                    continue
                 self._ship_group(index, [req], j)
 
     def _ship_group(self, index, reqs, j):
         """One transfer prefill[index] -> decode[j] covering ``reqs``,
         then adopt each on the decode scheduler. Mesh nesting (prefill
         outer, decode inner) mirrors ``warm_transport`` exactly — the
-        ambient mesh context is part of the dispatch cache key."""
+        ambient mesh context is part of the dispatch cache key. A
+        :class:`HandoffError` (transfer retries exhausted / bind failed)
+        downgrades every request in the group to the re-prefill
+        fallback."""
         pmesh, psched = self.prefill[index]
         dmesh, dsched = self.decode[j]
+        try:
+            with pmesh, dmesh:
+                self.transport.ship_many(
+                    [r.uid for r in reqs], psched.engine, dsched.engine,
+                    src=f"prefill{index}", dst=f"decode{j}")
+        except HandoffError as e:
+            logger.warning(f"fleet: {e}; re-prefilling on the decode side")
+            for req in reqs:
+                self._handoff_fallback(index, req, e.stage)
+            return
         with pmesh, dmesh:
-            self.transport.ship_many([r.uid for r in reqs], psched.engine,
-                                     dsched.engine, src=f"prefill{index}",
-                                     dst=f"decode{j}")
             for req in reqs:
                 meta = self._meta[req.uid]
                 dsched.adopt(req.uid, req.prompt, req.generated,
@@ -311,31 +444,337 @@ class PrefillDecodeFleet:
                              slo_class=req.slo_class)
         for req in reqs:
             self._route[req.uid] = ("decode", j)
+            self._readmit_owner[req.uid] = ("decode", j)
+
+    def _handoff_fallback(self, index, req, stage):
+        """A handoff that cannot complete re-prefills on the decode side:
+        flush the source pages if they are still resident (a transfer-stage
+        failure leaves them; a bind-stage failure already released them
+        with the export), then re-admit — same seed, same stream position,
+        so the output stays bit-exact; only the prefill compute is paid
+        again."""
+        pmesh, psched = self.prefill[index]
+        if psched.engine._state.get_sequence(req.uid) is not None:
+            with pmesh:
+                psched.engine.flush(req.uid)
+        self.handoff_fallbacks += 1
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_event("handoff_fallback", stage=stage)
+        self._readmit_request(req.uid, req, cause=f"handoff_{stage}")
 
     # -- serving loop ------------------------------------------------------
     def step(self):
-        """One pipelined round: every replica (both sides) dispatches its
-        forward before any result is fetched, so the submeshes compute
-        concurrently. Prefill completions collect during ``step_finish``
-        (the on_finish hook) and ship as ONE batched transfer per
-        (source, destination) pair at the end of the round; the adopted
-        requests decode next round. Returns uids that truly finished
-        (handed-off uids are not reported by the prefill side)."""
+        """One pipelined round: every stepping replica (both sides)
+        dispatches its forward before any result is fetched, so the
+        submeshes compute concurrently. Prefill completions collect during
+        ``step_finish`` (the on_finish hook) and ship as ONE batched
+        transfer per (source, destination) pair at the end of the round;
+        the adopted requests decode next round. Returns uids that truly
+        finished (handed-off uids are not reported by the prefill side).
+
+        Fault points per replica per round, in order: ``replica.stall``
+        (the replica skips the round WITHOUT heartbeating — the failure
+        detector declares it dead once overdue) and ``replica.lost`` (the
+        replica dies immediately — marked DEAD, routed around, its
+        in-flight requests re-admitted from their last committed output)."""
+        self._step_no += 1
+        faults.set_step(self._step_no)
         pendings = []
-        for side in (self.prefill, self.decode):
-            for mesh, sched in side:
+        for role, side in (("prefill", self.prefill),
+                           ("decode", self.decode)):
+            for i, (mesh, sched) in enumerate(side):
+                key = (role, i)
+                if not self.lifecycle.is_stepping(key):
+                    continue
+                try:
+                    faults.maybe_fail("replica.stall", f"{role}{i}")
+                    faults.maybe_fail("replica.lost", f"{role}{i}")
+                except InjectedFault as e:
+                    if e.point == "replica.lost":
+                        self._lose_replica(role, i, cause="replica.lost")
+                    # stall: wedged — skips the round and does NOT beat,
+                    # so the detector eventually declares it dead
+                    continue
+                self.detector.beat(key)
                 if not sched.has_work:
                     continue
                 with mesh:
                     p = sched.step_begin()
                 if p is not None:
-                    pendings.append((mesh, sched, p))
+                    pendings.append((key, mesh, sched, p))
         finished = []
-        for mesh, sched, p in pendings:
+        for key, mesh, sched, p in pendings:
+            if not self.lifecycle.is_stepping(key):
+                continue  # died between dispatch and fetch this round
             with mesh:
                 finished.extend(sched.step_finish(p))
+        # finished routes update BEFORE loss recovery, so a replica that
+        # completes requests and then misses its heartbeat never re-admits
+        # work it already reported
+        for uid in finished:
+            cur = self._route.get(uid)
+            if cur is not None:
+                self._route[uid] = ("done", cur[1])
+        for key in self.detector.check():
+            if self.lifecycle.is_stepping(key):
+                self._lose_replica(*key, cause="missed_heartbeat")
         self._flush_handoffs()
+        # planned drains retire once their last in-flight request finishes
+        for j in range(len(self.decode)):
+            key = ("decode", j)
+            if self.lifecycle.state(key) == lc.DRAINING and \
+                    self.decode[j][1].active_count() == 0:
+                self._retire_decode(j)
+        finished.extend(self._drain_recovered())
         return finished
+
+    # -- replica loss recovery ---------------------------------------------
+    def _lose_replica(self, role, index, cause):
+        """Declare ``(role, index)`` dead and re-admit every request it
+        held. The replica's host-side tables stay readable — the requests'
+        committed tokens are the recovery state; only the KV pages died
+        with the replica (re-prefill rebuilds them, and with prefix
+        caching only the tail past the last committed digest runs)."""
+        key = (role, index)
+        if self.lifecycle.state(key) == lc.DEAD:
+            return
+        self.lifecycle.mark_dead(key)
+        self.detector.forget(key)
+        self.replica_losses += 1
+        # its pool died with it — the page census must not read tombstones
+        self._census_exempt.add(key)
+        logger.warning(f"fleet: {role}{index} lost ({cause}); "
+                       f"re-admitting its in-flight requests")
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_event("replica_lost", replica=f"{role}{index}",
+                           cause=cause)
+        if role == "prefill":
+            # pending ships from the dead source are stranded (pages gone);
+            # their requests re-admit via the route scan below
+            self._pending_ships = [(i, r) for (i, r) in self._pending_ships
+                                   if i != index]
+        side = self.prefill if role == "prefill" else self.decode
+        sched = side[index][1]
+        for uid, route in list(self._route.items()):
+            if route != (role, index):
+                continue
+            req = sched._requests.get(uid)
+            if req is None:
+                continue
+            if role == "decode" and req.done:
+                continue  # finished and already reported (defensive)
+            self._readmit_request(uid, req, cause=cause)
+
+    def _readmit_request(self, uid, req, cause):
+        """Re-admit a request whose KV pages are gone (replica loss,
+        exhausted handoff, planned drain). Recovery state is the host-side
+        committed output: ``_readmit_prefix`` (tokens emitted before any
+        EARLIER re-admission) plus ``req.generated``. The stream resumes at
+        the same (seed, position), so recovery is bit-exact. Placement:
+        least-occupied live decode replica; live prefill as last resort;
+        with neither, the request is terminally lost (fleet-level terminal
+        event so the router still retires its backlog)."""
+        meta = self._meta.get(uid)
+        if meta is None:
+            return  # not fleet-managed (defensive)
+        tm = telemetry.get_telemetry()
+        prefix = self._readmit_prefix.get(uid, ())
+        prompt = req.prompt if not len(prefix) \
+            else req.prompt[:len(req.prompt) - len(prefix)]
+        full = list(prefix) + [int(t) for t in req.generated]
+        if not full:
+            # lost mid-prefill, nothing committed: re-run the prefill leg
+            live = self.live_prefill_indices()
+            if not live:
+                self._lost_terminally(uid, cause)
+                return
+            target = min(live,
+                         key=lambda i: self.prefill[i][1].active_count())
+            mesh, sched = self.prefill[target]
+            with mesh:
+                sched.submit(uid, prompt, max_new_tokens=1,
+                             eos_token_id=meta["eos_token_id"],
+                             temperature=meta["temperature"],
+                             top_k=meta["top_k"], top_p=meta["top_p"],
+                             seed=meta["seed"], slo_class=req.slo_class)
+            self._route[uid] = ("prefill", target)
+        elif len(full) >= meta["max_new_tokens"] or \
+                (meta["eos_token_id"] is not None and
+                 full[-1] == meta["eos_token_id"]):
+            # the stream was already complete in host state — surface it
+            # as finished without touching any device
+            self._recovered_done[uid] = np.asarray(full, np.int32)  # graftlint: allow[GL004] host-committed token list, never a device value
+            self._recovered_finished.append(uid)
+            self._route[uid] = ("done", -1)
+        else:
+            live = self.live_decode_indices()
+            if live:
+                role = "decode"
+                target = min(live, key=lambda j:
+                             self.decode[j][1].kv_stats()["occupancy"])
+                side = self.decode
+            else:
+                plive = self.live_prefill_indices()
+                if not plive:
+                    self._lost_terminally(uid, cause)
+                    return
+                role = "prefill"
+                target = min(plive,
+                             key=lambda i: self.prefill[i][1].active_count())
+                side = self.prefill
+            mesh, sched = side[target]
+            with mesh:
+                sched.readmit(uid, prompt, full,
+                              max_new_tokens=meta["max_new_tokens"],
+                              eos_token_id=meta["eos_token_id"],
+                              temperature=meta["temperature"],
+                              top_k=meta["top_k"], top_p=meta["top_p"],
+                              seed=meta["seed"], submit_ts=req.submit_ts,
+                              last_token_ts=req.last_token_ts,
+                              slo_class=req.slo_class)
+            self._readmit_prefix[uid] = full[:-1]
+            self._readmit_owner[uid] = (role, target)
+            self._route[uid] = (role, target)
+        self.readmitted += 1
+        if tm.enabled:
+            tm.fleet_event("readmitted", cause=cause)
+
+    def _lost_terminally(self, uid, cause):
+        """No live replica can take the request: terminal loss. The
+        fleet-level terminal event keeps the router's backlog accounting
+        exact even in a total-outage drill."""
+        logger.error(f"fleet: uid {uid} lost terminally ({cause}): "
+                     f"no live replica to re-admit onto")
+        self._terminal.append((uid, "lost"))
+        self._route[uid] = ("done", -1)
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_event("request_lost", cause=cause)
+
+    def _drain_recovered(self):
+        """Uids whose streams were already complete when recovered (no
+        device round needed) — surfaced once through ``step()``'s finished
+        list so the router retires them normally."""
+        uids, self._recovered_finished = self._recovered_finished, []
+        return uids
+
+    # -- elasticity (autoscaler surface) -----------------------------------
+    def live_prefill_indices(self):
+        return [i for (_, i) in self.lifecycle.live("prefill")]
+
+    def live_decode_indices(self):
+        return [j for (_, j) in self.lifecycle.live("decode")]
+
+    def decode_active(self, j):
+        return self.decode[j][1].active_count()
+
+    def decode_occupancy(self, j):
+        return self.decode[j][1].kv_stats()["occupancy"]
+
+    def live_replica_count(self):
+        """Replicas still consuming devices (LIVE + DRAINING) — the
+        denominator of goodput-per-replica-second."""
+        c = self.lifecycle.counts()
+        return c[lc.LIVE] + c[lc.DRAINING]
+
+    def _spare_devices(self, n):
+        """Next ``n`` devices never assigned to a replica (None when the
+        host is exhausted — the autoscaler then keeps the current fleet)."""
+        if self._next_device + n > len(self._devices):
+            return None
+        sub = self._devices[self._next_device:self._next_device + n]
+        self._next_device += n
+        return sub
+
+    def scale_up_decode(self):
+        """Raise one decode replica: warm pool first (a retired engine
+        revives compile-free), else a fresh build on spare devices. The
+        replica joins at a NEW index/lifecycle key — dead keys never
+        revive. Returns the new index, or None when no capacity exists."""
+        if self._warm_decode:
+            mesh, sched = self._warm_decode.pop()
+        else:
+            sub = self._spare_devices(self._tp)
+            if sub is None:
+                return None
+            mesh, sched = build_replica(
+                self._model, self._params, sub, tp_size=self._tp,
+                engine_config=self._decode_cfg,
+                token_budget=self._decode_budget)
+        j = len(self.decode)
+        self.decode.append((mesh, sched))
+        self.lifecycle.add(("decode", j))
+        self.scale_ups += 1
+        logger.info(f"fleet: scaled up decode{j}")
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_event("scale_up", replica=f"decode{j}")
+        return j
+
+    def scale_down_decode(self, j, migrate=True):
+        """Gracefully remove decode replica ``j``: mark DRAINING (no new
+        placements), migrate its in-flight requests to the surviving fleet
+        (cancel + bit-exact re-admission — the scale-down reuses the
+        recovery path, so it is chaos-tested by construction), and retire
+        the engine to the warm pool once idle. ``migrate=False`` lets the
+        replica finish its work in place instead."""
+        key = ("decode", j)
+        if not self.lifecycle.is_live(key):
+            raise ValueError(f"decode replica {j} is "
+                             f"{self.lifecycle.state(key)}")
+        self.lifecycle.mark_draining(key)
+        self.scale_downs += 1
+        logger.info(f"fleet: draining decode{j} for scale-down")
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_event("scale_down", replica=f"decode{j}")
+        if migrate:
+            self._migrate_decode(j)
+        if self.decode[j][1].active_count() == 0:
+            self._retire_decode(j)
+
+    def _migrate_decode(self, j):
+        """Move every live request off decode ``j``: scheduler ``cancel``
+        frees the pages (and appends a "cancelled" terminal event, which is
+        popped — migration is NOT terminal; the router must keep the
+        backlog), then the recovery path re-admits the stream elsewhere."""
+        mesh, sched = self.decode[j]
+        for uid, route in list(self._route.items()):
+            if route != ("decode", j):
+                continue
+            req = sched._requests.get(uid)
+            if req is None or req.done:
+                continue
+            with mesh:
+                sched.cancel(uid)
+            ev = sched.terminal_events.pop()
+            assert ev == (uid, "cancelled"), ev
+            self._readmit_request(uid, req, cause="drain")
+
+    def _retire_decode(self, j):
+        """Tombstone a drained decode replica and park its engine in the
+        warm pool (next scale-up reuses it compile-free)."""
+        key = ("decode", j)
+        self.lifecycle.mark_dead(key)
+        self.detector.forget(key)
+        self._warm_decode.append(self.decode[j])
+        logger.info(f"fleet: decode{j} retired to warm pool")
+
+    def drain_terminal(self):
+        """Terminal outcomes beyond plain finish since the last call, from
+        every replica scheduler plus the fleet itself (terminally lost
+        requests) — the router retires predicted backlog on these."""
+        events, self._terminal = self._terminal, []
+        seen = set()
+        for side in (self.prefill, self.decode):
+            for _, sched in side:
+                if id(sched) in seen:  # warm-pool revival aliases an index
+                    continue
+                seen.add(id(sched))
+                events.extend(sched.drain_terminal())
+        return events
 
     def cancel(self, uid):
         """Cancel wherever the request currently lives; frees its KV pages
@@ -349,17 +788,66 @@ class PrefillDecodeFleet:
             return False  # already done
         mesh, sched = side[index]
         with mesh:
-            return sched.cancel(uid)
+            ok = sched.cancel(uid)
+        if ok:
+            self._route[uid] = ("done", index)
+        return ok
 
     def results(self):
         """Merged {uid: generated tokens}; decode-side entries win (they
-        extend the prefill side's first token)."""
+        extend the prefill side's first token). Re-admitted requests
+        overlay as prefix-before-loss + current owner's tail, so a dead
+        replica's stale partial output never wins; streams that were
+        already complete at recovery come from ``_recovered_done``."""
         out = {}
-        for mesh, sched in self.prefill:
-            out.update(sched.results())
-        for mesh, sched in self.decode:
-            out.update(sched.results())
+        per = {}
+        for role, side in (("prefill", self.prefill),
+                           ("decode", self.decode)):
+            for i, (_, sched) in enumerate(side):
+                r = sched.results()
+                per[(role, i)] = r
+                out.update(r)
+        for uid, prefix in self._readmit_prefix.items():
+            owner = self._readmit_owner.get(uid)
+            if owner is None:
+                continue
+            tail = per.get(owner, {}).get(uid)
+            if tail is None:
+                continue
+            head = np.asarray(prefix, np.int32)  # graftlint: allow[GL004] host-committed token list, never a device value
+            tail = np.asarray(tail, np.int32)  # graftlint: allow[GL004] host-committed token list, never a device value
+            out[uid] = np.concatenate([head, tail]) if len(head) else tail
+        out.update(self._recovered_done)
         return out
+
+    def page_census(self):
+        """Fleet-wide KV page accounting for leak drills: per-replica
+        ``occupied_blocks`` (device blocks live under sequences) plus the
+        ``leaked_pages`` total — occupied blocks on replicas with ZERO
+        in-flight requests. Fault-dead replicas are exempt (their pool
+        died with them); planned retirements are NOT — a drained replica
+        must hand back every page."""
+        per = []
+        leaked = 0
+        seen = set()
+        for role, side in (("prefill", self.prefill),
+                           ("decode", self.decode)):
+            for i, (_, sched) in enumerate(side):
+                if id(sched) in seen:  # warm-pool revival aliases an index
+                    continue
+                seen.add(id(sched))
+                key = (role, i)
+                if key in self._census_exempt:
+                    continue
+                st = sched.kv_stats()
+                idle = sched.active_count() == 0
+                per.append({"replica": f"{role}{i}",
+                            "state": self.lifecycle.state(key),
+                            "occupied_blocks": st["occupied_blocks"],
+                            "active": sched.active_count()})
+                if idle:
+                    leaked += st["occupied_blocks"]
+        return {"replicas": per, "leaked_pages": int(leaked)}
 
     def run_to_completion(self, max_rounds=10000):
         for _ in range(max_rounds):
@@ -382,11 +870,19 @@ class PrefillDecodeFleet:
                            ("decode", self.decode)):
             for i, (mesh, sched) in enumerate(side):
                 per.append({"replica": f"{role}{i}", "role": role,
+                            "state": self.lifecycle.state((role, i)),
                             "active": sched.active_count(),
                             "tokens_per_round": sched.tokens_per_round(),
                             "kv_occupancy":
                                 sched.kv_stats()["occupancy"]})
-        rep = {"replicas": per, "transport": self.transport.stats()}
+        rep = {"replicas": per, "transport": self.transport.stats(),
+               "lifecycle": self.lifecycle.counts(),
+               "elasticity": {"replica_losses": self.replica_losses,
+                              "readmitted": self.readmitted,
+                              "handoff_fallbacks": self.handoff_fallbacks,
+                              "scale_ups": self.scale_ups,
+                              "scale_downs": self.scale_downs,
+                              "warm_pool": len(self._warm_decode)}}
         slo = telemetry.slo_snapshot()
         if slo:
             rep["slo_classes"] = slo

@@ -15,7 +15,10 @@ into an unbounded backlog.
 Backends: anything exposing ``router_targets() -> [(mesh, scheduler)]``,
 ``submit(uid, prompt, replica=i, **kw)``, ``step() -> finished uids`` and
 ``has_work`` — ``ReplicaGroup`` (dp replicas) and ``PrefillDecodeFleet``
-(specialized prefill/decode sides) both qualify.
+(specialized prefill/decode sides) both qualify. Two optional probes make
+the router elasticity-aware: ``target_alive(i)`` (dead/draining targets
+are never placed on) and ``drain_terminal()`` (evict/cancel/replica-loss
+outcomes retire from the backlog model exactly like finishes).
 """
 
 import collections
@@ -25,6 +28,7 @@ import math
 import numpy as np
 
 from deepspeed_tpu import telemetry
+from deepspeed_tpu.inference.v2.scheduler import sheddable_classes
 
 
 @dataclasses.dataclass
@@ -95,6 +99,13 @@ class SLORouter:
         self.queued = 0
         self.rejected = 0
         self.affinity_hits = 0
+        # terminal outcomes beyond plain finish retired from the backlog
+        # model (evict/cancel/replica loss — satellite of the chaos drill:
+        # EVERY terminal path must retire, or predictions creep pessimistic)
+        self.terminal_retired = 0
+        # sheds by SLO class (None key = untagged requests) — always-on
+        # dict so bench payloads prove batch absorbed ALL shedding
+        self.shed_by_class = {}
 
     # -- TTFT prediction ---------------------------------------------------
     def _step_seconds(self):
@@ -133,36 +144,98 @@ class SLORouter:
         """(best index, predicted ttft, affinity tokens) — least predicted
         TTFT; at equal TTFT the warmer prefix wins (the prediction is
         round-granular, so a cached prefix that doesn't change the round
-        count still saves real prefill compute), then active count."""
+        count still saves real prefill compute), then active count. Dead
+        and draining targets (``backend.target_alive``) are skipped; with
+        NO live target the result is None and the caller sheds/queues."""
+        alive = getattr(self._backend, "target_alive", None)
         best = None
         for i, t in enumerate(self._targets):
+            if alive is not None and not alive(i):
+                continue
             aff = t.peek_prefix(prompt) if self._prefix_affinity else 0
             ttft = self.predicted_ttft(i, len(prompt), aff)
             key = (ttft, -aff, t.active_count())
             if best is None or key < best[0]:
                 best = (key, i, ttft, aff)
+        if best is None:
+            return None
         return best[1], best[2], best[3]
 
+    def _burning_classes(self):
+        """SLO classes whose live burn-rate gauge exceeds 1 (either
+        metric) — the shed-precedence trigger. () with telemetry off."""
+        tm = telemetry.get_telemetry()
+        if not tm.enabled:
+            return ()
+        out = []
+        for cls in tm.slo_class_targets():
+            for metric in ("ttft", "tpot"):
+                v = tm.gauge_value(f"slo/{cls}/{metric}_burn_rate")
+                if v is not None and v > 1.0:
+                    out.append(cls)
+                    break
+        return out
+
     # -- admission ---------------------------------------------------------
+    def _reject(self, uid, slo_class, reason, ttft=math.inf):
+        """One typed shed, with per-class accounting on EVERY rejection
+        path (the chaos payload proves which class absorbed the shedding)."""
+        self.rejected += 1
+        self.shed_by_class[slo_class] = \
+            self.shed_by_class.get(slo_class, 0) + 1
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            tm.fleet_event("rejected")
+            tm.fleet_event("shed", slo_class=slo_class or "none")
+            tm.fleet_gauge("fleet/shed_rate", self.shed_rate)
+            tm.fleet_gauge(f"slo/shed_by_class/{slo_class or 'none'}",
+                           self.shed_by_class[slo_class])
+        return RequestRejected(uid, reason, ttft)
+
     def submit(self, uid, prompt, max_new_tokens=16, **kwargs):
         """Route one request. Returns a typed outcome: ``RequestAdmitted``
         (placed now), ``RequestQueued`` (bounded router queue) or
-        ``RequestRejected`` (shed)."""
+        ``RequestRejected`` (shed).
+
+        Shed precedence: while any SLO class's burn-rate gauge exceeds 1,
+        arrivals in classes with strictly LOOSER TTFT targets (and untagged
+        arrivals) are shed immediately — the burning interactive class
+        keeps the capacity; batch absorbs the shedding, never the
+        reverse."""
         self.submitted += 1
+        cls = kwargs.get("slo_class")
         prompt = np.asarray(prompt, np.int32)
         tm = telemetry.get_telemetry()
         max_ctx = min(t.max_context for t in self._targets)
         if len(prompt) >= max_ctx:
             # unservable anywhere: typed rejection instead of a ValueError
             # from deep inside a scheduler
-            self.rejected += 1
-            if tm.enabled:
-                tm.fleet_event("rejected")
-                tm.fleet_gauge("fleet/shed_rate", self.shed_rate)
-            return RequestRejected(
-                uid, f"prompt of {len(prompt)} tokens cannot fit "
-                     f"max_context {max_ctx}")
-        i, ttft, aff = self._place(prompt)
+            return self._reject(
+                uid, cls, f"prompt of {len(prompt)} tokens cannot fit "
+                          f"max_context {max_ctx}")
+        burning = self._burning_classes()
+        if burning and cls not in burning:
+            shed = sheddable_classes(telemetry.slo_class_targets(), burning)
+            if cls is None or cls in shed:
+                return self._reject(
+                    uid, cls, f"shed for SLO precedence: class "
+                              f"{sorted(burning)} is burning and "
+                              f"{cls or 'untagged'} yields first")
+        placed = self._place(prompt)
+        if placed is None:
+            # no live placement target (total prefill outage): queue if
+            # room — replicas may come back — else shed
+            if len(self._queue) < self._queue_limit:
+                self._queue.append((uid, prompt, max_new_tokens, kwargs))
+                self.queued += 1
+                if tm.enabled:
+                    tm.fleet_event("queued")
+                    tm.fleet_gauge("fleet/queue_depth", len(self._queue))
+                return RequestQueued(uid, len(self._queue) - 1, math.inf)
+            return self._reject(
+                uid, cls, "no live replica to place on and router queue "
+                          "full")
+        i, ttft, aff = placed
         if tm.enabled:
             tm.record_hist("fleet/predicted_ttft_s", ttft)
         if ttft <= self._slo:
@@ -175,13 +248,10 @@ class SLORouter:
                 tm.fleet_event("queued")
                 tm.fleet_gauge("fleet/queue_depth", len(self._queue))
             return RequestQueued(uid, len(self._queue) - 1, ttft)
-        self.rejected += 1
-        if tm.enabled:
-            tm.fleet_event("rejected")
-            tm.fleet_gauge("fleet/shed_rate", self.shed_rate)
-        return RequestRejected(
-            uid, f"predicted TTFT {ttft:.3f}s over SLO {self._slo:.3f}s on "
-                 f"every replica and router queue full", ttft)
+        return self._reject(
+            uid, cls, f"predicted TTFT {ttft:.3f}s over SLO "
+                      f"{self._slo:.3f}s on every replica and router "
+                      f"queue full", ttft)
 
     def _admit(self, uid, prompt, index, ttft, aff, max_new_tokens, kwargs):
         tm = telemetry.get_telemetry()
@@ -211,7 +281,10 @@ class SLORouter:
         cannot help."""
         while self._queue:
             uid, prompt, max_new_tokens, kwargs = self._queue[0]
-            i, ttft, aff = self._place(prompt)
+            placed = self._place(prompt)
+            if placed is None:
+                break  # total outage: hold the queue until a replica lives
+            i, ttft, aff = placed
             if ttft > self._slo and self._backend.has_work:
                 break
             self._queue.popleft()
@@ -233,17 +306,30 @@ class SLORouter:
     def shed_rate(self):
         return self.rejected / self.submitted if self.submitted else 0.0
 
+    def _retire(self, uid):
+        """Drop one uid from the backlog model (idempotent)."""
+        placed = self._placed.pop(uid, None)
+        if placed is not None:
+            index, expected = placed
+            self._backlog[index] = max(0, self._backlog[index] - expected)
+        return placed is not None
+
     def step(self):
         """Drain the queue into freed capacity, run one backend round, and
-        retire finished requests from the backlog model. Returns finished
-        uids."""
+        retire EVERY terminal outcome from the backlog model — finished
+        uids from the step return, plus evict/cancel/replica-loss events
+        from ``backend.drain_terminal()``. Anything less leaks phantom
+        backlog and the TTFT predictions creep pessimistic until the
+        router sheds a healthy fleet. Returns finished uids."""
         self._drain_queue()
         finished = self._backend.step()
         for uid in finished:
-            placed = self._placed.pop(uid, None)
-            if placed is not None:
-                index, expected = placed
-                self._backlog[index] = max(0, self._backlog[index] - expected)
+            self._retire(uid)
+        drain = getattr(self._backend, "drain_terminal", None)
+        if drain is not None:
+            for uid, _outcome in drain():
+                if self._retire(uid):
+                    self.terminal_retired += 1
         return finished
 
     def results(self):
@@ -272,7 +358,20 @@ class SLORouter:
                "shed_rate": self.shed_rate,
                "queue_depth": len(self._queue),
                "affinity_hits": self.affinity_hits,
-               "backlog_tokens": list(self._backlog)}
+               "backlog_tokens": list(self._backlog),
+               "terminal_retired": self.terminal_retired,
+               "shed_by_class": {str(k): v
+                                 for k, v in self.shed_by_class.items()},
+               # accounting identity (see tests/test_fleet_elastic.py):
+               # every submit is admitted, rejected, or still queued; every
+               # admitted-but-unfinished uid holds exactly its expected
+               # tokens of backlog — drained fleets must show in_flight 0
+               # and backlog_total 0
+               "accounting": {
+                   "in_flight": len(self._placed),
+                   "backlog_total": sum(self._backlog),
+                   "identity_holds": self.admitted + self.rejected
+                   + len(self._queue) == self.submitted}}
         tm = telemetry.get_telemetry()
         snap = tm.slo_snapshot()
         if snap:

@@ -34,6 +34,28 @@ from deepspeed_tpu import telemetry
 _now = time.perf_counter
 
 
+def sheddable_classes(targets, burning):
+    """Which SLO classes absorb shedding/preemption while ``burning``
+    classes exceed burn rate 1: every class whose TTFT target is strictly
+    LOOSER than the tightest burning class's. A batch class (30s TTFT)
+    sheds for a burning interactive class (4s); the reverse never holds —
+    a burning batch class cannot push interactive rows out. ``targets`` is
+    the ``telemetry.slo_class_targets()`` shape; classes without a TTFT
+    target never shed for anyone (and nothing sheds for them)."""
+    if not burning:
+        return frozenset()
+    tight = min((targets.get(c, {}).get("ttft_target_s") or float("inf"))
+                for c in burning)
+    out = set()
+    for cls, spec in targets.items():
+        if cls in burning:
+            continue
+        t = spec.get("ttft_target_s")
+        if t is not None and t > tight:
+            out.add(cls)
+    return frozenset(out)
+
+
 @dataclasses.dataclass
 class _Request:
     uid: int
@@ -47,6 +69,11 @@ class _Request:
     seed: int = 0
     prefill_pos: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
+    # sampling-stream offset for re-admitted requests: the request already
+    # emitted ``pos_offset`` tokens on a replica that died, so every sample
+    # here draws at position ``len(generated) + pos_offset`` — the exact
+    # position the uninterrupted stream would use (bit-exact recovery)
+    pos_offset: int = 0
     done: bool = False
     preempted: bool = False  # KV host-swapped out (scheduler preemption)
     # serving-telemetry timestamps (perf_counter; 0.0 = not yet / disabled)
@@ -131,6 +158,14 @@ class SplitFuseScheduler:
         # replica retires several tokens per round; predicting 1/round
         # systematically over-estimates its TTFT)
         self._tokens_per_round_ewma = 1.0
+        # terminal outcomes beyond plain finish (evict/cancel), drained by
+        # the fleet router so its predicted-backlog model retires on EVERY
+        # terminal event — plain list appends, always on (the router must
+        # not leak backlog just because telemetry is off)
+        self.terminal_events = []
+        # SLO-precedence preemptions taken (burn-rate gauge > 1 steered the
+        # victim choice) — always-on int for bench payloads
+        self.slo_preemptions = 0
         # prefill/decode disaggregation hook: called as on_finish(sched, req)
         # the moment a request completes, BEFORE the sequence flushes; a
         # truthy return means ownership (KV pages + remaining decode) moved
@@ -234,6 +269,50 @@ class SplitFuseScheduler:
         self._requests[uid] = req
         self._active += 1
 
+    def readmit(self, uid, prompt, generated, max_new_tokens=16,
+                eos_token_id=None, temperature=0.0, top_k=0, top_p=1.0,
+                seed=0, submit_ts=0.0, last_token_ts=0.0, slo_class=None):
+        """Re-admit a request that lost its KV mid-generation (replica loss
+        or an exhausted handoff): unlike ``adopt``, NO pages exist here —
+        the prompt plus every already-emitted token but the last re-prefill
+        as an ordinary SplitFuse prompt (with prefix caching on, only the
+        tail past the request's last committed prefix digest actually
+        runs), and the deterministic sampling stream resumes at position
+        ``len(generated)`` via ``pos_offset``, so the continuation is
+        bit-exact with the uninterrupted run. ``max_new_tokens`` is the
+        ORIGINAL quota; the emitted count is subtracted here."""
+        if uid in self._requests:
+            raise ValueError(f"uid {uid} already submitted")
+        generated = [int(t) for t in generated]
+        if not generated:
+            raise ValueError("readmit requires at least one generated "
+                             "token; resubmit the prompt instead")
+        emitted = len(generated)
+        if emitted >= int(max_new_tokens) or \
+                (eos_token_id is not None and generated[-1] == eos_token_id):
+            raise ValueError(f"uid {uid} is already complete "
+                             f"({emitted} tokens)")
+        prompt = np.asarray(prompt, np.int32)  # graftlint: allow[GL004] host-committed token list, never a device value
+        head = np.asarray(generated[:-1], np.int32)  # graftlint: allow[GL004] host-committed token list, never a device value
+        full = np.concatenate([prompt, head]) if emitted > 1 else prompt
+        req = _Request(uid, full, int(max_new_tokens) - (emitted - 1),
+                       eos_token_id, slo_class=slo_class,
+                       temperature=float(temperature), top_k=int(top_k),
+                       top_p=float(top_p), seed=int(seed),
+                       generated=[generated[-1]], pos_offset=emitted - 1)
+        req.submit_ts = float(submit_ts)
+        req.last_token_ts = float(last_token_ts)
+        tm = telemetry.get_telemetry()
+        if tm.enabled:
+            t = _now()
+            tm.serving_event("readmitted")
+            tm.record_request_phase(uid, "readmit", t,
+                                    seen_tokens=len(full),
+                                    new_tokens=emitted)
+            tm.record_request_flow(uid, "readmit", new_tokens=emitted)
+        self._requests[uid] = req
+        self._active += 1
+
     def cancel(self, uid):
         """Withdraw a request (router shedding / requeue): frees its KV
         blocks — device-resident or host-swapped — and records the terminal
@@ -246,6 +325,7 @@ class SplitFuseScheduler:
             return False
         r.done = True
         self._active -= 1
+        self.terminal_events.append((uid, "cancelled"))
         if self._engine._state.get_sequence(uid) is not None:
             self._engine.flush(uid)
         tm = telemetry.get_telemetry()
@@ -262,6 +342,32 @@ class SplitFuseScheduler:
     def active_count(self):
         """Submitted-but-unfinished request count, O(1)."""
         return self._active
+
+    def drain_terminal(self):
+        """Terminal outcomes beyond plain finish since the last call
+        (``[(uid, "evicted" | "cancelled"), ...]``) — the router retires
+        its predicted-backlog rounds on these; finished uids retire via the
+        ``step()`` return instead."""
+        events, self.terminal_events = self.terminal_events, []
+        return events
+
+    def _burning_classes(self):
+        """Classes whose live burn-rate gauge exceeds 1 (either metric).
+        Telemetry off or no classes configured -> () — precedence simply
+        disengages (two attribute reads, no allocation)."""
+        if not self._slo_classes:
+            return ()
+        tm = telemetry.get_telemetry()
+        if not tm.enabled:
+            return ()
+        out = []
+        for cls in self._slo_classes:
+            for metric in ("ttft", "tpot"):
+                v = tm.gauge_value(f"slo/{cls}/{metric}_burn_rate")
+                if v is not None and v > 1.0:
+                    out.append(cls)
+                    break
+        return out
 
     def tokens_per_round(self):
         """EWMA of tokens committed per decode row per round, >= 1.0 (the
@@ -316,6 +422,7 @@ class SplitFuseScheduler:
                 # exactly the worst-latency requests.
                 r.done = True
                 self._active -= 1
+                self.terminal_events.append((r.uid, "evicted"))
                 self._engine.flush(r.uid)
                 if tm.enabled:
                     t_evict = _now()
@@ -355,7 +462,12 @@ class SplitFuseScheduler:
             take = min(budget, room, len(r.prompt) - r.prefill_pos)
             if take < 1:
                 continue
-            if self._prefix_caching and r.prefill_pos == 0 and not r.generated:
+            if self._prefix_caching and r.prefill_pos == 0 and \
+                    (not r.generated or r.pos_offset):
+                # pos_offset marks a re-admitted request: its "prompt" is
+                # prompt + prior tokens, so the match below IS the
+                # re-admission-from-last-prefix-digest contract — only the
+                # tail past the cached chain re-runs
                 # longest-cached-prefix match, deferred to the moment the
                 # first chunk actually schedules — by then earlier requests
                 # have committed their blocks, so queued bursts sharing a
@@ -426,12 +538,30 @@ class SplitFuseScheduler:
                      if not r.done and not r.preempted)
         if len(candidates) < 1 or active < 2:
             return False  # alone: preempting would free blocks we then re-need
+        # SLO precedence (PR 17's gauges as an INPUT): while any class's
+        # burn rate exceeds 1, rows of strictly looser classes are
+        # preempted first — batch absorbs the KV pressure so interactive
+        # attainment holds. Falls through to pure blocks_of when no class
+        # burns, nothing is tagged, or only protected rows hold blocks.
+        slo_pick = False
+        burning = self._burning_classes()
+        if burning:
+            shed = sheddable_classes(telemetry.slo_class_targets(), burning)
+            preferred = [r for r in candidates
+                         if r.slo_class is None or r.slo_class in shed]
+            if preferred and len(preferred) < len(candidates):
+                candidates = preferred
+                slo_pick = True
         victim = max(candidates, key=blocks_of)
+        if slo_pick:
+            self.slo_preemptions += 1
         n_blocks = blocks_of(victim)
         self._engine.preempt(victim.uid)
         victim.preempted = True
         tm = telemetry.get_telemetry()
         if tm.enabled:
+            if slo_pick:
+                tm.serving_event("slo_preempted")
             tm.serving_event("preempted")
             tm.record_request_phase(victim.uid, "preempt", _now(),
                                     blocks=n_blocks)
@@ -527,8 +657,8 @@ class SplitFuseScheduler:
             # position after the chunk for decode rows (len(generated)
             # counts chunk[0], drafts follow), the first generated position
             # for prefill rows (mid-prompt rows discard their ids anyway)
-            positions = [len(r.generated) if r.prefilling
-                         else len(r.generated) + len(c) - 1
+            positions = [len(r.generated) + r.pos_offset if r.prefilling
+                         else len(r.generated) + len(c) - 1 + r.pos_offset
                          for r, c in zip(reqs, chunks)]
             # rows that can roll back must not commit prefix-cache blocks
             # until the accept walk ran (a rejected draft in the chain
@@ -550,7 +680,7 @@ class SplitFuseScheduler:
                 top_ks=[r.top_k for r in reqs],
                 top_ps=[r.top_p for r in reqs],
                 seeds=[r.seed for r in reqs],
-                positions=[len(r.generated) for r in reqs])
+                positions=[len(r.generated) + r.pos_offset for r in reqs])
             logits = None
         else:
             logits = self._engine.put(uids, chunks)
@@ -590,11 +720,19 @@ class SplitFuseScheduler:
                 r.prefill_pos += len(chunks[row])
                 if r.prefilling:
                     continue  # mid-prompt ids/logits are not a next token
-                # final prefill chunk: the last verify column is the row's
-                # ordinary last-token sample
-                emitted = [int(ids[row, -1])] if spec else \
-                    [int(ids[row]) if logits is None
-                     else self._sample(r, logits[row])]
+                if r.generated:
+                    # re-admitted row finishing its re-prefill: the stream's
+                    # last committed token is already in ``generated`` (its
+                    # context ends the rebuilt prompt), so the final chunk's
+                    # sample would duplicate it — discard; decode resumes by
+                    # feeding that token as an ordinary chunk next round
+                    emitted = []
+                else:
+                    # final prefill chunk: the last verify column is the
+                    # row's ordinary last-token sample
+                    emitted = [int(ids[row, -1])] if spec else \
+                        [int(ids[row]) if logits is None
+                         else self._sample(r, logits[row])]
             elif spec:
                 # accept walk: target column c is the token plain decode
                 # would emit after chunk position c; drafts match targets
@@ -643,7 +781,7 @@ class SplitFuseScheduler:
                     tm.record_hist("serving/ttft_s", ttft)
                     if r.slo_class:
                         tm.slo_observe(r.slo_class, "ttft", ttft)
-                elif r.last_token_ts:
+                elif r.last_token_ts and emitted:
                     # the round's gap amortized over every emitted token,
                     # one hist entry per token — counts stay token-aligned
                     # and the mean reflects the speculative speedup
@@ -730,7 +868,8 @@ class SplitFuseScheduler:
             logits = np.where(logits < cutoff, -1e9, logits)
         p = np.exp(logits - logits.max())
         p /= p.sum()
-        rng = np.random.default_rng((r.seed << 20) + len(r.generated))
+        rng = np.random.default_rng(
+            (r.seed << 20) + len(r.generated) + r.pos_offset)
         return int(rng.choice(len(p), p=p))
 
     def results(self):

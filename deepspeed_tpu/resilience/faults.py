@@ -51,9 +51,24 @@ import time
 #: ``slice.lost``   DeepSpeedEngine.step(), next to step.hang — a whole
 #:                  slice dying mid-step (resilience/elastic_reshard.py)
 #: ``worker.exit``  comm.init_distributed (every worker's first runtime call)
+#: ``replica.lost`` PrefillDecodeFleet.step(), per replica — the serving
+#:                  analog of slice.lost: the fleet marks the replica dead
+#:                  and re-admits its in-flight requests elsewhere
+#: ``replica.stall`` PrefillDecodeFleet.step(), same site — with the raise
+#:                  action the replica skips rounds (no heartbeat) until the
+#:                  failure detector declares it dead; with sleep it drags
+#:                  the round
+#: ``transport.drop`` KVPageTransport, BEFORE the page export — a dropped
+#:                  handoff transfer is retried (retry_call); exhaustion
+#:                  surfaces as HandoffError and the request re-prefills
+#:                  on the decode side
+#: ``handoff.bind_fail`` KVPageTransport, before the destination allocator
+#:                  bind — pages already left the source, so no retry:
+#:                  straight to the re-prefill fallback
 KNOWN_POINTS = ("ckpt.write", "ckpt.publish", "comm.collective",
                 "comm.partition", "io.host", "step.hang", "slice.lost",
-                "worker.exit")
+                "worker.exit", "replica.lost", "replica.stall",
+                "transport.drop", "handoff.bind_fail")
 
 #: points the elastic reshard path interprets as "a slice is gone" —
 #: an :class:`InjectedFault` from any of these is translated into a

@@ -92,6 +92,17 @@ def serving_gauge(name, value, **tags):
     _GLOBAL.serving_gauge(name, value, **tags)
 
 
+def gauge_value(name):
+    """Last value of serving gauge ``name`` (None when disabled/absent) —
+    the O(1) read that turns burn-rate gauges into a scheduler input."""
+    return _GLOBAL.gauge_value(name)
+
+
+def slo_class_targets():
+    """Installed per-class SLO targets ({} when none configured)."""
+    return _GLOBAL.slo_class_targets()
+
+
 def record_request_phase(uid, phase, t0, dur=None, **args):
     """One request-lifecycle phase on the request's Chrome-trace lane."""
     _GLOBAL.record_request_phase(uid, phase, t0, dur=dur, **args)

@@ -788,6 +788,25 @@ class Telemetry:
             self._emit_jsonl({"name": name, "kind": "gauge", "value": v,
                               "tags": tags or {}})
 
+    def gauge_value(self, name):
+        """Last recorded value of serving gauge ``name`` (None when disabled
+        or never recorded). O(1) dict read — this is how gauges become an
+        INPUT: the scheduler's preemption precedence and the router's shed
+        precedence read the live ``slo/<class>/<metric>_burn_rate`` gauges
+        every round without touching histograms or series."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            g = self.serving_gauges.get(name)
+            return g[0] if g is not None else None
+
+    def slo_class_targets(self):
+        """The installed per-class SLO targets (``set_slo_classes`` shape);
+        {} when none configured. Shared policy input for shed/preemption
+        precedence (scheduler + fleet router)."""
+        with self._lock:
+            return dict(self.slo_classes)
+
     def record_request_phase(self, uid, phase, t0, dur=None, **args):
         """One lifecycle phase of request ``uid`` on its own Chrome-trace
         lane. Each uid gets a synthetic tid (named ``request/<uid>`` via a

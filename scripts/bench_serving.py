@@ -45,12 +45,21 @@ additionally embeds the full telemetry summary in each payload's ``extra``
   (pages shipped == pages bound; bytes; latency), gated by perf_gate's
   fleet checks.
 
+- ``--diurnal --chaos [SPEC]`` — elastic-fleet chaos replay: the SLO
+  router + prefill/decode fleet + ``FleetAutoscaler`` drive a seeded
+  diurnal trace with fault injection armed (a decode replica dies
+  mid-stream, a handoff transfer drops, a replica stalls). Reports goodput
+  per replica-second, re-admission/leak accounting, and per-class shedding
+  — gated by perf_gate's ``check_chaos_baseline``.
+
 Usage: python scripts/bench_serving.py [--replay] [--prefix-mix] [--fleet]
            [--speculate] [--long-context] [--longctx-max T]
            [--requests N] [--seed S] [--arrival poisson|burst] [--rate R]
            [--burst-size B] [--prompt T] [--new T]
            [--prefix-pools P] [--prefix-len L]
            [--fleet-prefill N] [--fleet-decode N]
+           [--chaos [SPEC]] [--diurnal] [--diurnal-period T]
+           [--diurnal-depth D]
 """
 
 import argparse
@@ -1002,6 +1011,272 @@ def fleet_replay_bench(args, on_tpu):
     return payload
 
 
+#: default chaos spec for --chaos with no argument. Step windows count
+#: fleet rounds; fault hits within a round visit stepping replicas in
+#: (prefill0, prefill1, decode0, ...) order, so with 2 prefill replicas the
+#: third ``replica.lost`` hit at step 30 deterministically kills decode0
+#: mid-trace. ``transport.drop:n2`` makes one handoff transfer fail (the
+#: transport's retry absorbs it); ``replica.stall:once@step45`` wedges one
+#: replica for a round (it skips WITHOUT heartbeating).
+DEFAULT_CHAOS_SPEC = ("replica.lost:n3@step30-100000;"
+                      "transport.drop:n2;"
+                      "replica.stall:once@step45")
+
+
+def _diurnal_arrivals(n_req, seed, base_rate, period_s, depth):
+    """Non-homogeneous Poisson arrivals on a compressed diurnal cycle:
+    instantaneous rate(t) = base_rate * (1 + depth*sin(2*pi*t/period_s)),
+    realized by dividing seeded unit-exponential gaps by the local rate
+    (inverse-intensity spacing). Same seed -> identical trace; peaks
+    saturate the fleet, troughs idle it — the autoscaler's signal."""
+    import numpy as np
+    gen = np.random.default_rng(seed)
+    gaps = gen.exponential(1.0, n_req)
+    floor = max(base_rate * (1.0 - depth), 1e-3)
+    t = 0.0
+    out = np.empty(n_req)
+    for i in range(n_req):
+        r = base_rate * (1.0 + depth * np.sin(2.0 * np.pi * t / period_s))
+        t += gaps[i] / max(r, floor)
+        out[i] = t
+    out -= out[0]
+    return out
+
+
+def chaos_replay_bench(args, on_tpu):
+    """Elastic serving fleet under chaos (``--replay --chaos [--diurnal]``):
+    ``SLORouter`` + ``PrefillDecodeFleet`` + ``FleetAutoscaler`` driven over
+    a seeded (optionally diurnal) trace WITH fault injection armed for the
+    whole measured leg — a decode replica dies mid-stream, a handoff
+    transfer drops (retried), a replica stalls past a heartbeat. The fleet
+    must route around the loss, re-admit the dead replica's in-flight
+    requests bit-exactly, replace the lost capacity from the warm standby
+    pool, and keep the interactive SLO class attained while ALL shedding
+    lands on batch.
+
+    Headline number: goodput per replica-second — completed requests'
+    prompt+decode tokens divided by the integral of live replicas over the
+    wall clock (re-prefill waste and over-provisioned idle replicas both
+    drag it down). perf_gate's ``check_chaos_baseline`` ratchets it via
+    onchip_results/serving_chaos_baseline.json."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.v2.fleet import (FleetAutoscaler,
+                                                  PrefillDecodeFleet,
+                                                  RequestRejected, SLORouter)
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.resilience import faults
+
+    n_prefill = args.fleet_prefill
+    n_decode = max(args.fleet_decode, 2)  # the chaos kill needs a survivor
+    standby = 1  # pre-built warm capacity the autoscaler revives
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=args.prompt + args.new + 64,
+                          remat=False)
+        n_req = args.requests
+        prompt_scale, new_scale = args.prompt // 2, args.new
+        max_prompt, max_new = args.prompt, args.new * 4
+        budget, base_rate = 256, args.rate
+        period_s = args.diurnal_period or 30.0
+    else:
+        cfg = LlamaConfig.tiny(remat=False)
+        n_req = min(args.requests, 48)
+        prompt_scale, new_scale = 64, 4
+        max_prompt, max_new = 192, 8
+        # peak rate (base * (1+depth)) must exceed the steady fleet's
+        # service capacity so the diurnal crest queues and the trough
+        # drains — the autoscaler's whole signal
+        budget, base_rate = 16, max(args.rate, 20.0)
+        period_s = args.diurnal_period or 1.2
+    prefill_budget = max(budget * 4, max_prompt)
+    need = n_prefill + n_decode + standby
+    if need > len(jax.devices()):
+        raise RuntimeError(
+            f"chaos replay needs {need} devices, have "
+            f"{len(jax.devices())} (CPU runs force 8 host devices)")
+    spec = args.chaos if args.chaos else DEFAULT_CHAOS_SPEC
+
+    prompt_lens, out_lens, arrivals = make_workload(
+        n_req, args.seed, arrival=args.arrival, rate=base_rate,
+        burst_size=args.burst_size, prompt_scale=prompt_scale,
+        new_scale=new_scale, max_prompt=max_prompt, max_new=max_new)
+    if args.diurnal:
+        arrivals = _diurnal_arrivals(n_req, args.seed + 1, base_rate,
+                                     period_s, args.diurnal_depth)
+    gen = np.random.default_rng(args.seed)
+    prompts = [gen.integers(0, cfg.vocab_size, int(n)).astype(np.int32)
+               for n in prompt_lens]
+    slo_assign = _assign_slo_classes(n_req)
+
+    model = LlamaForCausalLM(cfg)
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    block = 32 if on_tpu else 8
+    max_ctx = int(max_prompt) + int(max_new) + block
+    eng_cfg = {
+        "state_manager": {"max_ragged_sequence_count": max(4, n_req) + 1,
+                          "max_ragged_batch_size": prefill_budget,
+                          "max_context": max_ctx,
+                          "num_kv_blocks":
+                              max(64, (max_ctx // block + 2) * n_req)},
+        "kv_cache": {"block_size": block,
+                     "cache_dtype": "bf16" if on_tpu else "fp32"},
+        "slo_classes": REPLAY_SLO_CLASSES}
+    prefill_cfg = {
+        "state_manager": dict(eng_cfg["state_manager"],
+                              max_ragged_sequence_count=4),
+        "kv_cache": dict(eng_cfg["kv_cache"]),
+        "slo_classes": REPLAY_SLO_CLASSES}
+
+    # build the fleet WITH the standby replica, warm every batch shape on
+    # every engine (including the standby's), then retire the standby into
+    # the warm pool — the autoscaler's mid-trace scale-up revives a fully
+    # compiled engine, so elasticity costs a page-table reset, not a compile
+    fleet = PrefillDecodeFleet(
+        model, params, prefill_replicas=n_prefill,
+        decode_replicas=n_decode + standby,
+        engine_config=prefill_cfg, token_budget=prefill_budget,
+        decode_engine_config=eng_cfg, decode_token_budget=budget)
+    fleet.warm_transport()
+    t0 = time.perf_counter()
+    for mesh, sched in fleet.prefill + fleet.decode:
+        with mesh:
+            _precompile_batch_grid(sched, n_req, sched.budget)
+    print(f"chaos: warmup/compile {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+    fleet.scale_down_decode(n_decode + standby - 1)  # idle -> warm pool
+
+    router = SLORouter(fleet, slo_ttft_s=max(
+        4.0, REPLAY_SLO_CLASSES["interactive"]["ttft_target_s"]),
+        queue_limit=n_req)
+    scaler = FleetAutoscaler(fleet, router, min_decode=n_decode,
+                             max_decode=n_decode + standby,
+                             up_queue_depth=2, up_occupancy=0.85,
+                             down_idle_rounds=30, cooldown_rounds=15)
+
+    telemetry.reset()
+    telemetry.configure(enabled=True, sample_sync=False,
+                        chrome_trace_path=os.environ.get(
+                            "DS_TPU_TELEMETRY_TRACE", ""))
+    tm = telemetry.get_telemetry()
+
+    def drive():
+        t_start = time.perf_counter()
+        last = t_start
+        replica_seconds = 0.0
+        nxt = 0
+        rounds = 0
+        outcomes = []
+        while nxt < n_req or router.has_work:
+            now = time.perf_counter() - t_start
+            while nxt < n_req and arrivals[nxt] <= now:
+                outcomes.append(router.submit(
+                    nxt, prompts[nxt], max_new_tokens=int(out_lens[nxt]),
+                    slo_class=slo_assign[nxt]))
+                nxt += 1
+            if router.has_work:
+                router.step()
+                scaler.observe()
+                rounds += 1
+                if rounds > 200_000:
+                    raise RuntimeError("chaos replay did not converge")
+            elif nxt < n_req:
+                time.sleep(min(float(arrivals[nxt]) - now, 0.05))
+            t = time.perf_counter()
+            replica_seconds += fleet.live_replica_count() * (t - last)
+            last = t
+        return time.perf_counter() - t_start, replica_seconds, outcomes
+
+    faults.reset()
+    faults.configure(spec)
+    try:
+        wall, replica_seconds, outcomes = drive()
+        fault_trips = faults.trip_count()
+    finally:
+        faults.reset()
+
+    results = router.results()
+    rejected_uids = {o.uid for o in outcomes
+                     if isinstance(o, RequestRejected)}
+    served = [i for i in range(n_req) if i not in rejected_uids]
+    decoded = int(sum(len(results.get(i, ())) for i in served))
+    served_prompt = int(sum(int(prompt_lens[i]) for i in served))
+    completed = sum(1 for i in served if len(results.get(i, ())) > 0)
+    goodput = (served_prompt + decoded) / replica_seconds \
+        if replica_seconds else 0.0
+
+    census = fleet.page_census()
+    rep = router.report()
+    tstats = fleet.transport.stats()
+    slo = _slo_classes_extra(tm)
+    ttft = tm.hist_percentiles("serving/ttft_s", (0.5, 0.99)) or (0.0, 0.0)
+    tpot = tm.hist_percentiles("serving/tpot_s", (0.5, 0.99)) or (0.0, 0.0)
+    shed_by_class = rep["shed_by_class"]
+    extra = {
+        "goodput_tokens_per_replica_sec": round(goodput, 1),
+        "wall_s": round(wall, 2),
+        "replica_seconds": round(replica_seconds, 2),
+        "requests": n_req, "completed": completed,
+        "requests_lost": len(served) - completed,
+        "decode_tokens_total": decoded,
+        "prompt_tokens_total": served_prompt,
+        # chaos + recovery accounting
+        "chaos_spec": spec, "fault_trips": fault_trips,
+        "replica_losses": fleet.replica_losses,
+        "readmitted": fleet.readmitted,
+        "handoff_retries": tstats["retry_trips"],
+        "handoff_fallbacks": fleet.handoff_fallbacks,
+        "failed_handoffs": tstats["failed_handoffs"],
+        "leaked_pages": census["leaked_pages"],
+        # elasticity (autoscaler actions during the measured leg only)
+        "scale_ups": scaler.scale_ups, "scale_downs": scaler.scale_downs,
+        "live_decode_end": len(fleet.live_decode_indices()),
+        "decode_replicas": n_decode, "standby_replicas": standby,
+        "prefill_replicas": n_prefill,
+        # SLO precedence: batch absorbs ALL shedding
+        "shed_by_class": shed_by_class,
+        "interactive_sheds": shed_by_class.get("interactive", 0),
+        "shed_rate": round(router.shed_rate, 6),
+        "admitted": router.admitted, "rejected": router.rejected,
+        "accounting": rep["accounting"],
+        "ttft_p50_s": round(ttft[0], 6), "ttft_p99_s": round(ttft[1], 6),
+        "tpot_p50_s": round(tpot[0], 6), "tpot_p99_s": round(tpot[1], 6),
+        "diurnal": bool(args.diurnal),
+        "diurnal_period_s": period_s,
+        "diurnal_depth": args.diurnal_depth,
+        "base_rate_req_per_s": base_rate,
+        "arrival": "diurnal" if args.diurnal else args.arrival,
+        "seed": args.seed, "chips": jax.device_count(),
+        "prefill_token_budget": prefill_budget,
+        "decode_token_budget": budget,
+        "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}",
+    }
+    if slo:
+        extra["slo_classes"] = slo
+        attain = _min_attainment(slo)
+        if attain is not None:
+            extra["slo_min_attainment"] = round(attain, 6)
+        inter = _min_attainment({"interactive": slo["interactive"]}) \
+            if "interactive" in slo else None
+        if inter is not None:
+            extra["interactive_attainment"] = round(inter, 6)
+    _embed_telemetry(extra)
+    payload = {
+        "metric": "serving_chaos_goodput_tokens_per_replica_sec",
+        "value": round(goodput, 1),
+        "unit": "tokens/replica-s (completed prompt+decode, under faults)",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+    bench.emit(payload)
+    return payload
+
+
 def replay_bench(args, on_tpu):
     """Wall-clock traffic replay; latency percentiles from the telemetry
     serving stream."""
@@ -1181,9 +1456,30 @@ def main():
                          "throughput is bounded by live sequences per round, "
                          "not budget, so 1 is usually right until the KV "
                          "working set outgrows one pool")
+    ap.add_argument("--chaos", nargs="?", const="", default=None,
+                    metavar="SPEC",
+                    help="elastic-fleet chaos replay: drive the SLO router + "
+                         "prefill/decode fleet + autoscaler with fault "
+                         "injection armed (replica loss, handoff drops, "
+                         "stalls). SPEC is a resilience.faults grammar "
+                         "string; bare --chaos uses the default kill-one-"
+                         "decode-replica spec. Implies --replay")
+    ap.add_argument("--diurnal", action="store_true",
+                    help="replace the arrival schedule with a seeded "
+                         "diurnal cycle (sinusoidal rate modulation) so the "
+                         "autoscaler sees crests that queue and troughs "
+                         "that idle")
+    ap.add_argument("--diurnal-period", type=float, default=0.0,
+                    help="diurnal cycle period in seconds; 0 = per-platform "
+                         "default")
+    ap.add_argument("--diurnal-depth", type=float, default=0.85,
+                    help="diurnal modulation depth in [0,1): rate swings "
+                         "between base*(1-depth) and base*(1+depth)")
     args = ap.parse_args()
+    if args.chaos is not None:
+        args.replay = True
 
-    if args.fleet:
+    if args.fleet or args.chaos is not None:
         # the fleet leg needs one device per replica; CPU runs present them
         # via forced host devices (inert when a real TPU backend is used) —
         # must be set before jax first initializes
@@ -1204,6 +1500,8 @@ def main():
               if args.speculate
               else "serving_longctx_concurrent_seqs_per_chip"
               if args.long_context
+              else "serving_chaos_goodput_tokens_per_replica_sec"
+              if args.chaos is not None
               else "serving_fleet_replay_tokens_per_sec_per_chip"
               if args.replay and args.fleet
               else "serving_replay_tokens_per_sec_per_chip" if args.replay
@@ -1234,7 +1532,9 @@ def main():
         return
     if args.replay:
         try:
-            if args.fleet:
+            if args.chaos is not None:
+                chaos_replay_bench(args, on_tpu)
+            elif args.fleet:
                 fleet_replay_bench(args, on_tpu)
             elif args.prefix_mix:
                 prefix_mix_bench(args, on_tpu)

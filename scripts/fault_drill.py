@@ -24,6 +24,11 @@ live training subprocess), pinning the acceptance behaviors the unit suite
    (reshardable slice loss); the elastic agent excludes the dead hosts and
    relaunches the 2 survivors budget-free, which resume from the exact
    checkpointed step — the loss trajectory continues.
+6. ``replica-loss``     SERVING fleet chaos (subprocess on 8 forced CPU
+   devices): a ``replica.lost`` fault kills a decode replica mid-stream;
+   survivors must stay bit-exact, the dead replica's streams must re-admit
+   and complete bit-exact against the fault-free run (seeded sampling
+   included), and the fleet page census must show zero leaked KV pages.
 
 ``--emit-elastic-baseline PATH`` additionally runs the in-process 8→4→8
 mesh reshard drill (resilience/elastic_reshard.py, 8 forced CPU devices)
@@ -349,12 +354,118 @@ def drill_slice_loss(workdir):
           f"resumed at step 2 with bitwise loss continuity")
 
 
+# drill 6 worker: serving-fleet decode replica loss mid-stream, run as a
+# real subprocess on 8 forced CPU host devices (the fleet needs one device
+# per replica; the flag must land before jax first initializes). Runs the
+# SAME seeded sampled trace fault-free then with ``replica.lost:n3@step3``
+# (third hit at step 3 = decode0, with 2 prefill replicas ahead of it) and
+# writes a JSON verdict for the parent. @REPO@ is substituted at write time.
+REPLICA_LOSS_WORKER = '''
+import json, os, sys
+sys.path.insert(0, @REPO@)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np
+import jax
+from deepspeed_tpu.inference.v2.fleet import PrefillDecodeFleet
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.resilience import faults
+
+out_path = sys.argv[1]
+cfg = LlamaConfig.tiny(remat=False)
+model = LlamaForCausalLM(cfg)
+ids = np.random.default_rng(0).integers(
+    0, cfg.vocab_size, (1, 8)).astype(np.int32)
+params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+ENG = {"state_manager": {"max_ragged_sequence_count": 9,
+                         "max_ragged_batch_size": 64,
+                         "max_context": 96,
+                         "num_kv_blocks": 96},
+       "kv_cache": {"block_size": 8, "cache_dtype": "fp32"}}
+MAX_NEW = 6
+
+def requests():
+    rng = np.random.default_rng(5)
+    out = {}
+    for uid in range(6):
+        prompt = rng.integers(0, cfg.vocab_size,
+                              int(rng.integers(6, 60))).astype(np.int32)
+        # seeded non-greedy sampling: recovery must preserve the
+        # deterministic (seed, position) sampling contract, not just argmax
+        out[uid] = (prompt, dict(max_new_tokens=MAX_NEW, seed=100 + uid,
+                                 temperature=0.8, top_k=20, top_p=0.95))
+    return out
+
+def run(chaos):
+    faults.reset()
+    fleet = PrefillDecodeFleet(model, params, prefill_replicas=2,
+                               decode_replicas=2, engine_config=ENG,
+                               token_budget=48)
+    for uid, (p, kw) in requests().items():
+        fleet.submit(uid, p, **kw)
+    if chaos:
+        faults.configure(chaos)
+    out = fleet.run_to_completion()
+    faults.reset()
+    return fleet, {u: [int(t) for t in v] for u, v in out.items()}
+
+_, ref = run(None)
+fleet, got = run("replica.lost:n3@step3")
+readmitted_uids = sorted(fleet._readmit_prefix)
+verdict = {
+    "replica_losses": fleet.replica_losses,
+    "readmitted": fleet.readmitted,
+    "readmitted_uids": readmitted_uids,
+    "bit_exact": all(got.get(u) == ref[u] for u in ref),
+    "all_complete": sorted(got) == sorted(ref)
+    and all(len(v) == MAX_NEW for v in got.values()),
+    "leaked_pages": fleet.page_census()["leaked_pages"],
+    "dead_replicas": fleet.lifecycle.counts()["dead"],
+}
+with open(out_path, "w") as f:
+    json.dump(verdict, f)
+'''
+
+
+def drill_replica_loss(workdir):
+    """Decode replica loss mid-stream on a live serving fleet: the failure
+    path must re-admit the dead replica's streams and finish them BIT-EXACT
+    against the fault-free run (seeded sampling included), leave survivors
+    untouched, and leak zero KV pages."""
+    import json
+    worker = os.path.join(workdir, "replica_loss_worker.py")
+    with open(worker, "w") as f:
+        f.write(REPLICA_LOSS_WORKER.replace("@REPO@", repr(REPO)))
+    verdict_path = os.path.join(workdir, "verdict.json")
+    p = _spawn(worker, verdict_path)
+    try:
+        rc = p.wait(timeout=420)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert rc == 0, f"worker exited {rc}"
+    with open(verdict_path) as f:
+        v = json.load(f)
+    assert v["replica_losses"] == 1, v
+    assert v["readmitted"] > 0, f"loss fired but nothing re-admitted: {v}"
+    assert v["bit_exact"], f"recovery diverged from fault-free run: {v}"
+    assert v["all_complete"], f"re-admitted streams incomplete: {v}"
+    assert v["leaked_pages"] == 0, f"KV pages leaked: {v}"
+    print(f"  decode replica lost mid-stream; {v['readmitted']} request(s) "
+          f"re-admitted (uids {v['readmitted_uids']}); all 6 streams "
+          f"bit-exact vs fault-free; 0 pages leaked")
+
+
 DRILLS = {
     "kill-async-save": drill_kill_async_save,
     "bitflip": drill_bitflip,
     "preemption": drill_preemption,
     "watchdog": drill_watchdog,
     "slice-loss": drill_slice_loss,
+    "replica-loss": drill_replica_loss,
 }
 
 

@@ -131,6 +131,10 @@ GATES = {
     # stalled restoring spilled KV blocks from host DRAM growing means the
     # swap path got slower (or restores stopped overlapping decode)
     "swap_in_stall_s": ("up", "max_swap_stall_growth"),
+    # chaos replay (bench_serving --chaos --diurnal): completed tokens per
+    # live-replica-second UNDER FAULTS shrinking means recovery or the
+    # autoscaler got more wasteful (re-prefill churn, idle over-provision)
+    "goodput_tokens_per_replica_sec": ("down", "max_goodput_drop"),
 }
 
 #: extra/doc keys lifted verbatim into the metric dict when positive
@@ -148,6 +152,10 @@ FLEET_KEYS = ("rate_multiplier",)
 #: long-context tiering payload keys (bench_serving --long-context); lifted
 #: only when present
 LONGCTX_KEYS = ("swap_in_stall_s",)
+
+#: chaos replay payload keys (bench_serving --chaos --diurnal); lifted only
+#: when present
+CHAOS_KEYS = ("goodput_tokens_per_replica_sec",)
 
 
 def load_doc(path):
@@ -206,7 +214,8 @@ def extract_metrics(doc):
                     m["peak_hbm_bytes"] = v
             except (TypeError, ValueError):
                 pass
-        for key in SERVING_KEYS + PREFIX_KEYS + FLEET_KEYS + LONGCTX_KEYS:
+        for key in SERVING_KEYS + PREFIX_KEYS + FLEET_KEYS + LONGCTX_KEYS \
+                + CHAOS_KEYS:
             if key in src and key not in m:
                 try:
                     v = float(src[key])
@@ -588,6 +597,71 @@ def validate_fleet_payload(doc):
                 f"{extra['pages_bound']} — KV handoff leaked pages")
     if extra["handoffs"] < 0:
         return "fleet replay payload: negative handoff count"
+    return None
+
+
+def validate_chaos_payload(doc):
+    """Shape-check a bench_serving --chaos payload: a SUCCESSFUL run
+    (value > 0) must carry finite recovery/elasticity accounting (losses,
+    re-admissions, leaks, scale actions), ordered latency percentiles, a
+    shed rate in [0, 1], non-negative per-class sheds, and the router's
+    accounting identity — every submit admitted, rejected, or queued, with
+    zero in-flight backlog after the drain (anything else means a terminal
+    outcome failed to retire). Pure dict checks — runs in the tier-1
+    dry-run lane without jax. Returns an error string or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "serving_chaos" not in str(doc.get("metric", "")):
+        return None
+    try:
+        if float(doc.get("value", 0)) <= 0:
+            return None
+    except (TypeError, ValueError):
+        return None
+    extra = doc.get("extra")
+    if not isinstance(extra, dict):
+        return "chaos payload has no extra dict"
+    def bad_num(v):
+        return not isinstance(v, (int, float)) or isinstance(v, bool) or \
+            not (v == v and abs(v) != float("inf"))
+    for key in ("goodput_tokens_per_replica_sec", "wall_s",
+                "replica_seconds", "replica_losses", "readmitted",
+                "leaked_pages", "scale_ups", "scale_downs",
+                "interactive_sheds", "shed_rate", "fault_trips",
+                "requests_lost", "ttft_p50_s", "ttft_p99_s",
+                "tpot_p50_s", "tpot_p99_s"):
+        if bad_num(extra.get(key)):
+            return f"chaos payload: extra[{key!r}] missing or not finite " \
+                   f"(got {extra.get(key)!r})"
+    for prefix in ("ttft", "tpot"):
+        if extra[f"{prefix}_p50_s"] > extra[f"{prefix}_p99_s"]:
+            return f"chaos payload: {prefix} p50 > p99"
+    if not 0.0 <= extra["shed_rate"] <= 1.0:
+        return "chaos payload: shed_rate outside [0, 1]"
+    for key in ("replica_losses", "readmitted", "leaked_pages", "scale_ups",
+                "scale_downs", "interactive_sheds", "requests_lost"):
+        if extra[key] < 0:
+            return f"chaos payload: negative {key}"
+    if extra["replica_seconds"] < extra["wall_s"]:
+        return ("chaos payload: replica_seconds below wall_s — the "
+                "live-replica integral cannot undercount a 1-replica fleet")
+    shed = extra.get("shed_by_class")
+    if not isinstance(shed, dict) or \
+            any(bad_num(v) or v < 0 for v in shed.values()):
+        return "chaos payload: shed_by_class missing or malformed"
+    acct = extra.get("accounting")
+    if not isinstance(acct, dict) or \
+            bad_num(acct.get("in_flight")) or bad_num(
+                acct.get("backlog_total")):
+        return "chaos payload: accounting section missing or malformed"
+    if acct.get("identity_holds") is not True:
+        return ("chaos payload: router accounting identity does not hold "
+                "(admitted + rejected + queued != submitted)")
+    if acct["in_flight"] != 0 or acct["backlog_total"] != 0:
+        return ("chaos payload: drained run left phantom backlog "
+                f"(in_flight={acct['in_flight']}, "
+                f"backlog_total={acct['backlog_total']}) — some terminal "
+                "outcome never retired from the router")
     return None
 
 
@@ -1152,6 +1226,81 @@ def check_fleet_baseline(baseline_path=None):
             "single_ttft_p99_s": extra["single_ttft_p99_s"]}, errors
 
 
+#: chaos-replay acceptance for the checked-in baseline: the recorded run
+#: must have ACTUALLY taken faults (a replica loss with live re-admissions),
+#: recovered without losing a request or leaking a KV page, replaced the
+#: lost capacity (scale-up), and kept the interactive class attained while
+#: batch (or untagged) traffic absorbed every shed
+CHAOS_MIN_INTERACTIVE_ATTAINMENT = 0.9
+CHAOS_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                   "serving_chaos_baseline.json")
+
+
+def check_chaos_baseline(baseline_path=None):
+    """Validate the checked-in ``--chaos --diurnal`` baseline: payload shape
+    (``validate_chaos_payload`` incl. the router accounting identity), then
+    the acceptance ratchet — at least one injected replica loss with
+    ``readmitted > 0``, zero requests lost, zero leaked KV pages, at least
+    one autoscaler scale-up (the lost capacity was replaced), zero
+    interactive sheds, interactive attainment >=
+    ``CHAOS_MIN_INTERACTIVE_ATTAINMENT`` under faults, and a positive
+    goodput per replica-second (the number the candidate-vs-baseline run
+    ratchets via ``--max-goodput-drop``). Pure dict checks over recorded
+    values. Returns (report, errors) for the dry-run lane."""
+    path = baseline_path or CHAOS_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no chaos baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable chaos baseline {path}"]
+    err = validate_chaos_payload(doc)
+    if err:
+        return {}, [f"chaos baseline: {err}"]
+    extra = doc.get("extra", {}) if isinstance(doc, dict) else {}
+    if "replica_losses" not in extra:
+        return {}, ["chaos baseline payload carries no chaos fields "
+                    "(regenerate with bench_serving --chaos --diurnal)"]
+    errors = []
+    if extra["replica_losses"] < 1 or extra["fault_trips"] < 1:
+        errors.append("chaos baseline: no replica loss recorded — the run "
+                      "never exercised the recovery path")
+    if extra["readmitted"] <= 0:
+        errors.append("chaos baseline: replica lost but nothing re-admitted "
+                      "— in-flight recovery never ran")
+    if extra["requests_lost"] != 0:
+        errors.append(f"chaos baseline: {extra['requests_lost']} admitted "
+                      f"request(s) lost — recovery must complete every "
+                      f"admitted stream")
+    if extra["leaked_pages"] != 0:
+        errors.append(f"chaos baseline: {extra['leaked_pages']} KV page(s) "
+                      f"leaked after the drain")
+    if extra["scale_ups"] < 1:
+        errors.append("chaos baseline: autoscaler never scaled up — the "
+                      "lost capacity was not replaced")
+    if extra["interactive_sheds"] != 0:
+        errors.append(f"chaos baseline: {extra['interactive_sheds']} "
+                      f"interactive shed(s) — shedding must land on looser "
+                      f"classes only")
+    att = extra.get("interactive_attainment")
+    if att is None:
+        errors.append("chaos baseline: no interactive_attainment recorded")
+    elif att < CHAOS_MIN_INTERACTIVE_ATTAINMENT:
+        errors.append(f"chaos baseline: interactive attainment {att} < "
+                      f"{CHAOS_MIN_INTERACTIVE_ATTAINMENT} under faults")
+    goodput = extra["goodput_tokens_per_replica_sec"]
+    if goodput <= 0:
+        errors.append("chaos baseline: non-positive goodput per "
+                      "replica-second")
+    return {"goodput_tokens_per_replica_sec": goodput,
+            "replica_losses": extra["replica_losses"],
+            "readmitted": extra["readmitted"],
+            "leaked_pages": extra["leaked_pages"],
+            "scale_ups": extra["scale_ups"],
+            "scale_downs": extra["scale_downs"],
+            "interactive_sheds": extra["interactive_sheds"],
+            "interactive_attainment": att}, errors
+
+
 #: long-context tiering acceptance for the checked-in baseline: at the fp
 #: leg's KV HBM budget the int8 pool must fit >= 2x the max-context
 #: sequences, the recorded run must actually have spilled AND revived
@@ -1619,7 +1768,8 @@ def main(argv=None):
         if doc is None:
             return 2
         err = validate_summary(doc) or validate_serving_payload(doc) \
-            or validate_fleet_payload(doc) or validate_longctx_payload(doc) \
+            or validate_fleet_payload(doc) or validate_chaos_payload(doc) \
+            or validate_longctx_payload(doc) \
             or validate_speculate_payload(doc) \
             or validate_overlap_payload(doc) \
             or validate_timeseries_payload(doc) or validate_slo_payload(doc)
@@ -1652,6 +1802,9 @@ def main(argv=None):
         fleet_report, fleet_errors = check_fleet_baseline()
         for err in fleet_errors:
             print(f"perf_gate: fleet: {err}", file=sys.stderr)
+        chaos_report, chaos_errors = check_chaos_baseline()
+        for err in chaos_errors:
+            print(f"perf_gate: chaos: {err}", file=sys.stderr)
         longctx_report, longctx_errors = check_longctx_baseline()
         for err in longctx_errors:
             print(f"perf_gate: longctx: {err}", file=sys.stderr)
@@ -1672,8 +1825,8 @@ def main(argv=None):
             print(f"perf_gate: slo: {err}", file=sys.stderr)
         errors = table_errors + qgz_errors + moe_wire_errors \
             + overlap_errors + sched_errors + moe_base_errors \
-            + prefix_errors + fleet_errors + longctx_errors \
-            + spec_errors + elastic_errors + lint_errors \
+            + prefix_errors + fleet_errors + chaos_errors \
+            + longctx_errors + spec_errors + elastic_errors + lint_errors \
             + profile_errors + slo_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
@@ -1685,6 +1838,7 @@ def main(argv=None):
                           "moe_baseline": moe_base_report,
                           "prefix_cache": prefix_report,
                           "fleet": fleet_report,
+                          "chaos": chaos_report,
                           "longctx": longctx_report,
                           "speculate": spec_report,
                           "elastic": elastic_report,
