@@ -861,6 +861,17 @@ def main():
                             error=f"{type(e).__name__}: {e}"[:200])
             extra["fault"] = "backend_unavailable"
             extra["telemetry"] = telemetry.summary()
+            # flush the black box: the bundle (ring + summary + holders +
+            # env) is what makes the next BENCH_r0x wedged round diagnosable
+            # instead of a bare fault event (scripts/postmortem.py)
+            bundle = telemetry.flush_postmortem(
+                "backend_unavailable",
+                detail=f"{type(e).__name__}: {e}"[:300],
+                dir=os.environ.get("DS_TPU_POSTMORTEM_DIR")
+                or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "postmortems"),
+                extra={"holders": holders[:8] if holders else None})
+            extra["postmortem_bundle"] = bundle
         last = load_last_good()
         if last is not None:
             # prior on-hardware measurement, labeled as such — diagnostic

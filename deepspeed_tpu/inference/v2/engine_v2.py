@@ -109,6 +109,11 @@ class InferenceEngineV2:
         bs = self._state.kv_block_size
         self._max_blocks_per_seq = -(-sm.max_context // bs)
         self._host_sync_count = 0
+        # postmortem-bundle collector (telemetry/flightrec.py): the newest
+        # engine's host-side KV pool stats ride every bundle — pure host
+        # reads, so collection is safe even from an abnormal path
+        from deepspeed_tpu.telemetry import flightrec
+        flightrec.register_collector("engine_v2/kv_stats", self.kv_stats)
         logger.info(f"InferenceEngineV2: S<={sm.max_ragged_sequence_count} "
                     f"tokens<={sm.max_ragged_batch_size} context<={sm.max_context}")
 

@@ -165,6 +165,9 @@ class KVPageTransport:
         tm = telemetry.get_telemetry()
         if tm.enabled:
             tm.fleet_event("handoff_retry")
+        from deepspeed_tpu.telemetry import flightrec
+        flightrec.record("handoff", "handoff/retry",
+                         {"trips": self.retry_trips})
 
     def stats(self):
         return {"handoffs": self.handoffs,
@@ -258,6 +261,14 @@ class PrefillDecodeFleet:
         self.handoff_fallbacks = 0
         self.scale_ups = 0
         self.scale_downs = 0
+        # postmortem-bundle collectors (telemetry/flightrec.py): the newest
+        # fleet in the process owns the snapshot — a bundle flushed on any
+        # abnormal path carries the page census, lifecycle report and
+        # transport stats alongside the event ring
+        from deepspeed_tpu.telemetry import flightrec
+        flightrec.register_collector("fleet/page_census", self.page_census)
+        flightrec.register_collector("fleet/lifecycle", self.lifecycle.counts)
+        flightrec.register_collector("fleet/transport", self.transport.stats)
         logger.info(f"PrefillDecodeFleet: {prefill_replicas} prefill + "
                     f"{decode_replicas} decode replicas, tp={tp_size}")
 
@@ -550,6 +561,13 @@ class PrefillDecodeFleet:
         if tm.enabled:
             tm.fleet_event("replica_lost", replica=f"{role}{index}",
                            cause=cause)
+        from deepspeed_tpu.telemetry import flightrec
+        flightrec.record("replica", "replica/lost",
+                         {"replica": f"{role}{index}", "cause": cause})
+        # a lost replica is an abnormal path even though the fleet survives
+        # it: leave the incident artifact (no-op without a destination)
+        flightrec.flush_bundle("replica_loss",
+                               detail=f"{role}{index}: {cause}")
         if role == "prefill":
             # pending ships from the dead source are stranded (pages gone);
             # their requests re-admit via the route scan below

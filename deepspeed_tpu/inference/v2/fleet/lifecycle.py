@@ -98,6 +98,11 @@ class ReplicaLifecycle:
             raise ValueError(
                 f"illegal lifecycle transition {cur} -> {new} for {key!r}")
         self._state[key] = new
+        # black box: lifecycle transitions are rare and high-signal — a
+        # postmortem bundle's ring shows which replicas drained/died when
+        # (telemetry/flightrec.py; records with telemetry disabled too)
+        telemetry.flight_record("replica", f"replica/{new}",
+                                {"key": str(key), "from": cur})
 
     def mark_draining(self, key):
         self._to(key, DRAINING)

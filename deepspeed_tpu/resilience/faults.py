@@ -78,6 +78,13 @@ SLICE_LOSS_POINTS = ("slice.lost", "comm.partition")
 ENV_SPEC = "DS_TPU_FAULTS"
 ENV_SEED = "DS_TPU_FAULT_SEED"
 
+#: sleep-action faults at or above this many seconds count as a wedge
+#: (an incident, not chaos latency): the injector flushes a "stall"
+#: postmortem bundle BEFORE sleeping, so a kill landing mid-stall still
+#: leaves evidence (telemetry/flightrec.py; no-op without a configured
+#: bundle destination).
+STALL_FLUSH_MIN_SLEEP_S = 30.0
+
 
 class InjectedFault(RuntimeError):
     """The exception an armed ``raise``-action fault point throws."""
@@ -246,11 +253,32 @@ class FaultInjector:
             return
         self._record_trip(fire, detail)
         if fire.action == "sleep":
+            if fire.arg >= STALL_FLUSH_MIN_SLEEP_S:
+                # a sleep this long is a wedge, not chaos latency — flush
+                # the black box BEFORE stalling so a SIGKILL landing inside
+                # the window (the kill-async-save drill) still leaves a
+                # classifiable artifact
+                self._flush_postmortem("stall", fire, detail)
             time.sleep(fire.arg)
             return
         if fire.action == "exit":
+            # os._exit skips atexit/finally — this flush is the only
+            # evidence the process will ever leave
+            self._flush_postmortem("injected_exit", fire, detail,
+                                   exit_code=fire.arg)
             os._exit(fire.arg)
         raise InjectedFault(point, detail or fire.describe())
+
+    @staticmethod
+    def _flush_postmortem(reason, rule, detail, exit_code=None):
+        try:
+            from deepspeed_tpu.telemetry import flightrec
+            flightrec.flush_bundle(
+                reason, detail=detail or rule.describe(),
+                exit_code=exit_code,
+                extra={"fault_point": rule.point, "rule": rule.describe()})
+        except Exception:
+            pass  # forensics must never mask the injected fault itself
 
     def _record_trip(self, rule, detail):
         from deepspeed_tpu.utils.logging import logger

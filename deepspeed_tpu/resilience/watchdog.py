@@ -103,7 +103,17 @@ class StepWatchdog:
             if step_seconds is not None and step_seconds > 0:
                 self._samples.append(step_seconds)
             self._last_beat = now
-            self._beat_seq += 1
+            seq = self._beat_seq = self._beat_seq + 1
+        try:
+            # black-box heartbeat: the postmortem bundle's ring shows the
+            # last beat before death (telemetry/flightrec.py, O(1))
+            from deepspeed_tpu.telemetry import flightrec
+            flightrec.record("watchdog", "watchdog/beat",
+                             {"seq": seq,
+                              "step_seconds": round(step_seconds, 6)
+                              if step_seconds is not None else None})
+        except Exception:
+            pass
 
     def threshold(self):
         """Current stall threshold in seconds."""
@@ -177,6 +187,17 @@ class StepWatchdog:
                 self.on_hang(report)
             except Exception:
                 logger.exception("watchdog on_hang callback failed")
+        try:
+            # a stall is an incident whether or not we abort: leave the
+            # classifiable artifact (no-op without a configured destination;
+            # if an injected long-sleep already flushed, this is skipped)
+            from deepspeed_tpu.telemetry import flightrec
+            flightrec.flush_bundle(
+                "watchdog_stall",
+                detail=f"no step progress for {idle:.2f}s (thr {thr:.2f}s)",
+                exit_code=self.exit_code if self.abort else None)
+        except Exception:
+            pass
         if self.abort:
             logger.error(f"watchdog: aborting process (exit "
                          f"{self.exit_code}) so the elastic agent can "

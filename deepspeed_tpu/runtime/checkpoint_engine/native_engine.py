@@ -28,6 +28,7 @@ import zipfile
 import jax
 import numpy as np
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.resilience import CorruptCheckpointError, InjectedFault, faults
 from deepspeed_tpu.utils.retry import retry_call
 
@@ -134,6 +135,9 @@ def _publish_dir(tmp, path):
     _fsync_dir(parent)
     if old is not None:
         shutil.rmtree(old, ignore_errors=True)
+    # black box: publish edges bracket the crash-sensitive window — a
+    # postmortem ring that ends between "publish" events names the torn tag
+    telemetry.flight_record("ckpt", "ckpt/publish", {"path": path})
 
 
 class NativeCheckpointEngine(CheckpointEngine):

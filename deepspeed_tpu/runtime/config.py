@@ -161,9 +161,14 @@ class ResilienceConfig(DeepSpeedConfigModel):
     """``resilience`` section — fault injection, preemption-aware save and
     the step watchdog (deepspeed_tpu/resilience, docs/RESILIENCE.md).
     ``faults`` takes the DS_TPU_FAULTS grammar
-    (``"point:mode[@stepA[-B]][!action]"``); the env var layers on top."""
+    (``"point:mode[@stepA[-B]][!action]"``); the env var layers on top.
+    ``postmortem_dir`` names the flight-recorder bundle destination
+    (telemetry/flightrec.py) — empty leaves bundles governed by the
+    ``DS_TPU_POSTMORTEM_DIR`` env var, and unset both means abnormal
+    exits leave no bundle (the ring still records)."""
     faults = ""
     fault_seed = 0
+    postmortem_dir = ""
     preemption = PreemptionConfig()
     watchdog = WatchdogConfig()
     elastic = ElasticReshardConfig()

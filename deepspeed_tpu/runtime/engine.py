@@ -19,6 +19,7 @@ fused micro-step and stages the result, ``backward`` commits it, ``step``
 applies the optimizer at the gradient-accumulation boundary.
 """
 
+import json
 import os
 import time
 from typing import Any, NamedTuple, Optional
@@ -302,6 +303,13 @@ class DeepSpeedEngine:
         rcfg = self.config.resilience_config
         if rcfg.faults:
             _faults.configure(rcfg.faults, seed=rcfg.fault_seed)
+        # flight recorder (telemetry/flightrec.py): point bundles at the
+        # configured destination and snapshot a config digest into every
+        # bundle this process flushes
+        from deepspeed_tpu.telemetry import flightrec as _flightrec
+        if rcfg.postmortem_dir:
+            _flightrec.configure(dir=rcfg.postmortem_dir)
+        _flightrec.register_collector("engine/config", self._config_digest)
         self._last_save_dir = None
         self._preemption = None
         if rcfg.preemption.enabled:
@@ -1828,6 +1836,19 @@ class DeepSpeedEngine:
                      f"lr={self.get_lr()}, loss_scale={self.cur_scale}", ranks=[0])
         self._resilience_step_boundary()
 
+    def _config_digest(self):
+        """Postmortem-bundle collector: a stable digest + key shape facts
+        of the user config, enough to tell WHICH config crashed without
+        shipping the whole (possibly large) dict."""
+        import hashlib
+        raw = json.dumps(self.config._param_dict, sort_keys=True,
+                         default=str)
+        return {"sha256": hashlib.sha256(raw.encode()).hexdigest(),
+                "keys": sorted(self.config._param_dict),
+                "global_steps": self.global_steps,
+                "train_batch_size": getattr(
+                    self.config, "train_batch_size", None)}
+
     def _resilience_step_boundary(self):
         """Post-step resilience hooks (docs/RESILIENCE.md): feed the
         watchdog heartbeat, and honor a pending preemption request — save
@@ -1854,6 +1875,10 @@ class DeepSpeedEngine:
             logger.warning(f"preemption (signal {pre.signal_received}): no "
                            f"save_dir configured or used yet — exiting "
                            f"{cfg.exit_code} WITHOUT an emergency checkpoint")
+        telemetry.flush_postmortem(
+            "preemption",
+            detail=f"signal {pre.signal_received} at step {self.global_steps}",
+            exit_code=int(cfg.exit_code))
         raise SystemExit(int(cfg.exit_code))
 
     def _handle_slice_loss(self, fault):
@@ -1888,6 +1913,10 @@ class DeepSpeedEngine:
                 f"slice loss ({fault.point}): no save_dir configured or "
                 f"used yet — exiting {ecfg.exit_code} WITHOUT an "
                 f"emergency checkpoint")
+        telemetry.flush_postmortem(
+            "slice_loss",
+            detail=f"{fault.point} at step {self.global_steps}",
+            exit_code=int(ecfg.exit_code))
         raise SystemExit(int(ecfg.exit_code))
 
     def _run_guards(self, old_state, stats):
@@ -2366,6 +2395,9 @@ class DeepSpeedEngine:
                 q = self._quarantine(path) if os.path.isdir(path) else None
                 logger.error(f"checkpoint {path} corrupt: {e}"
                              + (f" — quarantined to {q}" if q else ""))
+                telemetry.flush_postmortem(
+                    "corrupt_ckpt", detail=f"{path}: {e}"[:300],
+                    extra={"quarantined": q, "tag": str(tag)})
                 candidates = [t for t in self._checkpoint_tags(load_dir)
                               if t not in attempted]
                 if not candidates:

@@ -22,6 +22,7 @@ sync, no file I/O. See docs/OBSERVABILITY.md for config keys, the exporter
 matrix and the dispatch reason-code table.
 """
 
+from deepspeed_tpu.telemetry import flightrec  # noqa: F401
 from deepspeed_tpu.telemetry.core import Telemetry, _NULL_SPAN  # noqa: F401
 
 _GLOBAL = Telemetry()
@@ -195,6 +196,18 @@ def sample_memory(point, device_index=0, **tags):
 def maybe_oom_postmortem(exc, top_n=10):
     """Dump an OOM post-mortem if ``exc`` is an HBM-exhaustion error."""
     return _GLOBAL.maybe_oom_postmortem(exc, top_n=top_n)
+
+
+def flight_record(kind, name, detail=None, ts=None):
+    """Append one event to the always-on flight-recorder ring
+    (telemetry/flightrec.py) — records even when telemetry is disabled."""
+    return flightrec.record(kind, name, detail=detail, ts=ts)
+
+
+def flush_postmortem(reason, **kwargs):
+    """Flush a postmortem bundle (see :func:`flightrec.flush_bundle`);
+    returns the bundle path, or None when no destination is configured."""
+    return flightrec.flush_bundle(reason, **kwargs)
 
 
 def oom_postmortem(error=None, top_n=10):

@@ -59,6 +59,18 @@ import socket
 import threading
 import time
 
+# the always-on black box (telemetry/flightrec.py): Fault/* and Recovery/*
+# events, SLO violations and memory samples are mirrored into its bounded
+# ring so an abnormal exit can flush them as a postmortem bundle — even
+# when this pipeline itself is disabled. Stdlib-only, so import-safe here.
+from deepspeed_tpu.telemetry import flightrec as _flightrec
+
+#: event-name prefixes mirrored into the flight recorder ring. A module
+#: constant so the disabled-path check in record() allocates nothing.
+_FLIGHT_FAULT_PREFIX = "Fault/"
+_FLIGHT_PREFIXES = ("Fault/", "Recovery/")
+_FLIGHT_SPAN_PREFIXES = ("Recovery/", "recovery/")
+
 # injectable clocks (the PR-2 pattern, see docs/OBSERVABILITY.md): tests pin
 # time by monkeypatching THESE module aliases, never time.* globally (which
 # would break jax internals). All span/ledger timing reads _now; _now_wall
@@ -421,6 +433,12 @@ class Telemetry:
     span_begin = span  # same object, explicit begin/end idiom
 
     def _end_span(self, name, t0, dt, tags):
+        if name.startswith(_FLIGHT_SPAN_PREFIXES):
+            # recovery intervals (emergency saves, ckpt fallback, reshard)
+            # belong in the black box next to the faults that caused them
+            _flightrec.record("recovery", name,
+                              detail={"seconds": round(dt, 6),
+                                      **(tags or {})})
         with self._lock:
             st = self.span_stats.get(name)
             if st is None:
@@ -445,7 +463,14 @@ class Telemetry:
     # ------------------------------------------------------------------
     def record(self, name, value, kind="gauge", **tags):
         """Record one scalar sample. ``kind``: "gauge" | "counter" | "bytes"
-        | "seconds" (free-form strings are kept verbatim)."""
+        | "seconds" (free-form strings are kept verbatim). ``Fault/*`` and
+        ``Recovery/*`` events additionally land in the flight-recorder ring
+        — with telemetry disabled too, so postmortem bundles always carry
+        the fault history."""
+        if name.startswith(_FLIGHT_PREFIXES):
+            _flightrec.record(
+                "fault" if name.startswith(_FLIGHT_FAULT_PREFIX)
+                else "recovery", name, detail=tags or None)
         if not self.enabled:
             return
         with self._lock:
@@ -697,6 +722,10 @@ class Telemetry:
                 st = per[metric] = [0, 0]
             ok = v <= target
             st[0 if ok else 1] += n
+            if not ok:
+                _flightrec.record("slo", f"slo/{slo_class}/{metric}_violation",
+                                  detail={"value": round(v, 6),
+                                          "target_s": target, "n": n})
             # one JSONL line per observation so multi-host tooling
             # (scripts/trace_merge.py) can rebuild per-class attainment
             # per host from the raw streams
@@ -1038,6 +1067,9 @@ class Telemetry:
                               "value": in_use,
                               "tags": {**(tags or {}),
                                        "peak_bytes_in_use": peak}})
+        _flightrec.record("memory", f"memory/{point}",
+                          detail={"bytes_in_use": in_use,
+                                  "peak_bytes_in_use": peak})
         return stats
 
     def sample_memory(self, point, device_index=0, **tags):
@@ -1107,6 +1139,13 @@ class Telemetry:
                    live_buffers=len(buffers))
         if stats:
             self.record_memory("oom", stats=stats)
+        # an OOM is an abnormal path: leave the incident artifact (no-op
+        # when no postmortem destination is configured)
+        _flightrec.flush_bundle("oom", detail=(error or "")[:300],
+                                extra={"oom_report": {
+                                    "live_buffer_count": len(buffers),
+                                    "live_bytes_total": report[
+                                        "live_bytes_total"]}})
         return report
 
     # ------------------------------------------------------------------

@@ -1509,9 +1509,27 @@ def main():
     try:
         devs = bench.init_backend_with_retry(lease_name="bench_serving")
     except Exception as e:
+        extra = {"error": f"{type(e).__name__}: {e}"[:300]}
+        wedged = "UNAVAILABLE" in str(e) or "initialize backend" in str(e)
+        if wedged:
+            # same contract as bench.py's wedged-chip path: the fault goes
+            # on the Fault/* stream AND leaves a postmortem bundle so the
+            # next BENCH_r0x backend-unavailable round is diagnosable
+            from deepspeed_tpu import telemetry
+            if not telemetry.enabled():
+                telemetry.configure(enabled=True, sample_sync=False)
+            telemetry.count("Fault/backend_unavailable",
+                            error=f"{type(e).__name__}: {e}"[:200])
+            extra["fault"] = "backend_unavailable"
+            extra["postmortem_bundle"] = telemetry.flush_postmortem(
+                "backend_unavailable",
+                detail=f"{type(e).__name__}: {e}"[:300],
+                dir=os.environ.get("DS_TPU_POSTMORTEM_DIR")
+                or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, "postmortems"))
         bench.emit({"metric": metric, "value": 0.0,
                     "unit": "tokens/s", "vs_baseline": None,
-                    "extra": {"error": f"{type(e).__name__}: {e}"[:300]}})
+                    "extra": extra})
         return
     on_tpu = devs[0].platform in ("tpu", "axon")
     if args.speculate:

@@ -41,6 +41,7 @@ Exit 0 iff every selected drill passes.
 """
 
 import argparse
+import importlib.util
 import os
 import shutil
 import signal
@@ -56,6 +57,35 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 EXIT_CLEAN_PREEMPTION = 83
 EXIT_WATCHDOG_ABORT = 85
+
+POSTMORTEM_ENV = "DS_TPU_POSTMORTEM_DIR"
+
+
+def _postmortem_mod():
+    """Load scripts/postmortem.py standalone (stdlib-only analyzer)."""
+    spec = importlib.util.spec_from_file_location(
+        "ds_tpu_postmortem", os.path.join(REPO, "scripts", "postmortem.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _assert_bundles(pm_dir, expect, desc):
+    """Forensics leg of every drill: the kill/crash left EXACTLY the
+    expected postmortem bundles, each schema-valid and classified by
+    scripts/postmortem.py to the drill's incident type. ``expect`` maps
+    incident type -> exact bundle count."""
+    pm = _postmortem_mod()
+    bundles = pm.find_bundles([pm_dir])
+    got = {}
+    for b in bundles:
+        errs = pm.validate_bundle(b)
+        assert not errs, f"{desc}: malformed bundle {b}: {errs}"
+        typ, evidence = pm.classify_bundle(pm.load_bundle(b))
+        got[typ] = got.get(typ, 0) + 1
+    assert got == expect, (f"{desc}: bundle classification {got} != "
+                           f"{expect} (bundles: {bundles})")
+    return bundles
 
 # one trainer template, parameterized by the resilience config and loop
 # behavior — every drill runs this as a real subprocess
@@ -144,7 +174,8 @@ def drill_kill_async_save(workdir):
         open(os.path.join(out, "armed"), "w").close()
         time.sleep(600)  # parent SIGKILLs us here
     """)
-    p = _spawn(trainer, out)
+    pm_dir = os.path.join(workdir, "pm")
+    p = _spawn(trainer, out, extra_env={POSTMORTEM_ENV: pm_dir})
     try:
         _wait_for(os.path.join(out, "armed"), p, desc="publish-window marker")
         p.send_signal(signal.SIGKILL)
@@ -159,7 +190,11 @@ def drill_kill_async_save(workdir):
     engine = _fresh_engine()
     path, _ = engine.load_checkpoint(out)
     assert engine.global_steps == 1, engine.global_steps
-    print(f"  latest={tag!r} loads, resumed at step {engine.global_steps}")
+    # forensics: the long publish stall flushed a "stall" bundle BEFORE the
+    # SIGKILL landed — the black box survived the unflushable death
+    _assert_bundles(pm_dir, {"stall": 1}, "kill-async-save")
+    print(f"  latest={tag!r} loads, resumed at step {engine.global_steps}; "
+          f"1 stall bundle left by the killed process")
 
 
 def drill_bitflip(workdir):
@@ -175,11 +210,22 @@ def drill_bitflip(workdir):
     raw = bytearray(open(shard, "rb").read())
     raw[len(raw) // 2] ^= 0xFF
     open(shard, "wb").write(bytes(raw))
-    path, _ = engine.load_checkpoint(out)
+    # this drill runs in-process: point the flight recorder at a scratch
+    # destination so the quarantine path flushes a bundle here
+    from deepspeed_tpu.telemetry import flightrec
+    pm_dir = os.path.join(workdir, "pm")
+    flightrec.reset()
+    flightrec.configure(dir=pm_dir)
+    try:
+        path, _ = engine.load_checkpoint(out)
+    finally:
+        flightrec.reset()
     assert path.endswith("global_step1"), path
     assert os.path.isdir(os.path.join(out, "global_step2.corrupt"))
     assert open(os.path.join(out, "latest")).read().strip() == "global_step1"
-    print("  bit-flip caught; fell back to global_step1; latest repaired")
+    _assert_bundles(pm_dir, {"corrupt_ckpt": 1}, "bitflip")
+    print("  bit-flip caught; fell back to global_step1; latest repaired; "
+          "1 corrupt_ckpt bundle flushed at quarantine")
 
 
 def drill_preemption(workdir):
@@ -196,7 +242,8 @@ def drill_preemption(workdir):
             open(os.path.join(out, "ready"), "w").close()
     """)
     os.makedirs(out, exist_ok=True)
-    p = _spawn(trainer, out)
+    pm_dir = os.path.join(workdir, "pm")
+    p = _spawn(trainer, out, extra_env={POSTMORTEM_ENV: pm_dir})
     try:
         _wait_for(os.path.join(out, "ready"), p, desc="first step")
         p.send_signal(signal.SIGTERM)
@@ -209,8 +256,9 @@ def drill_preemption(workdir):
     engine = _fresh_engine()
     path, _ = engine.load_checkpoint(out)
     assert path.endswith("emergency")
+    _assert_bundles(pm_dir, {"preemption": 1}, "preemption")
     print(f"  SIGTERM → exit {rc}; emergency tag resumed at step "
-          f"{engine.global_steps}")
+          f"{engine.global_steps}; 1 preemption bundle")
 
 
 def drill_watchdog(workdir):
@@ -230,7 +278,8 @@ def drill_watchdog(workdir):
             loss = engine(b); engine.backward(loss); engine.step()
     """)
     os.makedirs(out, exist_ok=True)
-    p = _spawn(trainer, out)
+    pm_dir = os.path.join(workdir, "pm")
+    p = _spawn(trainer, out, extra_env={POSTMORTEM_ENV: pm_dir})
     try:
         rc = p.wait(timeout=180)
     finally:
@@ -240,8 +289,12 @@ def drill_watchdog(workdir):
     assert os.path.exists(dump), "watchdog wrote no stack dump"
     report = open(dump).read()
     assert "no step progress" in report and "--- thread" in report
+    # the injected long stall flushes first; the watchdog's own flush is
+    # then skipped by the one-bundle-per-process guard → exactly one
+    # artifact, classified stall
+    _assert_bundles(pm_dir, {"stall": 1}, "watchdog")
     print(f"  hang flagged; aborted with exit {rc}; stack dump "
-          f"({len(report)} bytes) written")
+          f"({len(report)} bytes) written; 1 stall bundle")
 
 
 # per-"host" worker for the slice-loss drill: rank/world come from the
@@ -335,7 +388,14 @@ def drill_slice_loss(workdir):
                            backoff=BackoffPolicy(base=0.05, factor=1.0,
                                                  max_delay=0.05,
                                                  jitter="none"))
-    rc = agent.run()
+    # elastic-agent workers inherit os.environ: deliver the bundle
+    # destination to every gang member through it
+    pm_dir = os.path.join(workdir, "pm")
+    os.environ[POSTMORTEM_ENV] = pm_dir
+    try:
+        rc = agent.run()
+    finally:
+        os.environ.pop(POSTMORTEM_ENV, None)
     assert rc == 0, f"agent exited {rc}"
     assert agent.world_history == [4, 2], agent.world_history
     assert agent.restart_counts["reshard"] == 1, dict(agent.restart_counts)
@@ -349,6 +409,10 @@ def drill_slice_loss(workdir):
         # first gang computed before dying — the trajectory continued
         assert g1["losses"]["2"] == g1["gen0_loss2"], (
             g1["losses"]["2"], g1["gen0_loss2"])
+    # forensics: the SIGKILLed half each flushed a stall bundle from the
+    # held-open publish window; the surviving half each flushed a
+    # slice_loss bundle on the exit-84 path. Gen-1 exits clean → no more.
+    _assert_bundles(pm_dir, {"stall": 2, "slice_loss": 2}, "slice-loss")
     print(f"  4-host gang lost its upper half; agent relaunched 2 "
           f"survivors budget-free (reasons={agent.restart_reasons}); "
           f"resumed at step 2 with bitwise loss continuity")
@@ -440,7 +504,8 @@ def drill_replica_loss(workdir):
     with open(worker, "w") as f:
         f.write(REPLICA_LOSS_WORKER.replace("@REPO@", repr(REPO)))
     verdict_path = os.path.join(workdir, "verdict.json")
-    p = _spawn(worker, verdict_path)
+    pm_dir = os.path.join(workdir, "pm")
+    p = _spawn(worker, verdict_path, extra_env={POSTMORTEM_ENV: pm_dir})
     try:
         rc = p.wait(timeout=420)
     finally:
@@ -454,9 +519,10 @@ def drill_replica_loss(workdir):
     assert v["bit_exact"], f"recovery diverged from fault-free run: {v}"
     assert v["all_complete"], f"re-admitted streams incomplete: {v}"
     assert v["leaked_pages"] == 0, f"KV pages leaked: {v}"
+    _assert_bundles(pm_dir, {"replica_loss": 1}, "replica-loss")
     print(f"  decode replica lost mid-stream; {v['readmitted']} request(s) "
           f"re-admitted (uids {v['readmitted_uids']}); all 6 streams "
-          f"bit-exact vs fault-free; 0 pages leaked")
+          f"bit-exact vs fault-free; 0 pages leaked; 1 replica_loss bundle")
 
 
 DRILLS = {

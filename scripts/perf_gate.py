@@ -1698,6 +1698,73 @@ def check_lint_baseline(baseline_path=None, scan_root=None):
         verdict["regressions"]
 
 
+#: checked-in exemplar postmortem bundle (telemetry/flightrec.py) — the
+#: bundle schema and the analyzer's signature catalogue are pinned against
+#: each other here; regenerate alongside any flightrec format bump
+POSTMORTEM_EXEMPLAR_DIR = os.path.join(REPO_ROOT, "onchip_results",
+                                       "postmortem_exemplar")
+
+
+def _load_postmortem_module():
+    """Load scripts/postmortem.py standalone (stdlib-only — the analyzer
+    must run on hosts without jax, so the dry-run lane holds it to that)."""
+    import importlib.util
+    mod_path = os.path.join(REPO_ROOT, "scripts", "postmortem.py")
+    spec = importlib.util.spec_from_file_location("_postmortem", mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def validate_postmortem_bundle(exemplar_dir=None):
+    """Schema-validate the checked-in exemplar bundle with the analyzer's
+    own ``validate_bundle`` (manifest spine, event keys, seq order,
+    payload files). Returns (report, errors) for the dry-run lane."""
+    d = exemplar_dir or POSTMORTEM_EXEMPLAR_DIR
+    if not os.path.isdir(d):
+        return {"skipped": f"no postmortem exemplar at {d}"}, []
+    try:
+        pm = _load_postmortem_module()
+    except Exception as e:
+        return {}, [f"cannot load postmortem module: {e}"]
+    bundles = pm.find_bundles([d])
+    if not bundles:
+        return {}, [f"no postmortem-* bundle under {d}"]
+    errors = []
+    for b in bundles:
+        errors.extend(f"{os.path.basename(b)}: {e}"
+                      for e in pm.validate_bundle(b))
+    return {"bundles": len(bundles)}, errors
+
+
+def check_postmortem_classify(exemplar_dir=None):
+    """Pin the exemplar's classification: the full analyzer pipeline
+    (discover -> validate -> merge by run_id -> classify) must produce
+    exactly one ``backend_unavailable`` incident — a signature-catalogue
+    or timeline regression flips this. Returns (report, errors)."""
+    d = exemplar_dir or POSTMORTEM_EXEMPLAR_DIR
+    if not os.path.isdir(d):
+        return {"skipped": f"no postmortem exemplar at {d}"}, []
+    try:
+        pm = _load_postmortem_module()
+    except Exception as e:
+        return {}, [f"cannot load postmortem module: {e}"]
+    report, errors = pm.analyze([d])
+    if report is None:
+        return {}, errors
+    incidents = [i["incident"] for i in report["incidents"]]
+    if incidents != ["backend_unavailable"]:
+        errors = list(errors) + [
+            f"exemplar classified {incidents} != ['backend_unavailable'] — "
+            f"the signature catalogue drifted from the bundle format"]
+    events = sum(i["event_count"] for i in report["incidents"])
+    if events < 3:
+        errors = list(errors) + [
+            f"exemplar incident carries {events} ring events (< 3) — the "
+            f"flight-recorder timeline went missing from the bundle"]
+    return {"incidents": incidents, "events": events}, errors
+
+
 def compare(baseline, candidate, thresholds):
     """-> (verdicts, regressed). Only metrics on both sides are gated."""
     verdicts = []
@@ -1823,11 +1890,17 @@ def main(argv=None):
         slo_report, slo_errors = check_slo_baseline()
         for err in slo_errors:
             print(f"perf_gate: slo: {err}", file=sys.stderr)
+        pm_report, pm_errors = validate_postmortem_bundle()
+        for err in pm_errors:
+            print(f"perf_gate: postmortem_bundle: {err}", file=sys.stderr)
+        pm_cls_report, pm_cls_errors = check_postmortem_classify()
+        for err in pm_cls_errors:
+            print(f"perf_gate: postmortem_classify: {err}", file=sys.stderr)
         errors = table_errors + qgz_errors + moe_wire_errors \
             + overlap_errors + sched_errors + moe_base_errors \
             + prefix_errors + fleet_errors + chaos_errors \
             + longctx_errors + spec_errors + elastic_errors + lint_errors \
-            + profile_errors + slo_errors
+            + profile_errors + slo_errors + pm_errors + pm_cls_errors
         print(json.dumps({"dry_run": True,
                           "inputs_ok": not errors,
                           "kernel_table": table_report,
@@ -1845,6 +1918,8 @@ def main(argv=None):
                           "lint": lint_report,
                           "profile_store": profile_report,
                           "slo": slo_report,
+                          "postmortem_bundle": pm_report,
+                          "postmortem_classify": pm_cls_report,
                           "metrics": {label: extract_metrics(doc)
                                       for label, doc in docs.items()}}))
         return 2 if errors else 0

@@ -1,0 +1,350 @@
+#!/usr/bin/env python
+"""Postmortem bundle analyzer — classify abnormal exits after the fact.
+
+Loads one or many flight-recorder bundles (telemetry/flightrec.py), merges
+multi-process/multi-host bundles by ``run_id`` (the trace_merge pattern),
+reconstructs a causal event timeline from the ring contents, classifies
+each incident against the known signature catalogue, and emits a human
+verdict (stderr) plus ONE machine-readable JSON payload line (stdout) —
+the same emit contract as bench.py.
+
+Usage::
+
+    python scripts/postmortem.py BUNDLE_OR_PARENT [more ...]
+    python scripts/postmortem.py /runs/postmortems        # scans for
+                                                          # postmortem-* dirs
+
+Incident types (docs/OBSERVABILITY.md signature catalogue)::
+
+    oom | stall | preemption | slice_loss | replica_loss | corrupt_ckpt
+    | backend_unavailable | unknown
+
+Exit codes: 0 = every bundle loaded and classified; 2 = no bundle found
+or a bundle was malformed (missing/unparsable manifest or events).
+
+Stdlib-only — runs on hosts without jax (the whole point: the process that
+would have imported jax is dead).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+BUNDLE_PREFIX = "postmortem-"
+MANIFEST_NAME = "manifest.json"
+EVENTS_NAME = "events.jsonl"
+SUMMARY_NAME = "summary.json"
+STATE_NAME = "state.json"
+
+REPORT_SCHEMA = "postmortem_report.v1"
+
+INCIDENT_TYPES = ("oom", "stall", "preemption", "slice_loss",
+                  "replica_loss", "corrupt_ckpt", "backend_unavailable",
+                  "unknown")
+
+#: flush reasons that map straight to an incident type (the flusher knew
+#: what was happening); event-signature matching covers the rest.
+_REASON_MAP = {
+    "oom": "oom",
+    "stall": "stall",
+    "watchdog_stall": "stall",
+    "preemption": "preemption",
+    "slice_loss": "slice_loss",
+    "replica_loss": "replica_loss",
+    "corrupt_ckpt": "corrupt_ckpt",
+    "backend_unavailable": "backend_unavailable",
+}
+
+#: fault points whose presence in the ring implies an incident type even
+#: when the flush reason is generic (unhandled_exception, injected_exit).
+_FAULT_POINT_MAP = {
+    "slice.lost": "slice_loss",
+    "comm.partition": "slice_loss",
+    "replica.lost": "replica_loss",
+    "replica.stall": "replica_loss",
+    "step.hang": "stall",
+    "ckpt.write": "corrupt_ckpt",
+    "ckpt.publish": "corrupt_ckpt",
+}
+
+_EXIT_CODE_MAP = {83: "preemption", 84: "slice_loss", 85: "stall"}
+
+
+# ---------------------------------------------------------------------------
+# loading + validation
+
+def find_bundles(paths):
+    """Expand each path to bundle dirs: a path that IS a bundle (has a
+    manifest) counts as one; otherwise its ``postmortem-*`` children do."""
+    out = []
+    for p in paths:
+        if os.path.isfile(os.path.join(p, MANIFEST_NAME)):
+            out.append(p)
+            continue
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                sub = os.path.join(p, name)
+                if (name.startswith(BUNDLE_PREFIX) and ".tmp." not in name
+                        and os.path.isfile(os.path.join(sub, MANIFEST_NAME))):
+                    out.append(sub)
+    return out
+
+
+def load_bundle(path):
+    """Load one bundle directory into a dict; raises on a malformed
+    manifest/events (the crash-consistent publish makes partial bundles
+    impossible, so malformed means tampered or truncated-in-transit)."""
+    with open(os.path.join(path, MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    events = []
+    ev_path = os.path.join(path, EVENTS_NAME)
+    if os.path.exists(ev_path):
+        with open(ev_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                events.append(json.loads(line))
+    out = {"path": path, "manifest": manifest, "events": events,
+           "summary": None, "state": None}
+    for key, name in (("summary", SUMMARY_NAME), ("state", STATE_NAME)):
+        p = os.path.join(path, name)
+        if os.path.exists(p):
+            try:
+                with open(p) as f:
+                    out[key] = json.load(f)
+            except (OSError, ValueError):
+                pass  # optional payloads: forensic extras, not the spine
+    return out
+
+
+_MANIFEST_REQUIRED = ("format_version", "kind", "reason", "host", "pid",
+                      "run_id", "created_unix")
+_EVENT_REQUIRED = ("seq", "ts", "kind", "name")
+
+
+def validate_bundle(path):
+    """Schema-validate one bundle dir; returns a list of error strings
+    (empty = valid). Shared with perf_gate's ``validate_postmortem_bundle``
+    dry-run check."""
+    errors = []
+    try:
+        b = load_bundle(path)
+    except (OSError, ValueError, KeyError) as e:
+        return [f"unreadable bundle {path}: {type(e).__name__}: {e}"]
+    man = b["manifest"]
+    for key in _MANIFEST_REQUIRED:
+        if key not in man:
+            errors.append(f"manifest missing key {key!r}")
+    if man.get("kind") != "postmortem_bundle":
+        errors.append(f"manifest kind {man.get('kind')!r} != "
+                      f"'postmortem_bundle'")
+    if not isinstance(man.get("format_version"), int):
+        errors.append("manifest format_version is not an int")
+    for i, ev in enumerate(b["events"]):
+        for key in _EVENT_REQUIRED:
+            if key not in ev:
+                errors.append(f"event #{i} missing key {key!r}")
+                break
+    seqs = [ev.get("seq") for ev in b["events"]]
+    if seqs != sorted(seqs):
+        errors.append("events are not in seq order")
+    if not os.path.exists(os.path.join(path, SUMMARY_NAME)):
+        errors.append(f"missing {SUMMARY_NAME}")
+    if not os.path.exists(os.path.join(path, STATE_NAME)):
+        errors.append(f"missing {STATE_NAME}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+def _fault_points(events):
+    """Injected/observed fault points in the ring: ``Fault/<point>``
+    event names plus explicit ``fault_point`` manifest extras."""
+    pts = []
+    for ev in events:
+        name = ev.get("name", "")
+        if name.startswith("Fault/"):
+            pts.append(name[len("Fault/"):])
+    return pts
+
+
+def classify_bundle(bundle):
+    """Classify ONE bundle -> (incident_type, evidence list). Signature
+    order is fixed: a direct flush reason wins, then fault-point and
+    event-name signatures in catalogue order, then the exit code."""
+    man = bundle["manifest"]
+    events = bundle["events"]
+    reason = str(man.get("reason", ""))
+    evidence = []
+
+    direct = _REASON_MAP.get(reason)
+    if direct:
+        return direct, [f"flush reason {reason!r}"]
+
+    points = _fault_points(events)
+    names = [ev.get("name", "") for ev in events]
+    extra = man.get("extra") or {}
+    if isinstance(extra, dict) and extra.get("fault_point"):
+        points.append(str(extra["fault_point"]))
+
+    # catalogue order mirrors INCIDENT_TYPES (docs/OBSERVABILITY.md)
+    if "oom" in points:
+        return "oom", ["Fault/oom event in ring"]
+    for pt in points:
+        mapped = _FAULT_POINT_MAP.get(pt)
+        if mapped in ("slice_loss", "replica_loss"):
+            return mapped, [f"fault point {pt!r} in ring"]
+    if "slice_lost" in points:
+        return "slice_loss", ["Fault/slice_lost event in ring"]
+    if any(n == "replica/lost" or n == "replica/dead" for n in names):
+        return "replica_loss", ["replica lifecycle death in ring"]
+    if "ckpt_corrupt" in points:
+        return "corrupt_ckpt", ["Fault/ckpt_corrupt event in ring"]
+    if "backend_unavailable" in points:
+        return "backend_unavailable", ["Fault/backend_unavailable in ring"]
+    if "preemption" in points:
+        return "preemption", ["Fault/preemption event in ring"]
+    if "hang" in points:
+        return "stall", ["Fault/hang (watchdog) event in ring"]
+    for pt in points:
+        mapped = _FAULT_POINT_MAP.get(pt)
+        if mapped:
+            return mapped, [f"fault point {pt!r} in ring"]
+
+    code = man.get("exit_code")
+    if code in _EXIT_CODE_MAP:
+        return _EXIT_CODE_MAP[code], [f"exit code {code}"]
+    evidence.append(f"flush reason {reason!r} matched no signature")
+    return "unknown", evidence
+
+
+def _merge_timeline(bundles):
+    """Causal timeline across one incident's bundles: every ring event
+    stamped with (host, pid), ordered by wall-clock ts then seq. Bundle
+    timestamps are wall time (flightrec records time.time), so cross-host
+    order is as causal as the hosts' clocks."""
+    out = []
+    for b in bundles:
+        man = b["manifest"]
+        who = f"{man.get('host', '?')}:{man.get('pid', '?')}"
+        for ev in b["events"]:
+            out.append({"ts": ev.get("ts", 0), "seq": ev.get("seq", 0),
+                        "who": who, "kind": ev.get("kind"),
+                        "name": ev.get("name"),
+                        "detail": ev.get("detail")})
+    out.sort(key=lambda e: (e["ts"], e["who"], e["seq"]))
+    return out
+
+
+def classify_incident(bundles):
+    """Classify one run_id group. Per-bundle classifications are combined
+    by specificity: any non-unknown type beats unknown; ties between
+    different concrete types keep catalogue order (the earliest in
+    INCIDENT_TYPES — the most root-cause-ish signature — names the
+    incident, the rest ride as evidence)."""
+    per = []
+    for b in bundles:
+        typ, ev = classify_bundle(b)
+        per.append((typ, ev, b))
+    concrete = [t for (t, _, _) in per if t != "unknown"]
+    if concrete:
+        incident = min(concrete, key=INCIDENT_TYPES.index)
+    else:
+        incident = "unknown"
+    evidence = []
+    for typ, ev, b in per:
+        for e in ev:
+            evidence.append(f"{os.path.basename(b['path'])}: {e}"
+                            + (f" -> {typ}" if typ != incident else ""))
+    timeline = _merge_timeline(bundles)
+    return {
+        "incident": incident,
+        "run_id": bundles[0]["manifest"].get("run_id"),
+        "bundles": [b["path"] for b in bundles],
+        "hosts": sorted({b["manifest"].get("host") for b in bundles}),
+        "pids": sorted({b["manifest"].get("pid") for b in bundles}),
+        "exit_codes": sorted({b["manifest"].get("exit_code")
+                              for b in bundles
+                              if b["manifest"].get("exit_code") is not None}),
+        "reasons": sorted({b["manifest"].get("reason") for b in bundles}),
+        "evidence": evidence,
+        "event_count": len(timeline),
+        "first_ts": timeline[0]["ts"] if timeline else None,
+        "last_ts": timeline[-1]["ts"] if timeline else None,
+        "timeline_tail": timeline[-8:],
+    }
+
+
+def analyze(paths):
+    """Full pipeline: discover -> validate -> group by run_id -> classify.
+    Returns (report dict, error list)."""
+    errors = []
+    bundle_dirs = find_bundles(paths)
+    if not bundle_dirs:
+        return None, [f"no postmortem bundle found under {list(paths)}"]
+    bundles = []
+    for d in bundle_dirs:
+        errs = validate_bundle(d)
+        if errs:
+            errors.extend(f"{d}: {e}" for e in errs)
+            continue
+        bundles.append(load_bundle(d))
+    groups = {}
+    for b in bundles:
+        groups.setdefault(b["manifest"].get("run_id"), []).append(b)
+    incidents = [classify_incident(bs)
+                 for _, bs in sorted(groups.items(),
+                                     key=lambda kv: str(kv[0]))]
+    report = {"schema": REPORT_SCHEMA,
+              "bundles": len(bundles),
+              "malformed": len(bundle_dirs) - len(bundles),
+              "incidents": incidents}
+    return report, errors
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+def _human_verdict(report, out=sys.stderr):
+    for inc in report["incidents"]:
+        hosts = ",".join(str(h) for h in inc["hosts"])
+        codes = ",".join(str(c) for c in inc["exit_codes"]) or "-"
+        print(f"incident run_id={inc['run_id']}: "
+              f"{inc['incident'].upper()} "
+              f"({len(inc['bundles'])} bundle(s), hosts [{hosts}], "
+              f"exit [{codes}], {inc['event_count']} events)", file=out)
+        for e in inc["evidence"]:
+            print(f"  evidence: {e}", file=out)
+        for ev in inc["timeline_tail"]:
+            print(f"  {ev['ts']:.3f} {ev['who']} {ev['kind']}:{ev['name']}",
+                  file=out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="classify postmortem bundles into incident verdicts")
+    ap.add_argument("paths", nargs="+",
+                    help="bundle dirs and/or parents containing postmortem-*")
+    ap.add_argument("--json-out", default="",
+                    help="also write the JSON report to this file")
+    args = ap.parse_args(argv)
+
+    report, errors = analyze(args.paths)
+    for e in errors:
+        print(f"postmortem: {e}", file=sys.stderr)
+    if report is None:
+        return 2
+    _human_verdict(report)
+    line = json.dumps(report, sort_keys=True, default=str)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    return 2 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,9 +40,19 @@ report ranks hosts by exposed-comm seconds (``exposure_by_host`` /
 ``most_exposed_host``) so cross-host skew and exposure read off one
 report.
 
+Postmortem bundles (``--bundles``, telemetry/flightrec.py) fold in as a
+per-host ``flightrec`` lane (tid 2): every ring event a dead process left
+behind becomes an instant event on its host's track, so the last beats,
+faults and flush of a crashed host read in the same timeline as the
+survivors' spans. Bundle timestamps are wall-clock (not perf_counter), so
+the flightrec lanes are zero-based on the earliest ring event across all
+bundles — causal order holds across bundles, not against the span lanes.
+
 Usage:
     python scripts/trace_merge.py host0.jsonl host1.jsonl ... \
         --out merged_trace.json --report straggler_report.json
+    python scripts/trace_merge.py --bundles /runs/postmortems \
+        --out merged_trace.json          # bundles alone: a dead fleet
 
 Exit 0 on success, 2 on unreadable/empty input.
 """
@@ -90,6 +100,79 @@ def host_exposures(per_host):
             "intervals": att["comm_intervals"],
         }
     return out
+
+
+def _postmortem_module():
+    """scripts/postmortem.py loaded standalone (stdlib-only, same idiom as
+    the overlap analyzer) — bundle discovery/parsing stays in one place."""
+    spec = importlib.util.spec_from_file_location(
+        "_postmortem", os.path.join(REPO_ROOT, "scripts", "postmortem.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_bundle_lanes(bundle_paths):
+    """Discover + load postmortem bundles -> ``{host_label: [bundle]}``
+    keyed by the SAME ``host:pid`` label scheme the JSONL loader uses, so
+    a crashed process's flightrec lane lands on its own telemetry track
+    when both artifacts survive."""
+    pm = _postmortem_module()
+    lanes = {}
+    for d in pm.find_bundles(bundle_paths):
+        try:
+            b = pm.load_bundle(d)
+        except (OSError, ValueError) as e:
+            print(f"trace_merge: skipping malformed bundle {d}: {e}",
+                  file=sys.stderr)
+            continue
+        man = b["manifest"]
+        label = f"{man.get('host', '?')}:{man.get('pid', '?')}"
+        lanes.setdefault(label, []).append(b)
+    return lanes
+
+
+def flightrec_lane_events(lanes, host_pids):
+    """Chrome events for the per-host ``flightrec`` lane (tid 2). Hosts
+    already holding a track keep their chrome pid; bundle-only hosts (the
+    process died before telemetry exported anything) get fresh pids. Ring
+    timestamps are wall-clock, zero-based on the earliest event across ALL
+    bundles so cross-process causal order is preserved."""
+    all_ts = [ev.get("ts", 0.0)
+              for bundles in lanes.values()
+              for b in bundles for ev in b["events"]]
+    base = min(all_ts) if all_ts else 0.0
+    events = []
+    next_pid = max(host_pids.values(), default=0) + 1
+    for label in sorted(lanes):
+        pid = host_pids.get(label)
+        if pid is None:
+            pid = next_pid
+            next_pid += 1
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": label}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": 2, "args": {"name": "flightrec"}})
+        for b in lanes[label]:
+            for ev in b["events"]:
+                events.append({
+                    "pid": pid, "tid": 2,
+                    "name": ev.get("name", "?"), "ph": "i", "s": "t",
+                    "cat": "flightrec",
+                    "ts": round((ev.get("ts", 0.0) - base) * 1e6, 3),
+                    "args": {"kind": ev.get("kind"), "seq": ev.get("seq"),
+                             "detail": ev.get("detail")}})
+            man = b["manifest"]
+            events.append({
+                "pid": pid, "tid": 2,
+                "name": f"postmortem:{man.get('reason', '?')}", "ph": "i",
+                "s": "p", "cat": "flightrec",
+                "ts": round((man.get("created_unix", base) - base) * 1e6, 3),
+                "args": {"exit_code": man.get("exit_code"),
+                         "detail": man.get("detail"),
+                         "dropped": man.get("event_dropped"),
+                         "bundle": os.path.basename(b["path"])}})
+    return events
 
 
 def load_host_records(path):
@@ -317,7 +400,7 @@ def merged_trace_events(per_host, offsets, exposures=None):
     return events
 
 
-def merge(paths, out_path=None, report_path=None):
+def merge(paths, out_path=None, report_path=None, bundles=None):
     per_host = {}
     for path in paths:
         host, records = load_host_records(path)
@@ -334,9 +417,26 @@ def merge(paths, out_path=None, report_path=None):
     events = merged_trace_events(per_host, offsets, exposures=exposures)
     report = straggler_report(per_host, offsets, exposures=exposures)
     report["alignment_anchor"] = list(anchor) if anchor else None
+    if bundles:
+        lanes = load_bundle_lanes(bundles)
+        if not lanes:
+            print(f"trace_merge: no postmortem bundle under {bundles}",
+                  file=sys.stderr)
+            return None, None
+        host_pids = {h: pid for pid, h in
+                     enumerate(sorted(per_host), start=1)}
+        events.extend(flightrec_lane_events(lanes, host_pids))
+        report["flightrec"] = {
+            "bundles": sum(len(bs) for bs in lanes.values()),
+            "hosts": sorted(lanes),
+            "reasons": sorted({b["manifest"].get("reason")
+                               for bs in lanes.values() for b in bs}),
+        }
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "otherData": {"producer": "deepspeed_tpu.scripts.trace_merge",
-                         "hosts": sorted(per_host)}}
+                         "hosts": sorted(set(per_host)
+                                         | set(report.get("flightrec", {})
+                                               .get("hosts", [])))}}
     if out_path:
         with open(out_path, "w") as f:
             json.dump(doc, f)
@@ -348,20 +448,30 @@ def merge(paths, out_path=None, report_path=None):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("jsonl", nargs="+", help="per-host telemetry JSONL files")
+    ap.add_argument("jsonl", nargs="*",
+                    help="per-host telemetry JSONL files (optional when "
+                         "--bundles is given)")
     ap.add_argument("--out", default="merged_trace.json",
                     help="merged Chrome-trace output path")
     ap.add_argument("--report", default="",
                     help="straggler-report JSON output path ('' = stdout only)")
+    ap.add_argument("--bundles", nargs="+", default=None, metavar="PATH",
+                    help="postmortem bundle dirs (or parents holding "
+                         "postmortem-*) folded in as per-host flightrec "
+                         "lanes")
     args = ap.parse_args(argv)
+    if not args.jsonl and not args.bundles:
+        ap.error("need at least one JSONL file or --bundles")
     doc, report = merge(args.jsonl, out_path=args.out,
-                        report_path=args.report or None)
+                        report_path=args.report or None,
+                        bundles=args.bundles)
     if doc is None:
         return 2
     brief = {k: v for k, v in report.items() if k != "matches"}
     print(json.dumps(brief, indent=2))
     print(f"trace_merge: {len(doc['traceEvents'])} events from "
-          f"{len(brief['hosts'])} host(s) -> {args.out}", file=sys.stderr)
+          f"{len(doc['otherData']['hosts'])} host(s) -> {args.out}",
+          file=sys.stderr)
     return 0
 
 

@@ -293,6 +293,56 @@ def test_perf_gate_dry_run_tier1_wiring():
     # schedule must attribute as fully exposed with a non-empty critical path
     assert out["overlap"]["exposed_comm_s"] == out["overlap"]["comm_s"]
     assert out["overlap"]["critical_path_ops"] > 0
+    # the postmortem exemplar rides the same lane: the checked-in bundle
+    # must stay schema-valid and classify as its pinned incident type
+    assert out["postmortem_bundle"] == {"bundles": 1}
+    assert out["postmortem_classify"]["incidents"] == ["backend_unavailable"]
+
+
+def test_perf_gate_postmortem_checks_catch_tampering(tmp_path):
+    """validate_postmortem_bundle flags a schema-broken bundle and
+    check_postmortem_classify flags a catalogue/classification drift."""
+    import importlib.util
+    import shutil
+    spec = importlib.util.spec_from_file_location("_pg_pm", PERF_GATE)
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    # the checked-in exemplar passes both checks
+    report, errs = pg.validate_postmortem_bundle()
+    assert errs == [] and report == {"bundles": 1}
+    report, errs = pg.check_postmortem_classify()
+    assert errs == [] and report["incidents"] == ["backend_unavailable"]
+
+    # copy + strip a required manifest key -> validation error
+    src = pg.POSTMORTEM_EXEMPLAR_DIR
+    broken = tmp_path / "broken"
+    shutil.copytree(src, broken)
+    (bundle,) = [broken / n for n in os.listdir(broken)]
+    man = json.loads((bundle / "manifest.json").read_text())
+    del man["run_id"]
+    (bundle / "manifest.json").write_text(json.dumps(man))
+    _, errs = pg.validate_postmortem_bundle(exemplar_dir=str(broken))
+    assert any("run_id" in e for e in errs)
+
+    # copy + rewrite the flush reason -> classification pin fires
+    drifted = tmp_path / "drifted"
+    shutil.copytree(src, drifted)
+    (bundle,) = [drifted / n for n in os.listdir(drifted)]
+    man = json.loads((bundle / "manifest.json").read_text())
+    man["reason"] = "oom"
+    (bundle / "manifest.json").write_text(json.dumps(man))
+    _, errs = pg.check_postmortem_classify(exemplar_dir=str(drifted))
+    assert any("signature catalogue" in e for e in errs)
+
+    # an empty exemplar dir is an error, a missing one is a skip
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    _, errs = pg.validate_postmortem_bundle(exemplar_dir=str(empty))
+    assert errs, "an exemplar dir without a bundle must fail the gate"
+    report, errs = pg.validate_postmortem_bundle(
+        exemplar_dir=str(tmp_path / "absent"))
+    assert errs == [] and "skipped" in report
 
 
 def test_perf_gate_kernel_table_check_fails_on_bad_table(tmp_path,

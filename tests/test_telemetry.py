@@ -102,6 +102,17 @@ def test_disabled_noop_fast_path(tmp_path, monkeypatch):
     assert telemetry.attach_overlap({"not": "even a valid report"}) is None
     assert telemetry.get_telemetry().overlap_report is None
 
+    # the flight recorder is the ONE always-on hook: Fault/* mirrors into
+    # its bounded ring even here, without waking the telemetry pipeline
+    from deepspeed_tpu.telemetry import flightrec
+    ring = flightrec.get_recorder()
+    base = ring.total_count
+    telemetry.record("Fault/slice.lost", 1, kind="counter")
+    assert ring.total_count == base + 1
+    assert ring.events()[-1]["name"] == "Fault/slice.lost"
+    assert flightrec.flush_bundle("stall") is None, \
+        "no destination configured -> no bundle litter"
+
     assert not jl.exists(), "disabled record must never open the jsonl sink"
     assert telemetry.summary() == {"enabled": False}
     assert telemetry.monitor_events(1) == []
