@@ -22,6 +22,12 @@ class DSStateManagerConfig(DeepSpeedConfigModel):
     # (contents preserved, device id freed) instead of being evicted; the
     # pressure order becomes spill-to-host -> evict-to-free -> preempt-live.
     host_kv_blocks = 0
+    # NVMe tier under the host tier (ZeRO-Infinity's disk rung, the 1M-token
+    # regime): when the host tier fills, its oldest payload demotes to the
+    # in-tree swap_tensor aio path instead of forcing an eviction — pressure
+    # order spill -> NVMe -> evict -> preempt. Requires host_kv_blocks > 0.
+    nvme_kv_blocks = 0
+    nvme_kv_dir = ""                     # "" = fresh tempdir per manager
 
 
 class KVCacheConfig(DeepSpeedConfigModel):

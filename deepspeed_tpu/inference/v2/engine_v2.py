@@ -417,15 +417,27 @@ class InferenceEngineV2:
         refcount-1 block ids; creates the sequence mid-stream."""
         return self._state.import_sequence_pages(uid, handle)
 
-    def export_pages_many(self, uids):
+    def export_pages_many(self, uids, skip=None):
         """Batched ``export_pages``: one device gather covers every listed
         finished sequence (the fleet ships a whole round's handoffs as one
-        transfer)."""
-        return self._state.export_sequences_pages(list(uids))
+        transfer). ``skip`` maps uid -> leading full blocks to delta-ship
+        (digest references instead of page bytes — the destination already
+        holds them in its prefix cache)."""
+        return self._state.export_sequences_pages(list(uids), skip=skip)
 
     def import_pages_many(self, handle) -> int:
         """Batched ``import_pages``; returns total pages bound."""
         return self._state.import_sequences_pages(handle)
+
+    def sequence_block_digests(self, uids):
+        """Per-uid full-block chain digests — the source half of the
+        delta-shipping digest exchange (``{}`` without prefix caching)."""
+        return self._state.sequence_block_digests(list(uids))
+
+    def held_prefix_lens(self, chains):
+        """Per-uid count of leading chain links this engine's prefix cache
+        already holds — the destination half of the digest exchange."""
+        return self._state.held_prefix_lens(chains)
 
     def kv_stats(self):
         """Pure host-side KV pool stats (occupancy, free blocks,

@@ -6,7 +6,10 @@ PAPER.md §inference): ``SLORouter`` places by least-predicted-TTFT with
 prefix-digest affinity and sheds/queues with typed outcomes;
 ``PrefillDecodeFleet`` specializes replicas so prefill never competes with
 decode for a token budget, shipping finished KV pages between submeshes
-through ``KVPageTransport``. The elasticity layer (``lifecycle``) makes
+through ``KVPageTransport`` (device codec, or the serialized ``wire``
+codec with delta-shipping and ``FlowControl`` — the KV fabric;
+``two_process`` runs the decode side in a separate OS process over the
+same frames). The elasticity layer (``lifecycle``) makes
 the fleet chaos-tolerant: replica lifecycle state machine, missed-
 heartbeat failure detection, bit-exact re-admission after replica loss,
 and the saturation-driven ``FleetAutoscaler``. See docs/SERVING.md
@@ -21,4 +24,4 @@ from deepspeed_tpu.inference.v2.fleet.lifecycle import (  # noqa: F401
 from deepspeed_tpu.inference.v2.fleet.router import (  # noqa: F401
     RequestAdmitted, RequestQueued, RequestRejected, SLORouter)
 from deepspeed_tpu.inference.v2.fleet.disagg import (  # noqa: F401
-    HandoffError, KVPageTransport, PrefillDecodeFleet)
+    FlowControl, HandoffError, KVPageTransport, PrefillDecodeFleet)

@@ -42,6 +42,9 @@ import jax
 
 from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.v2.fleet import lifecycle as lc
+from deepspeed_tpu.inference.v2.fleet import wire
+from deepspeed_tpu.inference.v2.fleet.wire import (WireCRCError,
+                                                   WireVersionError)
 from deepspeed_tpu.inference.v2.replica_group import build_replica
 from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.resilience.faults import InjectedFault
@@ -69,25 +72,60 @@ class HandoffError(RuntimeError):
 class KVPageTransport:
     """Ships a finished sequence's KV pages between replica engines.
 
-    ``ship`` = export (device-side gather, source released) -> device_put
-    onto the destination pool's sharding -> import (allocator bind). The
-    latency recorded spans the whole protocol including the copy
-    (``block_until_ready`` — honesty over pipelining here; the handoff IS
-    the disaggregation tax being measured)."""
+    ``ship`` = export (device-side gather, source released) -> transport
+    leg -> import (allocator bind). Two codecs:
 
-    def __init__(self, retries=2, retry_delay_s=0.01, rng=None, sleep=None):
+    * ``codec="device"`` — the in-process ICI path: one ``jax.device_put``
+      of the gathered page rows onto the destination pool's sharding.
+    * ``codec="wire"`` — the serialized DCN path (``fleet/wire.py``): the
+      exported pages land on the host, frame as versioned + per-page-CRC32
+      bytes (int8 pools byte-for-byte; fp pools quantized at the wire),
+      and parse back before the destination put. This is the leg a
+      cross-process fabric runs; in-process it exists so the exact bytes a
+      socket would carry are testable (corruption -> CRC -> retry) without
+      a second host.
+
+    ``delta_shipping=True`` exchanges chain digests with the destination
+    before exporting and skips every leading full block its prefix cache
+    already holds — those blocks cross as digest references
+    (``acquire_known`` re-pins them at bind time), not page bytes.
+
+    The latency recorded spans the whole protocol including the copy
+    (``block_until_ready`` — honesty over pipelining here; the handoff IS
+    the disaggregation tax being measured). ``bytes_shipped`` counts
+    device page bytes (bucket-padded pool rows); ``wire_bytes_shipped``
+    counts TRUE wire bytes — the serialized frame length on the wire
+    codec, per-page data+scale bytes (padding excluded) on the device
+    codec — and is what ``record_handoff`` reports per request."""
+
+    def __init__(self, retries=2, retry_delay_s=0.01, rng=None, sleep=None,
+                 codec="device", delta_shipping=False, wire_quantize=True):
+        if codec not in ("device", "wire"):
+            raise ValueError(f"unknown transport codec {codec!r}; "
+                             f"expected 'device' or 'wire'")
+        self.codec = codec
+        self.delta_shipping = bool(delta_shipping)
+        self._wire_quantize = bool(wire_quantize)
         self.handoffs = 0
         self.transfers = 0
         self.pages_shipped = 0
         self.pages_bound = 0
         self.bytes_shipped = 0
+        self.wire_bytes_shipped = 0
+        self.wire_bytes_saved = 0     # delta-shipping: bytes NOT sent
+        self.pages_delta_skipped = 0
+        self.crc_failures = 0         # wire frames rejected by a page CRC
         self.total_s = 0.0
         self.retry_trips = 0
         self.failed_handoffs = 0
-        # transient-failure hardening: the transfer attempt is wrapped in
+        # transient-failure hardening: each retryable unit is wrapped in
         # utils/retry.retry_call (rng/sleep injectable so drills pin exact
-        # schedules); retries fire only on the armed ``transport.drop``
-        # fault point — the in-process device_put itself cannot blip
+        # schedules). Two units with different retry semantics:
+        #   export   — retries on the armed ``transport.drop`` fault only
+        #              (fires BEFORE the export, pages still resident);
+        #   wire leg — retries on WireCRCError (``transport.corrupt``
+        #              flips a payload byte; the CRC32 check catches it and
+        #              the frame re-serializes from the landed export).
         self._retries = int(retries)
         self._retry_delay_s = float(retry_delay_s)
         self._rng = rng
@@ -99,65 +137,163 @@ class KVPageTransport:
         return self.ship_many([uid], src_engine, dst_engine,
                               src=src, dst=dst)
 
-    def _transfer(self, uids, src_engine, dst_engine, detail):
-        """One transfer attempt (the retryable unit). ``transport.drop``
-        fires BEFORE the export, so a retried attempt still finds the
-        source pages resident — past the export the source allocator has
-        released them and a retry could never reproduce the data."""
+    def page_wire_cost(self, engine):
+        """Wire bytes ONE page (a block row, K+V, all layers) costs from
+        ``engine``'s pool — pure host-side shape math, no device touch.
+        The flow-control admission unit and the delta-shipping savings
+        ledger. int8 pools and the wire-quantized fp leg both put one int8
+        per element plus one fp32 scale per token row on the wire."""
+        kc = engine._state.kv_cache
+        L, _, H, bs, hd = kc.k_pool.shape
+        if kc.quantized or (self.codec == "wire" and self._wire_quantize):
+            return 2 * L * H * bs * (hd + 4)
+        return 2 * L * H * bs * hd * int(kc.k_pool.dtype.itemsize)
+
+    def _delta_skip(self, uids, src_engine, dst_engine):
+        """The digest exchange: {uid: leading full blocks the destination
+        already holds} (None when delta-shipping is off or nothing
+        matches). Advisory — the destination may evict between this answer
+        and the bind, so ``import_sequences_pages`` re-resolves and a
+        shortfall surfaces as a bind-stage HandoffError (re-prefill)."""
+        if not self.delta_shipping:
+            return None
+        chains = src_engine.sequence_block_digests(uids)
+        chains = {u: c for u, c in chains.items() if c}
+        if not chains:
+            return None
+        held = dst_engine.held_prefix_lens(chains)
+        skip = {u: n for u, n in held.items() if n}
+        return skip or None
+
+    def _export(self, uids, src_engine, skip, detail):
+        """The pre-export retryable unit. ``transport.drop`` fires BEFORE
+        the export, so a retried attempt still finds the source pages
+        resident — past the export the source allocator has released them
+        and a retry could never reproduce the data."""
         faults.maybe_fail("transport.drop", detail)
-        handle = src_engine.export_pages_many(uids)
+        if skip:
+            return src_engine.export_pages_many(uids, skip=skip)
+        return src_engine.export_pages_many(uids)
+
+    def _device_leg(self, handle, dst_engine):
+        """In-process codec: one device_put of the exported page rows
+        (``(data, scale)`` pairs flow through as a pytree) onto the
+        destination pool's sharding."""
         sharding = dst_engine.kv_page_sharding
         k = jax.device_put(handle["k"], sharding)
         v = jax.device_put(handle["v"], sharding)
         jax.block_until_ready((k, v))
         handle["k"], handle["v"] = k, v
-        return handle
+
+    def _wire_leg(self, handle, src_engine, dst_engine, detail):
+        """One wire-codec attempt (the post-export retryable unit):
+        serialize the exported handle, run the injected-corruption fault,
+        CRC-verify + parse, and land the pages on the destination's
+        sharding. A WireCRCError re-enters HERE — the export stays intact
+        in the handle, so the frame re-serializes; the export itself never
+        re-runs. Returns (import handle, frame bytes on the wire)."""
+        frame = wire.encode_handle(
+            handle, fetch=getattr(src_engine, "host_fetch", None),
+            wire_quantize=self._wire_quantize)
+        try:
+            faults.maybe_fail("transport.corrupt", detail)
+        except InjectedFault:
+            # the drill models the DCN flipping a bit in flight: corrupt
+            # the frame and let the REAL detection path (per-page CRC32 in
+            # decode_frame) catch it
+            frame = wire.corrupt(frame)
+        try:
+            out = wire.decode_frame(frame)
+        except WireCRCError:
+            self.crc_failures += 1
+            raise
+        sharding = dst_engine.kv_page_sharding
+        k = jax.device_put(out["k"], sharding)
+        v = jax.device_put(out["v"], sharding)
+        jax.block_until_ready((k, v))
+        out["k"], out["v"] = k, v
+        return out, len(frame)
 
     def ship_many(self, uids, src_engine, dst_engine, src="prefill",
                   dst="decode"):
         """Move several finished sequences' pages in ONE gather ->
-        device_put -> scatter. The fleet batches every handoff that
+        transport leg -> scatter. The fleet batches every handoff that
         finished in the same scheduler round into one transfer, so the
         dispatch cost is per ROUND, not per request. ``handoffs`` counts
         requests, ``transfers`` counts device copies; the transfer latency
         is apportioned to each request's telemetry lane by its page share.
         Returns the total pages bound at the destination. Raises
-        :class:`HandoffError` when the transfer retries exhaust or the
-        destination bind fails — the fleet catches it and re-prefills the
-        requests on the decode side."""
+        :class:`HandoffError` when any leg exhausts its retries (or hits a
+        deterministic reject: version skew, delta bind miss) — the fleet
+        catches it and re-prefills the requests on the decode side."""
         uids = list(uids)
         detail = f"{src}->{dst}"
         t0 = time.perf_counter()
+        skip = self._delta_skip(uids, src_engine, dst_engine)
         try:
             handle = retry_call(
-                self._transfer, uids, src_engine, dst_engine, detail,
+                self._export, uids, src_engine, skip, detail,
                 retries=self._retries, base_delay=self._retry_delay_s,
                 retry_on=(InjectedFault,), rng=self._rng, sleep=self._sleep,
                 on_retry=lambda a, e, d: self._count_retry())
         except RetryError as e:
             self.failed_handoffs += len(uids)
             raise HandoffError(uids, "transfer", str(e)) from e
+        wire_nbytes = None
+        try:
+            if self.codec == "wire":
+                handle, wire_nbytes = retry_call(
+                    self._wire_leg, handle, src_engine, dst_engine, detail,
+                    retries=self._retries, base_delay=self._retry_delay_s,
+                    retry_on=(WireCRCError,), rng=self._rng,
+                    sleep=self._sleep,
+                    on_retry=lambda a, e, d: self._count_retry())
+            else:
+                self._device_leg(handle, dst_engine)
+        except (RetryError, WireVersionError) as e:
+            # past the export the source pages are gone either way — the
+            # fallback re-prefills (it must NOT try to flush the source)
+            self.failed_handoffs += len(uids)
+            raise HandoffError(uids, "transfer", str(e)) from e
         k, v = handle["k"], handle["v"]
+        if wire_nbytes is None:
+            # device codec: the bytes a wire ship WOULD cost — per-page
+            # data+scale bytes for the real rows, bucket padding excluded
+            wire_nbytes = wire.page_wire_nbytes(k, v) * int(handle["n"])
         try:
             faults.maybe_fail("handoff.bind_fail", detail)
             bound = dst_engine.import_pages_many(handle)
-        except InjectedFault as e:
+        except (InjectedFault, ValueError) as e:
+            # ValueError: delta bind miss — the destination evicted a
+            # digest between the exchange and the bind (all-or-nothing
+            # import rolled back)
             self.failed_handoffs += len(uids)
             raise HandoffError(uids, "bind", str(e)) from e
         dt = time.perf_counter() - t0
-        nbytes = int(k.nbytes) + int(v.nbytes)
+        nbytes = sum(int(x.nbytes)
+                     for x in jax.tree_util.tree_leaves((k, v)))
+        skipped = sum(int(m.get("skipped", 0)) for m in handle["seqs"])
         self.handoffs += len(uids)
         self.transfers += 1
         self.pages_shipped += handle["n"]
         self.pages_bound += bound
         self.bytes_shipped += nbytes
+        self.wire_bytes_shipped += int(wire_nbytes)
+        if skipped:
+            self.pages_delta_skipped += skipped
+            self.wire_bytes_saved += skipped * self.page_wire_cost(src_engine)
         self.total_s += dt
+        tm = telemetry.get_telemetry()
+        if tm.enabled and self.wire_bytes_saved:
+            tm.record("fleet/wire_bytes_saved", self.wire_bytes_saved,
+                      kind="gauge")
         total = max(handle["n"], 1)
         for m in handle["seqs"]:
             share = m["n"] / total
             telemetry.record_handoff(m["uid"], m["n"],
                                      int(nbytes * share), dt * share,
-                                     src=src, dst=dst, bound=m["n"])
+                                     src=src, dst=dst, bound=m["n"],
+                                     wire_nbytes=int(wire_nbytes * share))
         return bound
 
     def _count_retry(self):
@@ -172,12 +308,86 @@ class KVPageTransport:
     def stats(self):
         return {"handoffs": self.handoffs,
                 "transfers": self.transfers,
+                "codec": self.codec,
+                "delta_shipping": self.delta_shipping,
                 "pages_shipped": self.pages_shipped,
                 "pages_bound": self.pages_bound,
+                "pages_delta_skipped": self.pages_delta_skipped,
                 "bytes_shipped": self.bytes_shipped,
+                "wire_bytes_shipped": self.wire_bytes_shipped,
+                "wire_bytes_saved": self.wire_bytes_saved,
+                "crc_failures": self.crc_failures,
                 "retry_trips": self.retry_trips,
                 "failed_handoffs": self.failed_handoffs,
                 "total_s": self.total_s}
+
+
+class FlowControl:
+    """Per-(src, dst) in-flight wire-byte budget with router-visible
+    backpressure.
+
+    The in-process fleet ships synchronously, so "in flight" is scoped to
+    one scheduler round: ``open_round`` clears the ledger at the top of
+    ``_flush_handoffs`` (last round's ships have all landed by then),
+    ``admit`` reserves a link's bytes, and a group that would oversubscribe
+    its link DEFERS to the next round (the fleet re-queues it) instead of
+    stalling the step. A group arriving at an empty link window always
+    admits even when larger than the budget — a mega-handoff must still
+    ship, just alone on its link.
+
+    Deferred bytes are the backpressure signal: ``backpressure_s(src)``
+    converts a source's queued backlog into seconds at the modeled link
+    bandwidth, and the SLO router adds that to its TTFT prediction for the
+    replica (``link_backpressure_s``) — an oversubscribed link queues
+    *visibly* instead of silently blowing admission estimates."""
+
+    def __init__(self, max_inflight_bytes=64 << 20, link_gbps=25.0):
+        self.max_inflight_bytes = int(max_inflight_bytes)
+        self._link_bytes_per_s = float(link_gbps) * 1e9 / 8
+        self._inflight = {}   # (src, dst) -> bytes reserved this round
+        self._queued = {}     # src -> bytes deferred past this round
+        self.deferrals = 0
+        self.peak_inflight_bytes = 0
+
+    def open_round(self):
+        """Start a fresh round window; deferred groups re-admit first (the
+        fleet keeps them at the head of its pending list)."""
+        self._inflight.clear()
+        self._queued.clear()
+
+    def admit(self, src, dst, nbytes):
+        """Reserve ``nbytes`` on the (src, dst) link; False = defer (the
+        reservation is recorded as queued backlog instead)."""
+        nbytes = int(nbytes)
+        cur = self._inflight.get((src, dst), 0)
+        if cur and cur + nbytes > self.max_inflight_bytes:
+            self._queued[src] = self._queued.get(src, 0) + nbytes
+            self.deferrals += 1
+            return False
+        self._inflight[(src, dst)] = cur + nbytes
+        self.peak_inflight_bytes = max(self.peak_inflight_bytes,
+                                       self.inflight_bytes())
+        return True
+
+    def inflight_bytes(self):
+        return sum(self._inflight.values())
+
+    def queued_bytes(self, src=None):
+        if src is None:
+            return sum(self._queued.values())
+        return self._queued.get(src, 0)
+
+    def backpressure_s(self, src=None):
+        """Seconds of queued handoff backlog at the modeled link
+        bandwidth — the TTFT term the SLO router folds in."""
+        return self.queued_bytes(src) / self._link_bytes_per_s
+
+    def stats(self):
+        return {"max_inflight_bytes": self.max_inflight_bytes,
+                "inflight_bytes": self.inflight_bytes(),
+                "queued_bytes": self.queued_bytes(),
+                "deferrals": self.deferrals,
+                "peak_inflight_bytes": self.peak_inflight_bytes}
 
 
 class PrefillDecodeFleet:
@@ -196,7 +406,21 @@ class PrefillDecodeFleet:
             decode pool for the working set of in-flight sequences — a
             handoff that cannot bind anywhere falls back to re-prefill on
             the decode side (bit-exact, but the prefill compute is paid
-            twice; ``handoff_fallbacks`` counts these).
+            twice; ``handoff_fallbacks`` counts these). Decode replicas
+            built from a dict/None config default ``speculative.enabled``
+            ON when the model has a verify forward (bit-exact either way,
+            test-pinned); pass an explicit ``speculative`` key or a config
+            OBJECT to override, or ``speculative_default=False`` to keep
+            plain decode.
+        transport: a configured :class:`KVPageTransport`; default builds
+            one from ``codec`` / ``delta_shipping``.
+        codec / delta_shipping: transport construction shorthand — the
+            serialized wire leg and the digest-exchange delta ship (see
+            :class:`KVPageTransport`).
+        flow: a :class:`FlowControl` bounding per-(src, dst) in-flight
+            handoff bytes; over-budget groups defer a round and surface as
+            ``link_backpressure_s`` in the SLO router's TTFT prediction.
+            None = unbounded (every handoff ships the round it finishes).
         heartbeat_timeout_s: failure-detector window — a replica that
             completes no step for this long is declared dead and its
             in-flight requests re-admit elsewhere.
@@ -205,7 +429,9 @@ class PrefillDecodeFleet:
     def __init__(self, model, params, prefill_replicas=1, decode_replicas=1,
                  tp_size=1, engine_config=None, token_budget=None,
                  decode_engine_config=None, decode_token_budget=None,
-                 transport=None, heartbeat_timeout_s=30.0):
+                 transport=None, codec="device", delta_shipping=False,
+                 flow=None, speculative_default=True,
+                 heartbeat_timeout_s=30.0):
         devices = jax.devices()
         need = (prefill_replicas + decode_replicas) * tp_size
         if need > len(devices):
@@ -225,15 +451,20 @@ class PrefillDecodeFleet:
             self.prefill.append((mesh, sched))
             self.lifecycle.add(("prefill", i))
         off = prefill_replicas * tp_size
+        decode_cfg = decode_engine_config or engine_config
+        if speculative_default:
+            decode_cfg = self._with_speculative_default(decode_cfg, model)
         self.decode = []
         for j in range(decode_replicas):
             sub = devices[off + j * tp_size:off + (j + 1) * tp_size]
             self.decode.append(build_replica(
                 model, params, sub, tp_size=tp_size,
-                engine_config=decode_engine_config or engine_config,
+                engine_config=decode_cfg,
                 token_budget=decode_token_budget or token_budget))
             self.lifecycle.add(("decode", j))
-        self.transport = transport or KVPageTransport()
+        self.transport = transport or KVPageTransport(
+            codec=codec, delta_shipping=delta_shipping)
+        self.flow = flow
         self._meta = {}   # uid -> decode-leg params (limits, sampling, seed)
         self._route = {}  # uid -> ("prefill" | "decode" | "done", index)
         self._pending_ships = []  # (prefill index, request) awaiting handoff
@@ -242,7 +473,7 @@ class PrefillDecodeFleet:
         # in the warm pool and revive (at a NEW lifecycle key) compile-free
         self._model, self._params = model, params
         self._tp = tp_size
-        self._decode_cfg = decode_engine_config or engine_config
+        self._decode_cfg = decode_cfg
         self._decode_budget = decode_token_budget or token_budget
         self._devices = devices
         self._next_device = need
@@ -271,6 +502,27 @@ class PrefillDecodeFleet:
         flightrec.register_collector("fleet/transport", self.transport.stats)
         logger.info(f"PrefillDecodeFleet: {prefill_replicas} prefill + "
                     f"{decode_replicas} decode replicas, tp={tp_size}")
+
+    @staticmethod
+    def _with_speculative_default(cfg, model):
+        """Decode replicas speculate by default: the fleet's decode side is
+        pure decode rows, exactly where draft-then-verify pays, and
+        generation is bit-exact either way (test-pinned through the
+        handoff). Only dict/None configs are touched — an explicit config
+        OBJECT is the operator's word — an explicit ``speculative`` key
+        always wins, and models without a verify forward (Mixtral/Falcon/
+        Phi/OPT) keep plain decode."""
+        if not (cfg is None or isinstance(cfg, dict)):
+            return cfg
+        if cfg and "speculative" in cfg:
+            return cfg
+        from deepspeed_tpu.inference.v2.engine_factory import \
+            resolve_verify_fn
+        if resolve_verify_fn(model) is None:
+            return cfg
+        out = dict(cfg or {})
+        out["speculative"] = {"enabled": True}
+        return out
 
     # -- routing surface (SLORouter backend protocol) ----------------------
     def router_targets(self):
@@ -397,7 +649,13 @@ class PrefillDecodeFleet:
         pools). A request that cannot bind anywhere — pools exhausted, or
         the transfer/bind itself failed past retries — falls back to
         re-prefill on the decode side (``_handoff_fallback``) instead of
-        raising through ``fleet.step()``."""
+        raising through ``fleet.step()``. With flow control, a group that
+        would oversubscribe its (src, dst) link's in-flight byte budget
+        DEFERS to the next round (re-queued at the head of
+        ``_pending_ships``) — the deferred bytes surface to the SLO router
+        as ``link_backpressure_s``."""
+        if self.flow is not None:
+            self.flow.open_round()
         if not self._pending_ships:
             return
         pending, self._pending_ships = self._pending_ships, []
@@ -409,6 +667,9 @@ class PrefillDecodeFleet:
             pages = [-(-len(r.prompt) // block) for r in reqs]
             j = self._pick_decode(sum(pages))
             if j is not None:
+                if not self._flow_admit(index, j, sum(pages)):
+                    self._pending_ships.extend((index, r) for r in reqs)
+                    continue
                 self._ship_group(index, reqs, j)
                 continue
             for req, need in zip(reqs, pages):
@@ -420,7 +681,35 @@ class PrefillDecodeFleet:
                         f"re-prefill on the decode side")
                     self._handoff_fallback(index, req, "bind_capacity")
                     continue
+                if not self._flow_admit(index, j, need):
+                    self._pending_ships.append((index, req))
+                    continue
                 self._ship_group(index, [req], j)
+        if self.flow is not None:
+            tm = telemetry.get_telemetry()
+            if tm.enabled:
+                tm.record("fleet/inflight_bytes",
+                          self.flow.inflight_bytes(), kind="gauge")
+
+    def _flow_admit(self, index, j, need_pages):
+        """Reserve a group's estimated wire bytes on the prefill[index] ->
+        decode[j] link (always True without flow control). The estimate is
+        pool-shape math, pre-delta — conservative: a delta-shipped group
+        uses less of the window than it reserved."""
+        if self.flow is None:
+            return True
+        est = need_pages * self.transport.page_wire_cost(
+            self.prefill[index][1].engine)
+        return self.flow.admit(f"prefill{index}", f"decode{j}", est)
+
+    def link_backpressure_s(self, index):
+        """Seconds of deferred handoff backlog queued on prefill
+        ``index``'s outbound links — the flow-control term the SLO router
+        adds to its TTFT prediction for that replica. 0.0 without flow
+        control (nothing ever queues)."""
+        if self.flow is None:
+            return 0.0
+        return self.flow.backpressure_s(f"prefill{index}")
 
     def _ship_group(self, index, reqs, j):
         """One transfer prefill[index] -> decode[j] covering ``reqs``,
@@ -894,6 +1183,7 @@ class PrefillDecodeFleet:
                             "kv_occupancy":
                                 sched.kv_stats()["occupancy"]})
         rep = {"replicas": per, "transport": self.transport.stats(),
+               "flow": self.flow.stats() if self.flow is not None else None,
                "lifecycle": self.lifecycle.counts(),
                "elasticity": {"replica_losses": self.replica_losses,
                               "readmitted": self.readmitted,

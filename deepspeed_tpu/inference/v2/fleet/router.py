@@ -138,6 +138,13 @@ class SLORouter:
         ttft = rounds * self._step_seconds()
         if t.kv_stats()["occupancy"] >= self._occ_high:
             ttft *= self._occ_penalty
+        # KV-fabric flow control: handoff bytes queued on this replica's
+        # outbound links add wire seconds the backlog model can't see — an
+        # oversubscribed link pushes placements elsewhere instead of
+        # silently inflating TTFT after admission
+        bp = getattr(self._backend, "link_backpressure_s", None)
+        if bp is not None:
+            ttft += bp(index)
         return ttft
 
     def _place(self, prompt):

@@ -1,0 +1,281 @@
+"""KV page wire format: the serialized DCN leg of the prefill->decode fabric.
+
+The PR-13 quantized page layout IS the wire format (ZeRO-Inference's "one
+lifecycle" principle: the storage encoding doubles as the transport
+encoding): int8 pools ship their ``(int8 data, fp32 per-token scale)`` pages
+byte-for-byte — a lossless roundtrip, so greedy parity across a process
+boundary is bit-exact. fp16/fp32/bf16 pools quantize *at the wire* with the
+PR-7 ``block_quantize`` kernel (one group per token row over head_dim,
+matching the int8 pool layout) for the same ~4x DCN saving; that leg is
+lossy by design and documented as such — parity-pinned paths run int8 pools.
+
+Frame layout (little-endian)::
+
+    MAGIC "DSKV" | version u16 | flags u16 | meta_len u32 | meta JSON | pages
+
+``meta`` carries the page geometry, per-sequence adoption metadata (uid,
+seen_tokens, tokens, delta-ship ``skipped_digests`` as hex), and one CRC32
+per page. The payload is page-major — page *j* is the concatenation of its
+K data, V data (and K/V scale rows when present) — so a flipped byte is
+localized to one page and surfaces as a typed :class:`WireCRCError` (the
+transport's retryable fault), while a version skew raises
+:class:`WireVersionError` (deterministic reject, never retried).
+
+Only the ``n`` real page rows ship — the pow2 transfer-bucket padding is a
+compile-caching artifact, not payload; ``decode_frame`` re-pads so the
+destination's scatter still compiles once per bucket.
+"""
+
+import json
+import struct
+import zlib
+
+import numpy as np
+
+MAGIC = b"DSKV"
+VERSION = 1
+
+_FLAG_QUANTIZED = 1       # pool pages are int8 + fp32 scales (as-is wire)
+_FLAG_WIRE_QUANTIZED = 2  # fp pool quantized at the wire (lossy leg)
+
+_HEADER = struct.Struct("<4sHHI")
+
+
+class WireError(RuntimeError):
+    """Base class for wire-format failures."""
+
+
+class WireVersionError(WireError):
+    """Header rejected: bad magic or a version this build doesn't speak.
+    Deterministic — retrying the same frame cannot help."""
+
+
+class WireCRCError(WireError):
+    """A page's CRC32 didn't match: bytes corrupted in flight. Retryable —
+    the source re-serializes from its (still intact) pool gather."""
+
+    def __init__(self, page, detail=""):
+        super().__init__(f"CRC mismatch on wire page {page}{detail}")
+        self.page = page
+
+
+def _np_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency: bf16 et al as numpy dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _split(x):
+    return x if isinstance(x, tuple) else (x, None)
+
+
+def _land(arr, fetch, what):
+    if fetch is not None:
+        return np.asarray(fetch(arr, what))
+    import jax
+    return np.asarray(jax.device_get(arr))  # graftlint: allow[GL003] unwired fallback; the transport injects the engine's accounted host_fetch
+
+
+def _page_major(a, n):
+    """[L, B, ...] -> contiguous [n, L, ...] (drop bucket padding)."""
+    return np.ascontiguousarray(np.moveaxis(np.asarray(a)[:, :n], 1, 0))
+
+
+def _pad_rows(a, bucket):
+    """Pad pool-major [L, n, ...] to [L, bucket, ...] with zero rows."""
+    n = a.shape[1]
+    if bucket > n:
+        pad = np.zeros((a.shape[0], bucket - n) + a.shape[2:], a.dtype)
+        a = np.concatenate([a, pad], axis=1)
+    return a
+
+
+def _pool_major(a, bucket):
+    """[n, L, ...] -> [L, bucket, ...], zero rows past n (trash padding)."""
+    return _pad_rows(np.moveaxis(a, 0, 1), bucket)
+
+
+def _bucket(n):
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _quantize_pages(data):
+    """fp pages [L, n, H, bs, hd] -> (int8 [same], fp32 scale
+    [L, n, H, 1, bs]) via the PR-7 wire producer — one group per token row
+    over head_dim, the exact int8-pool scale layout."""
+    from deepspeed_tpu.ops.pallas.quant_collective import block_quantize
+    L, n, H, bs, hd = data.shape
+    rows = np.asarray(data, np.float32).reshape(L * n * H * bs, hd)
+    q, scale = block_quantize(rows, num_bits=8, group_size=hd)
+    q = np.asarray(q).reshape(L, n, H, bs, hd)
+    scale = np.asarray(scale).reshape(L, n, H, bs, 1)
+    return q, np.ascontiguousarray(np.moveaxis(scale, 4, 3))  # -> [.,1,bs]
+
+
+def _dequantize_pages(q, scale, dtype):
+    """Inverse of ``_quantize_pages`` (per-row symmetric dequant)."""
+    from deepspeed_tpu.ops.pallas.quant_collective import block_dequantize
+    L, n, H, bs, hd = q.shape
+    rows = np.asarray(q).reshape(L * n * H * bs, hd)
+    s = np.moveaxis(scale, 3, 4).reshape(L * n * H * bs, 1)
+    out = np.asarray(block_dequantize(rows, s, num_bits=8, group_size=hd,
+                                      out_len=hd, dtype=np.float32))
+    return out.reshape(L, n, H, bs, hd).astype(_np_dtype(dtype))
+
+
+def encode_handle(handle, fetch=None, wire_quantize=True):
+    """Serialize an ``export_sequences_pages`` handle into one wire frame.
+
+    ``fetch(arr, what) -> numpy`` is the engine's accounted device->host
+    fetch (every landing is a real DCN-bound copy and must show up in the
+    host-sync ledger). int8 pools serialize as-is; fp pools quantize at the
+    wire when ``wire_quantize`` (lossy) else ship raw page bytes."""
+    n = int(handle["n"])
+    k_data, k_scale = _split(handle["k"])
+    v_data, v_scale = _split(handle["v"])
+    quantized = k_scale is not None
+    kd = _page_major(_land(k_data, fetch, "fleet/wire_encode"), n)
+    vd = _page_major(_land(v_data, fetch, "fleet/wire_encode"), n)
+    if quantized:
+        ks = _page_major(_land(k_scale, fetch, "fleet/wire_encode"), n)
+        vs = _page_major(_land(v_scale, fetch, "fleet/wire_encode"), n)
+        wire_quantized = False
+    elif wire_quantize and n:
+        (kd, ks), (vd, vs) = (
+            _quantize_pages(np.moveaxis(kd, 0, 1)),
+            _quantize_pages(np.moveaxis(vd, 0, 1)))
+        kd, vd = _page_major(kd, n), _page_major(vd, n)
+        ks, vs = _page_major(ks, n), _page_major(vs, n)
+        wire_quantized = True
+    else:
+        ks = vs = None
+        wire_quantized = False
+    parts = [p for p in (kd, vd, ks, vs) if p is not None]
+    pages, crcs = [], []
+    for j in range(n):
+        raw = b"".join(p[j].tobytes() for p in parts)
+        pages.append(raw)
+        crcs.append(zlib.crc32(raw))
+    seqs = []
+    for m in handle["seqs"]:
+        e = {"uid": m["uid"], "n": int(m["n"]),
+             "seen_tokens": int(m["seen_tokens"]),
+             "tokens": [int(t) for t in m.get("tokens", [])]}
+        if m.get("skipped"):
+            e["skipped"] = int(m["skipped"])
+            e["skipped_digests"] = [d.hex() for d in m["skipped_digests"]]
+        seqs.append(e)
+    geom = {p: list(arr.shape[1:]) for p, arr in
+            zip(("k", "v", "ks", "vs"), (kd, vd, ks, vs)) if arr is not None}
+    meta = {"n": n, "geom": geom,
+            "dtypes": {p: str(arr.dtype) for p, arr in
+                       zip(("k", "v", "ks", "vs"), (kd, vd, ks, vs))
+                       if arr is not None},
+            "quantized": quantized, "wire_quantized": wire_quantized,
+            "page_nbytes": len(pages[0]) if pages else 0,
+            "crcs": crcs, "seqs": seqs}
+    mb = json.dumps(meta).encode()
+    flags = (_FLAG_QUANTIZED if quantized else 0) \
+        | (_FLAG_WIRE_QUANTIZED if wire_quantized else 0)
+    return _HEADER.pack(MAGIC, VERSION, flags, len(mb)) + mb + b"".join(pages)
+
+
+def decode_frame(frame):
+    """Parse + CRC-verify a wire frame back into an import handle.
+
+    Raises :class:`WireVersionError` on magic/version skew (before touching
+    any payload byte) and :class:`WireCRCError` on the first corrupt page.
+    Returns ``{"n", "k", "v", "seqs", "wire_nbytes"}`` with numpy page
+    arrays re-padded to the pow2 transfer bucket (wire-quantized fp pages
+    come back dequantized — that leg is lossy by design)."""
+    if len(frame) < _HEADER.size:
+        raise WireVersionError(f"frame too short ({len(frame)} bytes)")
+    magic, version, flags, meta_len = _HEADER.unpack_from(frame)
+    if magic != MAGIC:
+        raise WireVersionError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise WireVersionError(f"wire version {version}, expected {VERSION}")
+    meta = json.loads(frame[_HEADER.size:_HEADER.size + meta_len])
+    n, pn = int(meta["n"]), int(meta["page_nbytes"])
+    body = frame[_HEADER.size + meta_len:]
+    pages = []
+    for j in range(n):
+        raw = body[j * pn:(j + 1) * pn]
+        if len(raw) < pn:
+            raise WireCRCError(j, " (truncated frame)")
+        if zlib.crc32(raw) != meta["crcs"][j]:
+            raise WireCRCError(j)
+        pages.append(raw)
+    parts, off = {}, 0
+    for name in meta["geom"]:  # insertion order == serialization order
+        shape = tuple(meta["geom"][name])
+        dt = _np_dtype(meta["dtypes"][name])
+        nb = int(np.prod(shape)) * dt.itemsize
+        arr = np.zeros((n,) + shape, dt)
+        for j, raw in enumerate(pages):
+            arr[j] = np.frombuffer(raw[off:off + nb], dt).reshape(shape)
+        parts[name] = arr
+        off += nb
+    bucket = _bucket(max(n, 1))
+    if meta["wire_quantized"]:
+        # dequant to fp32; the destination pool's scatter casts to its dtype
+        k = _pad_rows(_dequantize_pages(
+            np.moveaxis(parts["k"], 0, 1),
+            np.moveaxis(parts["ks"], 0, 1), "float32"), bucket)
+        v = _pad_rows(_dequantize_pages(
+            np.moveaxis(parts["v"], 0, 1),
+            np.moveaxis(parts["vs"], 0, 1), "float32"), bucket)
+    elif meta["quantized"]:
+        k = (_pool_major(parts["k"], bucket), _pool_major(parts["ks"], bucket))
+        v = (_pool_major(parts["v"], bucket), _pool_major(parts["vs"], bucket))
+    else:
+        k = _pool_major(parts["k"], bucket)
+        v = _pool_major(parts["v"], bucket)
+    seqs = []
+    for e in meta["seqs"]:
+        m = {"uid": e["uid"], "n": int(e["n"]),
+             "seen_tokens": int(e["seen_tokens"]), "tokens": e["tokens"]}
+        if e.get("skipped"):
+            m["skipped"] = int(e["skipped"])
+            m["skipped_digests"] = [bytes.fromhex(d)
+                                    for d in e["skipped_digests"]]
+        seqs.append(m)
+    return {"n": n, "k": k, "v": v, "seqs": seqs, "wire_nbytes": len(frame)}
+
+
+def corrupt(frame, offset=-1):
+    """Flip one payload byte (fault injection / tests). ``offset`` indexes
+    from the end so the default lands in page bytes, not the header."""
+    b = bytearray(frame)
+    b[offset] ^= 0xFF
+    return bytes(b)
+
+
+# -- wire accounting (true DCN bytes, not device page bytes) ----------------
+def page_wire_nbytes(k, v):
+    """Per-page WIRE bytes of an exported page group: data + scale bytes
+    for one block row, regardless of the pow2 bucket padding."""
+    total = 0
+    for part in (k, v):
+        data, scale = _split(part)
+        bucket = int(np.asarray(data).shape[1])
+        total += int(np.asarray(data).nbytes) // bucket
+        if scale is not None:
+            total += int(np.asarray(scale).nbytes) // bucket
+    return total
+
+
+def page_fp32_nbytes(k, v):
+    """Per-page bytes the same geometry would cost at fp32 — the ratio
+    denominator for the ``wire bytes <= 0.3x fp32`` ratchet."""
+    total = 0
+    for part in (k, v):
+        data, _ = _split(part)
+        shape = np.asarray(data).shape  # [L, B, H, bs, hd]
+        total += 4 * int(np.prod(shape)) // int(shape[1])
+    return total

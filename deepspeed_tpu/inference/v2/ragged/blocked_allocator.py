@@ -23,7 +23,18 @@ states:
     ``total``: ``free + live + cached + host == num_blocks + host`` always
     (device side, ``free + live + cached == num_blocks``, stays a hard
     invariant; ``counts`` exposes all the terms and the property test pins
-    them).
+    them)
+
+and, when an NVMe store is bound (``bind_nvme``), a fifth:
+
+  * **nvme**   — demoted from the host tier to disk (ZeRO-Infinity's NVMe
+    rung, the 1M-token regime): when a spill finds the host tier full, the
+    *oldest* host payload is written through the store and its handle moves
+    tiers; the handle itself stays valid and ``restore`` reads it back
+    transparently. The census total grows by both off-device tiers
+    (``free + live + cached + host + nvme == num_blocks + host + nvme``)
+    and the swap identity extends to
+    ``spilled == restored + dropped + host + nvme``.
 
 A spill handle is single-shot: ``restore`` consumes it, and a second restore
 (or any restore of a dropped handle) raises — swapped-out refs cannot be
@@ -54,6 +65,12 @@ class BlockedAllocator:
         self._host_spills = 0    # cumulative blocks spilled (swapped out)
         self._host_restores = 0  # cumulative blocks restored (swapped in)
         self._host_drops = 0     # cumulative records invalidated unread
+        # NVMe tier (bind_nvme): handle -> store key. Handles share the host
+        # namespace — a record is in _host XOR _nvme, never both.
+        self._nvme_store = None
+        self._nvme_capacity = 0
+        self._nvme = {}
+        self._nvme_demotions = 0  # cumulative host -> NVMe writes
 
     def bind_cache(self, cache):
         """Attach a prefix cache: refcount-0 blocks it recognises are parked
@@ -86,15 +103,26 @@ class BlockedAllocator:
     def host_capacity(self) -> int:
         return self._host_capacity
 
+    @property
+    def nvme_blocks(self) -> int:
+        """Blocks currently resident in the NVMe spill tier."""
+        return len(self._nvme)
+
+    @property
+    def nvme_capacity(self) -> int:
+        return self._nvme_capacity
+
     def counts(self):
         """State census for the allocator invariant: device side
         ``free + live + cached == num_blocks`` is hard, and with the spill
-        tier ``free + live + cached + host == total`` where ``total`` grows
-        by the host-resident count (host blocks hold no device id)."""
+        tiers ``free + live + cached + host + nvme == total`` where ``total``
+        grows by the off-device resident counts (spilled blocks hold no
+        device id)."""
         host = len(self._host)
+        nvme = len(self._nvme)
         return {"free": len(self._free), "live": self.live_blocks,
-                "cached": self._parked, "host": host,
-                "total": self._num_blocks + host}
+                "cached": self._parked, "host": host, "nvme": nvme,
+                "total": self._num_blocks + host + nvme}
 
     def refcount(self, block: int) -> int:
         return self._refs[block]
@@ -168,23 +196,49 @@ class BlockedAllocator:
             self._parked -= 1
             self._release_one(b)
 
-    # -- host-DRAM spill tier ----------------------------------------------
+    # -- host-DRAM + NVMe spill tiers ---------------------------------------
+    def bind_nvme(self, store, capacity: int):
+        """Attach an NVMe store (``write(payload) -> key``, ``read(key) ->
+        payload``, ``drop(key)``) holding up to ``capacity`` demoted blocks.
+        When a spill finds the host tier full, the oldest host payload is
+        written through the store and its handle moves tiers — extending the
+        pressure order to spill -> NVMe -> evict -> preempt."""
+        if capacity < 1:
+            raise ValueError(f"nvme capacity must be >= 1, got {capacity}")
+        self._nvme_store = store
+        self._nvme_capacity = int(capacity)
+
+    def _can_demote(self) -> bool:
+        return (self._nvme_store is not None and self._host
+                and len(self._nvme) < self._nvme_capacity)
+
     def can_spill(self) -> bool:
-        """Room left in the host tier? (Full tier -> callers fall back to
-        plain eviction; records are never silently dropped, which keeps the
-        swap accounting identity ``spills == restores + resident`` exact.)"""
-        return len(self._host) < self._host_capacity
+        """Room left in the spill tiers? True when the host tier has a slot
+        or demoting its oldest payload to NVMe would open one. (Full tiers ->
+        callers fall back to plain eviction; records are never silently
+        dropped, which keeps the swap accounting identity
+        ``spills == restores + drops + host + nvme`` exact.)"""
+        return len(self._host) < self._host_capacity or self._can_demote()
 
     def spill(self, block: int, payload):
         """Parked (cached, refcount-0) block -> host: store ``payload`` under
         a fresh single-shot handle and return the device id to the free list.
-        Raises on non-parked blocks or a full host tier."""
+        A full host tier first demotes its oldest payload to the NVMe store
+        (when bound and not itself full) — the demoted handle stays valid.
+        Raises on non-parked blocks or when both tiers are full."""
         self._check_range(block)
         if self._refs[block] != 0 or block in self._free_set:
             raise ValueError(f"spill of non-parked block {block}")
-        if not self.can_spill():
-            raise ValueError(
-                f"host tier full ({len(self._host)}/{self._host_capacity})")
+        if len(self._host) >= self._host_capacity:
+            if not self._can_demote():
+                raise ValueError(
+                    f"host tier full ({len(self._host)}/"
+                    f"{self._host_capacity}), nvme "
+                    f"{len(self._nvme)}/{self._nvme_capacity}")
+            # demote the oldest host record (dict preserves insertion order)
+            old = next(iter(self._host))
+            self._nvme[old] = self._nvme_store.write(self._host.pop(old))
+            self._nvme_demotions += 1
         self._parked -= 1
         self._release_one(block)
         ref = self._next_host_ref
@@ -194,31 +248,47 @@ class BlockedAllocator:
         return ref
 
     def restore(self, ref: int):
-        """Consume a spill handle and return its payload. The caller
-        allocates a fresh device block and rebinds the contents; the handle
-        is dead afterwards (no resurrection of swapped-out refs)."""
-        if ref not in self._host:
-            raise ValueError(f"restore of non-host record {ref}")
-        self._host_restores += 1
-        return self._host.pop(ref)
+        """Consume a spill handle and return its payload — read back through
+        the NVMe store when the record was demoted. The caller allocates a
+        fresh device block and rebinds the contents; the handle is dead
+        afterwards (no resurrection of swapped-out refs)."""
+        if ref in self._host:
+            self._host_restores += 1
+            return self._host.pop(ref)
+        if ref in self._nvme:
+            key = self._nvme.pop(ref)
+            payload = self._nvme_store.read(key)
+            self._nvme_store.drop(key)
+            self._host_restores += 1
+            return payload
+        raise ValueError(f"restore of non-host record {ref}")
 
     def drop_host(self, ref: int):
-        """Discard a host record without restoring it (cache invalidation —
-        e.g. the owning prefix cache is flushed)."""
-        if ref not in self._host:
+        """Discard a host or NVMe record without restoring it (cache
+        invalidation — e.g. the owning prefix cache is flushed)."""
+        if ref in self._host:
+            self._host_drops += 1
+            del self._host[ref]
+        elif ref in self._nvme:
+            self._nvme_store.drop(self._nvme.pop(ref))
+            self._host_drops += 1
+        else:
             raise ValueError(f"drop of non-host record {ref}")
-        self._host_drops += 1
-        del self._host[ref]
 
     def host_swap_stats(self):
         """Cumulative spill/restore/drop counters;
-        ``spilled == restored + dropped + resident`` always (the swap
-        accounting identity the perf gate checks)."""
+        ``spilled == restored + dropped + resident + nvme_resident`` always
+        (the swap accounting identity the perf gate checks — a spilled
+        record is either consumed, invalidated, or still parked in one of
+        the two off-device tiers)."""
         return {"spilled": self._host_spills,
                 "restored": self._host_restores,
                 "dropped": self._host_drops,
                 "resident": len(self._host),
-                "capacity": self._host_capacity}
+                "capacity": self._host_capacity,
+                "nvme_resident": len(self._nvme),
+                "nvme_capacity": self._nvme_capacity,
+                "nvme_demotions": self._nvme_demotions}
 
     def _release_one(self, b):
         self._free.append(b)

@@ -27,6 +27,11 @@ Storage tiers (the long-context capacity axes):
   prefix hits. All device->host landings route through the injectable
   accounted fetch (``set_host_fetch`` — the engine wires ``host_fetch`` in so
   the host-sync ratchet sees them).
+* an NVMe tier (``nvme_capacity`` blocks) under the host tier — the
+  allocator's fifth state, fed by demotion when the host tier fills: the
+  oldest host payload is persisted through the in-tree ``swap_tensor`` aio
+  path (``NVMeKVStore``) and restores transparently. Pressure order:
+  spill -> NVMe -> evict -> preempt.
 """
 
 import time
@@ -34,7 +39,7 @@ import time
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
-from deepspeed_tpu.runtime.swap_tensor.kv_swapper import HostKVSwapper
+from deepspeed_tpu.runtime.swap_tensor.kv_swapper import HostKVSwapper, _Payload
 
 _DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16, "fp32": jnp.float32}
 
@@ -48,10 +53,33 @@ def split_pages(x):
     return x if isinstance(x, tuple) else (x, None)
 
 
+class _NVMeAdapter:
+    """Bridges the allocator's opaque spill payloads to an ``NVMeKVStore``:
+    a demotion lands a still-pending payload first (the store persists host
+    numpy, never in-flight device arrays), and a read comes back as an
+    already-landed payload so ``restore_block``'s ``land`` is a no-op."""
+
+    def __init__(self, store, swapper):
+        self._store = store
+        self._swapper = swapper
+
+    def write(self, payload):
+        return self._store.write(self._swapper.land(payload))
+
+    def read(self, key):
+        p = _Payload(self._store.read(key))
+        p.landed = True
+        return p
+
+    def drop(self, key):
+        self._store.drop(key)
+
+
 class BlockedKVCache:
 
     def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
-                 head_dim, dtype="bf16", kv_dtype="fp", host_capacity=0):
+                 head_dim, dtype="bf16", kv_dtype="fp", host_capacity=0,
+                 nvme_capacity=0, nvme_dir=None):
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
@@ -77,6 +105,23 @@ class BlockedKVCache:
         self._fetch = None  # injectable accounted device->host fetch
         self._swapper = HostKVSwapper(self._fetch_arrays, buffer_count=2,
                                       land_wrapper=self._timed_land)
+        self._nvme_store = None
+        if nvme_capacity:
+            if not host_capacity:
+                raise ValueError("nvme tier requires a host tier "
+                                 "(pressure order spill -> NVMe)")
+            import tempfile
+            from deepspeed_tpu.runtime.swap_tensor.nvme_kv_store import \
+                NVMeKVStore
+            self._nvme_store = NVMeKVStore(
+                nvme_dir or tempfile.mkdtemp(prefix="ds_tpu_nvme_kv_"))
+            self._allocator.bind_nvme(
+                _NVMeAdapter(self._nvme_store, self._swapper), nvme_capacity)
+
+    @property
+    def nvme_store(self):
+        """Bound ``NVMeKVStore`` (None when the tier is off)."""
+        return self._nvme_store
 
     @property
     def allocator(self) -> BlockedAllocator:

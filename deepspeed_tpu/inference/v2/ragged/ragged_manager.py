@@ -21,7 +21,11 @@ class DSStateManager:
         self.kv_cache = BlockedKVCache(num_layers, num_blocks, kv.block_size,
                                        num_kv_heads, head_dim, kv.cache_dtype,
                                        kv_dtype=sm.kv_dtype,
-                                       host_capacity=sm.host_kv_blocks)
+                                       host_capacity=sm.host_kv_blocks,
+                                       nvme_capacity=getattr(
+                                           sm, "nvme_kv_blocks", 0),
+                                       nvme_dir=getattr(
+                                           sm, "nvme_kv_dir", "") or None)
         # block-granular prefix sharing (config_v2.py prefix_caching knob,
         # default off). None when disabled — every cache-path branch below
         # is a single attribute test, so the disabled path does zero
@@ -151,7 +155,13 @@ class DSStateManager:
                  "host_kv_occupancy": (hs["resident"] / hs["capacity"]
                                        if hs["capacity"] else 0.0),
                  "kv_spilled": hs["spilled"], "kv_restored": hs["restored"],
-                 "kv_dropped": hs["dropped"]}
+                 "kv_dropped": hs["dropped"],
+                 # NVMe tier (fifth allocator state): extends the identity to
+                 # kv_spilled == kv_restored + kv_dropped
+                 #              + host_kv_blocks + nvme_kv_blocks
+                 "nvme_kv_blocks": hs.get("nvme_resident", 0),
+                 "nvme_kv_capacity": hs.get("nvme_capacity", 0),
+                 "nvme_kv_demotions": hs.get("nvme_demotions", 0)}
         if self.prefix_cache is not None:
             stats.update(self.prefix_cache.stats())
         return stats
@@ -180,6 +190,9 @@ class DSStateManager:
             if stats["host_kv_capacity"]:
                 tm.serving_gauge("serving/host_kv_blocks",
                                  stats["host_kv_blocks"], point=point)
+            if stats["nvme_kv_capacity"]:
+                tm.serving_gauge("serving/nvme_kv_blocks",
+                                 stats["nvme_kv_blocks"], point=point)
         return stats
 
     def get_sequence(self, uid):
@@ -301,6 +314,39 @@ class DSStateManager:
             self.kv_cache.free(seq.kv_blocks)
 
     # -- page transfer (prefill/decode disaggregation) ---------------------
+    def sequence_block_digests(self, uids):
+        """Full-block chain digests for the given tracked sequences — what a
+        delta-shipping transport exchanges with the destination before
+        exporting, so blocks the destination's prefix cache already holds
+        never cross the wire. Requires prefix caching (token streams are
+        only tracked then); returns ``{}`` when disabled. Untracked uids are
+        silently skipped (the transport treats them as nothing-to-skip)."""
+        if self.prefix_cache is None:
+            return {}
+        bs = self.kv_block_size
+        out = {}
+        for uid in uids:
+            seq = self._seqs.get(uid)
+            if seq is None:
+                continue
+            full = min(seq.seen_tokens // bs, len(seq.kv_blocks))
+            parent, chain = b"", []
+            for i in range(full):
+                parent = PrefixCache.chain_digest(
+                    parent, seq.tokens[i * bs:(i + 1) * bs])
+                chain.append(parent)
+            out[uid] = chain
+        return out
+
+    def held_prefix_lens(self, chains):
+        """Per-uid count of leading chain links this pool's prefix cache
+        already holds (device or host/NVMe tier) — the delta-shipping
+        set-difference answered from the destination side."""
+        if self.prefix_cache is None:
+            return {uid: 0 for uid in chains}
+        return {uid: self.prefix_cache.held_prefix_len(chain)
+                for uid, chain in chains.items()}
+
     def export_sequence_pages(self, uid):
         """Detach ``uid``'s KV pages for shipping to another engine's pool
         (single-sequence form of ``export_sequences_pages``). Returns a
@@ -310,7 +356,7 @@ class DSStateManager:
         return {"n": m["n"], "k": h["k"], "v": h["v"],
                 "seen_tokens": m["seen_tokens"], "tokens": m["tokens"]}
 
-    def export_sequences_pages(self, uids):
+    def export_sequences_pages(self, uids, skip=None):
         """Batched export: EVERY listed sequence's page rows leave in ONE
         device gather (``export_blocks`` over the concatenated block lists)
         — the fleet ships a whole round's finished prefills as one
@@ -319,7 +365,12 @@ class DSStateManager:
         — with prefix caching on, full blocks are donated to the cache
         first, so a prefill replica keeps serving warm prefixes after the
         handoff. Returns a handle for ``import_sequences_pages`` whose
-        ``seqs`` list preserves submission order."""
+        ``seqs`` list preserves submission order.
+
+        ``skip`` (delta-shipping): ``{uid: k}`` leading full blocks the
+        DESTINATION's prefix cache already holds — those rows are excluded
+        from the gather and ride as ``skipped_digests`` instead, for the
+        importer to re-acquire locally. Requires prefix caching."""
         for uid in uids:  # validate everything before mutating anything
             seq = self._seqs.get(uid)
             if seq is None:
@@ -327,14 +378,30 @@ class DSStateManager:
             if seq.is_swapped:
                 raise ValueError(f"cannot export swapped sequence {uid}")
             assert seq.in_flight_tokens == 0, "cannot export mid-forward"
+        if skip and self.prefix_cache is None:
+            raise ValueError("delta export requires prefix caching")
+        bs = self.kv_block_size
         blocks, seqs, popped = [], [], []
         for uid in uids:
             seq = self._seqs.pop(uid)
             popped.append(seq)
-            seqs.append({"uid": uid, "n": len(seq.kv_blocks),
-                         "seen_tokens": seq.seen_tokens,
-                         "tokens": list(seq.tokens)})
-            blocks.extend(seq.kv_blocks)
+            hold = 0
+            if skip:
+                hold = min(int(skip.get(uid, 0)), seq.seen_tokens // bs,
+                           len(seq.kv_blocks))
+            m = {"uid": uid, "n": len(seq.kv_blocks) - hold,
+                 "seen_tokens": seq.seen_tokens,
+                 "tokens": list(seq.tokens)}
+            if hold:
+                parent, digs = b"", []
+                for i in range(hold):
+                    parent = PrefixCache.chain_digest(
+                        parent, seq.tokens[i * bs:(i + 1) * bs])
+                    digs.append(parent)
+                m["skipped"] = hold
+                m["skipped_digests"] = digs
+            seqs.append(m)
+            blocks.extend(seq.kv_blocks[hold:])
         # one gather for the whole group — it COPIES, so the ids can be
         # freed/donated immediately after
         k, v = self.kv_cache.export_blocks(blocks)
@@ -368,24 +435,56 @@ class DSStateManager:
         for m in handle["seqs"]:
             if m["uid"] in self._seqs:
                 raise ValueError(f"uid {m['uid']} already tracked")
-        ids = list(self.kv_cache.import_blocks(
-            handle["k"], handle["v"], int(handle["n"])))
+        # delta-shipping: re-acquire skipped prefix blocks from the LOCAL
+        # prefix cache first — a miss (evicted between the digest exchange
+        # and the ship) aborts before anything binds, and the transport's
+        # bind-failure path re-prefills the request
+        prefix_ids, prefix_digs, acquired = {}, {}, []
+        try:
+            for m in handle["seqs"]:
+                hold = int(m.get("skipped", 0))
+                if not hold:
+                    continue
+                if self.prefix_cache is None:
+                    raise ValueError("delta shipment without a prefix cache")
+                digs = [bytes.fromhex(d) if isinstance(d, str) else d
+                        for d in m["skipped_digests"]]
+                got = self.prefix_cache.acquire_known(digs)
+                acquired.extend(got)
+                if len(got) < hold:
+                    raise ValueError(
+                        f"delta bind miss for {m['uid']}: "
+                        f"held {len(got)}/{hold} skipped blocks")
+                prefix_ids[m["uid"]] = got
+                prefix_digs[m["uid"]] = digs
+            ids = list(self.kv_cache.import_blocks(
+                handle["k"], handle["v"], int(handle["n"])))
+        except Exception:
+            if acquired:
+                self.kv_cache.free(acquired)
+            raise
         off, created = 0, []
         try:
             for m in handle["seqs"]:
                 seq = self.get_or_create_sequence(m["uid"])
                 created.append(m["uid"])
-                seq.kv_blocks = ids[off:off + int(m["n"])]
+                seq.kv_blocks = prefix_ids.get(m["uid"], []) \
+                    + ids[off:off + int(m["n"])]
                 off += int(m["n"])
                 seq.seen_tokens = int(m["seen_tokens"])
                 if self.prefix_cache is not None:
                     seq.tokens = [int(t) for t in m["tokens"]]
+                    # skipped blocks are already-registered cache entries;
+                    # seed their digests so commit starts past them
+                    seq.digests = list(prefix_digs.get(m["uid"], []))
         except Exception:
             for uid in created:
                 self._seqs.pop(uid, None)
             self.kv_cache.free(ids)
+            if acquired:
+                self.kv_cache.free(acquired)
             raise
-        return len(ids)
+        return len(ids) + len(acquired)
 
     # -- host swap tier (ZeRO-Inference KV offload analog) -----------------
     def swap_out_sequence(self, uid):

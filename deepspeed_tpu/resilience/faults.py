@@ -62,13 +62,19 @@ import time
 #:                  handoff transfer is retried (retry_call); exhaustion
 #:                  surfaces as HandoffError and the request re-prefills
 #:                  on the decode side
+#: ``transport.corrupt`` KVPageTransport wire codec, between serialize and
+#:                  parse — the raise is converted into a flipped payload
+#:                  byte, so the per-page CRC32 check detects it
+#:                  (WireCRCError) and the wire leg re-serializes from the
+#:                  still-resident export; exhaustion falls back like
+#:                  transport.drop
 #: ``handoff.bind_fail`` KVPageTransport, before the destination allocator
 #:                  bind — pages already left the source, so no retry:
 #:                  straight to the re-prefill fallback
 KNOWN_POINTS = ("ckpt.write", "ckpt.publish", "comm.collective",
                 "comm.partition", "io.host", "step.hang", "slice.lost",
                 "worker.exit", "replica.lost", "replica.stall",
-                "transport.drop", "handoff.bind_fail")
+                "transport.drop", "transport.corrupt", "handoff.bind_fail")
 
 #: points the elastic reshard path interprets as "a slice is gone" —
 #: an :class:`InjectedFault` from any of these is translated into a

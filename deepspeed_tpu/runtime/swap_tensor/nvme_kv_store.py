@@ -1,0 +1,88 @@
+"""NVMe KV-block store: the disk rung under the host-DRAM spill tier.
+
+ZeRO-Infinity's NVMe offload applied to inference KV (the 1M-token regime):
+when the ``BlockedAllocator`` host tier fills, its oldest payload is demoted
+through this store — each payload (the tuple of per-block page arrays the
+``HostKVSwapper`` landed) is written file-per-array through the in-tree
+``swap_tensor`` aio path (:class:`AsyncTensorSwapper` over
+``ops.aio.AsyncIOHandle``, which degrades to a thread-pool fallback when the
+native library isn't built). Reads rebuild the exact numpy tuple; dtype and
+shape ride in a host-side record, never on disk.
+
+Keys are single-shot like allocator spill handles: ``read`` does not drop
+(the allocator drops after a successful read so a failed read can't leak the
+record), ``drop`` removes the backing files.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+
+
+class NVMeKVStore:
+
+    def __init__(self, swap_dir, aio_config=None, buffer_count=4):
+        self._swapper = AsyncTensorSwapper(swap_dir, aio_config=aio_config,
+                                           buffer_count=buffer_count)
+        self._meta = {}   # key -> [(shape, dtype), ...] per array of the tuple
+        self._next = 0
+        self.writes = 0
+        self.reads = 0
+        self.drops = 0
+        self.bytes_written = 0
+        self.bytes_read = 0
+
+    @property
+    def swap_dir(self):
+        return self._swapper.swap_dir
+
+    @property
+    def resident(self) -> int:
+        return len(self._meta)
+
+    def _part(self, key, i):
+        return f"{key}-{i}"
+
+    def write(self, arrays):
+        """Persist a tuple/list of numpy arrays; returns the store key."""
+        key = f"kvblk{self._next}"
+        self._next += 1
+        arrays = tuple(np.asarray(a) for a in arrays)
+        for i, a in enumerate(arrays):
+            self._swapper.swap_out(self._part(key, i), a, async_op=True)
+            self.bytes_written += int(a.nbytes)
+        # drain before returning: the handle's buffers recycle per call and a
+        # later demotion must never race a still-queued write of this key
+        self._swapper.wait()
+        self._meta[key] = [(a.shape, a.dtype) for a in arrays]
+        self.writes += 1
+        return key
+
+    def read(self, key):
+        """Read the tuple back (preallocated, aio pread per array)."""
+        if key not in self._meta:
+            raise ValueError(f"read of unknown nvme key {key}")
+        out = []
+        for i, (shape, dtype) in enumerate(self._meta[key]):
+            buf = np.empty(shape, dtype=dtype)
+            self._swapper.swap_in(self._part(key, i), buf, async_op=True)
+            out.append(buf)
+            self.bytes_read += int(buf.nbytes)
+        self._swapper.wait()
+        self.reads += 1
+        return tuple(out)
+
+    def drop(self, key):
+        """Remove the backing files and forget the record."""
+        if key not in self._meta:
+            raise ValueError(f"drop of unknown nvme key {key}")
+        for i in range(len(self._meta.pop(key))):
+            self._swapper.release(self._part(key, i))
+        self.drops += 1
+
+    def stats(self):
+        return {"writes": self.writes, "reads": self.reads,
+                "drops": self.drops, "resident": len(self._meta),
+                "bytes_written": self.bytes_written,
+                "bytes_read": self.bytes_read,
+                "wait_seconds": self._swapper.wait_seconds}

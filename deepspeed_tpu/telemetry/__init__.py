@@ -175,10 +175,11 @@ def record_moe_step(exp_counts, total_routed, dropped=0, a2a_wire_bytes=None):
 
 
 def record_handoff(uid, pages, nbytes, seconds, src="prefill", dst="decode",
-                   bound=None):
-    """Record one prefill->decode KV page handoff (bytes/latency/pages)."""
+                   bound=None, wire_nbytes=None):
+    """Record one prefill->decode KV page handoff (bytes/latency/pages;
+    ``wire_nbytes`` = TRUE serialized wire bytes vs device page bytes)."""
     _GLOBAL.record_handoff(uid, pages, nbytes, seconds, src=src, dst=dst,
-                           bound=bound)
+                           bound=bound, wire_nbytes=wire_nbytes)
 
 
 def record_memory(point, stats=None, device_index=0, **tags):

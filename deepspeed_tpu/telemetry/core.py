@@ -324,7 +324,8 @@ class Telemetry:
         # moe stream (expert load / drop / a2a wire gauges)
         self.moe_gauges = {}       # name -> [last, peak]
         self.fleet_handoff = {"count": 0, "pages_shipped": 0,
-                              "pages_bound": 0, "bytes": 0, "total_s": 0.0}
+                              "pages_bound": 0, "bytes": 0,
+                              "wire_bytes": 0, "total_s": 0.0}
         # goodput ledger (seconds per category; idle derived at summary time)
         self.ledger_secs = {c: 0.0 for c in LEDGER_CATEGORIES if c != "idle"}
         self._ledger_epoch = self._epoch
@@ -959,13 +960,19 @@ class Telemetry:
                               "tags": tags or {}})
 
     def record_handoff(self, uid, pages, nbytes, seconds, src="prefill",
-                       dst="decode", bound=None):
+                       dst="decode", bound=None, wire_nbytes=None):
         """One prefill->decode KV page handoff: aggregates pages / bytes /
         latency into ``summary()["fleet"]["handoff"]`` (perf_gate checks
         the accounting identity ``pages_shipped == pages_bound``), records
         a ``fleet/handoff_s`` histogram sample, and drops a "handoff"
         slice on the request's Chrome-trace lane so the shipping cost sits
-        visibly between the prefill and decode phases."""
+        visibly between the prefill and decode phases.
+
+        ``nbytes`` is the device page footprint; ``wire_nbytes`` is what
+        actually crosses (or would cross) the link — serialized int8+scale
+        frame bytes, excluding transfer-bucket padding. They differ whenever
+        pages are quantized, so the fleet payload's wire-vs-fp32 ratio must
+        come from ``wire_bytes``, never ``bytes``."""
         if not self.enabled:
             return
         seconds = float(seconds)
@@ -976,11 +983,16 @@ class Telemetry:
             h["pages_shipped"] += int(pages)
             h["pages_bound"] += int(pages if bound is None else bound)
             h["bytes"] += int(nbytes)
+            h["wire_bytes"] += int(nbytes if wire_nbytes is None
+                                   else wire_nbytes)
             h["total_s"] += seconds
             self._emit_jsonl({"name": "fleet/handoff", "kind": "seconds",
                               "value": seconds,
                               "tags": {"uid": uid, "pages": int(pages),
                                        "bytes": int(nbytes),
+                                       "wire_bytes": int(
+                                           nbytes if wire_nbytes is None
+                                           else wire_nbytes),
                                        "src": src, "dst": dst}})
         self.record_hist("fleet/handoff_s", seconds)
         self.record_request_phase(uid, "handoff", t_end - seconds, seconds,
@@ -1000,6 +1012,7 @@ class Telemetry:
                             "pages_shipped": int(h["pages_shipped"]),
                             "pages_bound": int(h["pages_bound"]),
                             "bytes": int(h["bytes"]),
+                            "wire_bytes": int(h["wire_bytes"]),
                             "total_s": round(h["total_s"], 6)}}
 
     # ------------------------------------------------------------------

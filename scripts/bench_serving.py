@@ -45,6 +45,16 @@ additionally embeds the full telemetry summary in each payload's ``extra``
   (pages shipped == pages bound; bytes; latency), gated by perf_gate's
   fleet checks.
 
+- ``--fleet --two-process`` — KV fabric microbench: a prefix-mix trace
+  runs four legs — monolithic reference, in-process fleet on the
+  serialized ``wire`` codec with delta-shipping OFF then ON (with
+  ``FlowControl``), and a ``TwoProcessFleet`` leg where decode lives in a
+  SEPARATE OS process and every KV page crosses a pipe as a framed,
+  CRC32-checked wire message. The payload reports the int8-wire-to-fp32
+  byte ratio, the delta-shipping savings, CRC failure counts, and greedy
+  parity of every leg against the reference — gated by perf_gate's
+  ``check_kvfabric_baseline``.
+
 - ``--diurnal --chaos [SPEC]`` — elastic-fleet chaos replay: the SLO
   router + prefill/decode fleet + ``FleetAutoscaler`` drive a seeded
   diurnal trace with fault injection armed (a decode replica dies
@@ -57,7 +67,7 @@ Usage: python scripts/bench_serving.py [--replay] [--prefix-mix] [--fleet]
            [--requests N] [--seed S] [--arrival poisson|burst] [--rate R]
            [--burst-size B] [--prompt T] [--new T]
            [--prefix-pools P] [--prefix-len L]
-           [--fleet-prefill N] [--fleet-decode N]
+           [--fleet-prefill N] [--fleet-decode N] [--two-process]
            [--chaos [SPEC]] [--diurnal] [--diurnal-period T]
            [--diurnal-depth D]
 """
@@ -1011,6 +1021,174 @@ def fleet_replay_bench(args, on_tpu):
     return payload
 
 
+def kvfabric_bench(args, on_tpu):
+    """KV fabric microbench (``--fleet --two-process``): a prefix-mix trace
+    (groups of requests sharing long prompt prefixes) runs four legs over
+    int8 KV pools —
+
+    1. monolithic single replica (the greedy parity reference),
+    2. in-process fleet on the serialized ``wire`` codec, delta OFF
+       (the no-delta wire-byte reference),
+    3. same fleet with delta-shipping ON and ``FlowControl`` armed,
+    4. ``TwoProcessFleet``: decode in a separate OS process, every page
+       crossing a pipe as a framed, per-page-CRC32 wire message.
+
+    Headline: serialized wire bytes per page over the fp32 device bytes
+    they replace — the int8+scale wire row must stay under perf_gate's
+    ``KVFABRIC_MAX_WIRE_FP32_RATIO``. The model pins head_dim=32 (2 heads
+    on the tiny 64-wide trunk): the per-row overhead is hd+4 scale bytes
+    over 4*hd fp32, and the ratchet needs hd > 13 to be satisfiable at
+    all. Delta must ship measurably fewer bytes than leg 2, every leg must
+    match leg 1 token-for-token (int8 pools quantize identically on both
+    sides, so the wire is lossless end-to-end), and the two-process leg
+    must complete every request."""
+    import jax
+    import numpy as np
+    from deepspeed_tpu.inference.v2.fleet import (FlowControl,
+                                                  PrefillDecodeFleet)
+    from deepspeed_tpu.inference.v2.fleet.two_process import TwoProcessFleet
+    from deepspeed_tpu.inference.v2.replica_group import build_replica
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=128,
+                      scan_layers=True, remat=False)
+    model = LlamaForCausalLM(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+    eng_cfg = {"state_manager": {"max_ragged_sequence_count": 16,
+                                 "max_ragged_batch_size": 64,
+                                 "max_context": 96,
+                                 "num_kv_blocks": 160,
+                                 "kv_dtype": "int8"},
+               "kv_cache": {"block_size": 8, "cache_dtype": "fp32"},
+               "prefix_caching": True}
+    max_new = 8
+
+    # prefix-mix trace: pools of shared prefixes — the delta leg's savings
+    # come from the decode pool already holding a group's prefix blocks
+    # after its first member ships
+    gen = np.random.default_rng(args.seed)
+    n_pools = 4
+    per_pool = 3
+    prefixes = [gen.integers(1, cfg.vocab_size, 32).astype(np.int32)
+                for _ in range(n_pools)]
+    prompts = {}
+    for g in range(n_pools):
+        for i in range(per_pool):
+            uid = g * per_pool + i
+            suffix = gen.integers(1, cfg.vocab_size,
+                                  4 + uid % 5).astype(np.int32)
+            prompts[uid] = np.concatenate([prefixes[g], suffix])
+
+    def drive(backend):
+        for uid, p in prompts.items():
+            backend.submit(uid, p, max_new_tokens=max_new,
+                           temperature=0.0, seed=7)
+        rounds = 0
+        while backend.has_work:
+            backend.step()
+            rounds += 1
+            if rounds > 4096:
+                raise RuntimeError("kvfabric leg did not converge")
+        return {u: np.asarray(v) for u, v in backend.results().items()}
+
+    # leg 1 — monolithic reference
+    mesh1, sched1 = build_replica(model, params, [jax.devices()[0]],
+                                  engine_config=eng_cfg, token_budget=64)
+
+    class _Single:
+        has_work = property(lambda self: sched1.has_work)
+
+        def submit(self, uid, prompt, **kw):
+            with mesh1:
+                sched1.submit(uid, prompt, **kw)
+
+        def step(self):
+            with mesh1:
+                return sched1.step()
+
+        def results(self):
+            return sched1.results()
+
+    ref = drive(_Single())
+
+    def parity(out):
+        return all(u in out and np.array_equal(ref[u], out[u])
+                   for u in prompts)
+
+    def fleet_leg(**kw):
+        fleet = PrefillDecodeFleet(model, params, prefill_replicas=1,
+                                   decode_replicas=1, engine_config=eng_cfg,
+                                   token_budget=64, codec="wire", **kw)
+        out = drive(fleet)
+        return fleet, out
+
+    # leg 2 — wire codec, delta OFF: the no-delta byte reference
+    f_plain, out_plain = fleet_leg(delta_shipping=False)
+    plain = f_plain.transport.stats()
+    # fp32 equivalent of the SAME page traffic (pure shape math)
+    kc = f_plain.prefill[0][1].engine._state.kv_cache
+    n_layers, _, n_heads, bsz, hd = kc.k_pool.shape
+    fp32_page = 2 * n_layers * n_heads * bsz * hd * 4
+    wire_page = f_plain.transport.page_wire_cost(f_plain.prefill[0][1].engine)
+
+    # leg 3 — delta-shipping ON + flow control
+    flow = FlowControl(max_inflight_bytes=1 << 20)
+    f_delta, out_delta = fleet_leg(delta_shipping=True, flow=flow)
+    delta = f_delta.transport.stats()
+
+    # leg 4 — two-process: decode across a real OS process boundary
+    import dataclasses
+    mc = dataclasses.asdict(cfg)
+    tp = TwoProcessFleet(model, params, mc, engine_config=eng_cfg,
+                         token_budget=64, delta_shipping=True)
+    try:
+        out_tp = drive(tp)
+        tp_stats = tp.stats()
+    finally:
+        tp.close()
+    tp_lost = [u for u in prompts if u not in out_tp or not len(out_tp[u])]
+    tp_stats["lost_requests"] = len(tp_lost)
+
+    ratio = wire_page / fp32_page
+    extra = {
+        "wire_fp32_ratio": round(ratio, 6),
+        "wire_page_bytes": wire_page,
+        "fp32_page_bytes": fp32_page,
+        "head_dim": hd,
+        "nodelta_wire_bytes": plain["wire_bytes_shipped"],
+        "delta_wire_bytes": delta["wire_bytes_shipped"],
+        "wire_bytes_saved": delta["wire_bytes_saved"],
+        "pages_shipped": delta["pages_shipped"],
+        "pages_delta_skipped": delta["pages_delta_skipped"],
+        "crc_failures": plain["crc_failures"] + delta["crc_failures"],
+        "failed_handoffs": plain["failed_handoffs"]
+        + delta["failed_handoffs"],
+        "handoffs": delta["handoffs"],
+        "parity_nodelta": parity(out_plain),
+        "parity_delta": parity(out_delta),
+        "flow": flow.stats(),
+        "two_process": dict(tp_stats, parity=parity(out_tp)),
+        "requests": len(prompts), "prefix_pools": n_pools,
+        "max_new_tokens": max_new, "seed": args.seed,
+        "chips": jax.device_count(),
+        "model": f"llama-{cfg.hidden_size}x{cfg.num_hidden_layers}"
+                 f"-hd{hd}-int8kv",
+    }
+    _embed_telemetry(extra)
+    payload = {
+        "metric": "serving_kvfabric_wire_fp32_ratio",
+        "value": round(ratio, 6),
+        "unit": "serialized wire bytes / fp32 device bytes (lower=better)",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+    bench.emit(payload)
+    return payload
+
+
 #: default chaos spec for --chaos with no argument. Step windows count
 #: fleet rounds; fault hits within a round visit stepping replicas in
 #: (prefill0, prefill1, decode0, ...) order, so with 2 prefill replicas the
@@ -1456,6 +1634,12 @@ def main():
                          "throughput is bounded by live sequences per round, "
                          "not budget, so 1 is usually right until the KV "
                          "working set outgrows one pool")
+    ap.add_argument("--two-process", action="store_true",
+                    help="with --fleet: the KV fabric microbench — wire "
+                         "codec byte ratios, delta-shipping savings, and a "
+                         "leg where decode runs in a SEPARATE OS process "
+                         "with every KV page crossing a pipe as a framed "
+                         "CRC32-checked wire message")
     ap.add_argument("--chaos", nargs="?", const="", default=None,
                     metavar="SPEC",
                     help="elastic-fleet chaos replay: drive the SLO router + "
@@ -1496,7 +1680,9 @@ def main():
                             chrome_trace_path=os.environ.get(
                                 "DS_TPU_TELEMETRY_TRACE", ""))
 
-    metric = ("serving_speculate_tokens_per_sec_multiplier"
+    metric = ("serving_kvfabric_wire_fp32_ratio"
+              if args.fleet and args.two_process
+              else "serving_speculate_tokens_per_sec_multiplier"
               if args.speculate
               else "serving_longctx_concurrent_seqs_per_chip"
               if args.long_context
@@ -1532,6 +1718,14 @@ def main():
                     "extra": extra})
         return
     on_tpu = devs[0].platform in ("tpu", "axon")
+    if args.fleet and args.two_process:
+        try:
+            kvfabric_bench(args, on_tpu)
+        except Exception as e:
+            bench.emit({"metric": metric, "value": 0.0,
+                        "unit": "ratio", "vs_baseline": None,
+                        "extra": {"error": f"{type(e).__name__}: {e}"[:400]}})
+        return
     if args.speculate:
         try:
             speculate_bench(args, on_tpu)

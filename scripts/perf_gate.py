@@ -48,7 +48,14 @@ ordered percentiles for both legs, shed rate in [0, 1], every shipped KV
 page bound) plus the fleet acceptance ratchet — saturation-rate multiplier
 >= 2x the single replica, shed rate <= 0.1, at least one real handoff,
 fleet TTFT p99 no worse than the saturated single replica
-(``check_fleet_baseline``) — and validates the checked-in long-context KV
+(``check_fleet_baseline``) — and validates the checked-in KV-fabric
+baseline (``onchip_results/serving_kvfabric_baseline.json``): serialized
+wire bytes per page <= 0.3x the fp32 device bytes they replace, the delta
+leg shipping measurably fewer bytes than the no-delta leg, zero CRC
+failures, every leg bit-exact against the monolithic reference, and a
+two-process leg (decode in a separate OS process) that completed every
+request (``check_kvfabric_baseline``) — and validates the checked-in
+long-context KV
 tiering baseline (``onchip_results/serving_longctx_baseline.json``):
 payload shape (finite ordered percentiles, host occupancy in [0, 1], the
 swap accounting identity ``swapped_out == swapped_in + swap_dropped +
@@ -597,6 +604,58 @@ def validate_fleet_payload(doc):
                 f"{extra['pages_bound']} — KV handoff leaked pages")
     if extra["handoffs"] < 0:
         return "fleet replay payload: negative handoff count"
+    return None
+
+
+def validate_kvfabric_payload(doc):
+    """Shape-check a bench_serving --fleet --two-process payload: a
+    SUCCESSFUL run (value > 0) must carry a wire-to-fp32 ratio in (0, 1),
+    finite byte/page accounting for the no-delta and delta legs, a
+    two_process sub-dict with its own fabric counters, and parity booleans
+    for every leg. Pure dict checks — runs in the tier-1 dry-run lane
+    without jax. Returns an error string or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "serving_kvfabric" not in str(doc.get("metric", "")):
+        return None
+    try:
+        if float(doc.get("value", 0)) <= 0:
+            return None
+    except (TypeError, ValueError):
+        return None
+    extra = doc.get("extra")
+    if not isinstance(extra, dict):
+        return "kvfabric payload has no extra dict"
+
+    def bad_num(v):
+        return not isinstance(v, (int, float)) or isinstance(v, bool) or \
+            not (v == v and abs(v) != float("inf"))
+    for key in ("wire_fp32_ratio", "wire_page_bytes", "fp32_page_bytes",
+                "nodelta_wire_bytes", "delta_wire_bytes", "wire_bytes_saved",
+                "pages_shipped", "pages_delta_skipped", "crc_failures",
+                "failed_handoffs", "handoffs"):
+        if bad_num(extra.get(key)):
+            return f"kvfabric payload: extra[{key!r}] missing or not " \
+                   f"finite (got {extra.get(key)!r})"
+    if not 0.0 < extra["wire_fp32_ratio"] < 1.0:
+        return "kvfabric payload: wire_fp32_ratio outside (0, 1)"
+    if extra["wire_page_bytes"] * extra["fp32_page_bytes"] <= 0:
+        return "kvfabric payload: non-positive page byte costs"
+    for key in ("parity_nodelta", "parity_delta"):
+        if not isinstance(extra.get(key), bool):
+            return f"kvfabric payload: extra[{key!r}] missing or not a bool"
+    tp = extra.get("two_process")
+    if not isinstance(tp, dict):
+        return "kvfabric payload has no two_process leg"
+    for key in ("handoffs", "transfers", "pages_shipped",
+                "wire_bytes_shipped", "crc_naks", "fallbacks",
+                "lost_requests"):
+        if bad_num(tp.get(key)):
+            return f"kvfabric payload: two_process[{key!r}] missing or " \
+                   f"not finite (got {tp.get(key)!r})"
+    if not isinstance(tp.get("parity"), bool):
+        return "kvfabric payload: two_process['parity'] missing or " \
+               "not a bool"
     return None
 
 
@@ -1224,6 +1283,85 @@ def check_fleet_baseline(baseline_path=None):
             "pages_shipped": extra["pages_shipped"],
             "ttft_p99_s": extra["ttft_p99_s"],
             "single_ttft_p99_s": extra["single_ttft_p99_s"]}, errors
+
+
+#: KV-fabric acceptance for the checked-in --fleet --two-process baseline:
+#: a serialized int8 page (data row + fp32 scale) must cost at most this
+#: fraction of the fp32 device bytes it replaces — (hd+4)/(4*hd), so the
+#: 0.3 ceiling needs head_dim > 13 and holds 0.28125 at the bench's hd=32
+KVFABRIC_MAX_WIRE_FP32_RATIO = 0.3
+KVFABRIC_BASELINE_PATH = os.path.join(REPO_ROOT, "onchip_results",
+                                      "serving_kvfabric_baseline.json")
+
+
+def check_kvfabric_baseline(baseline_path=None):
+    """Validate the checked-in KV-fabric baseline: payload shape
+    (``validate_kvfabric_payload``), then the acceptance ratchet — wire
+    bytes per page <= ``KVFABRIC_MAX_WIRE_FP32_RATIO`` of fp32, the delta
+    leg shipped measurably fewer bytes than the no-delta leg (with at
+    least one page actually delta-skipped), zero CRC failures across the
+    in-process legs, every leg bit-exact against the monolithic reference,
+    and the two-process leg completed every request with zero losses.
+    Pure dict checks over recorded values (the wall-clock legs cannot be
+    re-derived jax-free). Returns (report, errors) for the dry-run
+    lane."""
+    path = baseline_path or KVFABRIC_BASELINE_PATH
+    if not os.path.exists(path):
+        return {"skipped": f"no kvfabric baseline at {path}"}, []
+    doc = load_doc(path)
+    if doc is None:
+        return {}, [f"unreadable kvfabric baseline {path}"]
+    err = validate_kvfabric_payload(doc)
+    if err:
+        return {}, [f"kvfabric baseline: {err}"]
+    extra = doc.get("extra", {}) if isinstance(doc, dict) else {}
+    if "wire_fp32_ratio" not in extra:
+        return {}, ["kvfabric baseline payload carries no fabric fields "
+                    "(regenerate with bench_serving --fleet --two-process)"]
+    errors = []
+    ratio = extra["wire_fp32_ratio"]
+    if ratio > KVFABRIC_MAX_WIRE_FP32_RATIO:
+        errors.append(
+            f"kvfabric baseline: wire/fp32 byte ratio {ratio} > "
+            f"{KVFABRIC_MAX_WIRE_FP32_RATIO} — the serialized page format "
+            f"no longer pays for itself over shipping raw fp32")
+    if extra["delta_wire_bytes"] >= extra["nodelta_wire_bytes"]:
+        errors.append(
+            f"kvfabric baseline: delta leg shipped "
+            f"{extra['delta_wire_bytes']} bytes >= no-delta leg "
+            f"{extra['nodelta_wire_bytes']} — delta-shipping saved nothing "
+            f"on the prefix-mix trace")
+    if extra["pages_delta_skipped"] <= 0 or extra["wire_bytes_saved"] <= 0:
+        errors.append("kvfabric baseline: no pages delta-skipped — the "
+                      "digest exchange never suppressed a transfer")
+    if extra["crc_failures"] != 0:
+        errors.append(f"kvfabric baseline: {extra['crc_failures']} CRC "
+                      f"failure(s) on an uninjected run — the wire is "
+                      f"corrupting pages")
+    if extra["failed_handoffs"] != 0:
+        errors.append(f"kvfabric baseline: {extra['failed_handoffs']} "
+                      f"failed handoff(s)")
+    if not (extra["parity_nodelta"] and extra["parity_delta"]):
+        errors.append("kvfabric baseline: an in-process wire leg lost "
+                      "greedy parity with the monolithic reference")
+    tp = extra["two_process"]
+    if tp["lost_requests"] != 0:
+        errors.append(f"kvfabric baseline: two-process leg lost "
+                      f"{tp['lost_requests']} request(s)")
+    if not tp["parity"]:
+        errors.append("kvfabric baseline: two-process leg lost greedy "
+                      "parity — the serialized boundary is not bit-exact")
+    if tp["handoffs"] <= 0:
+        errors.append("kvfabric baseline: two-process leg recorded no "
+                      "handoffs — the pipe transport never shipped a page")
+    return {"wire_fp32_ratio": ratio,
+            "nodelta_wire_bytes": extra["nodelta_wire_bytes"],
+            "delta_wire_bytes": extra["delta_wire_bytes"],
+            "wire_bytes_saved": extra["wire_bytes_saved"],
+            "pages_delta_skipped": extra["pages_delta_skipped"],
+            "crc_failures": extra["crc_failures"],
+            "two_process_lost": tp["lost_requests"],
+            "two_process_handoffs": tp["handoffs"]}, errors
 
 
 #: chaos-replay acceptance for the checked-in baseline: the recorded run
@@ -1869,6 +2007,9 @@ def main(argv=None):
         fleet_report, fleet_errors = check_fleet_baseline()
         for err in fleet_errors:
             print(f"perf_gate: fleet: {err}", file=sys.stderr)
+        kvfabric_report, kvfabric_errors = check_kvfabric_baseline()
+        for err in kvfabric_errors:
+            print(f"perf_gate: kvfabric: {err}", file=sys.stderr)
         chaos_report, chaos_errors = check_chaos_baseline()
         for err in chaos_errors:
             print(f"perf_gate: chaos: {err}", file=sys.stderr)
@@ -1898,7 +2039,8 @@ def main(argv=None):
             print(f"perf_gate: postmortem_classify: {err}", file=sys.stderr)
         errors = table_errors + qgz_errors + moe_wire_errors \
             + overlap_errors + sched_errors + moe_base_errors \
-            + prefix_errors + fleet_errors + chaos_errors \
+            + prefix_errors + fleet_errors + kvfabric_errors \
+            + chaos_errors \
             + longctx_errors + spec_errors + elastic_errors + lint_errors \
             + profile_errors + slo_errors + pm_errors + pm_cls_errors
         print(json.dumps({"dry_run": True,
@@ -1911,6 +2053,7 @@ def main(argv=None):
                           "moe_baseline": moe_base_report,
                           "prefix_cache": prefix_report,
                           "fleet": fleet_report,
+                          "kvfabric": kvfabric_report,
                           "chaos": chaos_report,
                           "longctx": longctx_report,
                           "speculate": spec_report,

@@ -58,7 +58,7 @@ def make_engine(cfg, model, params, prefix_caching=False, num_kv_blocks=64,
 def test_allocator_refcount_lifecycle_and_double_free():
     a = BlockedAllocator(8)
     b1, b2 = a.allocate(2)
-    assert a.counts() == {"free": 6, "live": 2, "cached": 0, "host": 0,
+    assert a.counts() == {"free": 6, "live": 2, "cached": 0, "host": 0, "nvme": 0,
                           "total": 8}
     a.ref([b1])
     assert a.refcount(b1) == 2
@@ -66,7 +66,7 @@ def test_allocator_refcount_lifecycle_and_double_free():
     assert a.refcount(b1) == 1
     assert a.counts()["live"] == 2
     a.free([b1])
-    assert a.counts() == {"free": 7, "live": 1, "cached": 0, "host": 0,
+    assert a.counts() == {"free": 7, "live": 1, "cached": 0, "host": 0, "nvme": 0,
                           "total": 8}
     with pytest.raises(ValueError, match="double free"):
         a.free([b1])
@@ -168,7 +168,7 @@ def test_prefix_cache_strict_prefix_match_and_lifecycle():
     a.free([blocks[2]])  # uncommitted tail: straight to the free list
     a.free([blocks[1]])
     a.free([blocks[0]])
-    assert a.counts() == {"free": 14, "live": 0, "cached": 2, "host": 0,
+    assert a.counts() == {"free": 14, "live": 0, "cached": 2, "host": 0, "nvme": 0,
                           "total": 16}
     assert c.evictable_blocks == 2
 
@@ -189,7 +189,7 @@ def test_prefix_cache_strict_prefix_match_and_lifecycle():
     # allocator-driven eviction under pool pressure: 15 free + 1 parked
     out = a.allocate(16)
     assert len(out) == 16 and c.evictions == 2
-    assert a.counts() == {"free": 0, "live": 16, "cached": 0, "host": 0,
+    assert a.counts() == {"free": 0, "live": 16, "cached": 0, "host": 0, "nvme": 0,
                           "total": 16}
     with pytest.raises(ValueError, match="only 0 free"):
         a.allocate(1)
@@ -207,7 +207,7 @@ def test_prefix_cache_insert_dedup_returns_canonical():
     assert d2 == d and canon2 == b_first
     assert a.refcount(b_first) == 2  # dedup took a reference for the caller
     a.free([b_dup])  # caller drops its private copy
-    assert a.counts() == {"free": 7, "live": 1, "cached": 0, "host": 0,
+    assert a.counts() == {"free": 7, "live": 1, "cached": 0, "host": 0, "nvme": 0,
                           "total": 8}
 
 
@@ -232,6 +232,34 @@ class _StubSpiller:
         self.restore_calls += 1
 
 
+class _StubNVMeStore:
+    """NVMe store stand-in (``runtime/swap_tensor/nvme_kv_store.py``
+    surface: write/read/drop) — records live keys so the property test can
+    assert the store's census matches the allocator's nvme tier exactly."""
+
+    def __init__(self):
+        self._next = 0
+        self.payloads = {}
+        self.writes = 0
+
+    @property
+    def live(self):
+        return set(self.payloads)
+
+    def write(self, arrays):
+        key = self._next
+        self._next += 1
+        self.payloads[key] = arrays
+        self.writes += 1
+        return key
+
+    def read(self, key):
+        return self.payloads[key]
+
+    def drop(self, key):
+        del self.payloads[key]
+
+
 def test_random_share_flush_evict_spill_preserve_invariants():
     """Random allocate/share/flush/evict/spill/restore PLUS speculative
     advance/rollback through the PrefixCache over a host-capable allocator,
@@ -244,11 +272,13 @@ def test_random_share_flush_evict_spill_preserve_invariants():
     another chain holds, and the cache's evictable/host counts equal the
     allocator's."""
     rng = np.random.default_rng(42)
-    total, bs, host_cap = 24, 4, 6
+    total, bs, host_cap, nvme_cap = 24, 4, 6, 4
     a = BlockedAllocator(total, host_capacity=host_cap)
     c = PrefixCache(a, bs)
     sp = _StubSpiller()
     c.bind_spiller(sp)
+    store = _StubNVMeStore()
+    a.bind_nvme(store, nvme_cap)
     live = {}   # uid -> committed chain blocks (shareable through the cache)
     tails = {}  # uid -> private speculative tail blocks (refcount-1 only)
     streams = []
@@ -265,19 +295,29 @@ def test_random_share_flush_evict_spill_preserve_invariants():
         cnt = a.counts()
         assert cnt["free"] + cnt["live"] + cnt["cached"] == total
         assert cnt["free"] + cnt["live"] + cnt["cached"] + cnt["host"] \
-            == cnt["total"] == total + cnt["host"]
-        assert cnt["host"] <= host_cap
+            + cnt["nvme"] == cnt["total"] == total + cnt["host"] \
+            + cnt["nvme"]
+        assert cnt["host"] <= host_cap and cnt["nvme"] <= nvme_cap
         assert min(cnt.values()) >= 0
         hs = a.host_swap_stats()
-        assert hs["spilled"] == hs["restored"] + hs["dropped"] + hs["resident"]
+        # the fifth-state identity: a spilled record is consumed, dropped,
+        # or still parked in ONE of the two off-device tiers
+        assert hs["spilled"] == hs["restored"] + hs["dropped"] \
+            + hs["resident"] + hs["nvme_resident"]
         assert hs["spilled"] == sp.spill_calls
         assert hs["restored"] == sp.restore_calls == c.restores
+        # the stub store's live keys ARE the allocator's nvme census (every
+        # restore/drop of a demoted record must drop its store key)
+        assert hs["nvme_resident"] == len(store.live) == cnt["nvme"]
+        assert store.writes == hs["nvme_demotions"]
         free_list = list(a._free)
         assert len(free_list) == len(set(free_list)), "free-list duplicate"
         assert all(a.refcount(b) == 0 for b in free_list)
         assert all(a.refcount(b) >= 0 for b in range(total))
         assert c.evictable_blocks == cnt["cached"]
-        assert c.host_cached_blocks == cnt["host"]
+        # the prefix cache sees one off-device tier; demotion host -> nvme
+        # is invisible to it (the spill handle stays valid)
+        assert c.host_cached_blocks == cnt["host"] + cnt["nvme"]
         assert a.stats()["free"] == cnt["free"]
         spec_tail = [b for t in tails.values() for b in t]
         assert len(spec_tail) == len(set(spec_tail))
@@ -357,6 +397,8 @@ def test_random_share_flush_evict_spill_preserve_invariants():
 
     assert sp.spill_calls > 0, "400 steps must exercise the spill tier"
     assert sp.restore_calls > 0, "reused streams must restore host blocks"
+    assert a.host_swap_stats()["nvme_demotions"] > 0, \
+        "400 steps must push the host tier over capacity into NVMe"
     assert advances > 10 and rollbacks > 10, \
         "400 steps must exercise speculative advance AND rollback"
     for uid in list(live):
@@ -366,7 +408,7 @@ def test_random_share_flush_evict_spill_preserve_invariants():
     c.evict(c.evictable_blocks)
     cnt = a.counts()
     assert cnt["free"] == total and cnt["live"] == 0 and cnt["cached"] == 0
-    assert cnt["host"] == c.host_cached_blocks
+    assert cnt["host"] + cnt["nvme"] == c.host_cached_blocks
     check()
 
 
@@ -394,7 +436,8 @@ def test_host_tier_spill_restore_guards_and_no_resurrection():
         a.drop_host(ref)
     hs = a.host_swap_stats()
     assert hs == {"spilled": 1, "restored": 1, "dropped": 0, "resident": 0,
-                  "capacity": 1}
+                  "capacity": 1, "nvme_resident": 0, "nvme_capacity": 0,
+                  "nvme_demotions": 0}
 
 
 def test_prefix_cache_spills_lru_first_and_restores_on_match():
@@ -459,7 +502,7 @@ def test_acquire_chain_pins_links_before_reentrant_restore_eviction():
     assert a.refcount(b1) == 1 and a.refcount(resolved[0]) == 1
     assert a.refcount(x) == 1
     assert c.hits == 1 and c.misses == 0
-    assert a.counts() == {"free": 0, "live": 3, "cached": 0, "host": 1,
+    assert a.counts() == {"free": 0, "live": 3, "cached": 0, "host": 1, "nvme": 0,
                           "total": 4}
 
 
@@ -488,7 +531,7 @@ def test_acquire_chain_failed_restore_unpins_and_counts_miss():
     # handle), b1 re-parked, and the unrelated live block was untouched
     assert c.host_cached_blocks == 1 and sp.restore_calls == 0
     assert c.evictable_blocks == 1 and a.refcount(x) == 1
-    assert a.counts() == {"free": 0, "live": 1, "cached": 1, "host": 1,
+    assert a.counts() == {"free": 0, "live": 1, "cached": 1, "host": 1, "nvme": 0,
                           "total": 3}
 
 
